@@ -1,0 +1,82 @@
+"""paddle_tpu — a TPU-native deep-learning framework with Paddle's capabilities.
+
+Built from scratch on JAX/XLA/Pallas/pjit per SURVEY.md §7: the Paddle-shaped
+API + semantics layers live here; XLA is the kernel library, fusion compiler,
+executor, and communication backend; Pallas provides the hot TPU kernels.
+
+Usage mirrors the reference:
+
+    import paddle_tpu as paddle
+    x = paddle.to_tensor([[1., 2.], [3., 4.]], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# int64/float64 are first-class in Paddle (default int dtype is int64);
+# enable x64 before anything traces. TPU work uses bf16/f32 regardless.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (  # noqa: F401
+    bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    set_default_dtype, get_default_dtype, finfo, iinfo,
+)
+from .core.device import (  # noqa: F401
+    CPUPlace, TPUPlace, CUDAPlace, GPUPlace, XPUPlace, Place,
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+)
+from .core import device  # noqa: F401
+from .core.flags import set_flags, get_flags  # noqa: F401
+from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+
+from . import autograd  # noqa: F401
+from .autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: F401
+from .autograd.pylayer import PyLayer  # noqa: F401
+
+from .ops import *  # noqa: F401,F403
+from . import ops  # noqa: F401
+from .ops.linalg import fft  # noqa: F401
+
+
+def is_grad_enabled():
+    return autograd.is_grad_enabled()
+
+
+def disable_static(*a, **k):
+    return None  # eager is the only mode; kept for script parity
+
+
+def enable_static(*a, **k):
+    raise NotImplementedError(
+        "paddle_tpu is eager+jit only; use paddle_tpu.jit.to_static "
+        "(see SURVEY.md §7 'What we deliberately do NOT rebuild')")
+
+
+def in_dynamic_mode():
+    return True
+
+
+# linalg namespace (paddle.linalg.*)
+import types as _types
+
+linalg = _types.SimpleNamespace()
+from .ops import linalg as _linalg_mod  # noqa: E402
+for _n in ("cholesky", "cholesky_solve", "inverse", "pinv", "solve",
+           "triangular_solve", "lu", "qr", "svd", "svdvals", "eig", "eigh",
+           "eigvals", "eigvalsh", "matrix_power", "matrix_rank", "det",
+           "slogdet", "cond", "lstsq", "householder_product", "corrcoef",
+           "cov", "matrix_exp", "multi_dot"):
+    setattr(linalg, _n, getattr(_linalg_mod, _n))
+from .ops.reduction import norm as _norm  # noqa: E402
+from .ops.math import matmul as _matmul  # noqa: E402
+linalg.norm = _norm
+linalg.matmul = _matmul
+linalg.inv = linalg.inverse
+del _types, _n

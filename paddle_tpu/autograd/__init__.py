@@ -1,0 +1,13 @@
+"""paddle_tpu.autograd — eager tape + functional transforms.
+
+Reference parity: python/paddle/autograd/ (upstream-canonical, unverified —
+SURVEY.md §0)."""
+from .tape import (  # noqa: F401
+    backward, grad, no_grad, enable_grad, set_grad_enabled, grad_enabled,
+    GradNode,
+)
+from .pylayer import PyLayer, PyLayerContext  # noqa: F401
+
+
+def is_grad_enabled() -> bool:
+    return grad_enabled()

@@ -1,0 +1,99 @@
+"""PyLayer — user-defined autograd functions.
+
+Reference parity: python/paddle/autograd/py_layer.py (PyLayer with static
+forward/backward + ctx.save_for_backward). Upstream-canonical, unverified
+(SURVEY.md §0).
+
+TPU-native note: for the functional/jit path, prefer jax.custom_vjp directly;
+this class exists for eager-tape parity and is implemented as a hand-built
+GradNode whose vjp calls the user's backward.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .tape import GradNode, grad_enabled
+
+_float0 = jax.dtypes.float0
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.__dict__["_attrs"] = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = property(lambda self: self._saved)
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        self._non_diff = set(id(a) for a in args)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs = grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+        if not needs:
+            return outs
+
+        def vjp_fn(cotangents):
+            cots = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            # non-float output slots arrive as float0 zeros — pass None
+            # (paddle's PyLayer passes no grad for non-differentiable outputs)
+            gts = [None if (isinstance(c, np.ndarray) and c.dtype == _float0)
+                   else Tensor(c, stop_gradient=True) for c in cots]
+            gin = cls.backward(ctx, *gts) if len(gts) > 1 else cls.backward(ctx, gts[0])
+            if not isinstance(gin, (tuple, list)):
+                gin = (gin,)
+            return tuple(None if g is None else (g._data if isinstance(g, Tensor) else jnp.asarray(g))
+                         for g in gin)
+
+        node = GradNode(
+            vjp_fn,
+            tensor_inputs,
+            [(tuple(o._data.shape), np.dtype(o._data.dtype)) for o in out_list],
+            multi_out=True,
+            name=cls.__name__,
+        )
+        for j, o in enumerate(out_list):
+            if np.dtype(o._data.dtype).kind in "fc":
+                o.stop_gradient = False
+                o._grad_node = node
+                o._out_index = j
+        return outs
+
+
+class LegacyPyLayer(PyLayer):
+    pass

@@ -1,0 +1,270 @@
+"""Define-by-run autograd tape over jax.vjp.
+
+Reference parity: paddle/fluid/eager/ — GradNodeBase, AutogradMeta,
+GradTensorHolder, egr::Backward (backward.cc). Upstream-canonical paths,
+unverified (SURVEY.md §0).
+
+TPU-native design (SURVEY.md §7 "hard parts" #1): the reference's C++ tape
+records per-op GradNodes and walks them in reverse topological order. Here each
+eager op calls `jax.vjp` at record time; the returned vjp closure IS the grad
+node's operator(). `backward()` walks nodes in reverse sequence order,
+accumulating cotangents per (node, output-slot) — functionally identical to
+GradTensorHolder accumulation. Everything heavy still runs under jax.jit in the
+functional training path (paddle_tpu.jit), where this tape is bypassed
+entirely; the tape exists to present eager `loss.backward()` semantics.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "grad_enabled"):
+        _state.grad_enabled = True
+        _state.seq = 0
+    return _state
+
+
+def grad_enabled() -> bool:
+    return _st().grad_enabled
+
+
+@contextlib.contextmanager
+def no_grad():
+    st = _st()
+    prev, st.grad_enabled = st.grad_enabled, False
+    try:
+        yield
+    finally:
+        st.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    st = _st()
+    prev, st.grad_enabled = st.grad_enabled, True
+    try:
+        yield
+    finally:
+        st.grad_enabled = prev
+
+
+class set_grad_enabled:
+    """Applies immediately on construction (paddle/torch semantics: the plain
+    call `set_grad_enabled(False)` flips the mode); also usable as a context
+    manager that restores the previous mode on exit."""
+
+    def __init__(self, mode):
+        st = _st()
+        self._prev = st.grad_enabled
+        st.grad_enabled = bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _st().grad_enabled = self._prev
+        return False
+
+
+def _next_seq() -> int:
+    st = _st()
+    st.seq += 1
+    return st.seq
+
+
+class GradNode:
+    """One recorded differentiable op. vjp_fn maps output cotangents to input
+    cotangents (w.r.t. the differentiable inputs only, in order)."""
+
+    __slots__ = (
+        "vjp_fn", "inputs", "n_outputs", "out_avals", "multi_out", "seq",
+        "name", "__weakref__",
+    )
+
+    def __init__(self, vjp_fn, inputs: Sequence["Any"], out_avals, multi_out: bool, name: str):
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)  # Tensor refs (differentiable inputs)
+        self.out_avals = out_avals  # [(shape, dtype)] per output
+        self.n_outputs = len(out_avals)
+        self.multi_out = multi_out
+        self.seq = _next_seq()
+        self.name = name
+
+    def __repr__(self):
+        return f"<GradNode {self.name} seq={self.seq}>"
+
+
+def _zero_cotangent(shape, dtype):
+    d = np.dtype(dtype)
+    if d.kind in "iub":
+        return np.zeros(shape, dtype=jax.dtypes.float0)
+    return jnp.zeros(shape, dtype=d)
+
+
+def _accumulate(a, b):
+    return b if a is None else a + b
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False,
+             _grad_filter=None) -> None:
+    """paddle.autograd.backward — reverse-topo traversal with accumulation.
+
+    Leaf tensors (is_leaf, stop_gradient=False) receive `.grad`; non-leaf
+    tensors receive `.grad` only if `retain_grads()` was called (paddle
+    semantics). Tensor hooks (register_hook) run on the grad flowing into each
+    tensor. `_grad_filter` (internal, used by `grad()`): a set of tensor ids —
+    when given, only those tensors' `.grad` is written, so `paddle.grad`
+    doesn't pollute unrelated leaves.
+    """
+    from ..core.tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # Seed cotangents per (node, out_index); leaves seeded directly.
+    pending: Dict[int, List[Optional[jax.Array]]] = {}
+    nodes: Dict[int, GradNode] = {}
+
+    def _seed(t: Tensor, g):
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    "pass grad_tensors for non-scalar backward()")
+            g = jnp.ones_like(t._data)
+        else:
+            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._grad_node
+        if node is None:
+            _write_grad(t, g)
+            return
+        nid = id(node)
+        nodes[nid] = node
+        slots = pending.setdefault(nid, [None] * node.n_outputs)
+        slots[t._out_index] = _accumulate(slots[t._out_index], g)
+
+    def _apply_hooks(t: Tensor, g):
+        for hook in t._hooks:
+            out = hook(Tensor(g, stop_gradient=True))
+            if out is not None:
+                g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+        return g
+
+    def _write_grad(t: Tensor, g):
+        g = _apply_hooks(t, g)
+        if t.stop_gradient:
+            return
+        if _grad_filter is not None and id(t) not in _grad_filter:
+            return
+        if t.grad is None:
+            t.grad = Tensor(g, stop_gradient=True)
+        else:
+            t.grad = Tensor(t.grad._data + g, stop_gradient=True)
+
+    for t, g in zip(tensors, grad_tensors):
+        _seed(t, g)
+
+    # Discover reachable nodes (for correct ordering we rely on seq numbers:
+    # a node's inputs were produced by lower-seq nodes).
+    stack = list(nodes.values())
+    seen = set(nodes.keys())
+    while stack:
+        n = stack.pop()
+        for t in n.inputs:
+            pn = getattr(t, "_grad_node", None)
+            if pn is not None and id(pn) not in seen:
+                seen.add(id(pn))
+                nodes[id(pn)] = pn
+                stack.append(pn)
+
+    order = sorted(nodes.values(), key=lambda n: n.seq, reverse=True)
+
+    for node in order:
+        slots = pending.get(id(node))
+        if slots is None or all(s is None for s in slots):
+            continue  # node not on the path from the seeded outputs
+        cotangents = tuple(
+            s if s is not None else _zero_cotangent(*aval)
+            for s, aval in zip(slots, node.out_avals)
+        )
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"trying to backward through {node.name} a second time; "
+                "set retain_graph=True if you need to")
+        in_grads = node.vjp_fn(cotangents if node.multi_out else cotangents[0])
+        if not isinstance(in_grads, tuple):
+            in_grads = (in_grads,)
+        for t, g in zip(node.inputs, in_grads):
+            if t is None or g is None:
+                continue
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            g = _apply_hooks(t, g)
+            if t.stop_gradient:
+                continue
+            pn = t._grad_node
+            if (pn is None or t._retain_grads) and (
+                    _grad_filter is None or id(t) in _grad_filter):
+                if t.grad is None:
+                    t.grad = Tensor(g, stop_gradient=True)
+                else:
+                    t.grad = Tensor(t.grad._data + g, stop_gradient=True)
+            if pn is not None:
+                nid = id(pn)
+                pslots = pending.setdefault(nid, [None] * pn.n_outputs)
+                pslots[t._out_index] = _accumulate(pslots[t._out_index], g)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.inputs = []
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad — functional gradient w.r.t. given inputs.
+
+    create_graph=True (double grad) is served by the functional API
+    (paddle_tpu.incubate.autograd / jax.grad composition), not the eager tape.
+    """
+    from ..core.tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True on the eager tape is not supported; use "
+            "paddle_tpu.jit.grad (jax.grad composition) for higher-order "
+            "derivatives (see paddle_tpu/autograd/tape.py)")
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    # Stash and restore .grad so paddle.grad doesn't clobber accumulated grads;
+    # _grad_filter keeps backward() from writing .grad on any other leaf.
+    saved = [t.grad for t in inputs]
+    saved_retain = [t._retain_grads for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t._retain_grads = True
+    try:
+        backward(outputs, grad_outputs, retain_graph=bool(retain_graph),
+                 _grad_filter={id(t) for t in inputs})
+        out = []
+        for t in inputs:
+            if t.grad is None and not allow_unused:
+                raise RuntimeError(
+                    f"one of the input tensors was not used in the graph "
+                    f"(shape={t.shape}); pass allow_unused=True to get None")
+            out.append(t.grad)
+        return out
+    finally:
+        for t, g, r in zip(inputs, saved, saved_retain):
+            t.grad = g
+            t._retain_grads = r

@@ -1,0 +1,2 @@
+from . import dtype, device, flags, random  # noqa: F401
+from .tensor import Tensor, Parameter, to_tensor  # noqa: F401

@@ -1,0 +1,150 @@
+"""Device / Place abstraction over jax devices.
+
+Reference parity: paddle/common/place.h (phi::Place, CPUPlace/GPUPlace/...) and
+python/paddle/device/__init__.py (set_device/get_device). Upstream-canonical
+paths, unverified (SURVEY.md §0).
+
+TPU-first design: a Place is a thin named handle onto a `jax.Device`. The
+paddle device strings ("cpu", "gpu:0", ...) map onto jax platforms; "tpu" is
+the first-class accelerator, and "gpu"/"cuda" aliases resolve to whatever
+accelerator backend jax exposes so that reference scripts run with only a
+device-string change (BASELINE.json north_star).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+_ACCEL_ALIASES = ("tpu", "axon", "gpu", "cuda")
+
+
+@functools.lru_cache(maxsize=None)
+def _platforms() -> dict:
+    out = {}
+    for d in jax.devices():
+        out.setdefault(d.platform, []).append(d)
+    # CPU devices are always constructible even when an accelerator is default.
+    if "cpu" not in out:
+        try:
+            out["cpu"] = jax.devices("cpu")
+        except RuntimeError:
+            pass
+    return out
+
+
+def _accelerator_platform() -> Optional[str]:
+    plats = _platforms()
+    for p in plats:
+        if p != "cpu":
+            return p
+    return None
+
+
+class Place:
+    """A device handle. Compares by (platform, index) like phi::Place."""
+
+    __slots__ = ("_device",)
+
+    def __init__(self, device: jax.Device):
+        self._device = device
+
+    @property
+    def jax_device(self) -> jax.Device:
+        return self._device
+
+    @property
+    def platform(self) -> str:
+        return self._device.platform
+
+    @property
+    def index(self) -> int:
+        return self._device.id
+
+    def is_cpu_place(self) -> bool:
+        return self.platform == "cpu"
+
+    def is_gpu_place(self) -> bool:  # paddle API name; true for any accelerator
+        return self.platform != "cpu"
+
+    is_tpu_place = is_gpu_place
+    is_accelerator_place = is_gpu_place
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and self._device == other._device
+
+    def __hash__(self):
+        return hash(self._device)
+
+    def __repr__(self):
+        return f"Place({self.platform}:{self.index})"
+
+
+def CPUPlace(idx: int = 0) -> Place:
+    return Place(_platforms()["cpu"][idx])
+
+
+def TPUPlace(idx: int = 0) -> Place:
+    plat = _accelerator_platform()
+    if plat is None:
+        raise RuntimeError("no TPU/accelerator devices visible to jax")
+    return Place(_platforms()[plat][idx])
+
+
+# Reference scripts say CUDAPlace/GPUPlace; on this framework they resolve to
+# the accelerator backend (TPU) when present, else CPU.
+def CUDAPlace(idx: int = 0) -> Place:
+    try:
+        return TPUPlace(idx)
+    except RuntimeError:
+        return CPUPlace(idx)
+
+
+GPUPlace = CUDAPlace
+XPUPlace = TPUPlace
+
+_current_place: Optional[Place] = None
+
+
+def set_device(device) -> Place:
+    """paddle.device.set_device — accepts 'cpu', 'tpu', 'tpu:1', 'gpu:0', ..."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return device
+    name, _, idx = str(device).partition(":")
+    idx = int(idx) if idx else 0
+    name = name.lower()
+    if name == "cpu":
+        _current_place = CPUPlace(idx)
+    elif name in _ACCEL_ALIASES:
+        _current_place = TPUPlace(idx)
+    else:
+        raise ValueError(f"unknown device {device!r}")
+    return _current_place
+
+
+def get_device() -> str:
+    p = _default_place()
+    return f"{p.platform}:{p.index}" if not p.is_cpu_place() else "cpu"
+
+
+def _default_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = Place(jax.devices()[0])
+    return _current_place
+
+
+def is_compiled_with_cuda() -> bool:
+    return False  # CUDA-free build by design (BASELINE.json north_star)
+
+
+def is_compiled_with_tpu() -> bool:
+    return _accelerator_platform() is not None
+
+
+def device_count() -> int:
+    plat = _accelerator_platform()
+    return len(_platforms()[plat]) if plat else len(jax.devices())

@@ -1,0 +1,106 @@
+"""Dtype system: Paddle-shaped dtype names over jnp dtypes.
+
+Reference parity: paddle/phi/common/data_type.h + python/paddle/framework/dtype.py
+(upstream-canonical paths; see SURVEY.md §0 — reference mount was empty, paths
+unverified). Paddle exposes dtypes as `paddle.float32` etc. and follows mostly
+numpy-style promotion; we delegate promotion to jnp (with x64 enabled so int64
+and float64 are first-class, matching Paddle's defaults of int64/float32).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects are numpy dtypes (jnp uses numpy dtypes natively).
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_ALIASES = {
+    "bool": bool_, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16, "bfloat16": bfloat16,
+    "float32": float32, "float64": float64, "complex64": complex64,
+    "complex128": complex128, "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    # paddle VarType-style spellings
+    "FP16": float16, "FP32": float32, "FP64": float64, "BF16": bfloat16,
+    "INT8": int8, "INT16": int16, "INT32": int32, "INT64": int64,
+    "BOOL": bool_, "UINT8": uint8,
+    "half": float16, "float": float32, "double": float64, "int": int32,
+    "long": int64,
+}
+
+FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+INTEGER = {uint8, int8, int16, int32, int64}
+COMPLEX = {complex64, complex128}
+
+# Default dtypes (Paddle: float32 for python floats, int64 for python ints).
+_default_float = float32
+
+
+def set_default_dtype(d) -> None:
+    global _default_float
+    d = convert_dtype(d)
+    if d not in FLOATING:
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_float = d
+
+
+def get_default_dtype():
+    return _default_float
+
+
+def convert_dtype(d) -> np.dtype:
+    """Normalize any dtype spec (str, np.dtype, jnp type, Tensor dtype) to np.dtype."""
+    if d is None:
+        return _default_float
+    if isinstance(d, str):
+        name = d
+        if name.startswith("paddle."):
+            name = name.split(".", 1)[1]
+        if name in _ALIASES:
+            return _ALIASES[name]
+        return np.dtype(name)
+    if isinstance(d, np.dtype):
+        return d
+    try:
+        return np.dtype(d)
+    except TypeError:
+        # jnp scalar types like jnp.float32
+        return np.dtype(getattr(d, "dtype", d))
+
+
+def is_floating_point(d) -> bool:
+    return convert_dtype(d) in FLOATING
+
+
+def is_integer(d) -> bool:
+    return convert_dtype(d) in INTEGER
+
+
+def is_complex(d) -> bool:
+    return convert_dtype(d) in COMPLEX
+
+
+def promote_types(a, b) -> np.dtype:
+    return np.dtype(jnp.promote_types(convert_dtype(a), convert_dtype(b)))
+
+
+def finfo(d):
+    return ml_dtypes.finfo(convert_dtype(d))
+
+
+def iinfo(d):
+    return np.iinfo(convert_dtype(d))

@@ -1,0 +1,60 @@
+"""Global flag registry — paddle.set_flags/get_flags shim.
+
+Reference parity: paddle/common/flags.h (PHI_DEFINE_EXPORTED_* gflags clone,
+~600 FLAGS_*) + python paddle.set_flags. Upstream-canonical, unverified
+(SURVEY.md §0). We keep a small typed registry; XLA flags pass through via the
+XLA_FLAGS env var at process start (documented, not settable mid-run).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default: Any, help_: str = "") -> None:
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    env = os.environ.get(name)
+    if env is not None:
+        if isinstance(default, bool):
+            default = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            default = int(env)
+        elif isinstance(default, float):
+            default = float(env)
+        else:
+            default = env
+    _REGISTRY[name] = default
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    for k, v in flags.items():
+        if not k.startswith("FLAGS_"):
+            k = "FLAGS_" + k
+        _REGISTRY[k] = v
+
+
+def get_flags(keys) -> Dict[str, Any]:
+    if isinstance(keys, str):
+        keys = [keys]
+    out = {}
+    for k in keys:
+        kk = k if k.startswith("FLAGS_") else "FLAGS_" + k
+        out[k] = _REGISTRY.get(kk)
+    return out
+
+
+def flag(name: str) -> Any:
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    return _REGISTRY.get(name)
+
+
+# Core flags (parity with the reference's most-used ones)
+define_flag("FLAGS_check_nan_inf", False, "raise on nan/inf in op outputs (debug)")
+define_flag("FLAGS_use_pallas", True, "use Pallas TPU kernels for hot ops when available")
+define_flag("FLAGS_eager_jit_ops", False, "jit-compile each eager op (dispatch caching)")
+define_flag("FLAGS_allocator_strategy", "xla", "allocator is owned by XLA/PJRT on TPU")
+define_flag("FLAGS_cudnn_deterministic", False, "determinism toggle (XLA flag passthrough)")

@@ -1,0 +1,119 @@
+"""Stateful RNG facade over jax.random.
+
+Reference parity: paddle/phi/core/generator.h (per-device Generator with
+seed/offset) and python paddle.seed / get_rng_state. Upstream-canonical,
+unverified (SURVEY.md §0).
+
+Design: one global stateful Generator holding a jax PRNG key; every random op
+splits it. For TP determinism the reference keeps RNGStatesTracker with
+model-parallel seeds (fleet/layers/mpu/random.py); we mirror that with named
+generators derived via fold_in — the TPU-native analog of per-mesh-axis seeds.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+
+    def manual_seed(self, seed: int) -> "Generator":
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        return self
+
+    def split(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return jax.random.key_data(self._key)
+
+    def set_state(self, state):
+        self._key = jax.random.wrap_key_data(np.asarray(state))
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+
+_default_generator = Generator(0)
+_named: Dict[str, Generator] = {}
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed — reseed the global generator (and named trackers)."""
+    import zlib
+
+    _default_generator.manual_seed(s)
+    for name, g in _named.items():
+        # stable per-name offset (python hash() is randomized per process)
+        g.manual_seed(s ^ zlib.crc32(name.encode()))
+    return _default_generator
+
+
+def next_key() -> jax.Array:
+    return _default_generator.split()
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)
+
+
+class RNGStatesTracker:
+    """fleet.meta_parallel.get_rng_state_tracker parity: named RNG streams so
+    TP-replicated regions (dropout on replicated activations) share randomness
+    while TP-sharded regions differ. TPU-native: fold_in the mesh-axis index."""
+
+    def __init__(self):
+        self._gens: Dict[str, Generator] = {}
+
+    def add(self, name: str, seed_: int) -> None:
+        if name in self._gens:
+            raise ValueError(f"rng state {name} already exists")
+        g = Generator(seed_)
+        self._gens[name] = g
+        _named[name] = g
+
+    def get_states_tracker(self):
+        return {k: g.get_state() for k, g in self._gens.items()}
+
+    def rng_state(self, name: str = "global_seed"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            global _default_generator
+            if name not in self._gens:
+                self.add(name, _default_generator.initial_seed)
+            prev = _default_generator
+            _default_generator = self._gens[name]
+            try:
+                yield
+            finally:
+                _default_generator = prev
+
+        return _ctx()
+
+
+_tracker: Optional[RNGStatesTracker] = None
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    global _tracker
+    if _tracker is None:
+        _tracker = RNGStatesTracker()
+    return _tracker

@@ -1,0 +1,162 @@
+"""paddle_tpu.ops — the functional op surface.
+
+Single source of truth for op definitions: every op registered via
+ops/_registry.py is (a) exported here, (b) attached as a Tensor method, and
+(c) given an in-place `<name>_` variant where paddle has one. The reference
+generates the same three surfaces from ops.yaml (SURVEY.md §2.1 "Op definition
+YAML + codegen", paddle/phi/ops/yaml/ — upstream-canonical, unverified)."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+from ._registry import REGISTRY, defop, op, eager, as_array  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .comparison import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+
+from . import creation, math, reduction, manipulation, comparison, linalg  # noqa: F401
+
+# names that are python builtins shadowed above (keep references)
+import builtins as _bt
+
+# ---------------------------------------------------------------------------
+# Tensor method attachment ("codegen" step)
+# ---------------------------------------------------------------------------
+
+_METHOD_SOURCES = [creation, math, reduction, manipulation, comparison, linalg]
+
+# ops that should NOT become Tensor methods (first arg isn't a tensor / special)
+_NON_METHODS = {
+    "zeros", "ones", "full", "empty", "arange", "linspace", "logspace", "eye",
+    "meshgrid", "rand", "randn", "randint", "randperm", "uniform", "normal",
+    "one_hot", "scatter_nd", "broadcast_tensors", "broadcast_shape",
+    "multi_dot", "einsum", "is_tensor", "fft",
+    # machinery, not ops
+    "to_tensor", "as_array", "defop", "eager", "op", "getitem", "setitem_",
+    "bernoulli", "multinomial", "randint_like", "randn_like", "rand_like",
+}
+
+# paddle method aliases
+_ALIASES = {
+    "sub": "subtract", "mul": "multiply", "div": "divide", "remainder": "mod",
+    "rsub": None,
+}
+
+# ops with in-place variants in paddle
+_INPLACE = [
+    "add", "subtract", "multiply", "divide", "clip", "scale", "exp", "sqrt",
+    "rsqrt", "floor", "ceil", "round", "reciprocal", "abs", "sin", "cos",
+    "tanh", "sigmoid", "relu", "flatten", "reshape", "squeeze", "unsqueeze",
+    "pow", "mod", "floor_divide", "neg", "log", "lerp", "erfinv",
+    "masked_fill", "index_put", "index_add", "put_along_axis",
+    "cast", "transpose",
+]
+
+
+from ._registry import adopt_inplace as _adopt
+
+
+def _attach():
+    import types
+
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            fn = getattr(mod, name)
+            if name.startswith("_") or not callable(fn):
+                continue
+            if isinstance(fn, type):
+                continue
+            if name in _NON_METHODS:
+                continue
+            if getattr(fn, "__module__", "").startswith("paddle_tpu") or name in REGISTRY:
+                if not hasattr(Tensor, name):
+                    setattr(Tensor, name, fn)
+
+    for alias, target in _ALIASES.items():
+        if target and hasattr(Tensor, target):
+            setattr(Tensor, alias, getattr(Tensor, target))
+
+    # in-place variants
+    g = globals()
+    for name in _INPLACE:
+        fn = g.get(name) or REGISTRY.get(name)
+        if fn is None:
+            continue
+
+        def make_inplace(f):
+            def inplace(self, *args, **kwargs):
+                return _adopt(self, f(self, *args, **kwargs))
+            return inplace
+
+        ip = make_inplace(fn)
+        ip.__name__ = name + "_"
+        g[name + "_"] = ip
+        setattr(Tensor, name + "_", ip)
+
+    # zero_/fill_ already defined on Tensor (core/tensor.py)
+
+    # ---- dunders ----------------------------------------------------------
+    import operator as _op
+
+    def _swap(f):
+        def r(self, other):
+            from ..core.tensor import to_tensor
+            return f(to_tensor(other), self)
+        return r
+
+    Tensor.__add__ = lambda s, o: add(s, o)
+    Tensor.__radd__ = lambda s, o: add(s, o)
+    Tensor.__sub__ = lambda s, o: subtract(s, o)
+    Tensor.__rsub__ = _swap(subtract)
+    Tensor.__mul__ = lambda s, o: multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: multiply(s, o)
+    Tensor.__truediv__ = lambda s, o: divide(s, o)
+    Tensor.__rtruediv__ = _swap(divide)
+    Tensor.__floordiv__ = lambda s, o: floor_divide(s, o)
+    Tensor.__rfloordiv__ = _swap(floor_divide)
+    Tensor.__mod__ = lambda s, o: mod(s, o)
+    Tensor.__rmod__ = _swap(mod)
+    Tensor.__pow__ = lambda s, o: globals()["pow"](s, o)
+    Tensor.__rpow__ = _swap(globals()["pow"])
+    Tensor.__matmul__ = lambda s, o: matmul(s, o)
+    Tensor.__rmatmul__ = _swap(matmul)
+    Tensor.__neg__ = lambda s: neg(s)
+    Tensor.__abs__ = lambda s: globals()["abs"](s)
+    Tensor.__invert__ = lambda s: logical_not(s) if s.dtype.kind == "b" else bitwise_not(s)
+    Tensor.__and__ = lambda s, o: logical_and(s, o) if s.dtype.kind == "b" else bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: logical_or(s, o) if s.dtype.kind == "b" else bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: logical_xor(s, o) if s.dtype.kind == "b" else bitwise_xor(s, o)
+    Tensor.__eq__ = lambda s, o: equal(s, o)
+    Tensor.__ne__ = lambda s, o: not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: less_than(s, o)
+    Tensor.__le__ = lambda s, o: less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: greater_equal(s, o)
+    Tensor.__hash__ = lambda s: id(s)
+
+    # method-only names
+    Tensor.dim = lambda s: s.ndim
+    Tensor.mod = lambda s, o, name=None: mod(s, o)
+    Tensor.pow = lambda s, o, name=None: globals()["pow"](s, o)
+    Tensor.abs = lambda s, name=None: globals()["abs"](s)
+    Tensor.all = lambda s, axis=None, keepdim=False, name=None: globals()["all"](s, axis, keepdim)
+    Tensor.any = lambda s, axis=None, keepdim=False, name=None: globals()["any"](s, axis, keepdim)
+    Tensor.sum = lambda s, axis=None, dtype=None, keepdim=False, name=None: globals()["sum"](s, axis, dtype, keepdim)
+    Tensor.max = lambda s, axis=None, keepdim=False, name=None: globals()["max"](s, axis, keepdim)
+    Tensor.min = lambda s, axis=None, keepdim=False, name=None: globals()["min"](s, axis, keepdim)
+    Tensor.round = lambda s, name=None: globals()["round"](s)
+    Tensor.sort = lambda s, axis=-1, descending=False, stable=False, name=None: sort(s, axis, descending, stable)
+    Tensor.split = lambda s, num_or_sections, axis=0, name=None: split(s, num_or_sections, axis)
+    Tensor.chunk = lambda s, chunks, axis=0, name=None: chunk(s, chunks, axis)
+    Tensor.unbind = lambda s, axis=0: unbind(s, axis)
+    Tensor.where = lambda s, x, y, name=None: where(s, x, y)
+    Tensor.nonzero = lambda s, as_tuple=False: nonzero(s, as_tuple)
+    Tensor.unique = lambda s, **kw: unique(s, **kw)
+
+
+_attach()
+
+del _bt

@@ -1,0 +1,184 @@
+"""Op registration & eager dispatch.
+
+Reference parity: paddle/phi/core/kernel_registry.h + kernel_factory.cc
+(PD_REGISTER_KERNEL / KernelFactory::SelectKernel) and the generated
+eager *_ad_func layer (paddle/fluid/eager/api/generated/). Upstream-canonical
+paths, unverified (SURVEY.md §0).
+
+TPU-native design: there is no per-backend kernel selection — XLA is the
+backend. An "op" here is a pure jnp-level function; `eager()` is the entire
+dispatch path: unwrap Tensors → (optionally) record a GradNode via jax.vjp →
+wrap outputs. The registry dict is the single source of truth from which
+Tensor methods and the functional namespace are generated (the reference does
+this from ops.yaml codegen — SURVEY.md §2.1).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+from ..core.flags import flag
+from ..autograd.tape import GradNode, grad_enabled
+
+REGISTRY: Dict[str, Callable] = {}
+
+_FLOAT_KINDS = ("f", "c", "V")  # V covers bfloat16/fp8 (numpy void-backed ml_dtypes)
+
+
+def _is_diff_dtype(arr) -> bool:
+    d = np.dtype(arr.dtype)
+    return d.kind in "fc" or d in dtypes.FLOATING
+
+
+def _maybe_check_finite(name, arrays):
+    if not flag("FLAGS_check_nan_inf"):
+        return
+    for a in arrays:
+        if _is_diff_dtype(a) and not bool(jnp.all(jnp.isfinite(a.astype(jnp.float32)))):
+            raise FloatingPointError(f"nan/inf detected in output of op '{name}'")
+
+
+def eager(raw: Callable, args, kwargs, name: str = "op"):
+    """Run one op eagerly, recording a GradNode when needed.
+
+    `raw` takes jnp arrays in the positions where Tensors were passed
+    (positional or keyword); all other args pass through unchanged. Returns
+    Tensor or tuple of Tensors.
+    """
+    arrs = []
+    tins = []
+    for a in args:
+        if isinstance(a, Tensor):
+            arrs.append(a._data)
+            tins.append(a)
+        else:
+            arrs.append(a)
+            tins.append(None)
+    kw_arrs = {}
+    kw_tins = {}
+    for k, v in kwargs.items():
+        if isinstance(v, Tensor):
+            kw_arrs[k] = v._data
+            kw_tins[k] = v
+        else:
+            kw_arrs[k] = v
+
+    diff_idx = [
+        i for i, t in enumerate(tins)
+        if t is not None and not t.stop_gradient and _is_diff_dtype(t._data)
+    ]
+    diff_keys = [
+        k for k, t in kw_tins.items()
+        if not t.stop_gradient and _is_diff_dtype(t._data)
+    ]
+    record = grad_enabled() and (bool(diff_idx) or bool(diff_keys))
+
+    if not record:
+        out = raw(*arrs, **kw_arrs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        _maybe_check_finite(name, outs)
+        wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
+        return wrapped if multi else wrapped[0]
+
+    n_pos = len(diff_idx)
+
+    def fn(*diff):
+        merged = list(arrs)
+        for i, d in zip(diff_idx, diff[:n_pos]):
+            merged[i] = d
+        mkw = dict(kw_arrs)
+        for k, d in zip(diff_keys, diff[n_pos:]):
+            mkw[k] = d
+        r = raw(*merged, **mkw)
+        return tuple(r) if isinstance(r, (tuple, list)) else r
+
+    primals = [arrs[i] for i in diff_idx] + [kw_arrs[k] for k in diff_keys]
+    out, vjp_fn = jax.vjp(fn, *primals)
+    multi = isinstance(out, tuple)
+    outs = out if multi else (out,)
+    _maybe_check_finite(name, outs)
+
+    node = GradNode(
+        vjp_fn,
+        [tins[i] for i in diff_idx] + [kw_tins[k] for k in diff_keys],
+        [(o.shape, np.dtype(o.dtype)) for o in outs],
+        multi_out=multi,
+        name=name,
+    )
+    wrapped = []
+    for j, o in enumerate(outs):
+        sg = not _is_diff_dtype(o)
+        t = Tensor(o, stop_gradient=sg)
+        if not sg:
+            t._grad_node = node
+            t._out_index = j
+        wrapped.append(t)
+    return tuple(wrapped) if multi else wrapped[0]
+
+
+def defop(name: str, raw: Callable) -> Callable:
+    """Register a jnp-level raw function as a public eager op."""
+
+    @functools.wraps(raw)
+    def op(*args, **kwargs):
+        return eager(raw, args, kwargs, name=name)
+
+    op.__name__ = name
+    op.raw = raw  # the pure jnp function — used by the functional/jit path
+    REGISTRY[name] = op
+    return op
+
+
+def op(name: str):
+    """Decorator form: @op("relu") def relu(x): return jnp.maximum(x, 0)."""
+    def deco(raw):
+        return defop(name, raw)
+    return deco
+
+
+def adopt_inplace(x: Tensor, out: Tensor) -> Tensor:
+    """Functionalized in-place: x takes over out's value and tape position.
+
+    The tape node recorded `x` (pre-mutation) as an input; swap that input to
+    a snapshot so the node doesn't point at its own output (which would cycle
+    the backward traversal).
+    """
+    node = out._grad_node
+    if node is None and x._grad_node is not None and not x.stop_gradient:
+        # e.g. y.add_(1) under no_grad on a non-leaf: the mutation is
+        # untracked and would silently corrupt grads — Paddle raises a
+        # version-mismatch at backward; we raise at the mutation site.
+        raise RuntimeError(
+            "in-place modification of a non-leaf tensor while gradient "
+            "recording is off would corrupt the autograd graph; detach() "
+            "first or perform the update out-of-place")
+    if node is not None and any(t is x for t in node.inputs):
+        old = Tensor(x._data, stop_gradient=x.stop_gradient)
+        old._grad_node = x._grad_node
+        old._out_index = x._out_index
+        old._retain_grads = x._retain_grads
+        node.inputs = [old if t is x else t for t in node.inputs]
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x._version += 1
+    return x
+
+
+def as_array(x, dtype=None):
+    """Coerce Tensor/np/python value to a jnp array (for raw fns that take
+    optional tensor-or-scalar args)."""
+    if isinstance(x, Tensor):
+        a = x._data
+    else:
+        a = jnp.asarray(x)
+    if dtype is not None:
+        a = a.astype(dtypes.convert_dtype(dtype))
+    return a

@@ -1,0 +1,38 @@
+"""Comparison & logic ops — python/paddle/tensor/logic.py parity
+(upstream-canonical, unverified — SURVEY.md §0)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._registry import defop, as_array
+
+equal = defop("equal", lambda x, y, name=None: jnp.equal(x, as_array(y)))
+not_equal = defop("not_equal", lambda x, y, name=None: jnp.not_equal(x, as_array(y)))
+greater_than = defop("greater_than", lambda x, y, name=None: jnp.greater(x, as_array(y)))
+greater_equal = defop("greater_equal", lambda x, y, name=None: jnp.greater_equal(x, as_array(y)))
+less_than = defop("less_than", lambda x, y, name=None: jnp.less(x, as_array(y)))
+less_equal = defop("less_equal", lambda x, y, name=None: jnp.less_equal(x, as_array(y)))
+
+logical_and = defop("logical_and", lambda x, y, out=None, name=None:
+                    jnp.logical_and(x, as_array(y)))
+logical_or = defop("logical_or", lambda x, y, out=None, name=None:
+                   jnp.logical_or(x, as_array(y)))
+logical_xor = defop("logical_xor", lambda x, y, out=None, name=None:
+                    jnp.logical_xor(x, as_array(y)))
+logical_not = defop("logical_not", lambda x, out=None, name=None: jnp.logical_not(x))
+
+
+def _isclose_raw(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.isclose(x, as_array(y), rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+isclose = defop("isclose", _isclose_raw)
+allclose = defop("allclose", lambda x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None:
+                 jnp.allclose(x, as_array(y), rtol=rtol, atol=atol, equal_nan=equal_nan))
+equal_all = defop("equal_all", lambda x, y, name=None: jnp.array_equal(x, as_array(y)))
+is_empty = defop("is_empty", lambda x, name=None: jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    from ..core.tensor import Tensor
+    return isinstance(x, Tensor)

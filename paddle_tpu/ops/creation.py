@@ -1,0 +1,217 @@
+"""Tensor creation ops — python/paddle/tensor/creation.py parity
+(upstream-canonical path, unverified — SURVEY.md §0)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor  # noqa: F401  (re-exported)
+from ..core import dtype as dtypes
+from ..core import random as prandom
+from ._registry import defop, as_array
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else dtypes.get_default_dtype()
+    return dtypes.convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = dtypes.get_default_dtype()  # paddle full defaults float
+        else:
+            dtype = dtypes.get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype=_dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+zeros_like = defop("zeros_like", lambda x, dtype=None, name=None: jnp.zeros_like(
+    x, dtype=None if dtype is None else dtypes.convert_dtype(dtype)))
+ones_like = defop("ones_like", lambda x, dtype=None, name=None: jnp.ones_like(
+    x, dtype=None if dtype is None else dtypes.convert_dtype(dtype)))
+full_like = defop("full_like", lambda x, fill_value, dtype=None, name=None: jnp.full_like(
+    x, fill_value, dtype=None if dtype is None else dtypes.convert_dtype(dtype)))
+empty_like = defop("empty_like", lambda x, dtype=None, name=None: jnp.zeros_like(
+    x, dtype=None if dtype is None else dtypes.convert_dtype(dtype)))
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange with Tensor bounds: pass python scalars")
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = "int64"
+        else:
+            dtype = dtypes.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.linspace(float(start), float(stop), int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns),
+                          dtype=_dt(dtype)))
+
+
+tril = defop("tril", lambda x, diagonal=0, name=None: jnp.tril(x, k=diagonal))
+triu = defop("triu", lambda x, diagonal=0, name=None: jnp.triu(x, k=diagonal))
+
+
+def _diag_raw(x, offset=0, padding_value=0, name=None):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
+        if padding_value != 0:
+            mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+            out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+        return out
+    return jnp.diagonal(x, offset=offset)
+
+
+diag = defop("diag", _diag_raw)
+diagflat = defop("diagflat", lambda x, offset=0, name=None: jnp.diagflat(x, k=offset))
+diag_embed = defop("diag_embed", lambda x, offset=0, dim1=-2, dim2=-1, name=None:
+                   _diag_embed_raw(x, offset, dim1, dim2))
+
+
+def _diag_embed_raw(x, offset, dim1, dim2):
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = base.at[..., r, c].set(x)
+    if (dim1, dim2) not in ((-2, -1), (x.ndim - 1, x.ndim)):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    from ._registry import eager
+    return eager(lambda *arrs: tuple(jnp.meshgrid(*arrs, indexing="ij")),
+                 tuple(tensors), {}, name="meshgrid")
+
+
+def assign(x, output=None) -> Tensor:
+    from ._registry import eager
+    out = eager(lambda a: a + 0 if np.dtype(a.dtype).kind in "fc" else jnp.array(a),
+                (x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)),), {}, name="assign")
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+def clone(x) -> Tensor:
+    return assign(x)
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    a = as_array(x)
+    return Tensor(jax.nn.one_hot(a, num_classes, dtype=dtypes.get_default_dtype()))
+
+
+# ---- random creation ------------------------------------------------------
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.uniform(prandom.next_key(), _shape(shape), dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.normal(prandom.next_key(), _shape(shape), dtype=_dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if shape is None:
+        shape = ()
+    n = jax.random.normal(prandom.next_key(), _shape(shape), dtype=dtypes.get_default_dtype())
+    return Tensor(n * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    key = jax.random.key(seed) if seed else prandom.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=_dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(prandom.next_key(), _shape(shape), low, high,
+                                     dtype=_dt(dtype, np.dtype("int64"))))
+
+
+def randperm(n, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.permutation(prandom.next_key(), int(n)).astype(
+        _dt(dtype, np.dtype("int64"))))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    # paddle supports float output dtypes: draw ints then cast
+    a = as_array(x)
+    out = randint(low, high, shape=tuple(a.shape), dtype="int64")
+    return out.astype(dtype if dtype is not None else np.dtype(a.dtype))
+
+
+def randn_like(x, dtype=None, name=None) -> Tensor:
+    a = as_array(x)
+    return randn(tuple(a.shape), dtype=dtype or np.dtype(a.dtype))
+
+
+def rand_like(x, dtype=None, name=None) -> Tensor:
+    a = as_array(x)
+    return rand(tuple(a.shape), dtype=dtype or np.dtype(a.dtype))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    a = as_array(x)
+    return Tensor(jax.random.bernoulli(prandom.next_key(), a).astype(a.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    a = as_array(x)
+    logits = jnp.log(jnp.maximum(a, 1e-30))
+    if replacement:
+        out = jax.random.categorical(prandom.next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + a.shape[:-1]).T if a.ndim > 1 else \
+              jax.random.categorical(prandom.next_key(), logits, shape=(num_samples,))
+        return Tensor(out.astype(np.dtype("int64")))
+    # without replacement: gumbel top-k trick
+    g = jax.random.gumbel(prandom.next_key(), a.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(np.dtype("int64")))

@@ -1,0 +1,143 @@
+"""Linear algebra + einsum + fft — python/paddle/tensor/linalg.py,
+python/paddle/fft.py parity (upstream-canonical, unverified — SURVEY.md §0).
+Backed by jnp.linalg / jnp.fft (XLA-lowered; decompositions run on CPU via
+XLA custom calls where TPU lacks native support — same split the reference
+makes by routing LAPACK ops through CPU kernels)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._registry import defop, as_array, eager
+
+
+def einsum(equation, *operands):
+    return eager(lambda *arrs: jnp.einsum(equation, *arrs), tuple(operands), {}, name="einsum")
+
+
+cholesky = defop("cholesky", lambda x, upper=False, name=None:
+                 jnp.linalg.cholesky(x).swapaxes(-1, -2).conj() if upper
+                 else jnp.linalg.cholesky(x))
+cholesky_solve = defop("cholesky_solve", lambda x, y, upper=False, name=None:
+                       jax.scipy.linalg.cho_solve((as_array(y), not upper), x))
+inverse = defop("inverse", lambda x, name=None: jnp.linalg.inv(x))
+pinv = defop("pinv", lambda x, rcond=1e-15, hermitian=False, name=None:
+             jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian))
+solve = defop("solve", lambda x, y, name=None: jnp.linalg.solve(x, as_array(y)))
+triangular_solve = defop("triangular_solve", lambda x, y, upper=True, transpose=False, unitriangular=False, name=None:
+                         jax.scipy.linalg.solve_triangular(
+                             x, as_array(y), lower=not upper, trans=1 if transpose else 0,
+                             unit_diagonal=unitriangular))
+lu = defop("lu", lambda x, pivot=True, get_infos=False, name=None: _lu_raw(x, get_infos))
+
+
+def _lu_raw(x, get_infos):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    piv = piv.astype(np.int32) + 1  # paddle returns 1-based pivots
+    if get_infos:
+        return lu_, piv, jnp.zeros(x.shape[:-2], dtype=np.int32)
+    return lu_, piv
+
+
+qr = defop("qr", lambda x, mode="reduced", name=None: tuple(jnp.linalg.qr(x, mode=mode)))
+
+
+def _svd_raw(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, vh.swapaxes(-1, -2).conj()  # paddle returns V not V^H
+
+
+svd = defop("svd", _svd_raw)
+svdvals = defop("svdvals", lambda x, name=None: jnp.linalg.svd(x, compute_uv=False))
+eig = defop("eig", lambda x, name=None: tuple(jnp.linalg.eig(x)))
+eigh = defop("eigh", lambda x, UPLO="L", name=None: tuple(jnp.linalg.eigh(x, UPLO=UPLO)))
+eigvals = defop("eigvals", lambda x, name=None: jnp.linalg.eigvals(x))
+eigvalsh = defop("eigvalsh", lambda x, UPLO="L", name=None: jnp.linalg.eigvalsh(x, UPLO=UPLO))
+matrix_power = defop("matrix_power", lambda x, n, name=None: jnp.linalg.matrix_power(x, n))
+matrix_rank = defop("matrix_rank", lambda x, tol=None, hermitian=False, name=None:
+                    jnp.linalg.matrix_rank(x, rtol=tol))
+det = defop("det", lambda x, name=None: jnp.linalg.det(x))
+slogdet = defop("slogdet", lambda x, name=None: jnp.stack(jnp.linalg.slogdet(x)))
+cond = defop("cond", lambda x, p=None, name=None: jnp.linalg.cond(x, p=p))
+lstsq = defop("lstsq", lambda x, y, rcond=None, driver=None, name=None:
+              tuple(jnp.linalg.lstsq(x, as_array(y), rcond=rcond)))
+householder_product = defop("householder_product", lambda x, tau, name=None:
+                            _householder_product_raw(x, as_array(tau)))
+
+
+def _householder_product_raw(a, tau):
+    m, n = a.shape[-2], a.shape[-1]
+    q = jnp.eye(m, dtype=a.dtype)
+    q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+
+    def body(i, q):
+        v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+        v = v.at[..., i].set(1.0)
+        t = tau[..., i]
+        qv = jnp.einsum("...ij,...j->...i", q, v)
+        return q - t[..., None, None] * qv[..., :, None] * v[..., None, :]
+
+    q = jax.lax.fori_loop(0, n, body, q)
+    return q[..., :, :n]
+
+
+def _corrcoef_raw(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+corrcoef = defop("corrcoef", _corrcoef_raw)
+cov = defop("cov", lambda x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None:
+            jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                    fweights=None if fweights is None else as_array(fweights),
+                    aweights=None if aweights is None else as_array(aweights)))
+matrix_exp = defop("matrix_exp", lambda x, name=None: jax.scipy.linalg.expm(x))
+
+
+def multi_dot(x, name=None):
+    return eager(lambda *arrs: jnp.linalg.multi_dot(arrs), tuple(x), {}, name="multi_dot")
+
+
+# ---- fft namespace --------------------------------------------------------
+class _FFT:
+    fft = staticmethod(defop("fft.fft", lambda x, n=None, axis=-1, norm="backward", name=None:
+                             jnp.fft.fft(x, n=n, axis=axis, norm=norm)))
+    ifft = staticmethod(defop("fft.ifft", lambda x, n=None, axis=-1, norm="backward", name=None:
+                              jnp.fft.ifft(x, n=n, axis=axis, norm=norm)))
+    fft2 = staticmethod(defop("fft.fft2", lambda x, s=None, axes=(-2, -1), norm="backward", name=None:
+                              jnp.fft.fft2(x, s=s, axes=axes, norm=norm)))
+    ifft2 = staticmethod(defop("fft.ifft2", lambda x, s=None, axes=(-2, -1), norm="backward", name=None:
+                               jnp.fft.ifft2(x, s=s, axes=axes, norm=norm)))
+    fftn = staticmethod(defop("fft.fftn", lambda x, s=None, axes=None, norm="backward", name=None:
+                              jnp.fft.fftn(x, s=s, axes=axes, norm=norm)))
+    ifftn = staticmethod(defop("fft.ifftn", lambda x, s=None, axes=None, norm="backward", name=None:
+                               jnp.fft.ifftn(x, s=s, axes=axes, norm=norm)))
+    rfft = staticmethod(defop("fft.rfft", lambda x, n=None, axis=-1, norm="backward", name=None:
+                              jnp.fft.rfft(x, n=n, axis=axis, norm=norm)))
+    irfft = staticmethod(defop("fft.irfft", lambda x, n=None, axis=-1, norm="backward", name=None:
+                               jnp.fft.irfft(x, n=n, axis=axis, norm=norm)))
+    rfft2 = staticmethod(defop("fft.rfft2", lambda x, s=None, axes=(-2, -1), norm="backward", name=None:
+                               jnp.fft.rfft2(x, s=s, axes=axes, norm=norm)))
+    irfft2 = staticmethod(defop("fft.irfft2", lambda x, s=None, axes=(-2, -1), norm="backward", name=None:
+                                jnp.fft.irfft2(x, s=s, axes=axes, norm=norm)))
+    hfft = staticmethod(defop("fft.hfft", lambda x, n=None, axis=-1, norm="backward", name=None:
+                              jnp.fft.hfft(x, n=n, axis=axis, norm=norm)))
+    ihfft = staticmethod(defop("fft.ihfft", lambda x, n=None, axis=-1, norm="backward", name=None:
+                               jnp.fft.ihfft(x, n=n, axis=axis, norm=norm)))
+    fftshift = staticmethod(defop("fft.fftshift", lambda x, axes=None, name=None:
+                                  jnp.fft.fftshift(x, axes=axes)))
+    ifftshift = staticmethod(defop("fft.ifftshift", lambda x, axes=None, name=None:
+                                   jnp.fft.ifftshift(x, axes=axes)))
+
+    @staticmethod
+    def fftfreq(n, d=1.0, dtype=None, name=None):
+        from ..core.tensor import Tensor
+        return Tensor(jnp.fft.fftfreq(n, d=d))
+
+    @staticmethod
+    def rfftfreq(n, d=1.0, dtype=None, name=None):
+        from ..core.tensor import Tensor
+        return Tensor(jnp.fft.rfftfreq(n, d=d))
+
+
+fft = _FFT()

@@ -1,0 +1,499 @@
+"""Shape/layout/indexing ops — python/paddle/tensor/manipulation.py +
+search.py parity (upstream-canonical, unverified — SURVEY.md §0)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._registry import defop, as_array, eager
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+reshape = defop("reshape", lambda x, shape, name=None: jnp.reshape(x, _shape_arg(shape)))
+view = defop("view", lambda x, shape_or_dtype, name=None: jnp.reshape(x, _shape_arg(shape_or_dtype)))
+
+
+def _transpose_raw(x, perm, name=None):
+    return jnp.transpose(x, [int(p) for p in perm])
+
+
+transpose = defop("transpose", _transpose_raw)
+moveaxis = defop("moveaxis", lambda x, source, destination, name=None:
+                 jnp.moveaxis(x, source, destination))
+swapaxes = defop("swapaxes", lambda x, axis0, axis1, name=None: jnp.swapaxes(x, axis0, axis1))
+transpose_ = None  # in-place variants attached in ops/__init__
+
+
+def _flatten_raw(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    s = start_axis % nd
+    e = stop_axis % nd
+    new_shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return x.reshape(new_shape)
+
+
+flatten = defop("flatten", _flatten_raw)
+squeeze = defop("squeeze", lambda x, axis=None, name=None:
+                jnp.squeeze(x, axis=None if axis is None else
+                            tuple(np.atleast_1d(axis).astype(int).tolist())))
+unsqueeze = defop("unsqueeze", lambda x, axis, name=None:
+                  jnp.expand_dims(x, tuple(np.atleast_1d(
+                      axis.numpy() if isinstance(axis, Tensor) else axis).astype(int).tolist())))
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis._data)
+    return eager(lambda *arrs: jnp.concatenate(arrs, axis=axis), tuple(x), {}, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    return eager(lambda *arrs: jnp.stack(arrs, axis=axis), tuple(x), {}, name="stack")
+
+
+def row_stack(x, name=None):
+    return eager(lambda *arrs: jnp.vstack(arrs), tuple(x), {}, name="row_stack")
+
+
+vstack = row_stack
+
+
+def hstack(x, name=None):
+    return eager(lambda *arrs: jnp.hstack(arrs), tuple(x), {}, name="hstack")
+
+
+def dstack(x, name=None):
+    return eager(lambda *arrs: jnp.dstack(arrs), tuple(x), {}, name="dstack")
+
+
+def _split_raw(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    secs = [int(s._data) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+    # paddle allows one -1 section
+    if -1 in secs:
+        known = np.sum([s for s in secs if s != -1])
+        secs[secs.index(-1)] = x.shape[axis] - int(known)
+    splits = np.cumsum(secs)[:-1].tolist()
+    return tuple(jnp.split(x, splits, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis._data)
+    return list(eager(lambda a: _split_raw(a, num_or_sections, axis), (x,), {}, name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    return list(eager(
+        lambda a: tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis)),
+        (x,), {}, name="unbind"))
+
+
+def _tile_raw(x, repeat_times, name=None):
+    return jnp.tile(x, _shape_arg(repeat_times))
+
+
+tile = defop("tile", _tile_raw)
+
+
+def _expand_raw(x, shape, name=None):
+    shape = _shape_arg(shape)
+    # paddle expand: -1 keeps original dim
+    nd_new = len(shape)
+    xs = (1,) * (nd_new - x.ndim) + tuple(x.shape)
+    tgt = tuple(xs[i] if shape[i] == -1 else shape[i] for i in range(nd_new))
+    return jnp.broadcast_to(x.reshape(xs), tgt)
+
+
+expand = defop("expand", _expand_raw)
+broadcast_to = defop("broadcast_to", lambda x, shape, name=None:
+                     _expand_raw(x, shape))
+expand_as = defop("expand_as", lambda x, y, name=None: jnp.broadcast_to(x, as_array(y).shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(eager(lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)),
+                      tuple(inputs), {}, name="broadcast_tensors"))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+flip = defop("flip", lambda x, axis, name=None:
+             jnp.flip(x, axis=tuple(np.atleast_1d(axis).astype(int).tolist())))
+
+
+def _roll_raw(x, shifts, axis=None, name=None):
+    if isinstance(shifts, Tensor):
+        shifts = shifts.numpy().tolist()
+    return jnp.roll(x, shifts, axis=axis)
+
+
+roll = defop("roll", _roll_raw)
+rot90 = defop("rot90", lambda x, k=1, axes=(0, 1), name=None: jnp.rot90(x, k=k, axes=tuple(axes)))
+
+cast = defop("cast", lambda x, dtype, name=None: x.astype(dtypes.convert_dtype(dtype)))
+
+# ---- gather/scatter family ------------------------------------------------
+
+def _gather_raw(x, index, axis=0, name=None):
+    index = as_array(index)
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=int(axis))
+
+
+gather = defop("gather", _gather_raw)
+
+
+def _gather_nd_raw(x, index, name=None):
+    index = as_array(index)
+    k = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(k))
+    return x[idx]
+
+
+gather_nd = defop("gather_nd", _gather_nd_raw)
+
+
+def _scatter_raw(x, index, updates, overwrite=True, name=None):
+    index = as_array(index)
+    updates = as_array(updates)
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle overwrite=False: zero the rows then accumulate
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+scatter = defop("scatter", _scatter_raw)
+
+
+def _scatter_nd_add_raw(x, index, updates, name=None):
+    index = as_array(index)
+    updates = as_array(updates)
+    k = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(k))
+    return x.at[idx].add(updates)
+
+
+scatter_nd_add = defop("scatter_nd_add", _scatter_nd_add_raw)
+
+
+def _scatter_nd_raw(index, updates, shape, name=None):
+    index = as_array(index)
+    updates = as_array(updates)
+    base = jnp.zeros(_shape_arg(shape), dtype=updates.dtype)
+    k = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(k))
+    return base.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    return eager(lambda u: _scatter_nd_raw(index, u, shape), (updates,), {}, name="scatter_nd")
+
+
+index_select = defop("index_select", lambda x, index, axis=0, name=None:
+                     jnp.take(x, as_array(index), axis=int(axis)))
+
+
+def _index_sample_raw(x, index):
+    index = as_array(index)
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+index_sample = defop("index_sample", _index_sample_raw)
+
+
+def _index_add_raw(x, index, axis, value, name=None):
+    index = as_array(index)
+    value = as_array(value)
+    xm = jnp.moveaxis(x, axis, 0)
+    vm = jnp.moveaxis(value, axis, 0)
+    out = xm.at[index].add(vm)
+    return jnp.moveaxis(out, 0, axis)
+
+
+index_add = defop("index_add", _index_add_raw)
+
+
+def _index_put_raw(x, indices, value, accumulate=False, name=None):
+    idx = tuple(as_array(i) for i in indices)
+    value = as_array(value)
+    return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
+
+
+index_put = defop("index_put", _index_put_raw)
+
+take_along_axis = defop("take_along_axis", lambda x, indices, axis, broadcast=True, name=None:
+                        jnp.take_along_axis(x, as_array(indices), axis=int(axis)))
+
+
+def _put_along_axis_raw(x, indices, values, axis, reduce="assign", name=None):
+    indices = as_array(indices)
+    values = jnp.broadcast_to(as_array(values).astype(x.dtype), indices.shape)
+    axis = int(axis)
+    dims = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(indices.ndim)])
+            for d, s in enumerate(indices.shape)]
+    idx = tuple(indices if d == (axis % x.ndim) else jnp.broadcast_to(dims[d], indices.shape)
+                for d in range(x.ndim))
+    if reduce in ("assign", None):
+        return x.at[idx].set(values)
+    if reduce == "add":
+        return x.at[idx].add(values)
+    if reduce in ("mul", "multiply"):
+        return x.at[idx].multiply(values)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+put_along_axis = defop("put_along_axis", _put_along_axis_raw)
+
+
+def take(x, index, mode="raise", name=None):
+    return eager(lambda a: jnp.take(a.reshape(-1), as_array(index), mode="clip" if mode == "clip" else "wrap" if mode == "wrap" else None), (x,), {}, name="take")
+
+
+masked_select = defop("masked_select", lambda x, mask, name=None:
+                      x[as_array(mask).astype(bool)])
+masked_fill = defop("masked_fill", lambda x, mask, value, name=None:
+                    jnp.where(as_array(mask).astype(bool), as_array(value).astype(x.dtype), x))
+
+
+def _masked_scatter_raw(x, mask, value, name=None):
+    mask = as_array(mask).astype(bool)
+    mask_b = jnp.broadcast_to(mask, x.shape)
+    vflat = as_array(value).reshape(-1)
+    pos = jnp.cumsum(mask_b.reshape(-1)) - 1
+    src = vflat[jnp.clip(pos, 0, vflat.shape[0] - 1)]
+    return jnp.where(mask_b, src.reshape(x.shape), x)
+
+
+masked_scatter = defop("masked_scatter", _masked_scatter_raw)
+
+# ---- where / nonzero ------------------------------------------------------
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    cond = as_array(condition).astype(bool)
+    xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    yt = y if isinstance(y, Tensor) else Tensor(jnp.asarray(y))
+    return eager(lambda a, b: jnp.where(cond, a, b.astype(jnp.result_type(a, b))),
+                 (xt, yt), {}, name="where")
+
+
+def nonzero(x, as_tuple=False):
+    arr = as_array(x)
+    idx = np.nonzero(np.asarray(arr))  # data-dependent shape → host computed (paddle parity)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1)))
+
+
+# ---- sort family ----------------------------------------------------------
+sort = defop("sort", lambda x, axis=-1, descending=False, stable=False, name=None:
+             jnp.flip(jnp.sort(x, axis=axis, stable=stable), axis=axis) if descending
+             else jnp.sort(x, axis=axis, stable=stable))
+argsort = defop("argsort", lambda x, axis=-1, descending=False, stable=False, name=None:
+                (jnp.flip(jnp.argsort(x, axis=axis, stable=stable), axis=axis) if descending
+                 else jnp.argsort(x, axis=axis, stable=stable)).astype(np.int64))
+
+
+def _topk_raw(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k._data)
+    axis = int(axis)
+    xm = jnp.moveaxis(x, axis, -1)
+    if largest:
+        v, i = jax.lax.top_k(xm, k)
+    else:
+        v, i = jax.lax.top_k(-xm, k)
+        v = -v
+    return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis).astype(np.int64)
+
+
+topk = defop("topk", _topk_raw)
+
+
+def _kthvalue_raw(x, k, axis=-1, keepdim=False, name=None):
+    xm = jnp.moveaxis(x, axis, -1)
+    sv = jnp.sort(xm, axis=-1)
+    si = jnp.argsort(xm, axis=-1)
+    v = sv[..., k - 1]
+    i = si[..., k - 1]
+    if keepdim:
+        v = jnp.moveaxis(v[..., None], -1, axis)
+        i = jnp.moveaxis(i[..., None], -1, axis)
+    return v, i.astype(np.int64)
+
+
+kthvalue = defop("kthvalue", _kthvalue_raw)
+searchsorted = defop("searchsorted", lambda sorted_sequence, values, out_int32=False, right=False, name=None:
+                     jnp.searchsorted(sorted_sequence, as_array(values),
+                                      side="right" if right else "left").astype(
+                                          np.int32 if out_int32 else np.int64))
+bucketize = defop("bucketize", lambda x, sorted_sequence, out_int32=False, right=False, name=None:
+                  jnp.searchsorted(as_array(sorted_sequence), x,
+                                   side="right" if right else "left").astype(
+                                       np.int32 if out_int32 else np.int64))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(as_array(x))  # data-dependent shape → host (paddle parity)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(as_array(x))
+    if axis is None:
+        arr = arr.reshape(-1)
+        axis = 0
+    sel = np.ones(arr.shape[axis], dtype=bool)
+    diff = np.any(np.diff(arr, axis=axis) != 0,
+                  axis=tuple(i for i in range(arr.ndim) if i != axis)) if arr.ndim > 1 else np.diff(arr) != 0
+    sel[1:] = diff
+    vals = np.compress(sel, arr, axis=axis)
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(np.cumsum(sel) - 1)))
+    if return_counts:
+        idx = np.nonzero(sel)[0]
+        counts = np.diff(np.append(idx, arr.shape[axis]))
+        outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+# ---- pad ------------------------------------------------------------------
+
+def _pad_raw(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad = [int(p._data) if isinstance(p, Tensor) else int(p) for p in
+           (pad.numpy().tolist() if isinstance(pad, Tensor) else pad)]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-rank paddle pad: [d0_lo, d0_hi, d1_lo, d1_hi, ...]
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # nn.functional-style: pads innermost spatial dims, reversed pairs
+        widths = [(0, 0)] * nd
+        k = len(pad) // 2
+        if data_format.endswith("C") and nd >= 3:  # NHWC/NLC/NDHWC: spatial dims start at 1
+            dims = list(range(1, 1 + k))
+        else:  # NCHW-style: spatial dims are the last k
+            dims = list(range(nd - k, nd))
+        for i in range(k):
+            widths[dims[k - 1 - i]] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, widths, mode=jmode, constant_values=value)
+    return jnp.pad(x, widths, mode=jmode)
+
+
+pad = defop("pad", _pad_raw)
+
+# ---- getitem/setitem ------------------------------------------------------
+
+def _norm_index(idx):
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    if isinstance(idx, Tensor):
+        a = idx._data
+        return a.astype(bool) if np.dtype(a.dtype).kind == "b" else a
+    return idx
+
+
+def getitem(x, idx):
+    nidx = _norm_index(idx)
+    return eager(lambda a: a[nidx], (x,), {}, name="getitem")
+
+
+def setitem_(x, idx, value):
+    nidx = _norm_index(idx)
+
+    def raw(a, v):
+        return a.at[nidx].set(v.astype(a.dtype) if hasattr(v, "astype") else v)
+
+    if isinstance(value, Tensor):
+        out = eager(raw, (x, value), {}, name="setitem")
+    else:
+        out = eager(lambda a: a.at[nidx].set(value), (x,), {}, name="setitem")
+    from ._registry import adopt_inplace
+    return adopt_inplace(x, out)
+
+
+def slice(input, axes, starts, ends):
+    idx = [jnp.s_[:]] * input.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        s = int(s._data) if isinstance(s, Tensor) else int(s)
+        e = int(e._data) if isinstance(e, Tensor) else int(e)
+        idx[int(ax)] = jnp.s_[s:e]
+    return getitem(input, tuple(idx))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[int(ax)] = jnp.s_[int(s):int(e):int(st)]
+    return getitem(x, tuple(idx))
+
+
+def _repeat_interleave_raw(x, repeats, axis=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if isinstance(repeats, (int, np.integer)):
+        return jnp.repeat(x, int(repeats), axis=axis)
+    r = as_array(repeats)
+    total = int(np.asarray(r).sum())
+    return jnp.repeat(x, r, axis=axis, total_repeat_length=total)
+
+
+repeat_interleave = defop("repeat_interleave", _repeat_interleave_raw)
+
+
+def _unfold_raw(x, axis, size, step, name=None):
+    # paddle.unfold(x, axis, size, step): sliding windows along axis
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    windows = jax.vmap(lambda s: jax.lax.dynamic_slice_in_dim(x, s, size, axis=axis))(starts)
+    # windows: [n, ...]; move to paddle layout: axis dim -> n, append size at end
+    out = jnp.moveaxis(windows, 0, axis)
+    return jnp.moveaxis(out, axis + 1, x.ndim)
+
+
+tensor_unfold = defop("tensor_unfold", _unfold_raw)
+
+as_complex = defop("as_complex", lambda x, name=None: jax.lax.complex(x[..., 0], x[..., 1]))
+as_real = defop("as_real", lambda x, name=None: jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1))
+
+numel = defop("numel", lambda x, name=None: jnp.asarray(x.size, dtype=np.int64))
+shard_index = defop("shard_index", lambda input, index_num, nshards, shard_id, ignore_value=-1, name=None:
+                    jnp.where((input // (index_num // nshards)) == shard_id,
+                              input % (index_num // nshards), ignore_value))

@@ -1,0 +1,207 @@
+"""Elementwise & binary math ops — python/paddle/tensor/math.py parity
+(upstream-canonical, unverified — SURVEY.md §0). Raw fns are pure jnp so the
+functional/jit path reuses them via `.raw` (see ops/_registry.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._registry import defop, as_array
+from ..core import dtype as dtypes
+
+# -- binary arithmetic ------------------------------------------------------
+add = defop("add", lambda x, y, name=None: jnp.add(x, as_array(y)))
+subtract = defop("subtract", lambda x, y, name=None: jnp.subtract(x, as_array(y)))
+multiply = defop("multiply", lambda x, y, name=None: jnp.multiply(x, as_array(y)))
+divide = defop("divide", lambda x, y, name=None: jnp.true_divide(x, as_array(y)))
+floor_divide = defop("floor_divide", lambda x, y, name=None: jnp.floor_divide(x, as_array(y)))
+mod = defop("mod", lambda x, y, name=None: jnp.mod(x, as_array(y)))
+remainder = mod
+floor_mod = mod
+pow = defop("pow", lambda x, y, name=None: jnp.power(x, as_array(y)))
+maximum = defop("maximum", lambda x, y, name=None: jnp.maximum(x, as_array(y)))
+minimum = defop("minimum", lambda x, y, name=None: jnp.minimum(x, as_array(y)))
+fmax = defop("fmax", lambda x, y, name=None: jnp.fmax(x, as_array(y)))
+fmin = defop("fmin", lambda x, y, name=None: jnp.fmin(x, as_array(y)))
+atan2 = defop("atan2", lambda x, y, name=None: jnp.arctan2(x, as_array(y)))
+hypot = defop("hypot", lambda x, y, name=None: jnp.hypot(x, as_array(y)))
+copysign = defop("copysign", lambda x, y, name=None: jnp.copysign(x, as_array(y)))
+nextafter = defop("nextafter", lambda x, y, name=None: jnp.nextafter(x, as_array(y)))
+ldexp = defop("ldexp", lambda x, y, name=None: jnp.ldexp(x, as_array(y).astype(np.int32)))
+heaviside = defop("heaviside", lambda x, y, name=None: jnp.heaviside(x, as_array(y)))
+gcd = defop("gcd", lambda x, y, name=None: jnp.gcd(x, as_array(y)))
+lcm = defop("lcm", lambda x, y, name=None: jnp.lcm(x, as_array(y)))
+
+# -- scale/axpy style -------------------------------------------------------
+scale = defop("scale", lambda x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None:
+              x * scale + bias if bias_after_scale else (x + bias) * scale)
+lerp = defop("lerp", lambda x, y, weight, name=None: x + as_array(weight) * (as_array(y) - x))
+
+
+def _addmm_raw(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+addmm = defop("addmm", _addmm_raw)
+
+# -- unary ------------------------------------------------------------------
+abs = defop("abs", lambda x, name=None: jnp.abs(x))
+neg = defop("neg", lambda x, name=None: jnp.negative(x))
+sign = defop("sign", lambda x, name=None: jnp.sign(x))
+sqrt = defop("sqrt", lambda x, name=None: jnp.sqrt(x))
+rsqrt = defop("rsqrt", lambda x, name=None: jax.lax.rsqrt(x))
+square = defop("square", lambda x, name=None: jnp.square(x))
+reciprocal = defop("reciprocal", lambda x, name=None: jnp.reciprocal(x))
+exp = defop("exp", lambda x, name=None: jnp.exp(x))
+expm1 = defop("expm1", lambda x, name=None: jnp.expm1(x))
+log = defop("log", lambda x, name=None: jnp.log(x))
+log2 = defop("log2", lambda x, name=None: jnp.log2(x))
+log10 = defop("log10", lambda x, name=None: jnp.log10(x))
+log1p = defop("log1p", lambda x, name=None: jnp.log1p(x))
+floor = defop("floor", lambda x, name=None: jnp.floor(x))
+ceil = defop("ceil", lambda x, name=None: jnp.ceil(x))
+round = defop("round", lambda x, name=None: jnp.round(x))
+trunc = defop("trunc", lambda x, name=None: jnp.trunc(x))
+frac = defop("frac", lambda x, name=None: x - jnp.trunc(x))
+sin = defop("sin", lambda x, name=None: jnp.sin(x))
+cos = defop("cos", lambda x, name=None: jnp.cos(x))
+tan = defop("tan", lambda x, name=None: jnp.tan(x))
+asin = defop("asin", lambda x, name=None: jnp.arcsin(x))
+acos = defop("acos", lambda x, name=None: jnp.arccos(x))
+atan = defop("atan", lambda x, name=None: jnp.arctan(x))
+sinh = defop("sinh", lambda x, name=None: jnp.sinh(x))
+cosh = defop("cosh", lambda x, name=None: jnp.cosh(x))
+tanh = defop("tanh", lambda x, name=None: jnp.tanh(x))
+asinh = defop("asinh", lambda x, name=None: jnp.arcsinh(x))
+acosh = defop("acosh", lambda x, name=None: jnp.arccosh(x))
+atanh = defop("atanh", lambda x, name=None: jnp.arctanh(x))
+erf = defop("erf", lambda x, name=None: jax.scipy.special.erf(x))
+erfinv = defop("erfinv", lambda x, name=None: jax.scipy.special.erfinv(x))
+sigmoid = defop("sigmoid", lambda x, name=None: jax.nn.sigmoid(x))
+logit = defop("logit", lambda x, eps=None, name=None:
+              jax.scipy.special.logit(jnp.clip(x, eps, 1 - eps) if eps else x))
+digamma = defop("digamma", lambda x, name=None: jax.scipy.special.digamma(x))
+lgamma = defop("lgamma", lambda x, name=None: jax.scipy.special.gammaln(x))
+gamma = defop("gamma", lambda x, name=None: jnp.exp(jax.scipy.special.gammaln(x)) * jnp.sign(x))
+i0 = defop("i0", lambda x, name=None: jax.scipy.special.i0(x))
+i1 = defop("i1", lambda x, name=None: jax.scipy.special.i1(x))
+rad2deg = defop("rad2deg", lambda x, name=None: jnp.rad2deg(x))
+deg2rad = defop("deg2rad", lambda x, name=None: jnp.deg2rad(x))
+angle = defop("angle", lambda x, name=None: jnp.angle(x))
+conj = defop("conj", lambda x, name=None: jnp.conj(x))
+real = defop("real", lambda x, name=None: jnp.real(x))
+imag = defop("imag", lambda x, name=None: jnp.imag(x))
+
+# -- tests ------------------------------------------------------------------
+isnan = defop("isnan", lambda x, name=None: jnp.isnan(x))
+isinf = defop("isinf", lambda x, name=None: jnp.isinf(x))
+isfinite = defop("isfinite", lambda x, name=None: jnp.isfinite(x))
+isreal = defop("isreal", lambda x, name=None: jnp.isreal(x))
+isneginf = defop("isneginf", lambda x, name=None: jnp.isneginf(x))
+isposinf = defop("isposinf", lambda x, name=None: jnp.isposinf(x))
+nan_to_num = defop("nan_to_num", lambda x, nan=0.0, posinf=None, neginf=None, name=None:
+                   jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf))
+
+
+def _clip_raw(x, min=None, max=None, name=None):
+    lo = None if min is None else as_array(min)
+    hi = None if max is None else as_array(max)
+    return jnp.clip(x, lo, hi)
+
+
+clip = defop("clip", _clip_raw)
+
+# -- cumulative -------------------------------------------------------------
+def _cumsum_raw(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    return out.astype(dtypes.convert_dtype(dtype)) if dtype else out
+
+
+cumsum = defop("cumsum", _cumsum_raw)
+
+
+def _cumprod_raw(x, dim=None, dtype=None, name=None):
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    out = jnp.cumprod(x, axis=dim)
+    return out.astype(dtypes.convert_dtype(dtype)) if dtype else out
+
+
+cumprod = defop("cumprod", _cumprod_raw)
+def _cum_extreme_raw(x, axis, op):
+    if axis is None:
+        x, axis = x.reshape(-1), 0
+    vals = jax.lax.associative_scan(op, x, axis=axis)
+    # indices: position where the running extreme was last updated
+    hit = jnp.equal(x, vals)
+    pos = jnp.arange(x.shape[axis]).reshape(
+        [-1 if i == (axis % x.ndim) else 1 for i in range(x.ndim)])
+    idx = jax.lax.associative_scan(jnp.maximum, jnp.where(hit, pos, -1), axis=axis)
+    return vals, idx.astype(np.int64)
+
+
+cummax = defop("cummax", lambda x, axis=None, name=None: _cum_extreme_raw(x, axis, jnp.maximum))
+cummin = defop("cummin", lambda x, axis=None, name=None: _cum_extreme_raw(x, axis, jnp.minimum))
+logcumsumexp = defop("logcumsumexp", lambda x, axis=None, name=None:
+                     jax.lax.associative_scan(jnp.logaddexp,
+                                              x.reshape(-1) if axis is None else x,
+                                              axis=0 if axis is None else axis))
+logaddexp = defop("logaddexp", lambda x, y, name=None: jnp.logaddexp(x, as_array(y)))
+
+# -- matmul family ----------------------------------------------------------
+def _matmul_raw(x, y, transpose_x=False, transpose_y=False, name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+matmul = defop("matmul", _matmul_raw)
+bmm = defop("bmm", lambda x, y, name=None: jnp.matmul(x, y))
+mm = defop("mm", lambda x, y, name=None: jnp.matmul(x, y))
+mv = defop("mv", lambda x, vec, name=None: jnp.matmul(x, vec))
+dot = defop("dot", lambda x, y, name=None: jnp.sum(x * y, axis=-1))
+inner = defop("inner", lambda x, y, name=None: jnp.inner(x, y))
+outer = defop("outer", lambda x, y, name=None: jnp.outer(x, y))
+cross = defop("cross", lambda x, y, axis=None, name=None:
+              jnp.cross(x, as_array(y), axis=-1 if axis is None else axis))
+kron = defop("kron", lambda x, y, name=None: jnp.kron(x, y))
+trace = defop("trace", lambda x, offset=0, axis1=0, axis2=1, name=None:
+              jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2))
+diagonal = defop("diagonal", lambda x, offset=0, axis1=0, axis2=1, name=None:
+                 jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2))
+t = defop("t", lambda x, name=None: x.T if x.ndim >= 2 else x)
+
+# -- misc -------------------------------------------------------------------
+def _diff_raw(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    kw = {}
+    if prepend is not None:
+        kw["prepend"] = as_array(prepend)
+    if append is not None:
+        kw["append"] = as_array(append)
+    return jnp.diff(x, n=n, axis=axis, **kw)
+
+
+diff = defop("diff", _diff_raw)
+stanh = defop("stanh", lambda x, scale_a=0.67, scale_b=1.7159, name=None:
+              scale_b * jnp.tanh(scale_a * x))
+polygamma = defop("polygamma", lambda x, n, name=None: jax.scipy.special.polygamma(n, x))
+sinc = defop("sinc", lambda x, name=None: jnp.sinc(x))
+signbit = defop("signbit", lambda x, name=None: jnp.signbit(x))
+trapezoid = defop("trapezoid", lambda y, x=None, dx=None, axis=-1, name=None:
+                  jnp.trapezoid(y, x=None if x is None else as_array(x),
+                                dx=1.0 if dx is None else dx, axis=axis))
+
+# -- bitwise ----------------------------------------------------------------
+bitwise_and = defop("bitwise_and", lambda x, y, name=None: jnp.bitwise_and(x, as_array(y)))
+bitwise_or = defop("bitwise_or", lambda x, y, name=None: jnp.bitwise_or(x, as_array(y)))
+bitwise_xor = defop("bitwise_xor", lambda x, y, name=None: jnp.bitwise_xor(x, as_array(y)))
+bitwise_not = defop("bitwise_not", lambda x, name=None: jnp.bitwise_not(x))
+bitwise_left_shift = defop("bitwise_left_shift", lambda x, y, name=None: jnp.left_shift(x, as_array(y)))
+bitwise_right_shift = defop("bitwise_right_shift", lambda x, y, name=None: jnp.right_shift(x, as_array(y)))

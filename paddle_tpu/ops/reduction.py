@@ -1,0 +1,129 @@
+"""Reduction & search ops — python/paddle/tensor/{math,search,stat}.py parity
+(upstream-canonical, unverified — SURVEY.md §0)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._registry import defop, as_array
+from ..core import dtype as dtypes
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    from ..core.tensor import Tensor
+    if isinstance(axis, Tensor):
+        v = axis.numpy()
+        return tuple(int(a) for a in np.atleast_1d(v))
+    return int(axis)
+
+
+def _sum_raw(x, axis=None, dtype=None, keepdim=False, name=None):
+    out = jnp.sum(x, axis=_axis(axis), keepdims=keepdim)
+    if dtype is not None:
+        out = out.astype(dtypes.convert_dtype(dtype))
+    elif np.dtype(x.dtype).kind == "b":
+        out = out.astype(np.int64)
+    return out
+
+
+sum = defop("sum", _sum_raw)
+nansum = defop("nansum", lambda x, axis=None, dtype=None, keepdim=False, name=None:
+               jnp.nansum(x, axis=_axis(axis), keepdims=keepdim,
+                          dtype=None if dtype is None else dtypes.convert_dtype(dtype)))
+mean = defop("mean", lambda x, axis=None, keepdim=False, name=None:
+             jnp.mean(x, axis=_axis(axis), keepdims=keepdim))
+nanmean = defop("nanmean", lambda x, axis=None, keepdim=False, name=None:
+                jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim))
+prod = defop("prod", lambda x, axis=None, keepdim=False, dtype=None, name=None:
+             jnp.prod(x, axis=_axis(axis), keepdims=keepdim,
+                      dtype=None if dtype is None else dtypes.convert_dtype(dtype)))
+max = defop("max", lambda x, axis=None, keepdim=False, name=None:
+            jnp.max(x, axis=_axis(axis), keepdims=keepdim))
+min = defop("min", lambda x, axis=None, keepdim=False, name=None:
+            jnp.min(x, axis=_axis(axis), keepdims=keepdim))
+amax = defop("amax", lambda x, axis=None, keepdim=False, name=None:
+             jnp.max(x, axis=_axis(axis), keepdims=keepdim))
+amin = defop("amin", lambda x, axis=None, keepdim=False, name=None:
+             jnp.min(x, axis=_axis(axis), keepdims=keepdim))
+all = defop("all", lambda x, axis=None, keepdim=False, name=None:
+            jnp.all(x, axis=_axis(axis), keepdims=keepdim))
+any = defop("any", lambda x, axis=None, keepdim=False, name=None:
+            jnp.any(x, axis=_axis(axis), keepdims=keepdim))
+std = defop("std", lambda x, axis=None, unbiased=True, keepdim=False, name=None:
+            jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim))
+var = defop("var", lambda x, axis=None, unbiased=True, keepdim=False, name=None:
+            jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim))
+median = defop("median", lambda x, axis=None, keepdim=False, mode="avg", name=None:
+               jnp.median(x, axis=_axis(axis), keepdims=keepdim))
+nanmedian = defop("nanmedian", lambda x, axis=None, keepdim=False, name=None:
+                  jnp.nanmedian(x, axis=_axis(axis), keepdims=keepdim))
+quantile = defop("quantile", lambda x, q, axis=None, keepdim=False, interpolation="linear", name=None:
+                 jnp.quantile(x, as_array(q), axis=_axis(axis), keepdims=keepdim,
+                              method=interpolation))
+count_nonzero = defop("count_nonzero", lambda x, axis=None, keepdim=False, name=None:
+                      jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim))
+logsumexp = defop("logsumexp", lambda x, axis=None, keepdim=False, name=None:
+                  jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim))
+
+argmax = defop("argmax", lambda x, axis=None, keepdim=False, dtype="int64", name=None:
+               jnp.argmax(x.reshape(-1) if axis is None else x,
+                          axis=None if axis is None else int(axis),
+                          keepdims=keepdim if axis is not None else False
+                          ).astype(dtypes.convert_dtype(dtype)))
+argmin = defop("argmin", lambda x, axis=None, keepdim=False, dtype="int64", name=None:
+               jnp.argmin(x.reshape(-1) if axis is None else x,
+                          axis=None if axis is None else int(axis),
+                          keepdims=keepdim if axis is not None else False
+                          ).astype(dtypes.convert_dtype(dtype)))
+
+
+def _mode_raw(x, axis=-1, keepdim=False, name=None):
+    # count occurrences by pairwise compare along axis (O(n^2) — API parity path)
+    xm = jnp.moveaxis(x, axis, -1)
+    eq = xm[..., :, None] == xm[..., None, :]
+    cnt = jnp.sum(eq, axis=-1)
+    pos = jnp.argmax(cnt, axis=-1)
+    out = jnp.take_along_axis(xm, pos[..., None], axis=-1)[..., 0]
+    out = jnp.moveaxis(out[..., None], -1, axis) if keepdim else out
+    idx = jnp.moveaxis(pos[..., None], -1, axis) if keepdim else pos
+    return out, idx.astype(np.int64)
+
+
+mode = defop("mode", _mode_raw)
+
+
+def _norm_raw(x, p="fro", axis=None, keepdim=False, name=None):
+    if p == "fro" or (p == 2 and axis is None):
+        return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=_axis(axis), keepdims=keepdim))
+    if p == "nuc":
+        return jnp.sum(jnp.linalg.svd(x, compute_uv=False), axis=-1, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=_axis(axis), keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=_axis(axis), keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=_axis(axis), keepdims=keepdim)
+    if p == 1:
+        return jnp.sum(jnp.abs(x), axis=_axis(axis), keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=_axis(axis), keepdims=keepdim), 1.0 / p)
+
+
+norm = defop("norm", _norm_raw)
+dist = defop("dist", lambda x, y, p=2, name=None: _norm_raw(x - as_array(y), p=p))
+
+
+def _histogram_raw(x, bins=100, min=0, max=0, name=None):
+    lo, hi = (float(jnp.min(x)), float(jnp.max(x))) if (min == 0 and max == 0) else (min, max)
+    h, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return h.astype(np.int64)
+
+
+histogram = defop("histogram", _histogram_raw)
+bincount = defop("bincount", lambda x, weights=None, minlength=0, name=None:
+                 jnp.bincount(x, weights=None if weights is None else as_array(weights),
+                              minlength=minlength, length=None))

@@ -1,0 +1,25 @@
+"""Test config: force CPU platform with 8 virtual devices.
+
+Carry-over from the reference's test strategy (SURVEY.md §4): multi-node is
+simulated locally — their trick is multi-process on 127.0.0.1; ours is
+XLA host-platform fake devices for in-process SPMD tests. The axon TPU plugin
+(sitecustomize) is overridden by updating jax config before any backend init.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reseed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    yield

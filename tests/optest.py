@@ -1,0 +1,102 @@
+"""OpTest harness: numpy-reference forward checks + finite-difference grad
+checks.
+
+Modeled on the reference's op-test backbone (SURVEY.md §4: OpTest in
+test/legacy_test/op_test.py builds a one-op program, checks fwd against a
+numpy reference and grads against numeric finite differences, with a
+tolerance ladder rtol 1e-5 fp32 / 1e-3 fp16 / 1e-2 bf16). Re-designed for
+eager+tape: we call the public op, compare with a numpy fn, and check
+`backward()` grads against central differences on the numpy fn.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+RTOL = {np.dtype("float64"): 1e-7, np.dtype("float32"): 1e-5,
+        np.dtype("float16"): 1e-3}
+DEFAULT_RTOL = 1e-2  # bf16 and below
+
+
+def rtol_for(dtype) -> float:
+    return RTOL.get(np.dtype(dtype), DEFAULT_RTOL)
+
+
+def check_output(op: Callable, np_ref: Callable, inputs: Sequence[np.ndarray],
+                 kwargs: Dict = None, rtol=None, atol=0.0):
+    """Run `op` on Tensors built from `inputs`, compare against np_ref(*inputs)."""
+    kwargs = kwargs or {}
+    tin = [paddle.to_tensor(x) for x in inputs]
+    out = op(*tin, **kwargs)
+    ref = np_ref(*inputs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    assert len(outs) == len(refs), f"{len(outs)} outputs vs {len(refs)} refs"
+    for o, r in zip(outs, refs):
+        o = o.numpy()
+        r = np.asarray(r)
+        tol = rtol if rtol is not None else rtol_for(o.dtype)
+        np.testing.assert_allclose(
+            o.astype(np.float64) if o.dtype.kind == "f" else o,
+            r.astype(np.float64) if np.asarray(r).dtype.kind == "f" else r,
+            rtol=tol, atol=atol or tol)
+    return outs
+
+
+def check_grad(op: Callable, inputs: Sequence[np.ndarray], kwargs: Dict = None,
+               eps=1e-4, rtol=1e-3, atol=1e-3, grad_index=None,
+               reduce_to_scalar=True):
+    """Compare tape gradients with central finite differences.
+
+    The op's (possibly multi-) output is reduced to a scalar via sum() so a
+    single backward pass yields all grads — same trick as the reference's
+    numeric check (SURVEY.md §4 check_grad).
+    """
+    kwargs = kwargs or {}
+    inputs = [np.asarray(x, dtype=np.float64) for x in inputs]
+    check_idx = range(len(inputs)) if grad_index is None else [grad_index]
+
+    def scalar(np_inputs):
+        tin = [paddle.to_tensor(x) for x in np_inputs]
+        out = op(*tin, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        total = None
+        for o in outs:
+            if o.dtype.kind != "f":
+                continue
+            s = o.sum()
+            total = s if total is None else total + s
+        return total
+
+    # analytic grads via tape
+    tin = [paddle.to_tensor(x, stop_gradient=False) for x in inputs]
+    out = op(*tin, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    total = None
+    for o in outs:
+        if o.dtype.kind != "f":
+            continue
+        s = o.sum()
+        total = s if total is None else total + s
+    total.backward()
+
+    for i in check_idx:
+        analytic = tin[i].grad
+        assert analytic is not None, f"no grad for input {i}"
+        analytic = analytic.numpy().astype(np.float64)
+        numeric = np.zeros_like(inputs[i], dtype=np.float64)
+        flat = inputs[i].reshape(-1)
+        nflat = numeric.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = float(scalar(inputs).numpy())
+            flat[j] = orig - eps
+            fm = float(scalar(inputs).numpy())
+            flat[j] = orig
+            nflat[j] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch for input {i}")

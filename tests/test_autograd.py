@@ -1,0 +1,180 @@
+"""Tape autograd tests — parity with the reference's eager backward semantics
+(SURVEY.md §2.2 eager autograd engine; §3.1 call stack)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(x, sg=False):
+    return paddle.to_tensor(np.asarray(x, dtype=np.float32), stop_gradient=sg)
+
+
+class TestBackward:
+    def test_chain(self):
+        x = t([2.0, 3.0])
+        y = (x * x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 3 * np.array([4.0, 9.0]), rtol=1e-6)
+
+    def test_fanout_accumulation(self):
+        x = t([1.0, 2.0])
+        a = x * 2
+        b = x * 3
+        (a + b).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0], rtol=1e-6)
+
+    def test_grad_accumulates_across_backwards(self):
+        x = t([1.0])
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0], rtol=1e-6)
+
+    def test_stop_gradient_blocks(self):
+        x = t([1.0, 2.0])
+        y = t([3.0, 4.0], sg=True)
+        (x * y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 4.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = t([2.0])
+        d = (x * 2).detach()
+        (d * x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])  # d treated as constant
+
+    def test_non_scalar_needs_grad_tensor(self):
+        x = t([[1.0, 2.0]])
+        with pytest.raises(RuntimeError):
+            (x * 2).backward()
+        (x * 2).backward(paddle.ones([1, 2]))
+        np.testing.assert_allclose(x.grad.numpy(), [[2.0, 2.0]])
+
+    def test_retain_graph(self):
+        x = t([1.0])
+        y = (x * 3).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_double_backward_without_retain_raises(self):
+        x = t([1.0])
+        y = (x * 3).sum()
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_multi_output_op(self):
+        x = t([[3.0, 1.0, 2.0]])
+        v, i = paddle.topk(x, 2)
+        v.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+
+    def test_no_grad(self):
+        x = t([1.0])
+        with paddle.no_grad():
+            y = x * 2
+        assert y._grad_node is None and y.stop_gradient
+
+    def test_hooks(self):
+        x = t([1.0, 1.0])
+        seen = {}
+
+        def hook(g):
+            seen["g"] = g.numpy().copy()
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(seen["g"], [3.0, 3.0])
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+    def test_retain_grads_intermediate(self):
+        x = t([2.0])
+        y = x * 3
+        y.retain_grads()
+        (y * y).sum().backward()
+        np.testing.assert_allclose(y.grad.numpy(), [12.0])
+        np.testing.assert_allclose(x.grad.numpy(), [36.0])
+
+    def test_setitem_grad_through(self):
+        x = t([1.0, 2.0, 3.0])
+        y = x * 2
+        y[0] = 10.0
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = t([2.0])
+        y = (x * x).sum()
+        (g,) = paddle.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), [4.0])
+        assert x.grad is None  # paddle.grad must not clobber .grad
+
+    def test_grad_unused_raises(self):
+        x = t([1.0])
+        z = t([1.0])
+        y = (x * 2).sum()
+        with pytest.raises(RuntimeError):
+            paddle.grad(y, z)
+        y2 = (x * 2).sum()
+        (g,) = paddle.grad(y2, [z], allow_unused=True)
+        assert g is None
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Double(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, g):
+                (x,) = ctx.saved_tensor()
+                return g * 2
+
+        x = t([3.0])
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(y.numpy(), [6.0])
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_pylayer_multiple_inputs(self):
+        class MulAdd(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, x, y):
+                ctx.save_for_backward(x, y)
+                return x * y + x
+
+            @staticmethod
+            def backward(ctx, g):
+                x, y = ctx.saved_tensor()
+                return g * (y + 1), g * x
+
+        x, y = t([2.0]), t([5.0])
+        MulAdd.apply(x, y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+        np.testing.assert_allclose(y.grad.numpy(), [2.0])
+
+
+class TestInplace:
+    def test_add_(self):
+        x = t([1.0, 2.0], sg=True)
+        x.add_(paddle.to_tensor([1.0, 1.0]))
+        np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+
+    def test_scale_(self):
+        x = t([2.0], sg=True)
+        x.scale_(scale=3.0)
+        np.testing.assert_allclose(x.numpy(), [6.0])
+
+    def test_zero_fill(self):
+        x = t([1.0, 2.0], sg=True)
+        x.zero_()
+        np.testing.assert_allclose(x.numpy(), [0.0, 0.0])
+        x.fill_(7.0)
+        np.testing.assert_allclose(x.numpy(), [7.0, 7.0])

@@ -1,0 +1,142 @@
+"""Op unit tests: math/elementwise — mirrors the reference's per-op OpTest
+files (SURVEY.md §4, test/legacy_test/test_*_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from optest import check_output, check_grad
+
+RNG = np.random.default_rng(7)
+
+
+def fdata(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+class TestBinaryOps:
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.add, np.add), (paddle.subtract, np.subtract),
+        (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+        (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+        (paddle.atan2, np.arctan2),
+    ])
+    def test_forward(self, op, ref):
+        x, y = fdata(3, 4), fdata(3, 4) + 2.0
+        check_output(op, ref, [x, y])
+
+    def test_broadcast(self):
+        check_output(paddle.add, np.add, [fdata(3, 1, 4), fdata(2, 4)])
+
+    @pytest.mark.parametrize("op", [paddle.add, paddle.subtract, paddle.multiply, paddle.divide])
+    def test_grad(self, op):
+        x, y = fdata(2, 3), fdata(2, 3) + 2.0
+        check_grad(op, [x, y])
+
+    def test_scalar_rhs(self):
+        x = paddle.to_tensor(fdata(2, 2))
+        np.testing.assert_allclose((x + 1.5).numpy(), x.numpy() + 1.5, rtol=1e-6)
+        np.testing.assert_allclose((2.0 * x).numpy(), 2 * x.numpy(), rtol=1e-6)
+        np.testing.assert_allclose((1.0 / (x + 10)).numpy(), 1 / (x.numpy() + 10), rtol=1e-6)
+
+    def test_int_ops(self):
+        a = np.array([7, 8, 9]); b = np.array([2, 3, 4])
+        check_output(paddle.floor_divide, np.floor_divide, [a, b])
+        check_output(paddle.mod, np.mod, [a, b])
+
+
+class TestUnaryOps:
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.exp, np.exp), (paddle.log, None), (paddle.sqrt, None),
+        (paddle.tanh, np.tanh), (paddle.sin, np.sin), (paddle.cos, np.cos),
+        (paddle.abs, np.abs), (paddle.floor, np.floor), (paddle.ceil, np.ceil),
+        (paddle.square, np.square), (paddle.sigmoid, None),
+    ])
+    def test_forward(self, op, ref):
+        x = fdata(3, 4)
+        if op in (paddle.log, paddle.sqrt):
+            x = np.abs(x) + 0.5
+            ref = {paddle.log: np.log, paddle.sqrt: np.sqrt}[op]
+        if op is paddle.sigmoid:
+            ref = lambda v: 1 / (1 + np.exp(-v))
+        check_output(op, ref, [x])
+
+    @pytest.mark.parametrize("op", [paddle.exp, paddle.tanh, paddle.sigmoid, paddle.sqrt])
+    def test_grad(self, op):
+        x = np.abs(fdata(2, 3)) + 0.5
+        check_grad(op, [x])
+
+    def test_clip(self):
+        x = fdata(4, 4) * 3
+        check_output(paddle.clip, lambda v: np.clip(v, -1, 1), [x],
+                     kwargs=dict(min=-1.0, max=1.0))
+        check_grad(paddle.clip, [x], kwargs=dict(min=-1.0, max=1.0))
+
+    def test_rsqrt(self):
+        x = np.abs(fdata(3, 3)) + 0.1
+        check_output(paddle.rsqrt, lambda v: 1 / np.sqrt(v), [x])
+
+
+class TestMatmul:
+    def test_2d(self):
+        check_output(paddle.matmul, np.matmul, [fdata(3, 4), fdata(4, 5)])
+
+    def test_batched(self):
+        check_output(paddle.matmul, np.matmul, [fdata(2, 3, 4), fdata(2, 4, 5)])
+
+    def test_transpose_flags(self):
+        x, y = fdata(4, 3), fdata(4, 5)
+        check_output(paddle.matmul, lambda a, b: a.T @ b, [x, y],
+                     kwargs=dict(transpose_x=True))
+        x2, y2 = fdata(3, 4), fdata(5, 4)
+        check_output(paddle.matmul, lambda a, b: a @ b.T, [x2, y2],
+                     kwargs=dict(transpose_y=True))
+
+    def test_grad(self):
+        check_grad(paddle.matmul, [fdata(2, 3), fdata(3, 2)])
+
+    def test_vec(self):
+        check_output(paddle.dot, lambda a, b: np.sum(a * b, -1), [fdata(5), fdata(5)])
+        check_output(paddle.mv, np.matmul, [fdata(3, 4), fdata(4)])
+
+
+class TestCumulative:
+    def test_cumsum(self):
+        x = fdata(3, 4)
+        check_output(paddle.cumsum, lambda v: np.cumsum(v, axis=1), [x],
+                     kwargs=dict(axis=1))
+        check_output(paddle.cumsum, lambda v: np.cumsum(v), [x])
+        check_grad(paddle.cumsum, [fdata(2, 3)], kwargs=dict(axis=0))
+
+    def test_cumprod(self):
+        x = np.abs(fdata(3, 4)) + 0.5
+        check_output(paddle.cumprod, lambda v: np.cumprod(v, axis=1), [x],
+                     kwargs=dict(dim=1))
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as ref  # scipy is available via jax deps
+        x = fdata(3, 4)
+        check_output(paddle.logsumexp, lambda v: ref(v, axis=1), [x],
+                     kwargs=dict(axis=1))
+
+    def test_cummax(self):
+        x = fdata(3, 5)
+        v, i = paddle.cummax(paddle.to_tensor(x), axis=1)
+        np.testing.assert_allclose(v.numpy(), np.maximum.accumulate(x, axis=1), rtol=1e-6)
+
+
+class TestScale:
+    def test_scale(self):
+        x = fdata(3, 3)
+        check_output(paddle.scale, lambda v: v * 2 + 1, [x],
+                     kwargs=dict(scale=2.0, bias=1.0))
+        check_output(paddle.scale, lambda v: (v + 1) * 2, [x],
+                     kwargs=dict(scale=2.0, bias=1.0, bias_after_scale=False))
+
+
+class TestBitwise:
+    def test_bitwise(self):
+        a = np.array([5, 6, 7], dtype=np.int32)
+        b = np.array([3, 3, 3], dtype=np.int32)
+        check_output(paddle.bitwise_and, np.bitwise_and, [a, b])
+        check_output(paddle.bitwise_or, np.bitwise_or, [a, b])
+        check_output(paddle.bitwise_xor, np.bitwise_xor, [a, b])

@@ -1,0 +1,263 @@
+"""Op unit tests: manipulation/reduction/comparison — SURVEY.md §4 style."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from optest import check_output, check_grad
+
+RNG = np.random.default_rng(11)
+
+
+def fdata(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+class TestReductions:
+    @pytest.mark.parametrize("pop,nop", [
+        (paddle.sum, np.sum), (paddle.mean, np.mean),
+        (paddle.max, np.max), (paddle.min, np.min), (paddle.prod, np.prod),
+    ])
+    def test_full(self, pop, nop):
+        check_output(pop, nop, [fdata(3, 4)])
+
+    @pytest.mark.parametrize("axis,keepdim", [(0, False), (1, True), ([0, 1], False)])
+    def test_sum_axis(self, axis, keepdim):
+        check_output(paddle.sum,
+                     lambda v: np.sum(v, axis=tuple(axis) if isinstance(axis, list) else axis,
+                                      keepdims=keepdim),
+                     [fdata(3, 4, 5)], kwargs=dict(axis=axis, keepdim=keepdim))
+
+    def test_grad(self):
+        check_grad(paddle.sum, [fdata(2, 3)])
+        check_grad(paddle.mean, [fdata(2, 3)], kwargs=dict(axis=1))
+        check_grad(paddle.max, [np.array([[1., 5., 2.], [7., 3., 4.]], dtype=np.float64)],
+                   kwargs=dict(axis=1))
+
+    def test_std_var(self):
+        x = fdata(4, 5)
+        check_output(paddle.std, lambda v: np.std(v, ddof=1), [x])
+        check_output(paddle.var, lambda v: np.var(v, axis=1, ddof=1), [x],
+                     kwargs=dict(axis=1))
+
+    def test_argmax_argmin(self):
+        x = fdata(3, 4)
+        out = paddle.argmax(paddle.to_tensor(x), axis=1)
+        np.testing.assert_array_equal(out.numpy(), np.argmax(x, axis=1))
+        assert out.dtype == np.dtype("int64")
+        out = paddle.argmin(paddle.to_tensor(x))
+        assert out.numpy() == np.argmin(x)
+
+    def test_all_any(self):
+        x = np.array([[True, False], [True, True]])
+        check_output(paddle.all, lambda v: np.all(v, axis=1), [x], kwargs=dict(axis=1))
+        check_output(paddle.any, np.any, [x])
+
+
+class TestManipulation:
+    def test_reshape_flatten(self):
+        x = fdata(2, 3, 4)
+        check_output(paddle.reshape, lambda v: v.reshape(6, 4), [x],
+                     kwargs=dict(shape=[6, 4]))
+        check_output(paddle.reshape, lambda v: v.reshape(2, 12), [x],
+                     kwargs=dict(shape=[2, -1]))
+        check_output(paddle.flatten, lambda v: v.reshape(2, 12), [x],
+                     kwargs=dict(start_axis=1))
+        check_grad(paddle.reshape, [fdata(2, 3)], kwargs=dict(shape=[3, 2]))
+
+    def test_transpose(self):
+        x = fdata(2, 3, 4)
+        check_output(paddle.transpose, lambda v: v.transpose(2, 0, 1), [x],
+                     kwargs=dict(perm=[2, 0, 1]))
+        check_grad(paddle.transpose, [fdata(2, 3)], kwargs=dict(perm=[1, 0]))
+
+    def test_squeeze_unsqueeze(self):
+        x = fdata(1, 3, 1, 4)
+        check_output(paddle.squeeze, lambda v: v.squeeze(0), [x], kwargs=dict(axis=0))
+        check_output(paddle.unsqueeze, lambda v: v[:, None], [fdata(3, 4)],
+                     kwargs=dict(axis=1))
+
+    def test_concat_stack_split(self):
+        xs = [fdata(2, 3), fdata(2, 3)]
+        t = [paddle.to_tensor(x) for x in xs]
+        np.testing.assert_allclose(paddle.concat(t, axis=1).numpy(),
+                                   np.concatenate(xs, axis=1), rtol=1e-6)
+        np.testing.assert_allclose(paddle.stack(t, axis=0).numpy(),
+                                   np.stack(xs), rtol=1e-6)
+        parts = paddle.split(paddle.to_tensor(fdata(6, 2)), 3, axis=0)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+        parts = paddle.split(paddle.to_tensor(fdata(7, 2)), [2, 5], axis=0)
+        assert parts[1].shape == [5, 2]
+        parts = paddle.split(paddle.to_tensor(fdata(7, 2)), [2, -1], axis=0)
+        assert parts[1].shape == [5, 2]
+
+    def test_tile_expand(self):
+        x = fdata(2, 3)
+        check_output(paddle.tile, lambda v: np.tile(v, (2, 1)), [x],
+                     kwargs=dict(repeat_times=[2, 1]))
+        e = paddle.expand(paddle.to_tensor(fdata(1, 3)), shape=[4, 3])
+        assert e.shape == [4, 3]
+        e = paddle.expand(paddle.to_tensor(fdata(1, 3)), shape=[4, -1])
+        assert e.shape == [4, 3]
+
+    def test_gather_scatter(self):
+        x = fdata(5, 3)
+        idx = np.array([0, 2, 4])
+        check_output(paddle.gather, lambda v: v[idx], [x],
+                     kwargs=dict(index=paddle.to_tensor(idx)))
+        base = np.zeros((5, 2), np.float32)
+        upd = fdata(2, 2)
+        out = paddle.scatter(paddle.to_tensor(base), paddle.to_tensor(np.array([1, 3])),
+                             paddle.to_tensor(upd))
+        ref = base.copy(); ref[[1, 3]] = upd
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+    def test_gather_nd(self):
+        x = fdata(3, 4, 5)
+        idx = np.array([[0, 1], [2, 3]])
+        out = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), x[[0, 2], [1, 3]], rtol=1e-6)
+
+    def test_where(self):
+        c = np.array([[True, False], [False, True]])
+        x, y = fdata(2, 2), fdata(2, 2)
+        out = paddle.where(paddle.to_tensor(c), paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), np.where(c, x, y), rtol=1e-6)
+        check_grad(lambda a, b: paddle.where(paddle.to_tensor(c), a, b), [x, y])
+
+    def test_sort_topk(self):
+        x = fdata(3, 6)
+        check_output(paddle.sort, lambda v: np.sort(v, axis=1), [x], kwargs=dict(axis=1))
+        out = paddle.argsort(paddle.to_tensor(x), axis=1, descending=True)
+        np.testing.assert_array_equal(out.numpy(), np.argsort(-x, axis=1))
+        v, i = paddle.topk(paddle.to_tensor(x), 2, axis=1)
+        ref = np.sort(x, axis=1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(v.numpy(), ref, rtol=1e-6)
+
+    def test_index_select_masked(self):
+        x = fdata(4, 3)
+        out = paddle.index_select(paddle.to_tensor(x), paddle.to_tensor(np.array([1, 3])), axis=0)
+        np.testing.assert_allclose(out.numpy(), x[[1, 3]], rtol=1e-6)
+        m = x > 0
+        out = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(m))
+        np.testing.assert_allclose(out.numpy(), x[m], rtol=1e-6)
+        out = paddle.masked_fill(paddle.to_tensor(x), paddle.to_tensor(m), 0.0)
+        np.testing.assert_allclose(out.numpy(), np.where(m, 0, x), rtol=1e-6)
+
+    def test_pad(self):
+        x = fdata(2, 3)
+        out = paddle.pad(paddle.to_tensor(x), [1, 1, 2, 2], value=9.0)
+        ref = np.pad(x, [(1, 1), (2, 2)], constant_values=9.0)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+    def test_flip_roll(self):
+        x = fdata(3, 4)
+        check_output(paddle.flip, lambda v: np.flip(v, 1), [x], kwargs=dict(axis=[1]))
+        check_output(paddle.roll, lambda v: np.roll(v, 2, axis=0), [x],
+                     kwargs=dict(shifts=2, axis=0))
+
+    def test_take_along_put_along(self):
+        x = fdata(3, 4)
+        idx = np.argsort(x, axis=1)
+        out = paddle.take_along_axis(paddle.to_tensor(x), paddle.to_tensor(idx), axis=1)
+        np.testing.assert_allclose(out.numpy(), np.take_along_axis(x, idx, 1), rtol=1e-6)
+        out = paddle.put_along_axis(paddle.to_tensor(x),
+                                    paddle.to_tensor(np.array([[0], [1], [2]])),
+                                    0.0, axis=1)
+        ref = x.copy(); np.put_along_axis(ref, np.array([[0], [1], [2]]), 0.0, 1)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+    def test_unique(self):
+        x = np.array([2, 1, 3, 1, 2])
+        out = paddle.unique(paddle.to_tensor(x))
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+        v, c = paddle.unique(paddle.to_tensor(x), return_counts=True)
+        np.testing.assert_array_equal(c.numpy(), [2, 2, 1])
+
+    def test_nonzero(self):
+        x = np.array([[1, 0], [0, 3]])
+        out = paddle.nonzero(paddle.to_tensor(x))
+        np.testing.assert_array_equal(out.numpy(), [[0, 0], [1, 1]])
+
+
+class TestComparison:
+    def test_compare(self):
+        x, y = fdata(3, 3), fdata(3, 3)
+        t = paddle.to_tensor
+        np.testing.assert_array_equal((t(x) > t(y)).numpy(), x > y)
+        np.testing.assert_array_equal((t(x) == t(x)).numpy(), np.ones_like(x, bool))
+        np.testing.assert_array_equal(paddle.less_equal(t(x), t(y)).numpy(), x <= y)
+
+    def test_allclose_equal_all(self):
+        x = fdata(2, 2)
+        assert bool(paddle.allclose(paddle.to_tensor(x), paddle.to_tensor(x + 1e-9)))
+        assert bool(paddle.equal_all(paddle.to_tensor(x), paddle.to_tensor(x)))
+
+    def test_logical(self):
+        a = np.array([True, False, True]); b = np.array([True, True, False])
+        check_output(paddle.logical_and, np.logical_and, [a, b])
+        check_output(paddle.logical_not, np.logical_not, [a])
+
+
+class TestCreation:
+    def test_basics(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2], dtype="int32").dtype == np.dtype("int32")
+        assert paddle.full([2, 2], 7.0).numpy()[0, 0] == 7
+        np.testing.assert_array_equal(paddle.arange(2, 8, 2).numpy(), [2, 4, 6])
+        assert paddle.eye(3).numpy().trace() == 3
+        x = paddle.to_tensor([1.0, 2.0])
+        np.testing.assert_array_equal(paddle.zeros_like(x).numpy(), [0, 0])
+
+    def test_tril_triu(self):
+        x = fdata(4, 4)
+        check_output(paddle.tril, np.tril, [x])
+        check_output(paddle.triu, lambda v: np.triu(v, 1), [x], kwargs=dict(diagonal=1))
+
+    def test_random_shapes(self):
+        assert paddle.rand([3, 4]).shape == [3, 4]
+        assert paddle.randn([2]).dtype == np.dtype("float32")
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(10))
+
+    def test_seed_reproducible(self):
+        paddle.seed(42)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(42)
+        b = paddle.randn([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+
+class TestLinalg:
+    def test_solve_inv(self):
+        a = fdata(3, 3) + 3 * np.eye(3, dtype=np.float32)
+        b = fdata(3, 2)
+        check_output(paddle.linalg.solve, np.linalg.solve, [a, b], rtol=1e-4)
+        check_output(paddle.linalg.inv, np.linalg.inv, [a], rtol=1e-4)
+
+    def test_qr_svd(self):
+        a = fdata(4, 3)
+        q, r = paddle.linalg.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose((q.numpy() @ r.numpy()), a, atol=1e-5)
+        u, s, v = paddle.linalg.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, a, atol=1e-5)
+
+    def test_det_cholesky(self):
+        a = fdata(3, 3)
+        spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        check_output(paddle.linalg.det, np.linalg.det, [spd], rtol=1e-4)
+        l = paddle.linalg.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(l.numpy() @ l.numpy().T, spd, atol=1e-4)
+
+    def test_einsum(self):
+        a, b = fdata(3, 4), fdata(4, 5)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+        check_grad(lambda x, y: paddle.einsum("ij,jk->ik", x, y), [fdata(2, 3), fdata(3, 2)])
+
+    def test_norm(self):
+        x = fdata(3, 4)
+        check_output(paddle.norm, np.linalg.norm, [x], rtol=1e-5)
+        check_output(paddle.norm, lambda v: np.linalg.norm(v, axis=1), [x],
+                     kwargs=dict(p=2, axis=1), rtol=1e-5)
