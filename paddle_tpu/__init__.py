@@ -44,6 +44,11 @@ from .ops import *  # noqa: F401,F403
 from . import ops  # noqa: F401
 from .ops.linalg import fft  # noqa: F401
 
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from .nn.layer import ParamAttr  # noqa: F401
+
 
 def is_grad_enabled():
     return autograd.is_grad_enabled()

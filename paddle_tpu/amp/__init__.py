@@ -1,0 +1,212 @@
+"""AMP — paddle.amp parity (python/paddle/amp/: auto_cast O1/O2 lists,
+GradScaler with dynamic loss scaling, decorate() master weights —
+upstream-canonical, unverified, SURVEY.md §0).
+
+TPU-native stance (SURVEY.md §2.4 AMP row): bf16 is the native mixed-precision
+dtype — no loss scaling needed (bf16 has fp32's exponent range), so
+GradScaler degrades to a pass-through when scaling is unnecessary while
+keeping the fp16 dynamic-scaling machinery for API/numeric parity.
+auto_cast is implemented at the op-dispatch layer: a thread-local policy the
+eager op wrapper consults to cast float inputs of whitelist ops.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+
+# O1 lists — mirrors the reference's white/black list semantics: whitelist ops
+# run in low precision; blacklist ops stay fp32; everything else follows its
+# inputs.
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "sdpa", "flash_attention", "addmm",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "cross_entropy",
+    "softmax_with_cross_entropy", "mean", "sum", "cumsum", "softmax",
+    "log_softmax", "layer_norm", "batch_norm", "group_norm", "instance_norm",
+    "rms_norm", "norm", "dist", "cosine_similarity", "pow", "square", "mse_loss",
+    "nll_loss", "binary_cross_entropy", "bce_with_logits", "kl_div",
+}
+
+_state = threading.local()
+
+
+def _amp_state():
+    if not hasattr(_state, "enabled"):
+        _state.enabled = False
+        _state.dtype = dtypes.bfloat16
+        _state.level = "O1"
+        _state.custom_white = set()
+        _state.custom_black = set()
+    return _state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    st = _amp_state()
+    prev = (st.enabled, st.dtype, st.level, st.custom_white, st.custom_black)
+    st.enabled = bool(enable)
+    st.dtype = dtypes.convert_dtype(dtype)
+    st.level = level
+    st.custom_white = set(custom_white_list or ())
+    st.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (st.enabled, st.dtype, st.level, st.custom_white, st.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def amp_dtype_for_op(op_name: str) -> Optional[np.dtype]:
+    """Consulted by the eager dispatcher (ops/_registry.eager): returns the
+    dtype to cast float inputs to, or None to leave them alone."""
+    st = _amp_state()
+    if not st.enabled:
+        return None
+    if st.level == "O2":
+        if op_name in BLACK_LIST or op_name in st.custom_black:
+            return dtypes.float32
+        return st.dtype
+    white = (WHITE_LIST | st.custom_white) - st.custom_black
+    if op_name in white:
+        return st.dtype
+    if op_name in (BLACK_LIST | st.custom_black):
+        return dtypes.float32
+    return None
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to low precision; optimizer keeps fp32 masters
+    (our Optimizer(multi_precision=True) path)."""
+    d = dtypes.convert_dtype(dtype)
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    for m in model_list:
+        if m is None:
+            continue
+        for _, p in m.named_parameters():
+            if dtypes.is_floating_point(p.dtype):
+                p._data = p._data.astype(d)
+    opt_list = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+    for o in opt_list:
+        if o is not None:
+            o._multi_precision = True if master_weight is None else bool(master_weight)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling — needed for fp16; bf16 path is a no-op scale of
+    1.0 (enable_loss_scaling=False equivalent), matching the reference's
+    GradScaler API (python/paddle/amp/grad_scaler.py, unverified)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = set()  # ids of optimizers already unscaled this cycle
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        """Idempotent per step-cycle — calling unscale_ then step() must not
+        divide gradients by the scale twice (clip-before-step pattern)."""
+        if not self._enable or id(optimizer) in self._unscaled:
+            return
+        self._unscaled.add(id(optimizer))
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad._data * inv
+                p.grad = Tensor(g, stop_gradient=True)
+                if not bool(jnp.all(jnp.isfinite(g.astype(jnp.float32)))):
+                    found = True
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled.discard(id(optimizer))
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+    set_state_dict = load_state_dict
+
+
+def is_bfloat16_supported(place=None):
+    return True
+
+
+def is_float16_supported(place=None):
+    return True

@@ -163,12 +163,21 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
                 g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
         return g
 
+    def _cast_to_param_dtype(t: Tensor, g):
+        # AMP: a fp32 param used by a bf16 whitelist op gets a bf16 vjp grad;
+        # .grad must accumulate in the param's dtype (reference AMP contract)
+        td = np.dtype(t._data.dtype)
+        if td.kind in "fc" and np.dtype(g.dtype) != td:
+            return g.astype(td)
+        return g
+
     def _write_grad(t: Tensor, g):
         g = _apply_hooks(t, g)
         if t.stop_gradient:
             return
         if _grad_filter is not None and id(t) not in _grad_filter:
             return
+        g = _cast_to_param_dtype(t, g)
         if t.grad is None:
             t.grad = Tensor(g, stop_gradient=True)
         else:
@@ -196,8 +205,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
         slots = pending.get(id(node))
         if slots is None or all(s is None for s in slots):
             continue  # node not on the path from the seeded outputs
+        # cast cotangents to the node's output dtype — at AMP boundaries the
+        # downstream grad may be fp32 while this node's output was bf16
         cotangents = tuple(
-            s if s is not None else _zero_cotangent(*aval)
+            (s.astype(aval[1]) if np.dtype(s.dtype) != aval[1] else s)
+            if s is not None else _zero_cotangent(*aval)
             for s, aval in zip(slots, node.out_avals)
         )
         if node.vjp_fn is None:
@@ -218,10 +230,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             pn = t._grad_node
             if (pn is None or t._retain_grads) and (
                     _grad_filter is None or id(t) in _grad_filter):
+                gw = _cast_to_param_dtype(t, g)
                 if t.grad is None:
-                    t.grad = Tensor(g, stop_gradient=True)
+                    t.grad = Tensor(gw, stop_gradient=True)
                 else:
-                    t.grad = Tensor(t.grad._data + g, stop_gradient=True)
+                    t.grad = Tensor(t.grad._data + gw, stop_gradient=True)
             if pn is not None:
                 nid = id(pn)
                 pslots = pending.setdefault(nid, [None] * pn.n_outputs)

@@ -1,0 +1,11 @@
+"""paddle_tpu.kernels — hot-op kernels.
+
+Reference parity: paddle/phi/kernels/fusion/ (flash_attention, fused
+rms/layer_norm, fused rope, MoE dispatch — upstream-canonical, unverified,
+SURVEY.md §0). TPU-native design per SURVEY.md §2.6: the CUDA fusion kernels
+become Pallas TPU kernels; each op ships a pure-jnp reference implementation
+(`*_ref`) used on CPU and for correctness tests, with the Pallas version
+selected on TPU when FLAGS_use_pallas is set.
+"""
+from . import rms_norm, rope, flash_attention  # noqa: F401
+from .flash_attention import flash_attention_fwd  # noqa: F401

@@ -1,0 +1,169 @@
+"""Flash attention: jnp reference + Pallas TPU kernel.
+
+Reference analog: paddle/phi/kernels/fusion flash_attn_kernel wrapping
+third_party/flashattn (upstream-canonical, unverified — SURVEY.md §0).
+TPU-native design: a Pallas splash-style blocked-softmax kernel (online
+softmax over KV blocks held in VMEM) with a custom VJP; the jnp reference
+path is exact softmax(QK^T)V used on CPU and in tests. Layout is
+[batch, seq, heads, head_dim] (paddle flash_attention layout).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def mha_ref(q, k, v, *, causal=False, bias=None, scale=None, mask=None):
+    """Exact attention reference. q,k,v: [B, S, H, D] → [B, S, H, D].
+    Supports GQA: k/v may have fewer heads (H % Hkv == 0)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if bias is not None:
+        logits = logits + bias
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        cm = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(cm[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel (forward). Grid: (batch*heads, q_blocks); the kernel
+# streams KV blocks with an online-softmax accumulator in VMEM scratch.
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
+                      seq_k):
+    from jax.experimental import pallas as pl
+
+    # q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq_k, d]; o_ref: [1, block_q, d]
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * scale
+    q_idx = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    qblk = pl.program_id(1)
+    q_offset = qblk * block_q
+
+    def body(kb, carry):
+        m_prev, l_prev, acc = carry
+        k_blk = pl.load(k_ref, (0, pl.ds(kb * block_k, block_k), slice(None))).astype(jnp.float32)
+        v_blk = pl.load(v_ref, (0, pl.ds(kb * block_k, block_k), slice(None))).astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            k_idx = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + kb * block_k
+            causal_mask = (q_idx + q_offset) >= k_idx
+            s = jnp.where(causal_mask, s, NEG_INF)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_cur[:, None])
+        alpha = jnp.exp(m_prev - m_cur)
+        l_cur = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        return m_cur, l_cur, acc
+
+    n_kb = seq_k // block_k
+    if causal:
+        # only blocks up to the diagonal contribute
+        last = (q_offset + block_q + block_k - 1) // block_k
+        n_iter = jnp.minimum(last, n_kb)
+    else:
+        n_iter = n_kb
+    m0 = jnp.full((block_q,), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q,), dtype=jnp.float32)
+    a0 = jnp.zeros((block_q, d), dtype=jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_iter, body, (m0, l0, a0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k"))
+def flash_attention_pallas(q, k, v, causal=False, scale=None, block_q=256,
+                           block_k=256):
+    """q,k,v: [B, S, H, D] (equal heads; GQA expanded by caller)."""
+    from jax.experimental import pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    # layout: fold batch*heads into the grid's first dim
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    grid = (b * h, sq // block_q)
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, block_k=block_k, causal=causal,
+                          scale=scale, seq_k=sk),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, qb: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0)),
+    )(qt, kt, vt)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _use_pallas(x):
+    from ..core.flags import flag
+
+    try:
+        plat = next(iter(x.devices())).platform
+    except Exception:
+        return False
+    return bool(flag("FLAGS_use_pallas")) and plat not in ("cpu",)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_fwd(q, k, v, causal=False, scale=None):
+    """Differentiable flash attention entry. Forward may run the Pallas
+    kernel; backward uses the exact reference (recomputed — flash-style
+    memory behavior, O(S) residuals instead of O(S^2))."""
+    return _flash_impl(q, k, v, causal, scale)
+
+
+def _flash_impl(q, k, v, causal, scale):
+    hq, hkv = q.shape[2], k.shape[2]
+    if _use_pallas(q) and q.shape[1] % 256 == 0 and k.shape[1] % 256 == 0:
+        if hq != hkv:
+            rep = hq // hkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        try:
+            return flash_attention_pallas(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return mha_ref(q, k, v, causal=causal, scale=scale)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale):
+    out = _flash_impl(q, k, v, causal, scale)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: mha_ref(q_, k_, v_, causal=causal,
+                                                scale=scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention_fwd.defvjp(_flash_fwd_rule, _flash_bwd_rule)

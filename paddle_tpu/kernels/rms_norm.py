@@ -1,0 +1,77 @@
+"""RMSNorm kernel: jnp reference + Pallas TPU version.
+
+Reference analog: paddle/phi/kernels/fusion/gpu rms_norm (upstream-canonical,
+unverified — SURVEY.md §0). On TPU the win is fusing the reduce + scale into
+one VMEM pass instead of XLA's usual two; the Pallas kernel tiles rows into
+VMEM blocks (lane dim = feature).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm_ref(x, weight=None, epsilon: float = 1e-6):
+    """Reference path (CPU + fallback). Accumulates in f32 for bf16 inputs —
+    same accumulation contract as the reference's fused kernel."""
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(ms + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    return out.astype(dt)
+
+
+def _rms_norm_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    out = x * jax.lax.rsqrt(ms + eps)
+    o_ref[:] = (out * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("epsilon", "block_rows"))
+def rms_norm_pallas(x, weight, epsilon: float = 1e-6, block_rows: int = 256):
+    """Pallas TPU path: rows blocked into VMEM, feature dim as lanes."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    orig_shape = x.shape
+    d = x.shape[-1]
+    xr = x.reshape(-1, d)
+    n = xr.shape[0]
+    blk = min(block_rows, n)
+    # pad rows to a multiple of the block
+    pad = (-n) % blk
+    if pad:
+        xr = jnp.pad(xr, ((0, pad), (0, 0)))
+    grid = (xr.shape[0] // blk,)
+    out = pl.pallas_call(
+        functools.partial(_rms_norm_kernel, eps=epsilon),
+        out_shape=jax.ShapeDtypeStruct(xr.shape, x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((blk, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((blk, d), lambda i: (i, 0)),
+    )(xr, weight)
+    if pad:
+        out = out[:n]
+    return out.reshape(orig_shape)
+
+
+def rms_norm(x, weight=None, epsilon: float = 1e-6):
+    """Dispatch: Pallas on TPU (when enabled + weight present), ref otherwise."""
+    from ..core.flags import flag
+
+    on_tpu = x.devices() and next(iter(x.devices())).platform != "cpu" \
+        if hasattr(x, "devices") else False
+    if flag("FLAGS_use_pallas") and on_tpu and weight is not None and x.shape[-1] % 128 == 0:
+        try:
+            return rms_norm_pallas(x, weight, epsilon)
+        except Exception:
+            pass  # fall back to the reference path (e.g. interpret contexts)
+    return rms_norm_ref(x, weight, epsilon)

@@ -1,0 +1,70 @@
+"""Rotary position embedding (RoPE) — fused rope kernel analog.
+
+Reference analog: paddle/phi/kernels/fusion fused_rope (upstream-canonical,
+unverified — SURVEY.md §0). The jnp form fuses fine under XLA (pure
+elementwise); a Pallas version buys little, so this stays XLA-native by
+design — the TPU-first answer is 'let the compiler fuse it into the
+surrounding matmuls'.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_freqs(head_dim: int, max_seq: int, base: float = 10000.0,
+               dtype=jnp.float32):
+    """Precompute cos/sin tables [max_seq, head_dim//2]."""
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(q, k, cos, sin, position_ids=None):
+    """q,k: [B, S, H, D] (or [B,S,D]); cos/sin: [S_max, D/2] tables.
+
+    Rotates pairs (x[2i], x[2i+1]) — "interleaved" convention matched to the
+    reference's fused_rotary_position_embedding default (use_neox=False
+    equivalence is handled by the caller's weight layout).
+    """
+    def rot(x):
+        d = x.shape[-1]
+        if position_ids is None:
+            c = cos[: x.shape[1], : d // 2]
+            s = sin[: x.shape[1], : d // 2]
+        else:
+            c = jnp.take(cos, position_ids, axis=0)[..., : d // 2]
+            s = jnp.take(sin, position_ids, axis=0)[..., : d // 2]
+        # broadcast over head dim: [B,S,1,D/2]
+        while c.ndim < x.ndim - 1:
+            c = c[:, :, None] if c.ndim == 2 else c[..., None, :]
+            s = s[:, :, None] if s.ndim == 2 else s[..., None, :]
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        o1 = x1 * c - x2 * s
+        o2 = x2 * c + x1 * s
+        return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def apply_rope_half(q, k, cos, sin, position_ids=None):
+    """NeoX/Llama 'rotate_half' convention: split head dim in halves."""
+    def rot(x):
+        d = x.shape[-1]
+        if position_ids is None:
+            c = jnp.concatenate([cos[: x.shape[1], : d // 2]] * 2, axis=-1)
+            s = jnp.concatenate([sin[: x.shape[1], : d // 2]] * 2, axis=-1)
+        else:
+            cc = jnp.take(cos, position_ids, axis=0)[..., : d // 2]
+            ss = jnp.take(sin, position_ids, axis=0)[..., : d // 2]
+            c = jnp.concatenate([cc, cc], axis=-1)
+            s = jnp.concatenate([ss, ss], axis=-1)
+        while c.ndim < x.ndim:
+            c = c[:, :, None, :] if c.ndim == 3 else c[None]
+            s = s[:, :, None, :] if s.ndim == 3 else s[None]
+        half = x.shape[-1] // 2
+        rot_x = jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+        return (x * c + rot_x * s).astype(x.dtype)
+
+    return rot(q), rot(k)

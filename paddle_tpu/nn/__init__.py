@@ -1,0 +1,88 @@
+"""paddle_tpu.nn — layer zoo + functional.
+
+Reference parity: python/paddle/nn/ (~200 Layer classes — upstream-canonical,
+unverified, SURVEY.md §0)."""
+from .layer import Layer, ParamAttr  # noqa: F401
+from . import initializer  # noqa: F401
+from . import functional  # noqa: F401
+from . import functional as F  # noqa: F401
+
+from .layers_common import (  # noqa: F401
+    Identity, Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    Flatten, Unflatten, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    PixelShuffle, PixelUnshuffle, ChannelShuffle, Pad1D, Pad2D, Pad3D,
+    ZeroPad2D, CosineSimilarity, PairwiseDistance, Sequential, LayerList,
+    ParameterList, LayerDict,
+)
+from .layers_conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+    MaxPool1D, MaxPool2D, AvgPool1D, AvgPool2D, AdaptiveAvgPool1D,
+    AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool2D,
+    LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+    SyncBatchNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm, SpectralNorm,
+)
+from .layers_act_loss import (  # noqa: F401
+    ReLU, ReLU6, GELU, SiLU, Swish, ELU, SELU, CELU, LeakyReLU, Hardshrink,
+    Softshrink, Tanhshrink, Hardtanh, Hardsigmoid, Hardswish, Mish, Softplus,
+    Softsign, LogSigmoid, Tanh, Sigmoid, LogSoftmax, Softmax, Maxout, PReLU,
+    ThresholdedReLU,
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    SmoothL1Loss, KLDivLoss, MarginRankingLoss, TripletMarginLoss,
+    CosineEmbeddingLoss, HingeEmbeddingLoss,
+)
+from .layers_transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layers_rnn import (  # noqa: F401
+    SimpleRNNCell, LSTMCell, GRUCell, SimpleRNN, LSTM, GRU, RNN, BiRNN,
+)
+
+from ..ops._registry import adopt_inplace as _  # noqa: F401  (import check)
+
+
+def utils_clip_grad_norm_(parameters, max_norm, norm_type=2.0):
+    """paddle.nn.utils.clip_grad_norm_ parity."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros((), dtype=jnp.float32))
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(p.grad._data)) for p in params)) \
+        if norm_type == 2.0 else \
+        jnp.power(sum(jnp.sum(jnp.power(jnp.abs(p.grad._data), norm_type))
+                      for p in params), 1.0 / norm_type)
+    clip = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad._data = p.grad._data * clip
+    return Tensor(total)
+
+
+class _Utils:
+    clip_grad_norm_ = staticmethod(utils_clip_grad_norm_)
+
+    @staticmethod
+    def clip_grad_value_(parameters, clip_value):
+        import jax.numpy as jnp
+        for p in parameters:
+            if p.grad is not None:
+                p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)
+
+    @staticmethod
+    def parameters_to_vector(parameters):
+        from ..ops.manipulation import concat
+        return concat([p.flatten() for p in parameters], axis=0)
+
+    @staticmethod
+    def vector_to_parameters(vec, parameters):
+        import numpy as np
+        offset = 0
+        for p in parameters:
+            n = p.size
+            p.set_value(vec[offset:offset + n].reshape(p.shape))
+            offset += n
+
+
+utils = _Utils()

@@ -1,0 +1,13 @@
+"""paddle_tpu.nn.functional — flat functional namespace (F.*).
+
+Reference parity: python/paddle/nn/functional/ (upstream-canonical,
+unverified — SURVEY.md §0)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+
+from ...ops.manipulation import pad  # noqa: F401  (F.pad is the same op)
+from ...ops.creation import one_hot  # noqa: F401

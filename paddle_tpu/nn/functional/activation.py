@@ -1,0 +1,113 @@
+"""Activation functionals — python/paddle/nn/functional/activation.py parity
+(upstream-canonical, unverified — SURVEY.md §0)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops._registry import defop, as_array, eager
+
+relu = defop("relu", lambda x, name=None: jax.nn.relu(x))
+relu6 = defop("relu6", lambda x, name=None: jnp.clip(x, 0, 6))
+relu_ = None  # in-place attached by nn/functional/__init__
+
+
+def _gelu_raw(x, approximate=False, name=None):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+gelu = defop("gelu", _gelu_raw)
+silu = defop("silu", lambda x, name=None: jax.nn.silu(x))
+swish = defop("swish", lambda x, name=None: jax.nn.silu(x))
+elu = defop("elu", lambda x, alpha=1.0, name=None: jax.nn.elu(x, alpha=alpha))
+selu = defop("selu", lambda x,
+             scale=1.0507009873554804934193349852946,
+             alpha=1.6732632423543772848170429916717, name=None:
+             scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)))
+celu = defop("celu", lambda x, alpha=1.0, name=None: jax.nn.celu(x, alpha=alpha))
+leaky_relu = defop("leaky_relu", lambda x, negative_slope=0.01, name=None:
+                   jax.nn.leaky_relu(x, negative_slope=negative_slope))
+prelu = defop("prelu", lambda x, weight, data_format="NCHW", name=None:
+              _prelu_raw(x, as_array(weight), data_format))
+
+
+def _prelu_raw(x, w, data_format):
+    if w.size == 1:
+        slope = w.reshape(())
+    else:
+        shape = [1] * x.ndim
+        axis = 1 if data_format[1] == "C" else x.ndim - 1
+        shape[axis] = w.size
+        slope = w.reshape(shape)
+    return jnp.where(x >= 0, x, slope * x)
+
+
+rrelu = defop("rrelu", lambda x, lower=1. / 8., upper=1. / 3., training=True, name=None:
+              jnp.where(x >= 0, x, x * ((lower + upper) / 2)))
+hardshrink = defop("hardshrink", lambda x, threshold=0.5, name=None:
+                   jnp.where(jnp.abs(x) > threshold, x, 0.0))
+softshrink = defop("softshrink", lambda x, threshold=0.5, name=None:
+                   jnp.where(x > threshold, x - threshold,
+                             jnp.where(x < -threshold, x + threshold, 0.0)))
+tanhshrink = defop("tanhshrink", lambda x, name=None: x - jnp.tanh(x))
+hardtanh = defop("hardtanh", lambda x, min=-1.0, max=1.0, name=None:
+                 jnp.clip(x, min, max))
+hardsigmoid = defop("hardsigmoid", lambda x, slope=0.1666667, offset=0.5, name=None:
+                    jnp.clip(x * slope + offset, 0.0, 1.0))
+hardswish = defop("hardswish", lambda x, name=None:
+                  x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0)
+mish = defop("mish", lambda x, name=None: x * jnp.tanh(jax.nn.softplus(x)))
+softplus = defop("softplus", lambda x, beta=1.0, threshold=20.0, name=None:
+                 jnp.where(x * beta > threshold, x,
+                           (1.0 / beta) * jnp.log1p(jnp.exp(beta * x))))
+softsign = defop("softsign", lambda x, name=None: jax.nn.soft_sign(x))
+log_sigmoid = defop("log_sigmoid", lambda x, name=None: jax.nn.log_sigmoid(x))
+tanh = defop("f_tanh", lambda x, name=None: jnp.tanh(x))
+sigmoid = defop("f_sigmoid", lambda x, name=None: jax.nn.sigmoid(x))
+
+
+def _softmax_raw(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtypes
+    if dtype is not None:
+        x = x.astype(dtypes.convert_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+softmax = defop("softmax", _softmax_raw)
+log_softmax = defop("log_softmax", lambda x, axis=-1, dtype=None, name=None:
+                    jax.nn.log_softmax(x, axis=axis))
+gumbel_softmax = defop("gumbel_softmax", lambda x, temperature=1.0, hard=False, axis=-1, name=None:
+                       _gumbel_softmax_raw(x, temperature, hard, axis))
+
+
+def _gumbel_softmax_raw(x, temperature, hard, axis):
+    from ...core import random as prandom
+    g = jax.random.gumbel(prandom.next_key(), x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        one_hot = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis],
+                                 axis=axis, dtype=y.dtype)
+        y = jax.lax.stop_gradient(one_hot - y) + y  # straight-through
+    return y
+
+
+def _glu_raw(x, axis=-1, name=None):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+glu = defop("glu", _glu_raw)
+
+
+def _maxout_raw(x, groups, axis=1, name=None):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+maxout = defop("maxout", _maxout_raw)
+thresholded_relu = defop("thresholded_relu", lambda x, threshold=1.0, name=None:
+                         jnp.where(x > threshold, x, 0.0))

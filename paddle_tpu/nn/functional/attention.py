@@ -1,0 +1,47 @@
+"""Attention functionals — paddle.nn.functional.flash_attention +
+scaled_dot_product_attention parity (reference: paddle/phi/kernels/fusion
+flash_attn + python/paddle/nn/functional/flash_attention.py —
+upstream-canonical, unverified, SURVEY.md §0)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ...ops._registry import eager, as_array
+from ...kernels.flash_attention import flash_attention_fwd, mha_ref
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """q/k/v: [B, S, H, D] (paddle layout)."""
+    if attn_mask is None and dropout_p == 0.0:
+        return eager(lambda q, k, v: flash_attention_fwd(q, k, v, is_causal, None),
+                     (query, key, value), {}, name="sdpa")
+
+    mask = None if attn_mask is None else as_array(attn_mask)
+
+    def raw(q, k, v):
+        bias = None
+        m = mask
+        if m is not None and m.dtype != jnp.bool_:
+            bias, m = m, None
+        return mha_ref(q, k, v, causal=is_causal, bias=bias, mask=m)
+
+    return eager(raw, (query, key, value), {}, name="sdpa")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    out = eager(lambda q, k, v: flash_attention_fwd(q, k, v, causal, None),
+                (query, key, value), {}, name="flash_attention")
+    return out, None  # (out, softmax) — softmax never materialized (flash)
+
+
+def flash_attn_unpadded(*args, **kwargs):
+    raise NotImplementedError(
+        "flash_attn_unpadded (varlen): deferred — XLA prefers fixed shapes; "
+        "pack ragged batches with attention masks instead "
+        "(paddle_tpu/nn/functional/attention.py)")

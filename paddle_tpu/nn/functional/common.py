@@ -1,0 +1,261 @@
+"""Common functionals: linear, dropout, embedding, normalize, interpolate,
+pixel ops — python/paddle/nn/functional/common.py + input.py parity
+(upstream-canonical, unverified — SURVEY.md §0)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops._registry import defop, as_array, eager
+from ...core.tensor import Tensor
+from ...core import random as prandom
+
+
+def _linear_raw(x, weight, bias=None, name=None):
+    # paddle weight layout is [in_features, out_features] (no transpose —
+    # feeds the MXU directly as x @ w)
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def linear(x, weight, bias=None, name=None):
+    if bias is None:
+        return eager(_linear_raw, (x, weight), {}, name="linear")
+    return eager(_linear_raw, (x, weight, bias), {}, name="linear")
+
+
+def _dropout_raw(x, p, training, mode, key):
+    if not training or p == 0.0:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)  # downscale_in_infer trains unscaled
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    key = prandom.next_key()
+    if axis is not None:
+        # broadcast mask along non-listed axes
+        axes = [axis] if isinstance(axis, int) else list(axis)
+
+        def raw(a):
+            shape = [a.shape[i] if i in axes else 1 for i in range(a.ndim)]
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(key, keep, tuple(shape))
+            scale = 1.0 / keep if mode == "upscale_in_train" else 1.0
+            return jnp.where(mask, a * scale, 0.0).astype(a.dtype)
+
+        return eager(raw, (x,), {}, name="dropout")
+    return eager(lambda a: _dropout_raw(a, p, training, mode, key), (x,), {},
+                 name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    key = prandom.next_key()
+    alpha = 1.6732632423543772848170429916717
+    scale = 1.0507009873554804934193349852946
+    alpha_p = -alpha * scale
+
+    def raw(a):
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(key, keep, a.shape)
+        A = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        B = -A * alpha_p * (1 - keep)
+        return (A * jnp.where(mask, a, alpha_p) + B).astype(a.dtype)
+
+    return eager(raw, (x,), {}, name="alpha_dropout")
+
+
+def _embedding_raw(x, weight, padding_idx=None, sparse=False, name=None):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    idx = as_array(x)
+    return eager(lambda w: _embedding_raw(idx, w, padding_idx), (weight,), {},
+                 name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    from ...core import dtype as dtypes
+    return Tensor(jax.nn.one_hot(as_array(x), num_classes,
+                                 dtype=dtypes.get_default_dtype()))
+
+
+def _normalize_raw(x, p=2, axis=1, epsilon=1e-12, name=None):
+    norm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True), 1.0 / p)
+    return x / jnp.maximum(norm, epsilon)
+
+
+normalize = defop("normalize", _normalize_raw)
+cosine_similarity = defop("cosine_similarity", lambda x1, x2, axis=1, eps=1e-8, name=None:
+                          _cos_sim_raw(x1, as_array(x2), axis, eps))
+
+
+def _cos_sim_raw(x1, x2, axis, eps):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def _interpolate_raw(x, size=None, scale_factor=None, mode="nearest",
+                     align_corners=False, align_mode=0, data_format="NCHW",
+                     name=None):
+    # NCHW assumed; NHWC handled by transpose
+    chan_last = data_format in ("NHWC", "NWC", "NDHWC")
+    if chan_last:
+        x = jnp.moveaxis(x, -1, 1)
+    spatial = x.shape[2:]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = tuple(int(s * f) for s, f in zip(spatial, scale_factor))
+    else:
+        size = tuple(int(v) for v in (size.numpy() if isinstance(size, Tensor) else size))
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "trilinear": "linear", "linear": "linear", "area": "linear"}[mode]
+    out_shape = x.shape[:2] + size
+    out = jax.image.resize(x, out_shape, method=method)
+    if chan_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+interpolate = defop("interpolate", _interpolate_raw)
+upsample = interpolate
+
+
+def _pixel_shuffle_raw(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+pixel_shuffle = defop("pixel_shuffle", _pixel_shuffle_raw)
+
+
+def _pixel_unshuffle_raw(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = x.transpose(0, 1, 3, 5, 2, 4)
+    return x.reshape(n, c * r * r, h // r, w // r)
+
+
+pixel_unshuffle = defop("pixel_unshuffle", _pixel_unshuffle_raw)
+
+
+def _channel_shuffle_raw(x, groups, data_format="NCHW", name=None):
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    x = x.transpose(0, 2, 1, 3, 4)
+    return x.reshape(n, c, h, w)
+
+
+channel_shuffle = defop("channel_shuffle", _channel_shuffle_raw)
+
+
+def _unfold_raw(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    # im2col: [N, C, H, W] -> [N, C*kh*kw, L] — reference exposes this as
+    # paddle.nn.functional.unfold; XLA's conv patch helper is the native path
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    ph, pw = pair(paddings) if not (isinstance(paddings, (list, tuple)) and len(paddings) == 4) else (0, 0)
+    dh, dw = pair(dilations)
+    if isinstance(paddings, (list, tuple)) and len(paddings) == 4:
+        pads = [(paddings[0], paddings[1]), (paddings[2], paddings[3])]
+    else:
+        pads = [(ph, ph), (pw, pw)]
+    n, c = x.shape[0], x.shape[1]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), pads, rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, OH, OW]
+    return patches.reshape(n, c * kh * kw, -1)
+
+
+unfold = defop("unfold", _unfold_raw)
+
+
+def _fold_raw(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    ph, pw = pair(paddings)
+    dh, dw = pair(dilations)
+    n, ckk, l = x.shape
+    c = ckk // (kh * kw)
+    ohh = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    oww = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = x.reshape(n, c, kh, kw, ohh, oww)
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), dtype=x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wj = j * dw
+            out = out.at[:, :, hi:hi + sh * ohh:sh, wj:wj + sw * oww:sw].add(cols[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+fold = defop("fold", _fold_raw)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def raw(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            return (1 - epsilon) * l + epsilon * as_array(prior_dist)
+        return (1 - epsilon) * l + epsilon / k
+
+    return eager(raw, (label,), {}, name="label_smooth")
+
+
+def _pairwise_distance_raw(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    d = x - y + epsilon
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p), axis=-1, keepdims=keepdim), 1.0 / p)
+
+
+pairwise_distance = defop("pairwise_distance", lambda x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None:
+                          _pairwise_distance_raw(x, as_array(y), p, epsilon, keepdim))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as _pad
+    return _pad(x, padding, mode="constant", value=0.0, data_format=data_format)

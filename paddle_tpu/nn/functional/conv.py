@@ -1,0 +1,320 @@
+"""Convolution & pooling functionals — python/paddle/nn/functional/conv.py,
+pooling.py parity (upstream-canonical, unverified — SURVEY.md §0).
+
+TPU-native: convs lower to XLA conv_general_dilated, which the TPU compiler
+tiles onto the MXU directly — this is the entire 'gpudnn' layer of the
+reference (paddle/phi/kernels/gpudnn/conv_kernel.cu) collapsed into one call.
+Layout note: paddle default is NCHW; XLA:TPU internally prefers NHWC and
+transposes automatically, so we keep API-level NCHW and let the compiler
+choose (same decision the reference makes per-backend with its layout
+transformer)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops._registry import defop, as_array, eager
+
+
+def _ntuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _conv_padding(padding, spatial, strides=None, dilations=None, ksize=None):
+    """Paddle padding spec → lax padding list. Supports int, list, pairs,
+    'SAME', 'VALID'."""
+    n = spatial
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # full-rank form [[0,0],[0,0],[h0,h1],[w0,w1]]
+        return [tuple(int(x) for x in p) for p in padding[-n:]]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv_raw(x, weight, bias, stride, padding, dilation, groups, ndim,
+              data_format, transpose=False, output_padding=0):
+    chan_last = data_format.endswith("C")
+    letters = "DHW"[3 - ndim:]
+    if chan_last:
+        dn_in = "N" + letters + "C"
+    else:
+        dn_in = "NC" + letters
+    dn = (dn_in, "OI" + letters, dn_in)
+    strides = _ntuple(stride, ndim)
+    dilations = _ntuple(dilation, ndim)
+    pad = _conv_padding(padding, ndim)
+    if not transpose:
+        out = jax.lax.conv_general_dilated(
+            x, weight, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups)
+    else:
+        # conv_transpose: paddle weight layout [in_c, out_c/groups, *k]
+        opad = _ntuple(output_padding, ndim)
+        if isinstance(pad, str):
+            lax_pad = pad
+        else:
+            # paddle conv_transpose pad p → lax transpose padding: for each dim
+            # (k-1)*d - p on both sides, + output_padding on the high side
+            k = weight.shape[2:]
+            lax_pad = [
+                (dilations[i] * (k[i] - 1) - pad[i][0],
+                 dilations[i] * (k[i] - 1) - pad[i][1] + opad[i])
+                for i in range(ndim)
+            ]
+        # grouped transpose: split, run per group, concat (XLA fuses)
+        w = jnp.swapaxes(weight, 0, 1)  # [out_c/groups, in_c, *k]
+        w = jnp.flip(w, axis=tuple(range(2, 2 + ndim)))
+        if groups == 1:
+            out = jax.lax.conv_general_dilated(
+                x, w, window_strides=(1,) * ndim, padding=lax_pad,
+                lhs_dilation=strides, dimension_numbers=dn)
+        else:
+            ci_ax = dn_in.index("C")
+            xs = jnp.split(x, groups, axis=ci_ax)
+            ws = jnp.split(w, groups, axis=1)
+            outs = [jax.lax.conv_general_dilated(
+                xg, wg, window_strides=(1,) * ndim, padding=lax_pad,
+                lhs_dilation=strides, dimension_numbers=dn)
+                for xg, wg in zip(xs, ws)]
+            out = jnp.concatenate(outs, axis=ci_ax)
+    if bias is not None:
+        bshape = [1] * out.ndim
+        bshape[dn_in.index("C")] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return eager(lambda *a: _conv_raw(a[0], a[1], a[2] if len(a) > 2 else None,
+                                      stride, padding, dilation, groups, 1,
+                                      data_format), args, {}, name="conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return eager(lambda *a: _conv_raw(a[0], a[1], a[2] if len(a) > 2 else None,
+                                      stride, padding, dilation, groups, 2,
+                                      data_format), args, {}, name="conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return eager(lambda *a: _conv_raw(a[0], a[1], a[2] if len(a) > 2 else None,
+                                      stride, padding, dilation, groups, 3,
+                                      data_format), args, {}, name="conv3d")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCL", name=None):
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return eager(lambda *a: _conv_raw(a[0], a[1], a[2] if len(a) > 2 else None,
+                                      stride, padding, dilation, groups, 1,
+                                      data_format, transpose=True,
+                                      output_padding=output_padding),
+                 args, {}, name="conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW", name=None):
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return eager(lambda *a: _conv_raw(a[0], a[1], a[2] if len(a) > 2 else None,
+                                      stride, padding, dilation, groups, 2,
+                                      data_format, transpose=True,
+                                      output_padding=output_padding),
+                 args, {}, name="conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return eager(lambda *a: _conv_raw(a[0], a[1], a[2] if len(a) > 2 else None,
+                                      stride, padding, dilation, groups, 3,
+                                      data_format, transpose=True,
+                                      output_padding=output_padding),
+                 args, {}, name="conv3d_transpose")
+
+
+# ---- pooling ---------------------------------------------------------------
+
+def _pool_raw(x, ksize, strides, padding, ndim, op, data_format="NCHW",
+              ceil_mode=False, exclusive=True, count_include_pad=False):
+    chan_last = data_format.endswith("C")
+    k = _ntuple(ksize, ndim)
+    s = _ntuple(strides if strides is not None else ksize, ndim)
+    pad = _conv_padding(padding, ndim)
+    if chan_last:
+        window = (1,) + k + (1,)
+        stride_full = (1,) + s + (1,)
+        pad_full = [(0, 0)] + (pad if isinstance(pad, list) else pad) + [(0, 0)] \
+            if not isinstance(pad, str) else pad
+    else:
+        window = (1, 1) + k
+        stride_full = (1, 1) + s
+        pad_full = [(0, 0), (0, 0)] + pad if not isinstance(pad, str) else pad
+    if op == "max":
+        init = -jnp.inf if np.dtype(x.dtype).kind == "f" else np.iinfo(np.dtype(x.dtype)).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, stride_full,
+                                     pad_full)
+    # avg
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride_full, pad_full)
+    if count_include_pad or (isinstance(pad_full, str)) or all(
+            p == (0, 0) for p in (pad_full if isinstance(pad_full, list) else [])):
+        denom = np.prod(k)
+        return summed / denom
+    ones = jnp.ones_like(x)
+    counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, stride_full, pad_full)
+    return summed / counts
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return eager(lambda a: _pool_raw(a, kernel_size, stride, padding, 1, "max",
+                                     data_format, ceil_mode), (x,), {}, name="max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = eager(lambda a: _pool_raw(a, kernel_size, stride, padding, 2, "max",
+                                    data_format, ceil_mode), (x,), {}, name="max_pool2d")
+    if return_mask:
+        # indices within each window, flattened HW index (parity shape only)
+        idx = eager(lambda a: _max_pool_indices(a, kernel_size, stride, padding),
+                    (x,), {}, name="max_pool2d_mask")
+        return out, idx
+    return out
+
+
+def _max_pool_indices(x, ksize, stride, padding):
+    n, c, h, w = x.shape
+    hw_idx = jnp.arange(h * w, dtype=jnp.float64).reshape(1, 1, h, w)
+    hw_idx = jnp.broadcast_to(hw_idx, x.shape)
+    # argmax via reduce: encode value+index (value in high part)
+    k = _ntuple(ksize, 2)
+    s = _ntuple(stride if stride is not None else ksize, 2)
+    pad = _conv_padding(padding, 2)
+    pad_full = [(0, 0), (0, 0)] + pad if not isinstance(pad, str) else pad
+
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    vals, idxs = jax.lax.reduce_window(
+        (x, hw_idx), (-jnp.inf, 0.0),
+        lambda a, b: sel(a, b),
+        (1, 1) + k, (1, 1) + s, pad_full)
+    return idxs.astype(jnp.int64)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return eager(lambda a: _pool_raw(a, kernel_size, stride, padding, 3, "max",
+                                     data_format, ceil_mode), (x,), {}, name="max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return eager(lambda a: _pool_raw(a, kernel_size, stride, padding, 1, "avg",
+                                     data_format, ceil_mode,
+                                     count_include_pad=not exclusive),
+                 (x,), {}, name="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return eager(lambda a: _pool_raw(a, kernel_size, stride, padding, 2, "avg",
+                                     data_format, ceil_mode,
+                                     count_include_pad=not exclusive),
+                 (x,), {}, name="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return eager(lambda a: _pool_raw(a, kernel_size, stride, padding, 3, "avg",
+                                     data_format, ceil_mode,
+                                     count_include_pad=not exclusive),
+                 (x,), {}, name="avg_pool3d")
+
+
+def _adaptive_pool_raw(x, output_size, ndim, op):
+    spatial = x.shape[2:]
+    out_size = _ntuple(output_size, ndim)
+    out_size = tuple(spatial[i] if out_size[i] is None else out_size[i]
+                     for i in range(ndim))
+    if all(spatial[i] % out_size[i] == 0 for i in range(ndim)):
+        # divisible fast path: reshape + reduce
+        shape = list(x.shape[:2])
+        red_axes = []
+        for i in range(ndim):
+            shape += [out_size[i], spatial[i] // out_size[i]]
+            red_axes.append(2 + 2 * i + 1)
+        xr = x.reshape(shape)
+        return jnp.max(xr, axis=tuple(red_axes)) if op == "max" else \
+            jnp.mean(xr, axis=tuple(red_axes))
+    # general: per-output-bin slices (static; unrolled at trace time)
+    def pool_axis(a, axis, n_out):
+        n_in = a.shape[axis]
+        pieces = []
+        for i in range(n_out):
+            lo = (i * n_in) // n_out
+            hi = -(-((i + 1) * n_in) // n_out)
+            seg = jax.lax.slice_in_dim(a, lo, hi, axis=axis)
+            red = jnp.max(seg, axis=axis, keepdims=True) if op == "max" else \
+                jnp.mean(seg, axis=axis, keepdims=True)
+            pieces.append(red)
+        return jnp.concatenate(pieces, axis=axis)
+
+    out = x
+    for i in range(ndim):
+        out = pool_axis(out, 2 + i, out_size[i])
+    return out
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return eager(lambda a: _adaptive_pool_raw(a, output_size, 1, "avg"), (x,), {},
+                 name="adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return eager(lambda a: _adaptive_pool_raw(a, output_size, 2, "avg"), (x,), {},
+                 name="adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return eager(lambda a: _adaptive_pool_raw(a, output_size, 3, "avg"), (x,), {},
+                 name="adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return eager(lambda a: _adaptive_pool_raw(a, output_size, 1, "max"), (x,), {},
+                 name="adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return eager(lambda a: _adaptive_pool_raw(a, output_size, 2, "max"), (x,), {},
+                 name="adaptive_max_pool2d")

@@ -1,0 +1,257 @@
+"""Loss functionals — python/paddle/nn/functional/loss.py parity
+(upstream-canonical, unverified — SURVEY.md §0)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops._registry import defop, as_array, eager
+from ...core.tensor import Tensor
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def _xent_raw(logits, label, weight=None, ignore_index=-100, reduction="mean",
+              soft_label=False, axis=-1, label_smoothing=0.0):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    n_class = logits.shape[axis]
+    if soft_label:
+        soft = label
+        if label_smoothing > 0.0:
+            soft = soft * (1 - label_smoothing) + label_smoothing / n_class
+        loss = -jnp.sum(soft * logp, axis=axis)
+        mask = None
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        mask = (lbl != ignore_index)
+        safe = jnp.where(mask, lbl, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=axis)[..., 0] \
+            if axis in (-1, logits.ndim - 1) else \
+            jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis).squeeze(axis)
+        if label_smoothing > 0.0:
+            # smoothed target = (1-eps)*one_hot + eps/K
+            smooth = jnp.mean(logp, axis=axis)
+            loss = -((1 - label_smoothing) * picked + label_smoothing * smooth)
+        else:
+            loss = -picked
+        if weight is not None:
+            loss = loss * jnp.take(weight, safe)
+        loss = jnp.where(mask, loss, 0.0)
+    if reduction == "mean" and mask is not None:
+        denom = jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+        if weight is not None:
+            safe = jnp.where(mask, label.astype(jnp.int32) if label.ndim == loss.ndim else 0, 0)
+            denom = jnp.maximum(jnp.sum(jnp.where(mask, jnp.take(weight, safe), 0.0)), 1e-12)
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    lbl = as_array(label)
+    args = [input] + ([weight] if weight is not None else [])
+
+    def raw(*a):
+        w = a[1] if weight is not None else None
+        return _xent_raw(a[0], lbl, w, ignore_index, reduction, soft_label,
+                         axis, label_smoothing)
+
+    if soft_label and isinstance(label, Tensor) and not label.stop_gradient:
+        return eager(lambda x, l: _xent_raw(x, l, None, ignore_index, reduction,
+                                            True, axis, label_smoothing),
+                     (input, label), {}, name="cross_entropy")
+    return eager(raw, tuple(args), {}, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    # paddle keeps the label dim on the loss
+    if not soft_label:
+        from .activation import softmax as _softmax
+        lbl = as_array(label)
+        if lbl.ndim == as_array(logits).ndim and lbl.shape[axis] == 1:
+            pass
+        else:
+            loss = loss.unsqueeze(axis)
+    if return_softmax:
+        from .activation import softmax as _softmax
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return eager(lambda x, y: _reduce(jnp.square(x - y), reduction),
+                 (input, label if isinstance(label, Tensor) else Tensor(jnp.asarray(label))),
+                 {}, name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return eager(lambda x, y: _reduce(jnp.abs(x - y), reduction),
+                 (input, label if isinstance(label, Tensor) else Tensor(jnp.asarray(label))),
+                 {}, name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def raw(x, y):
+        d = jnp.abs(x - y)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle's smooth_l1_loss multiplies by delta
+        return _reduce(loss * delta, reduction)
+
+    return eager(raw, (input, label), {}, name="smooth_l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    lbl = as_array(label).astype(jnp.int32)
+    args = [input] + ([weight] if weight is not None else [])
+
+    def raw(*a):
+        logp = a[0]
+        mask = lbl != ignore_index
+        safe = jnp.where(mask, lbl, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=1)[..., 0] \
+            if logp.ndim == 2 else \
+            jnp.take_along_axis(logp, safe[:, None], axis=1).squeeze(1)
+        loss = -picked
+        if weight is not None:
+            wv = jnp.take(a[1], safe)
+            loss = loss * wv
+        loss = jnp.where(mask, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(mask, jnp.take(a[1], safe), 0.0)) if weight is not None \
+                else jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    return eager(raw, tuple(args), {}, name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    args = [input, label] + ([weight] if weight is not None else [])
+
+    def raw(x, y, *w):
+        eps = 1e-12
+        loss = -(y * jnp.log(jnp.maximum(x, eps)) +
+                 (1 - y) * jnp.log(jnp.maximum(1 - x, eps)))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    return eager(raw, tuple(args), {}, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+
+    def raw(x, y, *rest):
+        # numerically stable: max(x,0) - x*y + log(1+exp(-|x|))
+        loss = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        i = 0
+        if pos_weight is not None:
+            pw = rest[-1]
+            logsig = jax.nn.log_sigmoid(x)
+            logsig_neg = jax.nn.log_sigmoid(-x)
+            loss = -(y * pw * logsig + (1 - y) * logsig_neg)
+        if weight is not None:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+
+    return eager(raw, tuple(args), {}, name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def raw(x, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - x)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / x.shape[0]
+        return _reduce(loss, reduction)
+
+    return eager(raw, (input, label), {}, name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def raw(x, y, l):
+        loss = jnp.maximum(-l * (x - y) + margin, 0.0)
+        return _reduce(loss, reduction)
+
+    return eager(raw, (input, other, label), {}, name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def raw(x, y):
+        loss = jnp.where(y == 1, x, jnp.maximum(margin - x, 0.0))
+        return _reduce(loss, reduction)
+
+    return eager(raw, (input, label), {}, name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def raw(x1, x2, l):
+        cos = jnp.sum(x1 * x2, -1) / jnp.maximum(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+        loss = jnp.where(l == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+
+    return eager(raw, (input1, input2, label), {}, name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def raw(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p), -1), 1 / p)
+
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return eager(raw, (input, positive, negative), {}, name="triplet_margin_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def raw(x, y):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if normalizer is not None:
+            loss = loss / as_array(normalizer)
+        return _reduce(loss, reduction)
+
+    return eager(raw, (logit, label), {}, name="sigmoid_focal_loss")
+
+
+def square_error_cost(input, label, name=None):
+    return eager(lambda x, y: jnp.square(x - y), (input, label),
+                 {}, name="square_error_cost")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    raise NotImplementedError(
+        "ctc_loss: deferred (paddle_tpu/nn/functional/loss.py) — needs a "
+        "lax.scan forward-backward; planned with the audio model family")

@@ -1,0 +1,195 @@
+"""Normalization functionals — python/paddle/nn/functional/norm.py parity
+(upstream-canonical, unverified — SURVEY.md §0). The fused rms_norm/layer_norm
+here are the jnp reference paths; paddle_tpu.kernels provides Pallas TPU
+versions selected via FLAGS_use_pallas (reference analog:
+paddle/phi/kernels/fusion/ fused norms)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops._registry import defop, as_array, eager
+from ...core.tensor import Tensor
+
+
+def _layer_norm_raw(x, weight, bias, epsilon, begin_norm_axis):
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin = -len(tuple(normalized_shape))
+
+    args, spec = [x], []
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+
+    def raw(*a):
+        xx = a[0]
+        w = a[1] if weight is not None else None
+        b = a[-1] if bias is not None else None
+        return _layer_norm_raw(xx, w, b, epsilon, xx.ndim + begin)
+
+    return eager(raw, tuple(args), {}, name="layer_norm")
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
+             name=None):
+    """RMSNorm — the reference ships this as a fused kernel
+    (phi/kernels/fusion rms_norm); Pallas version in paddle_tpu.kernels."""
+    from ...kernels import rms_norm as _k
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+
+    def raw(*a):
+        return _k.rms_norm_ref(a[0], a[1] if len(a) > 1 else None, epsilon)
+
+    return eager(raw, tuple(args), {}, name="rms_norm")
+
+
+def _batch_norm_raw(x, running_mean, running_var, weight, bias, training,
+                    momentum, epsilon, data_format, use_batch_stats):
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    red = tuple(i for i in range(x.ndim) if i != c_axis)
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    if use_batch_stats:
+        mean = jnp.mean(x, axis=red)
+        var = jnp.var(x, axis=red)
+    else:
+        mean, var = running_mean, running_var
+    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    use_batch_stats = training and not (use_global_stats is True)
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    rm = as_array(running_mean)
+    rv = as_array(running_var)
+
+    def raw(*a):
+        xx = a[0]
+        w = a[1] if weight is not None else None
+        b = a[-1] if (bias is not None) else None
+        out, _, _ = _batch_norm_raw(xx, rm, rv, w, b, training, momentum,
+                                    epsilon, data_format, use_batch_stats)
+        return out
+
+    out = eager(raw, tuple(args), {}, name="batch_norm")
+
+    if use_batch_stats and isinstance(running_mean, Tensor):
+        # update running stats in place (paddle semantics: stats are buffers,
+        # updated outside the grad tape)
+        c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+        red = tuple(i for i in range(x.ndim) if i != c_axis)
+        xd = as_array(x)
+        bm = jnp.mean(xd, axis=red)
+        n = int(np.prod([xd.shape[i] for i in red]))
+        bv = jnp.var(xd, axis=red) * (n / max(n - 1, 1))  # unbiased for running
+        running_mean._rebind(momentum * rm + (1 - momentum) * bm)
+        running_var._rebind(momentum * rv + (1 - momentum) * bv)
+    return out
+
+
+def _group_norm_raw(x, groups, weight, bias, epsilon, data_format):
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    if c_axis != 1:
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xg = x.reshape((n, groups, c // groups) + spatial)
+    red = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=red, keepdims=True)
+    var = jnp.var(xg, axis=red, keepdims=True)
+    out = ((xg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = [1, c] + [1] * len(spatial)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if c_axis != 1:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+
+    def raw(*a):
+        w = a[1] if weight is not None else None
+        b = a[-1] if bias is not None else None
+        return _group_norm_raw(a[0], num_groups, w, b, epsilon, data_format)
+
+    return eager(raw, tuple(args), {}, name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+
+    def raw(*a):
+        xx = a[0]
+        red = tuple(range(2, xx.ndim))
+        mean = jnp.mean(xx, axis=red, keepdims=True)
+        var = jnp.var(xx, axis=red, keepdims=True)
+        out = (xx - mean) * jax.lax.rsqrt(var + eps)
+        if weight is not None:
+            shape = [1, xx.shape[1]] + [1] * (xx.ndim - 2)
+            out = out * a[1].reshape(shape)
+        if bias is not None:
+            shape = [1, xx.shape[1]] + [1] * (xx.ndim - 2)
+            out = out + a[-1].reshape(shape)
+        return out
+
+    return eager(raw, tuple(args), {}, name="instance_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def raw(a):
+        sq = jnp.square(a)
+        half = size // 2
+        c = a.shape[1]
+        pads = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (a.ndim - 2)
+        sqp = jnp.pad(sq, pads)
+        win = sum(jax.lax.slice_in_dim(sqp, i, i + c, axis=1) for i in range(size))
+        return a / jnp.power(k + alpha * win / size, beta)
+
+    return eager(raw, (x,), {}, name="local_response_norm")

@@ -1,0 +1,296 @@
+"""Recurrent layers — parity with python/paddle/nn/layer/rnn.py
+(upstream-canonical, unverified — SURVEY.md §0).
+
+TPU-native: the time loop is jax.lax.scan (compiled once, no per-step python)
+— the reference's cudnn RNN kernels become one fused XLA while-loop whose body
+is MXU matmuls."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .layer import Layer
+from . import initializer as I
+from ..ops._registry import eager
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, n_gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        g = n_gates
+        self.weight_ih = self.create_parameter(
+            [g * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [g * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [g * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [g * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, 1, **kw)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ..ops.creation import zeros
+            states = zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+
+        def raw(x, h, wi, wh, bi, bh):
+            z = x @ wi.T + bi + h @ wh.T + bh
+            return jnp.tanh(z) if self.activation == "tanh" else jax.nn.relu(z)
+
+        out = eager(raw, (inputs, states, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh), {}, name="rnn_cell")
+        return out, out
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 4, **kw)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ..ops.creation import zeros
+            z = zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+            states = (z, z.clone())
+        h, c = states
+
+        def raw(x, hh, cc, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hh @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * cc + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h_new, c_new = eager(raw, (inputs, h, c, self.weight_ih, self.weight_hh,
+                                   self.bias_ih, self.bias_hh), {}, name="lstm_cell")
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 3, **kw)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ..ops.creation import zeros
+            states = zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+
+        def raw(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h
+
+        out = eager(raw, (inputs, states, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh), {}, name="gru_cell")
+        return out, out
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional RNN driven by lax.scan over time."""
+
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirectional else 1
+        self.num_directions = num_dir
+        n_gates = {"RNN": 1, "LSTM": 4, "GRU": 3}[self.MODE]
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        for layer in range(num_layers):
+            for d in range(num_dir):
+                in_size = input_size if layer == 0 else hidden_size * num_dir
+                sfx = f"_{layer}" + ("_reverse" if d else "")
+                self.add_parameter("weight_ih" + sfx, self.create_parameter(
+                    [n_gates * hidden_size, in_size], default_initializer=u))
+                self.add_parameter("weight_hh" + sfx, self.create_parameter(
+                    [n_gates * hidden_size, hidden_size], default_initializer=u))
+                self.add_parameter("bias_ih" + sfx, self.create_parameter(
+                    [n_gates * hidden_size], is_bias=True, default_initializer=u))
+                self.add_parameter("bias_hh" + sfx, self.create_parameter(
+                    [n_gates * hidden_size], is_bias=True, default_initializer=u))
+
+    def _cell(self, x, h, c, wi, wh, bi, bh):
+        if self.MODE == "LSTM":
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            return o * jnp.tanh(c_new), c_new
+        if self.MODE == "GRU":
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h, c
+        z = x @ wi.T + bi + h @ wh.T + bh
+        h_new = jnp.tanh(z) if self.activation == "tanh" else jax.nn.relu(z)
+        return h_new, c
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = self.MODE == "LSTM"
+        num_dir = self.num_directions
+
+        params = []
+        for layer in range(self.num_layers):
+            for d in range(num_dir):
+                sfx = f"_{layer}" + ("_reverse" if d else "")
+                params += [getattr(self, "weight_ih" + sfx),
+                           getattr(self, "weight_hh" + sfx),
+                           getattr(self, "bias_ih" + sfx),
+                           getattr(self, "bias_hh" + sfx)]
+
+        init_h = init_c = None
+        extra = []
+        if initial_states is not None:
+            if is_lstm:
+                init_h, init_c = initial_states
+                extra = [init_h, init_c]
+            else:
+                init_h = initial_states
+                extra = [init_h]
+
+        time_major = self.time_major
+        nl, hs, mode = self.num_layers, self.hidden_size, self.MODE
+
+        def raw(*arrs):
+            x = arrs[0]
+            ps = arrs[1:1 + len(params)]
+            rest = arrs[1 + len(params):]
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, F]
+            t_steps, b = x.shape[0], x.shape[1]
+            if rest:
+                h0 = rest[0]
+                c0 = rest[1] if is_lstm else None
+            else:
+                h0 = jnp.zeros((nl * num_dir, b, hs), dtype=x.dtype)
+                c0 = jnp.zeros((nl * num_dir, b, hs), dtype=x.dtype) if is_lstm else None
+            hs_out, cs_out = [], []
+            out = x
+            pi = 0
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(num_dir):
+                    wi, wh, bi, bh = ps[pi:pi + 4]
+                    pi += 4
+                    idx = layer * num_dir + d
+                    seq = out if d == 0 else jnp.flip(out, axis=0)
+
+                    def step(carry, xt):
+                        h, c = carry
+                        h_new, c_new = self._cell(xt, h, c, wi, wh, bi, bh)
+                        return (h_new, c_new), h_new
+
+                    czero = c0[idx] if is_lstm else jnp.zeros_like(h0[idx])
+                    (h_fin, c_fin), ys = jax.lax.scan(step, (h0[idx], czero), seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, axis=0)
+                    dir_outs.append(ys)
+                    hs_out.append(h_fin)
+                    if is_lstm:
+                        cs_out.append(c_fin)
+                out = jnp.concatenate(dir_outs, axis=-1) if num_dir == 2 else dir_outs[0]
+            final_h = jnp.stack(hs_out, axis=0)
+            outputs = out if time_major else jnp.swapaxes(out, 0, 1)
+            if is_lstm:
+                return outputs, final_h, jnp.stack(cs_out, axis=0)
+            return outputs, final_h
+
+        res = eager(raw, tuple([inputs] + params + extra), {}, name=self.MODE.lower())
+        if is_lstm:
+            outputs, h, c = res
+            return outputs, (h, c)
+        outputs, h = res
+        return outputs, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN"
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class RNN(Layer):
+    """Wrapper running an arbitrary cell over time (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        axis = 0 if self.time_major else 1
+        steps = inputs.shape[axis]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        outs = []
+        states = initial_states
+        from ..ops.manipulation import stack
+        for t in order:
+            xt = inputs[:, t] if axis == 1 else inputs[t]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.fw = RNN(cell_fw, False, time_major)
+        self.bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        sf = sb = None
+        if initial_states is not None:
+            sf, sb = initial_states
+        of, stf = self.fw(inputs, sf)
+        ob, stb = self.bw(inputs, sb)
+        from ..ops.manipulation import concat
+        return concat([of, ob], axis=-1), (stf, stb)

@@ -36,6 +36,26 @@ def _is_diff_dtype(arr) -> bool:
     return d.kind in "fc" or d in dtypes.FLOATING
 
 
+_amp_fn = None
+
+# dtypes AMP may cast (never complex/f64 — the reference casts fp32 only)
+_AMP_CASTABLE = (dtypes.float32, dtypes.float16, dtypes.bfloat16)
+
+
+def _amp_dtype(name):
+    global _amp_fn
+    if _amp_fn is None:
+        import sys
+        if "paddle_tpu.amp" not in sys.modules:
+            try:
+                from .. import amp  # noqa: F401
+            except ImportError:
+                return None  # package bootstrap: amp not importable yet
+        from ..amp import amp_dtype_for_op
+        _amp_fn = amp_dtype_for_op
+    return _amp_fn(name)
+
+
 def _maybe_check_finite(name, arrays):
     if not flag("FLAGS_check_nan_inf"):
         return
@@ -68,6 +88,19 @@ def eager(raw: Callable, args, kwargs, name: str = "op"):
             kw_tins[k] = v
         else:
             kw_arrs[k] = v
+
+    # AMP: cast float tensor inputs per the active auto_cast policy (the
+    # reference does this in the generated *_ad_func AMP block — SURVEY §3.1)
+    amp_dt = _amp_dtype(name)
+    if amp_dt is not None:
+        for i, t in enumerate(tins):
+            if t is not None and np.dtype(t._data.dtype) in _AMP_CASTABLE and \
+                    np.dtype(t._data.dtype) != amp_dt:
+                arrs[i] = arrs[i].astype(amp_dt)
+        for k, t in kw_tins.items():
+            if np.dtype(t._data.dtype) in _AMP_CASTABLE and \
+                    np.dtype(t._data.dtype) != amp_dt:
+                kw_arrs[k] = kw_arrs[k].astype(amp_dt)
 
     diff_idx = [
         i for i, t in enumerate(tins)

@@ -1,0 +1,8 @@
+"""paddle_tpu.optimizer — parity with python/paddle/optimizer/
+(upstream-canonical, unverified — SURVEY.md §0)."""
+from .optimizers import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp,
+    Lamb, L1Decay, L2Decay,
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+)
+from . import lr  # noqa: F401

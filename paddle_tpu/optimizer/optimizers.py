@@ -1,0 +1,452 @@
+"""Optimizers — python/paddle/optimizer/ parity (upstream-canonical,
+unverified — SURVEY.md §0).
+
+TPU-native design: each optimizer's math is one jitted pure function
+(param, grad, *state) → (param, *state); the reference's fused multi-tensor
+CUDA kernels (e.g. adamw_kernel.cu multi-tensor path, SURVEY.md §3.1) become
+XLA fusions of the same update applied per-parameter under jit. Master-weight
+(multi_precision) semantics: fp16/bf16 params keep an fp32 master copy in
+state, matching the reference's master_weights contract."""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+from ..autograd.tape import no_grad
+from .lr import LRScheduler
+
+
+class _GradClipBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(_GradClipBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        return [(p, jnp.clip(g, self.min, self.max)) for p, g in params_grads]
+
+
+class ClipGradByNorm(_GradClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, g * scale))
+        return out
+
+
+class ClipGradByGlobalNorm(_GradClipBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for _, g in params_grads))
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        return [(p, (g.astype(jnp.float32) * scale).astype(g.dtype))
+                for p, g in params_grads]
+
+
+class Optimizer:
+    """Base: manages lr (float or LRScheduler), regularization, clipping,
+    per-param state, state_dict."""
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if parameters is None:
+            raise ValueError(
+                "parameters=None: pass model.parameters() (the static-graph "
+                "global-collection mode is not supported; eager-only framework)")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._state: Dict[int, Dict[str, jax.Array]] = {}
+        self._step_count = 0
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("set_lr cannot override an LRScheduler")
+        self._learning_rate = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate, LRScheduler) else None
+
+    # -- state ---------------------------------------------------------------
+    def _param_state(self, p: Tensor) -> Dict[str, jax.Array]:
+        st = self._state.get(id(p))
+        if st is None:
+            st = self._init_state(p)
+            if self._multi_precision and dtypes.convert_dtype(p.dtype) in (
+                    dtypes.float16, dtypes.bfloat16):
+                st["master"] = p._data.astype(jnp.float32)
+            self._state[id(p)] = st
+        return st
+
+    def _init_state(self, p: Tensor) -> Dict[str, jax.Array]:
+        return {}
+
+    # -- the update ----------------------------------------------------------
+    def _update(self, value, grad, state, lr, lr_mult, wd):
+        """Pure: (fp32 param value, fp32 grad, state dict) → (new value, new state).
+        `wd` is a traced scalar so per-param decay (apply_decay_param_fun)
+        doesn't bake into the jit cache."""
+        raise NotImplementedError
+
+    def _decay_value(self, p: Tensor) -> float:
+        coeff, is_l1 = self._decay_info(p)
+        return 0.0 if is_l1 else coeff
+
+    def _decay_info(self, p: Optional[Tensor]):
+        """→ (coeff, is_l1). L1 decay is applied to the gradient in step()
+        (c*sign(w)); L2/float decay flows into the jitted update as `wd`."""
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0, False
+        fn = getattr(self, "_apply_decay_param_fun", None)
+        if fn is not None and p is not None and not fn(p.name):
+            return 0.0, False
+        if isinstance(wd, L1Decay):
+            return float(wd._coeff), True
+        if isinstance(wd, L2Decay):
+            return float(wd._coeff), False
+        return float(wd), False
+
+    @functools.cached_property
+    def _jitted_update(self):
+        return jax.jit(self._update)
+
+    def step(self):
+        params_grads = [(p, p.grad._data) for p in self._parameter_list
+                        if p.grad is not None and not p.stop_gradient]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        with no_grad():
+            lr = self.get_lr()
+            for p, g in params_grads:
+                st = self._param_state(p)
+                lr_mult = p.optimize_attr.get("learning_rate", 1.0) if hasattr(
+                    p, "optimize_attr") else 1.0
+                master = st.get("master")
+                value = master if master is not None else p._data
+                g32 = g.astype(value.dtype)
+                wd_coeff, wd_is_l1 = self._decay_info(p)
+                if wd_is_l1 and wd_coeff:
+                    g32 = g32 + wd_coeff * jnp.sign(value)
+                    wd_coeff = 0.0
+                new_value, new_st = self._jitted_update(
+                    value, g32, {k: v for k, v in st.items() if k != "master"},
+                    jnp.asarray(lr, dtype=jnp.float32), lr_mult,
+                    jnp.asarray(wd_coeff, dtype=jnp.float32))
+                if master is not None:
+                    new_st = dict(new_st)
+                    new_st["master"] = new_value
+                    p._rebind(new_value.astype(p._data.dtype))
+                else:
+                    p._rebind(new_value)
+                self._state[id(p)] = new_st
+        self._step_count += 1
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- persistence ----------------------------------------------------------
+    def state_dict(self):
+        out = {"_step_count": self._step_count}
+        for i, p in enumerate(self._parameter_list):
+            st = self._state.get(id(p))
+            if st:
+                for k, v in st.items():
+                    out[f"{p.name}.{k}"] = Tensor(v)
+        if self._lr_scheduler is not None:
+            out["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("_step_count", 0))
+        if "LR_Scheduler" in state and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state["LR_Scheduler"])
+        for p in self._parameter_list:
+            st = {}
+            for k, v in state.items():
+                if isinstance(k, str) and k.startswith(p.name + "."):
+                    st[k[len(p.name) + 1:]] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            if st:
+                self._state[id(p)] = st
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _update(self, value, grad, state, lr, lr_mult, wd):
+        grad = grad + wd * value
+        return value - lr * lr_mult * grad, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(
+            p._data, dtype=jnp.float32 if self._multi_precision else p._data.dtype)}
+
+    def _update(self, value, grad, state, lr, lr_mult, wd):
+        mu = self._momentum
+        grad = grad + wd * value
+        v = mu * state["velocity"] + grad
+        if self._nesterov:
+            step = grad + mu * v
+        else:
+            step = v
+        return value - lr * lr_mult * step, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._data, self._init_acc)}
+
+    def _update(self, value, grad, state, lr, lr_mult, wd):
+        grad = grad + wd * value
+        m = state["moment"] + jnp.square(grad)
+        return value - lr * lr_mult * grad / (jnp.sqrt(m) + self._epsilon), \
+            {"moment": m}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_state(self, p):
+        st = {"mean_square": jnp.zeros_like(p._data),
+              "velocity": jnp.zeros_like(p._data)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p._data)
+        return st
+
+    def _update(self, value, grad, state, lr, lr_mult, wd):
+        grad = grad + wd * value
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(grad)
+        st = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * grad
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            st["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        v = self._momentum * state["velocity"] + lr * lr_mult * grad / denom
+        st["velocity"] = v
+        return value - v, st
+
+
+class Adam(Optimizer):
+    """paddle Adam: weight_decay is L2 regularization (coupled)."""
+
+    _decoupled = False
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None, amsgrad=False, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _init_state(self, p):
+        dt = jnp.float32 if (self._multi_precision or
+                             dtypes.convert_dtype(p.dtype) in
+                             (dtypes.float16, dtypes.bfloat16)) else p._data.dtype
+        st = {"moment1": jnp.zeros(p._data.shape, dtype=dt),
+              "moment2": jnp.zeros(p._data.shape, dtype=dt),
+              "beta1_pow": jnp.ones((), dtype=jnp.float32),
+              "beta2_pow": jnp.ones((), dtype=jnp.float32)}
+        if self._amsgrad:
+            st["moment2_max"] = jnp.zeros(p._data.shape, dtype=dt)
+        return st
+
+    def _update(self, value, grad, state, lr, lr_mult, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        if not self._decoupled:
+            grad = grad + wd * value
+        m1 = b1 * state["moment1"] + (1 - b1) * grad
+        m2 = b2 * state["moment2"] + (1 - b2) * jnp.square(grad)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m1_hat = m1 / (1 - b1p)
+        if self._amsgrad:
+            m2max = jnp.maximum(state.get("moment2_max", m2), m2)
+            m2_hat = m2max / (1 - b2p)
+        else:
+            m2_hat = m2 / (1 - b2p)
+        step = lr * lr_mult * m1_hat / (jnp.sqrt(m2_hat) + eps)
+        if self._decoupled:
+            step = step + lr * lr_mult * wd * value
+        st = {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+        if self._amsgrad:
+            st["moment2_max"] = m2max
+        return value - step, st
+
+
+class AdamW(Adam):
+    """paddle AdamW: decoupled weight decay (default coeff 0.01)."""
+
+    _decoupled = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros_like(p._data),
+                "inf_norm": jnp.zeros_like(p._data),
+                "beta1_pow": jnp.ones((), dtype=jnp.float32)}
+
+    def _update(self, value, grad, state, lr, lr_mult, wd):
+        b1, b2 = self._beta1, self._beta2
+        grad = grad + wd * value
+        m = b1 * state["moment"] + (1 - b1) * grad
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(grad))
+        b1p = state["beta1_pow"] * b1
+        step = lr * lr_mult * m / ((1 - b1p) * (u + self._epsilon))
+        return value - step, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _decay_info(self, p):
+        # paddle Lamb's exclude fn takes the Parameter object (not its name)
+        if self._exclude_fn is not None and p is not None and self._exclude_fn(p):
+            return 0.0, False
+        return super()._decay_info(p)
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p._data),
+                "moment2": jnp.zeros_like(p._data),
+                "beta1_pow": jnp.ones((), dtype=jnp.float32),
+                "beta2_pow": jnp.ones((), dtype=jnp.float32)}
+
+    def _update(self, value, grad, state, lr, lr_mult, wd):
+        b1, b2 = self._beta1, self._beta2
+        m1 = b1 * state["moment1"] + (1 - b1) * grad
+        m2 = b2 * state["moment2"] + (1 - b2) * jnp.square(grad)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        r = (m1 / (1 - b1p)) / (jnp.sqrt(m2 / (1 - b2p)) + self._epsilon)
+        r = r + wd * value
+        w_norm = jnp.linalg.norm(value)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return value - lr * lr_mult * trust * r, \
+            {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None,
+                 **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p._data),
+                "avg_squared_update": jnp.zeros_like(p._data)}
+
+    def _update(self, value, grad, state, lr, lr_mult, wd):
+        rho, eps = self._rho, self._epsilon
+        grad = grad + wd * value
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * jnp.square(grad)
+        update = grad * jnp.sqrt(state["avg_squared_update"] + eps) / \
+            jnp.sqrt(asg + eps)
+        asu = rho * state["avg_squared_update"] + (1 - rho) * jnp.square(update)
+        return value - lr * lr_mult * update, \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff

@@ -1,0 +1,284 @@
+"""Layer tests — mirrors the reference's API/layer test style (SURVEY.md §4
+'direct eager-mode asserts vs numpy')."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.default_rng(3)
+
+
+def fdata(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+class TestLinearEmbedding:
+    def test_linear(self):
+        layer = nn.Linear(4, 3)
+        x = fdata(2, 4)
+        out = layer(paddle.to_tensor(x))
+        ref = x @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_linear_no_bias(self):
+        layer = nn.Linear(4, 3, bias_attr=False)
+        assert layer.bias is None
+        out = layer(paddle.to_tensor(fdata(2, 4)))
+        assert out.shape == [2, 3]
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 6)
+        out = emb(paddle.to_tensor(np.array([[1, 2], [3, 4]])))
+        assert out.shape == [2, 2, 6]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1], rtol=1e-6)
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor(np.array([0, 1])))
+        np.testing.assert_allclose(out.numpy()[0], np.zeros(4), atol=1e-7)
+
+    def test_linear_grad_flows(self):
+        layer = nn.Linear(4, 2)
+        out = layer(paddle.to_tensor(fdata(3, 4)))
+        out.sum().backward()
+        assert layer.weight.grad is not None and layer.bias.grad is not None
+        assert layer.weight.grad.shape == [4, 2]
+
+
+class TestConvPool:
+    def test_conv2d_shape_and_ref(self):
+        conv = nn.Conv2D(2, 4, 3, padding=1)
+        x = fdata(1, 2, 8, 8)
+        out = conv(paddle.to_tensor(x))
+        assert out.shape == [1, 4, 8, 8]
+        # reference check vs torch-free scipy-style direct computation on one pixel
+        w = conv.weight.numpy()
+        b = conv.bias.numpy()
+        patch = np.pad(x[0], ((0, 0), (1, 1), (1, 1)))[:, 0:3, 0:3]
+        ref00 = (w[0] * patch).sum() + b[0]
+        np.testing.assert_allclose(out.numpy()[0, 0, 0, 0], ref00, rtol=1e-4)
+
+    def test_conv2d_stride_groups(self):
+        conv = nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2)
+        out = conv(paddle.to_tensor(fdata(2, 4, 16, 16)))
+        assert out.shape == [2, 8, 8, 8]
+
+    def test_conv2d_transpose(self):
+        deconv = nn.Conv2DTranspose(4, 2, 2, stride=2)
+        out = deconv(paddle.to_tensor(fdata(1, 4, 8, 8)))
+        assert out.shape == [1, 2, 16, 16]
+
+    def test_conv1d(self):
+        conv = nn.Conv1D(3, 6, 5, padding=2)
+        out = conv(paddle.to_tensor(fdata(2, 3, 20)))
+        assert out.shape == [2, 6, 20]
+
+    def test_pools(self):
+        x = paddle.to_tensor(fdata(1, 2, 8, 8))
+        assert F.max_pool2d(x, 2).shape == [1, 2, 4, 4]
+        assert F.avg_pool2d(x, 2).shape == [1, 2, 4, 4]
+        assert F.adaptive_avg_pool2d(x, 1).shape == [1, 2, 1, 1]
+        assert F.adaptive_avg_pool2d(x, 3).shape == [1, 2, 3, 3]
+        np.testing.assert_allclose(
+            F.adaptive_avg_pool2d(x, 1).numpy()[0, 0, 0, 0],
+            x.numpy()[0, 0].mean(), rtol=1e-5)
+
+    def test_max_pool_values(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = F.max_pool2d(paddle.to_tensor(x), 2)
+        np.testing.assert_array_equal(out.numpy()[0, 0], [[5, 7], [13, 15]])
+
+    def test_conv_grad(self):
+        conv = nn.Conv2D(1, 2, 3)
+        out = conv(paddle.to_tensor(fdata(1, 1, 5, 5)))
+        out.sum().backward()
+        assert conv.weight.grad.shape == [2, 1, 3, 3]
+
+
+class TestNorms:
+    def test_layernorm_ref(self):
+        ln = nn.LayerNorm(8)
+        x = fdata(4, 8)
+        out = ln(paddle.to_tensor(x))
+        mu = x.mean(-1, keepdims=True)
+        sd = x.std(-1, keepdims=True, ddof=0)
+        np.testing.assert_allclose(out.numpy(), (x - mu) / np.sqrt(sd ** 2 + 1e-5),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = fdata(4, 3, 5, 5) * 2 + 1
+        out = bn(paddle.to_tensor(x))
+        m = out.numpy().mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+        bn.eval()
+        out2 = bn(paddle.to_tensor(x))
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        out = gn(paddle.to_tensor(fdata(2, 4, 6, 6)))
+        assert out.shape == [2, 4, 6, 6]
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(16)
+        x = fdata(2, 16)
+        out = rn(paddle.to_tensor(x))
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+
+
+class TestActivationsDropout:
+    def test_activation_layers(self):
+        x = paddle.to_tensor(fdata(3, 4))
+        for layer, ref in [
+            (nn.ReLU(), lambda v: np.maximum(v, 0)),
+            (nn.Sigmoid(), lambda v: 1 / (1 + np.exp(-v))),
+            (nn.Tanh(), np.tanh),
+            (nn.Hardswish(), lambda v: v * np.clip(v + 3, 0, 6) / 6),
+        ]:
+            np.testing.assert_allclose(layer(x).numpy(), ref(x.numpy()), rtol=1e-4, atol=1e-5)
+
+    def test_softmax(self):
+        x = fdata(2, 5)
+        out = F.softmax(paddle.to_tensor(x), axis=-1)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        np.testing.assert_allclose(out.numpy(), e / e.sum(-1, keepdims=True), rtol=1e-5)
+
+    def test_dropout_train_eval(self):
+        drop = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        out = drop(x)
+        kept = (out.numpy() != 0).mean()
+        assert 0.35 < kept < 0.65
+        np.testing.assert_allclose(out.numpy()[out.numpy() != 0], 2.0, rtol=1e-6)
+        drop.eval()
+        np.testing.assert_array_equal(drop(x).numpy(), x.numpy())
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = fdata(4, 5)
+        labels = np.array([0, 2, 1, 4])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = fdata(4, 5)
+        labels = np.array([0, -100, 1, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        p = np.exp(logits - logits.max(-1, keepdims=True)); p /= p.sum(-1, keepdims=True)
+        ref = -np.log(p[[0, 2], [0, 1]]).mean()
+        np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-5)
+
+    def test_mse_l1(self):
+        a, b = fdata(3, 3), fdata(3, 3)
+        np.testing.assert_allclose(
+            float(F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()),
+            np.abs(a - b).mean(), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        x, y = fdata(4), (fdata(4) > 0).astype(np.float32)
+        out = F.binary_cross_entropy_with_logits(paddle.to_tensor(x), paddle.to_tensor(y))
+        p = 1 / (1 + np.exp(-x))
+        ref = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(float(out.numpy()), ref, rtol=1e-4)
+
+
+class TestTransformerRNN:
+    def test_mha_self_attention(self):
+        mha = nn.MultiHeadAttention(32, 4)
+        out = mha(paddle.to_tensor(fdata(2, 6, 32)))
+        assert out.shape == [2, 6, 32]
+
+    def test_encoder_stack_not_tied(self):
+        enc = nn.TransformerEncoder(nn.TransformerEncoderLayer(16, 2, 32), 2)
+        l0 = enc.layers[0].linear1.weight.numpy()
+        l1 = enc.layers[1].linear1.weight.numpy()
+        assert not np.allclose(l0, l1)
+
+    def test_transformer_full(self):
+        model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32)
+        out = model(paddle.to_tensor(fdata(2, 5, 16)), paddle.to_tensor(fdata(2, 4, 16)))
+        assert out.shape == [2, 4, 16]
+
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(8, 16)
+        y, (h, c) = lstm(paddle.to_tensor(fdata(3, 5, 8)))
+        assert y.shape == [3, 5, 16] and h.shape == [1, 3, 16] and c.shape == [1, 3, 16]
+
+    def test_gru_cell_vs_layer(self):
+        cell = nn.GRUCell(4, 8)
+        out, h = cell(paddle.to_tensor(fdata(2, 4)))
+        assert out.shape == [2, 8]
+
+    def test_rnn_grad(self):
+        lstm = nn.LSTM(4, 8)
+        y, _ = lstm(paddle.to_tensor(fdata(2, 3, 4)))
+        y.sum().backward()
+        assert lstm.weight_ih_0.grad is not None
+
+
+class TestLayerInfra:
+    def test_state_dict_roundtrip(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = m.state_dict()
+        assert "0.weight" in sd and "2.bias" in sd
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict(sd)
+        x = paddle.to_tensor(fdata(2, 4))
+        np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+    def test_named_parameters_dedup_shared(self):
+        lin = nn.Linear(3, 3)
+
+        class Tied(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = lin
+                self.b = lin
+
+        names = [n for n, _ in Tied().named_parameters()]
+        assert len(names) == 2  # weight+bias counted once
+
+    def test_train_eval_propagates(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_apply_and_to_dtype(self):
+        m = nn.Linear(2, 2)
+        m.to(dtype="bfloat16")
+        assert m.weight.dtype == paddle.bfloat16
+
+    def test_hooks(self):
+        m = nn.Linear(2, 2)
+        calls = []
+        h = m.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        m(paddle.to_tensor(fdata(1, 2)))
+        assert calls == [1]
+        h.remove()
+        m(paddle.to_tensor(fdata(1, 2)))
+        assert calls == [1]
+
+    def test_buffers_in_state_dict(self):
+        bn = nn.BatchNorm2D(3)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_sublayer_iteration(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+        assert len(m.sublayers()) == 3

@@ -1,0 +1,184 @@
+"""Optimizer + AMP tests (SURVEY.md §2.4 optimizer/AMP rows)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def make_problem():
+    paddle.seed(0)
+    X = np.random.default_rng(0).standard_normal((32, 8)).astype(np.float32)
+    w = np.random.default_rng(1).standard_normal((8, 1)).astype(np.float32)
+    Y = X @ w
+    model = nn.Linear(8, 1)
+    return model, paddle.to_tensor(X), paddle.to_tensor(Y)
+
+
+def train(model, X, Y, opt, steps=40):
+    losses = []
+    for _ in range(steps):
+        loss = ((model(X) - Y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("cls,kw", [
+        (paddle.optimizer.SGD, dict(learning_rate=0.1)),
+        (paddle.optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+        (paddle.optimizer.Adam, dict(learning_rate=0.05)),
+        (paddle.optimizer.AdamW, dict(learning_rate=0.05)),
+        (paddle.optimizer.RMSProp, dict(learning_rate=0.01)),
+        (paddle.optimizer.Adagrad, dict(learning_rate=0.1)),
+        (paddle.optimizer.Adamax, dict(learning_rate=0.05)),
+        (paddle.optimizer.Lamb, dict(learning_rate=0.02)),
+        (paddle.optimizer.Adadelta, dict(learning_rate=5.0)),
+    ])
+    def test_converges(self, cls, kw):
+        model, X, Y = make_problem()
+        opt = cls(parameters=model.parameters(), **kw)
+        steps = 120 if cls is paddle.optimizer.Adadelta else 40
+        losses = train(model, X, Y, opt, steps=steps)
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    def test_sgd_exact_update(self):
+        p = paddle.core.tensor.Parameter(np.array([1.0, 2.0], np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[p])
+        p.grad = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.5, 1.5], rtol=1e-6)
+
+    def test_adamw_decoupled_decay(self):
+        # with zero grads, AdamW still shrinks weights; Adam does not
+        p1 = paddle.core.tensor.Parameter(np.ones(4, np.float32))
+        p2 = paddle.core.tensor.Parameter(np.ones(4, np.float32))
+        aw = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[p1])
+        ad = paddle.optimizer.Adam(learning_rate=0.1, parameters=[p2])
+        for p, o in [(p1, aw), (p2, ad)]:
+            p.grad = paddle.zeros([4])
+            o.step()
+        assert p1.numpy()[0] < 1.0
+        np.testing.assert_allclose(p2.numpy(), np.ones(4), rtol=1e-6)
+
+    def test_grad_clip_global_norm(self):
+        p = paddle.core.tensor.Parameter(np.zeros(2, np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p],
+                                   grad_clip=paddle.optimizer.ClipGradByGlobalNorm(1.0))
+        p.grad = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        opt.step()
+        np.testing.assert_allclose(np.linalg.norm(p.numpy()), 1.0, rtol=1e-5)
+
+    def test_state_dict_roundtrip(self):
+        model, X, Y = make_problem()
+        opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=model.parameters())
+        train(model, X, Y, opt, steps=3)
+        sd = opt.state_dict()
+        opt2 = paddle.optimizer.Adam(learning_rate=0.05, parameters=model.parameters())
+        opt2.set_state_dict(sd)
+        k = model.weight.name + ".moment1"
+        np.testing.assert_allclose(opt2._state[id(model.weight)]["moment1"],
+                                   opt._state[id(model.weight)]["moment1"], rtol=1e-6)
+
+    def test_lr_mult_per_param(self):
+        p = paddle.core.tensor.Parameter(np.ones(2, np.float32))
+        p.optimize_attr["learning_rate"] = 0.0  # frozen via lr multiplier
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        p.grad = paddle.ones([2])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), np.ones(2), rtol=1e-6)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sch = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(sch())
+            sch.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+    def test_warmup_then_cosine(self):
+        cos = paddle.optimizer.lr.CosineAnnealingDecay(0.1, T_max=10)
+        sch = paddle.optimizer.lr.LinearWarmup(cos, warmup_steps=5, start_lr=0.0,
+                                               end_lr=0.1)
+        first = sch()
+        for _ in range(5):
+            sch.step()
+        assert first == 0.0
+        assert abs(sch() - 0.1) < 1e-6
+
+    def test_optimizer_uses_scheduler(self):
+        model, X, Y = make_problem()
+        sch = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        opt = paddle.optimizer.SGD(learning_rate=sch, parameters=model.parameters())
+        assert opt.get_lr() == 0.1
+        sch.step()
+        assert abs(opt.get_lr() - 0.01) < 1e-9
+
+    def test_noam(self):
+        sch = paddle.optimizer.lr.NoamDecay(d_model=64, warmup_steps=10,
+                                            learning_rate=1.0)
+        for _ in range(9):
+            sch.step()
+        peak_region = sch()
+        for _ in range(100):
+            sch.step()
+        assert sch() < peak_region
+
+    def test_reduce_on_plateau(self):
+        sch = paddle.optimizer.lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        for loss in [1.0, 1.0, 1.0, 1.0]:
+            sch.step(loss)
+        assert sch() < 0.1
+
+
+class TestAMP:
+    def test_autocast_matmul_bf16(self):
+        with paddle.amp.auto_cast():
+            out = paddle.matmul(paddle.randn([4, 4]), paddle.randn([4, 4]))
+        assert out.dtype == paddle.bfloat16
+
+    def test_autocast_blacklist_stays_fp32(self):
+        x = paddle.randn([4, 4])
+        with paddle.amp.auto_cast():
+            out = paddle.ops.reduction.mean(x)
+        assert out.dtype == paddle.float32
+
+    def test_autocast_off_outside(self):
+        out = paddle.matmul(paddle.randn([2, 2]), paddle.randn([2, 2]))
+        assert out.dtype == paddle.float32
+
+    def test_grad_scaler_skips_on_inf(self):
+        p = paddle.core.tensor.Parameter(np.ones(2, np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        p.grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+        scaler.step(opt)
+        np.testing.assert_allclose(p.numpy(), np.ones(2))  # update skipped
+        assert scaler._scale < 4.0  # scale reduced
+
+    def test_grad_scaler_scales(self):
+        p = paddle.core.tensor.Parameter(np.ones(2, np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+        loss = (paddle.to_tensor(np.array([2.0, 2.0], np.float32)) * p).sum()
+        scaler.scale(loss).backward()
+        np.testing.assert_allclose(p.grad.numpy(), [16.0, 16.0], rtol=1e-6)
+        scaler.step(opt)
+        np.testing.assert_allclose(p.numpy(), 1 - 0.5 * 2 * np.ones(2), rtol=1e-6)
+
+    def test_amp_decorate_o2(self):
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, dtype="bfloat16")
+        assert model.weight.dtype == paddle.bfloat16
+        assert opt._multi_precision
+        out = model(paddle.to_tensor(np.ones((2, 4), np.float32)).astype('bfloat16'))
+        out.sum().backward()
+        opt.step()
+        # master weights kept in fp32
+        assert opt._state[id(model.weight)]["master"].dtype == np.float32
