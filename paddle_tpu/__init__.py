@@ -49,6 +49,20 @@ from . import optimizer  # noqa: F401
 from . import amp  # noqa: F401
 from .nn.layer import ParamAttr  # noqa: F401
 
+from . import io  # noqa: F401
+from . import vision  # noqa: F401
+from . import jit  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import metrics as metric  # noqa: F401
+from .utils.checkpoint import save, load  # noqa: F401
+from .hapi import Model, callbacks  # noqa: F401
+
+# regularizer namespace (paddle.regularizer.L1Decay/L2Decay)
+from .optimizer.optimizers import L1Decay as _L1, L2Decay as _L2
+import types as _t
+regularizer = _t.SimpleNamespace(L1Decay=_L1, L2Decay=_L2)
+del _t
+
 
 def is_grad_enabled():
     return autograd.is_grad_enabled()

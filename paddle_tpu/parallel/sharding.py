@@ -1,0 +1,202 @@
+"""Sharding rules: fleet strategies → GSPMD PartitionSpecs.
+
+Reference parity (SURVEY.md §2.3): DP batch sharding, GroupSharded stage1/2/3
+(python/paddle/distributed/fleet/meta_parallel/sharding/ — param/grad/
+opt-state partition), TP weight sharding, Megatron-SP activation sharding —
+all upstream-canonical, unverified.
+
+TPU-native design: one table of name-pattern → PartitionSpec rules; ZeRO-3 ≡
+sharding params on the 'sharding' axis, ZeRO-1/2 ≡ sharding only optimizer
+state; grad sync is XLA-inserted. The partitioner/reshard machinery of the
+reference's auto-parallel (SURVEY.md §3.4) is XLA's SPMD partitioner.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .topology import get_mesh
+
+
+def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def replicate(mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or get_mesh(), P())
+
+
+def _divisible(dim_size: int, axis_size: int) -> bool:
+    return dim_size % axis_size == 0 and dim_size >= axis_size
+
+
+def add_fsdp_axis(spec: P, shape: Sequence[int], mesh: Mesh,
+                  axis: str = "sharding") -> P:
+    """Augment a (possibly TP-sharded) spec with the FSDP axis on the largest
+    still-unsharded divisible dim — ZeRO-3's param partition as a spec."""
+    n = mesh.shape[axis]
+    if n == 1:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if entries[i] is None and _divisible(shape[i], n):
+            entries[i] = axis
+            return P(*entries)
+    return spec  # nothing divisible: stay as-is (replicated on this axis)
+
+
+class ShardingRules:
+    """Ordered (pattern → spec) table; first match wins. Specs may be
+    callables (shape)->P for shape-dependent decisions."""
+
+    def __init__(self, rules: Optional[List[Tuple[str, Union[P, Callable]]]] = None,
+                 default: P = P()):
+        self.rules = list(rules or [])
+        self.default = default
+
+    def add(self, pattern: str, spec) -> "ShardingRules":
+        self.rules.append((pattern, spec))
+        return self
+
+    def spec_for(self, name: str, shape: Sequence[int]) -> P:
+        for pat, spec in self.rules:
+            if re.search(pat, name):
+                return spec(tuple(shape)) if callable(spec) else spec
+        return self.default
+
+
+def spec_of_param(p: Tensor) -> P:
+    """TP layers annotate params with ._sharding_spec; default replicated."""
+    return getattr(p, "_sharding_spec", None) or P()
+
+
+def annotate(p: Tensor, spec: P) -> Tensor:
+    p._sharding_spec = spec
+    return p
+
+
+def model_shardings(layer: Layer, mesh: Optional[Mesh] = None,
+                    rules: Optional[ShardingRules] = None,
+                    fsdp: bool = False) -> Dict[str, NamedSharding]:
+    """Compute the NamedSharding for every state entry of `layer`:
+    per-param annotation (TP) → rules table → +FSDP axis."""
+    mesh = mesh or get_mesh()
+    out = {}
+    entries = layer.state_dict()
+    param_names = {name for name, _ in layer.named_parameters()}
+    for name, t in entries.items():
+        shape = tuple(t._data.shape)
+        spec = getattr(t, "_sharding_spec", None)
+        if spec is None and rules is not None:
+            spec = rules.spec_for(name, shape)
+        spec = spec or P()
+        if fsdp and name in param_names:
+            spec = add_fsdp_axis(spec, shape, mesh)
+        out[name] = NamedSharding(mesh, spec)
+    return out
+
+
+def shard_model(layer: Layer, mesh: Optional[Mesh] = None,
+                rules: Optional[ShardingRules] = None, fsdp: bool = False):
+    """Materialize: device_put every param/buffer with its computed sharding.
+    After this, eager ops run SPMD (computation-follows-sharding) and jitted
+    steps take these as in_shardings."""
+    mesh = mesh or get_mesh()
+    shardings = model_shardings(layer, mesh, rules, fsdp)
+    for name, t in layer.state_dict().items():
+        t._data = jax.device_put(t._data, shardings[name])
+    return shardings
+
+
+def shard_tensor(x, mesh: Optional[Mesh] = None, placements=None) -> Tensor:
+    """paddle.distributed.shard_tensor parity: Shard(i)/Replicate placements →
+    PartitionSpec (SURVEY.md §2.3 auto-parallel row: Shard(0) ≈ P(axis))."""
+    mesh = mesh or get_mesh()
+    t = x if isinstance(x, Tensor) else Tensor(jax.numpy.asarray(x))
+    spec = placements_to_spec(placements, t._data.ndim, mesh)
+    t._data = jax.device_put(t._data, NamedSharding(mesh, spec))
+    t._sharding_spec = spec
+    return t
+
+
+class Shard:
+    """dist.Shard(dim) placement."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard({self.dim})"
+
+
+class Replicate:
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial:
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+
+def placements_to_spec(placements, ndim: int, mesh: Mesh) -> P:
+    """[Shard(0), Replicate(), ...] (one entry per MESH axis, paddle
+    convention) → PartitionSpec (one entry per TENSOR dim)."""
+    if placements is None:
+        return P()
+    entries: List = [None] * ndim
+    axis_names = list(mesh.axis_names)
+    for axis_idx, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            if entries[pl.dim] is None:
+                entries[pl.dim] = axis_names[axis_idx]
+            elif isinstance(entries[pl.dim], tuple):
+                entries[pl.dim] = entries[pl.dim] + (axis_names[axis_idx],)
+            else:
+                entries[pl.dim] = (entries[pl.dim], axis_names[axis_idx])
+    return P(*entries)
+
+
+def with_sharding_constraint(x, spec: P, mesh: Optional[Mesh] = None):
+    """Annotate an intermediate (activation sharding — Megatron-SP is exactly
+    'seq dim gets the mp axis here')."""
+    arr = x._data if isinstance(x, Tensor) else x
+    out = jax.lax.with_sharding_constraint(
+        arr, NamedSharding(mesh or get_mesh(), spec))
+    return Tensor(out, stop_gradient=getattr(x, "stop_gradient", True)) \
+        if isinstance(x, Tensor) else out
+
+
+# canonical strategy rule-sets ------------------------------------------------
+
+def dp_rules() -> ShardingRules:
+    return ShardingRules(default=P())  # params replicated; batch on 'dp'
+
+
+def fsdp_rules() -> ShardingRules:
+    """stage3: every param sharded (largest dim) on 'sharding'."""
+    def rule(shape):
+        return P()  # base; add_fsdp_axis does the work via fsdp=True
+    return ShardingRules(default=P())
+
+
+def megatron_tp_rules(prefix_map: Optional[Dict[str, P]] = None) -> ShardingRules:
+    """Name-based TP rules for models not using the mpu layers: qkv/gate/up
+    column-sharded, out/down row-sharded, embeddings vocab-sharded."""
+    rules = [
+        (r"(q_proj|k_proj|v_proj|qkv|gate_proj|up_proj|fc1|linear1)\.weight", P(None, "mp")),
+        (r"(o_proj|out_proj|down_proj|fc2|linear2)\.weight", P("mp", None)),
+        (r"(q_proj|k_proj|v_proj|qkv|gate_proj|up_proj|fc1|linear1)\.bias", P("mp")),
+        (r"(embed_tokens|word_embeddings|embedding)\.weight", P("mp", None)),
+        (r"lm_head\.weight", P(None, "mp")),
+    ]
+    if prefix_map:
+        rules = [(k, v) for k, v in prefix_map.items()] + rules
+    return ShardingRules(rules)

@@ -1,0 +1,227 @@
+"""Hybrid-parallel topology — the mesh IS the topology object.
+
+Reference parity: python/paddle/distributed/fleet/base/topology.py
+(HybridCommunicateGroup builds the 5D cartesian [dp, pp, sharding, sep, mp]
+topology and per-axis NCCL comm groups — upstream-canonical, unverified,
+SURVEY.md §0).
+
+TPU-native design (SURVEY.md §2.3 init/topology row): a
+jax.sharding.Mesh with named axes replaces the rank bookkeeping entirely; a
+"communication group" degenerates to a mesh-axis name. Axis order maps the
+most communication-intensive axes innermost so their collectives ride
+ICI neighbor links: [dp | sharding | pp | sep | mp] with mp innermost.
+For multi-slice (DCN), pass a hybrid device list built with
+jax.experimental.mesh_utils.create_hybrid_device_mesh — dp/pp outermost over
+DCN (SURVEY.md §5 'Distributed communication backend').
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXES = ("dp", "sharding", "pp", "sep", "mp")
+_global_mesh: Optional[Mesh] = None
+_global_topo: Optional["HybridCommunicateGroup"] = None
+
+
+def build_mesh(dp: int = 1, sharding: int = 1, pp: int = 1, sep: int = 1,
+               mp: int = 1, devices: Optional[Sequence] = None,
+               dcn_dp: int = 1) -> Mesh:
+    """Create the hybrid mesh. `dcn_dp` > 1 splits dp over DCN for
+    multi-slice (hybrid mesh via mesh_utils)."""
+    shape = dict(dp=dp, sharding=sharding, pp=pp, sep=sep, mp=mp)
+    total = int(np.prod(list(shape.values())))
+    if devices is None:
+        devices = jax.devices()
+    if total != len(devices):
+        raise ValueError(
+            f"topology {shape} needs {total} devices, have {len(devices)}")
+    if dcn_dp > 1:
+        from jax.experimental import mesh_utils
+        per_slice = dict(shape)
+        per_slice["dp"] = dp // dcn_dp
+        dev_mesh = mesh_utils.create_hybrid_device_mesh(
+            tuple(per_slice.values()), (dcn_dp, 1, 1, 1, 1), devices=devices)
+        return Mesh(dev_mesh, AXES)
+    dev_array = np.asarray(devices).reshape(tuple(shape.values()))
+    return Mesh(dev_array, AXES)
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Mesh:
+    global _global_mesh
+    if _global_mesh is None:
+        _global_mesh = build_mesh(dp=len(jax.devices()))
+    return _global_mesh
+
+
+def mesh_axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_mesh()
+    return mesh.shape[axis]
+
+
+class CommGroup:
+    """A mesh-axis-backed communication group (ProcessGroup identity parity).
+
+    In the reference a group is a set of global ranks with an NCCL
+    communicator; here it names one or more mesh axes — collectives inside
+    shard_map reduce over `axis_names`."""
+
+    _next_id = 0
+
+    def __init__(self, axis_names, mesh: Optional[Mesh] = None, ranks=None):
+        self.axis_names = (axis_names,) if isinstance(axis_names, str) else tuple(axis_names)
+        self.mesh = mesh or get_mesh()
+        self.id = CommGroup._next_id
+        CommGroup._next_id += 1
+        self._ranks = ranks
+
+    @property
+    def nranks(self) -> int:
+        n = 1
+        for a in self.axis_names:
+            n *= self.mesh.shape[a]
+        return n
+
+    world_size = nranks
+
+    @property
+    def rank(self) -> int:
+        # single-controller: the concept is per-device; expose process index
+        # scaled into the axis (0 on single host)
+        return 0
+
+    def get_group_rank(self, rank):
+        return rank
+
+    def __repr__(self):
+        return f"CommGroup(axes={self.axis_names}, nranks={self.nranks})"
+
+
+class CommunicateTopology:
+    """fleet.base.topology.CommunicateTopology parity: named-dim cartesian
+    coordinate math over the mesh shape."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = collections.namedtuple("Coordinate", self._parallel_names)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **args):
+        assert len(args) == len(self._dims)
+        strides = np.cumprod([1] + self._dims[::-1][:-1])[::-1]
+        return int(sum(args[n] * s for n, s in zip(self._parallel_names, strides)))
+
+    def get_coord(self, rank):
+        coords = []
+        for d in self._dims[::-1]:
+            coords.append(rank % d)
+            rank //= d
+        return self.coordinate(*coords[::-1])
+
+
+class HybridCommunicateGroup:
+    """fleet.base.topology.HybridCommunicateGroup parity over a Mesh."""
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 mesh: Optional[Mesh] = None):
+        self.mesh = mesh or get_mesh()
+        sh = self.mesh.shape
+        self._dp_degree = sh["dp"]
+        self._pp_degree = sh["pp"]
+        self._sharding_degree = sh["sharding"]
+        self._sep_degree = sh["sep"]
+        self._mp_degree = sh["mp"]
+        self._topo = topology or CommunicateTopology(
+            dims=(sh["dp"], sh["pp"], sh["sharding"], sh["sep"], sh["mp"]))
+        self._dp_group = CommGroup("dp", self.mesh)
+        self._pp_group = CommGroup("pp", self.mesh)
+        self._sharding_group = CommGroup("sharding", self.mesh)
+        self._sep_group = CommGroup("sep", self.mesh)
+        self._mp_group = CommGroup("mp", self.mesh)
+
+    # degree getters (paddle names)
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # ranks: single-controller — callers that branch on rank are running the
+    # one global program; return 0 (the reference uses these to split work
+    # per-process, which GSPMD does automatically)
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    # groups
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_check_parallel_group(self, *a):
+        return CommGroup(AXES, self.mesh)
+
+    def topology(self):
+        return self._topo
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _global_topo
+    _global_topo = hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    global _global_topo
+    if _global_topo is None:
+        _global_topo = HybridCommunicateGroup()
+    return _global_topo

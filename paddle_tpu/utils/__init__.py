@@ -1,0 +1,3 @@
+from . import checkpoint  # noqa: F401
+from . import metrics  # noqa: F401
+from .checkpoint import save, load  # noqa: F401

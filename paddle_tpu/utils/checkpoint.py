@@ -1,0 +1,92 @@
+"""Checkpointing — paddle.save/paddle.load parity
+(python/paddle/framework/io.py — upstream-canonical, unverified, SURVEY.md §0).
+
+TPU-native design (SURVEY.md §5 checkpoint row): two formats behind one API —
+(1) single-file pickle-of-numpy for paddle-style `.pdparams`/`.pdopt` files
+(exact API parity, host-memory bound), and (2) Orbax for sharded/async
+distributed checkpoints (reshard-on-load is native: pass target shardings at
+restore). The distributed engine uses the orbax path.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return ("__tensor__", np.asarray(obj._data), str(obj.dtype))
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, tuple) and len(obj) == 3 and obj[0] == "__tensor__":
+        arr = obj[1]
+        if return_numpy:
+            return arr
+        import jax.numpy as jnp
+        import ml_dtypes
+        dt = np.dtype(obj[2]) if obj[2] != "bfloat16" else np.dtype(ml_dtypes.bfloat16)
+        return Tensor(jnp.asarray(arr).astype(dt) if str(arr.dtype) != obj[2] else jnp.asarray(arr))
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)) :
+        return type(obj)(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
+    """paddle.save — state dicts, Tensors, or nested py structures."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saveable(obj, return_numpy=return_numpy)
+
+
+# ---- orbax-backed distributed checkpointing --------------------------------
+
+def save_sharded(state: Dict[str, Any], directory: str, step: int = 0,
+                 async_save: bool = False):
+    """Distributed checkpoint via orbax (paddle.distributed.checkpoint.save
+    analog). `state` is a pytree of jax.Arrays (possibly sharded); each host
+    writes its shards."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.join(directory, str(step)))
+    ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler()) if async_save \
+        else ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+    arrays = {k: (v._data if isinstance(v, Tensor) else v)
+              for k, v in state.items()}
+    ckptr.save(path, arrays, force=True)
+    return ckptr
+
+
+def load_sharded(directory: str, step: int = 0, target_shardings=None):
+    """Restore; pass NamedShardings to reshard-on-load (TP/PP relayout is a
+    restore-time no-op, unlike the reference's merge scripts — SURVEY.md §5)."""
+    import orbax.checkpoint as ocp
+    import jax
+
+    path = os.path.abspath(os.path.join(directory, str(step)))
+    ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+    if target_shardings is None:
+        return ckptr.restore(path)
+    restore_args = jax.tree_util.tree_map(
+        lambda s: ocp.ArrayRestoreArgs(sharding=s), target_shardings)
+    return ckptr.restore(path, restore_args=restore_args)

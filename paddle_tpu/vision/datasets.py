@@ -1,0 +1,174 @@
+"""Vision datasets — python/paddle/vision/datasets/ parity (upstream-canonical,
+unverified — SURVEY.md §0). Zero-egress environment: download paths raise with
+instructions; FakeData (paddle-parity: paddle.vision.datasets has none, but the
+reference test-suites synthesize data the same way) serves as the offline
+stand-in for smoke tests."""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic image classification dataset (offline smoke tests)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self.seed + idx)
+        img = rng.standard_normal(self.image_shape).astype(np.float32)
+        label = int(rng.integers(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, dtype=np.int64)
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files (no download — zero egress)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="cv2"):
+        if image_path is None or label_path is None or \
+                not os.path.exists(image_path):
+            raise RuntimeError(
+                "MNIST download unavailable (zero-egress environment); place "
+                "idx files locally and pass image_path/label_path "
+                "(paddle_tpu/vision/datasets.py)")
+        self.transform = transform
+        self.images, self.labels = self._load(image_path, label_path)
+
+    def _load(self, image_path, label_path):
+        with gzip.open(image_path, "rb") if image_path.endswith(".gz") else \
+                open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols)
+        with gzip.open(label_path, "rb") if label_path.endswith(".gz") else \
+                open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), dtype=np.uint8)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local python-pickle tarball (no download)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "Cifar10 download unavailable (zero-egress environment); pass "
+                "a local cifar-10-python.tar.gz via data_file")
+        self.transform = transform
+        names, label_key = self._members(mode)
+        xs, ys = [], []
+        with tarfile.open(data_file) as tar:
+            for m in tar.getmembers():
+                if any(m.name.endswith(n) for n in names):
+                    d = pickle.load(tar.extractfile(m), encoding="bytes")
+                    xs.append(d[b"data"])
+                    ys.extend(d[label_key])
+        if not xs:
+            raise RuntimeError(
+                f"no {names} members found in {data_file} — wrong archive?")
+        self.data = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        self.labels = np.asarray(ys, dtype=np.int64)
+
+    @staticmethod
+    def _members(mode):
+        names = [f"data_batch_{i}" for i in range(1, 6)] if mode == "train" \
+            else ["test_batch"]
+        return names, b"labels"
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    """CIFAR-100 layout differs: members 'train'/'test', key b'fine_labels'."""
+
+    @staticmethod
+    def _members(mode):
+        return (["train"] if mode == "train" else ["test"]), b"fine_labels"
+
+
+class DatasetFolder(Dataset):
+    """ImageFolder-style directory dataset (class-per-subdir)."""
+
+    IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or self.IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError(
+                f"no loader for {path}: PIL unavailable; use .npy files or "
+                "pass loader=") from e
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+ImageFolder = DatasetFolder

@@ -1,0 +1,249 @@
+"""Vision transforms — python/paddle/vision/transforms/ parity
+(upstream-canonical, unverified — SURVEY.md §0). Numpy/PIL-free: operates on
+HWC numpy arrays (PIL accepted if available). Host-side preprocessing stays on
+CPU by design — device work starts at the batch boundary."""
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+from typing import List, Sequence
+
+import numpy as np
+
+
+def _to_hwc_array(img):
+    if isinstance(img, np.ndarray):
+        return img
+    # PIL image duck-typing
+    return np.asarray(img)
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(_to_hwc_array(img))
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] → CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        out = img.astype(np.float32) / 255.0 if img.dtype == np.uint8 \
+            else img.astype(np.float32)
+        if self.data_format == "CHW":
+            out = out.transpose(2, 0, 1)
+        return out
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = img.astype(np.float32)
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        import jax
+        import jax.numpy as jnp
+
+        h, w = self.size
+        method = {"bilinear": "linear", "nearest": "nearest",
+                  "bicubic": "cubic"}[self.interpolation]
+        squeeze = img.ndim == 2
+        if squeeze:
+            img = img[:, :, None]
+        out = np.asarray(jax.image.resize(
+            jnp.asarray(img.astype(np.float32)), (h, w, img.shape[2]), method=method))
+        if img.dtype == np.uint8:
+            out = np.clip(out, 0, 255).astype(np.uint8)
+        return out[:, :, 0] if squeeze else out
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return img[i:i + th, j:j + tw]
+
+
+def _norm_padding4(p):
+    """int | (lr, tb) | (l, t, r, b) → (l, t, r, b)."""
+    if isinstance(p, (int, numbers.Integral)):
+        return (p, p, p, p)
+    p = tuple(p)
+    if len(p) == 2:
+        return (p[0], p[1], p[0], p[1])
+    if len(p) == 4:
+        return p
+    raise ValueError(f"padding must be int, 2-tuple, or 4-tuple; got {p}")
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if self.padding:
+            l, t, r, b = _norm_padding4(self.padding)
+            pads = [(t, b), (l, r)] + [(0, 0)] * (img.ndim - 2)
+            img = np.pad(img, pads, constant_values=self.fill)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            pads = [(0, max(th - h, 0)), (0, max(tw - w, 0))] + \
+                [(0, 0)] * (img.ndim - 2)
+            img = np.pad(img, pads, constant_values=self.fill)
+            h, w = img.shape[:2]
+        if h < th or w < tw:
+            raise ValueError(
+                f"image ({h},{w}) smaller than crop {self.size}; pass "
+                "pad_if_needed=True")
+        i = pyrandom.randint(0, h - th)
+        j = pyrandom.randint(0, w - tw)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if pyrandom.random() < self.prob:
+            return img[:, ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if pyrandom.random() < self.prob:
+            return img[::-1].copy()
+        return img
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * pyrandom.uniform(*self.scale)
+            ar = np.exp(pyrandom.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target_area * ar)))
+            th = int(round(np.sqrt(target_area / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = pyrandom.randint(0, h - th)
+                j = pyrandom.randint(0, w - tw)
+                return self.resize._apply_image(img[i:i + th, j:j + tw])
+        return self.resize._apply_image(CenterCrop(min(h, w))._apply_image(img))
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = _norm_padding4(padding)
+        self.fill = fill
+
+    def _apply_image(self, img):
+        l, t, r, b = self.padding
+        pads = [(t, b), (l, r)] + [(0, 0)] * (img.ndim - 2)
+        return np.pad(img, pads, constant_values=self.fill)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return img.transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + pyrandom.uniform(-self.value, self.value)
+        return np.clip(img.astype(np.float32) * f, 0,
+                       255 if img.dtype == np.uint8 else np.inf).astype(img.dtype)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.brightness = brightness
+        self.contrast = contrast
+
+    def _apply_image(self, img):
+        out = img.astype(np.float32)
+        if self.brightness:
+            out = out * (1 + pyrandom.uniform(-self.brightness, self.brightness))
+        if self.contrast:
+            mean = out.mean()
+            out = (out - mean) * (1 + pyrandom.uniform(-self.contrast, self.contrast)) + mean
+        hi = 255 if img.dtype == np.uint8 else np.inf
+        return np.clip(out, 0, hi).astype(img.dtype)
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(_to_hwc_array(img))
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    return _to_hwc_array(img)[:, ::-1].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)

@@ -1,0 +1,232 @@
+"""io + vision + hapi tests (SURVEY.md §2.4 DataLoader/vision rows; BASELINE
+config 0 smoke)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (DataLoader, TensorDataset, BatchSampler,
+                           DistributedBatchSampler, Subset, ConcatDataset,
+                           random_split, IterableDataset)
+from paddle_tpu.vision import FakeData, models
+from paddle_tpu.vision import transforms as T
+
+
+class TestDatasets:
+    def test_tensor_dataset(self):
+        ds = TensorDataset([paddle.randn([10, 3]), paddle.arange(10)])
+        assert len(ds) == 10
+        x, y = ds[3]
+        assert x.shape == [3] and int(y.numpy()) == 3
+
+    def test_concat_subset_split(self):
+        a = FakeData(size=6, image_shape=(2,), num_classes=2)
+        b = FakeData(size=4, image_shape=(2,), num_classes=2)
+        cat = ConcatDataset([a, b])
+        assert len(cat) == 10
+        sub = Subset(a, [0, 2])
+        assert len(sub) == 2
+        tr, va = random_split(a, [4, 2])
+        assert len(tr) == 4 and len(va) == 2
+        tr, va = random_split(a, [0.5, 0.5])
+        assert len(tr) + len(va) == 6
+
+
+class TestDataLoader:
+    def test_basic_batching(self):
+        ds = FakeData(size=10, image_shape=(3, 4, 4), num_classes=3)
+        dl = DataLoader(ds, batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 3, 4, 4] and x.dtype == np.float32
+        assert y.shape == [4] and y.dtype == np.int64
+        assert batches[-1][0].shape[0] == 2  # remainder kept
+
+    def test_drop_last_shuffle(self):
+        ds = FakeData(size=10, image_shape=(2,), num_classes=2)
+        dl = DataLoader(ds, batch_size=4, drop_last=True, shuffle=True)
+        assert len(list(dl)) == 2
+
+    def test_iterable_dataset(self):
+        class Stream(IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.float32(i)
+
+        dl = DataLoader(Stream(), batch_size=3)
+        batches = list(dl)
+        assert len(batches) == 3
+        np.testing.assert_allclose(batches[0].numpy(), [0, 1, 2])
+
+    def test_multiprocess_workers(self):
+        ds = FakeData(size=12, image_shape=(2, 3), num_classes=2)
+        dl = DataLoader(ds, batch_size=4, num_workers=2)
+        ref = DataLoader(ds, batch_size=4, num_workers=0, use_buffer_reader=False)
+        got = [b[0].numpy() for b in dl]
+        want = [b[0].numpy() for b in ref]
+        assert len(got) == len(want) == 3
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w)
+
+    def test_worker_error_propagates(self):
+        class Bad(FakeData):
+            def __getitem__(self, idx):
+                raise ValueError("boom")
+
+        dl = DataLoader(Bad(size=4, image_shape=(2,)), batch_size=2, num_workers=1)
+        with pytest.raises(ValueError):
+            list(dl)
+
+    def test_distributed_batch_sampler_shards(self):
+        ds = FakeData(size=12, image_shape=(2,), num_classes=2)
+        seen = []
+        for rank in range(3):
+            bs = DistributedBatchSampler(ds, batch_size=2, num_replicas=3,
+                                         rank=rank)
+            idx = [i for batch in bs for i in batch]
+            assert len(idx) == 4
+            seen.extend(idx)
+        assert sorted(seen) == list(range(12))
+
+
+class TestTransforms:
+    def test_compose_pipeline(self):
+        img = (np.random.default_rng(0).uniform(0, 255, (32, 40, 3))).astype(np.uint8)
+        tf = T.Compose([T.Resize(36), T.CenterCrop(32), T.ToTensor(),
+                        T.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])])
+        out = tf(img)
+        assert out.shape == (3, 32, 32)
+        assert out.dtype == np.float32
+        assert -1.01 <= out.min() and out.max() <= 1.01
+
+    def test_flip_crop(self):
+        img = np.arange(24, dtype=np.uint8).reshape(4, 6)
+        assert T.RandomHorizontalFlip(1.0)(img)[0, 0] == img[0, -1]
+        out = T.RandomCrop(2)(img)
+        assert out.shape == (2, 2)
+
+
+class TestVisionModels:
+    def test_resnet18_forward_backward(self):
+        net = models.resnet18(num_classes=4)
+        out = net(paddle.randn([2, 3, 32, 32]))
+        assert out.shape == [2, 4]
+        out.sum().backward()
+        assert net.conv1.weight.grad is not None
+
+    def test_resnet50_structure(self):
+        net = models.resnet50(num_classes=10)
+        n = sum(p.size for p in net.parameters())
+        assert 23e6 < n < 26e6
+        names = dict(net.named_parameters())
+        assert "layer1.0.conv1.weight" in names
+        assert "fc.weight" in names
+
+    def test_lenet(self):
+        net = models.LeNet()
+        assert net(paddle.randn([2, 1, 28, 28])).shape == [2, 10]
+
+    def test_mobilenet_v2(self):
+        net = models.mobilenet_v2(num_classes=5)
+        assert net(paddle.randn([1, 3, 32, 32])).shape == [1, 5]
+
+    def test_vgg11_tiny(self):
+        net = models.vgg11(num_classes=3)
+        assert net(paddle.randn([1, 3, 224, 224])).shape == [1, 3]
+
+
+class TestHapiModel:
+    def test_fit_evaluate_predict(self, tmp_path):
+        paddle.seed(0)
+        net = models.LeNet()
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=0.001,
+                                            parameters=net.parameters()),
+            loss=paddle.nn.CrossEntropyLoss(),
+            metrics=paddle.metric.Accuracy())
+        ds = FakeData(size=8, image_shape=(1, 28, 28), num_classes=10)
+        model.fit(ds, batch_size=4, epochs=1, verbose=0)
+        logs = model.evaluate(ds, batch_size=4, verbose=0)
+        assert "eval_acc" in logs
+        out = model.predict(ds, batch_size=4)
+        assert len(out[0]) == 2
+        p = str(tmp_path / "ck")
+        model.save(p)
+        model.load(p)
+
+    def test_pure_save_load_roundtrip(self, tmp_path):
+        net = models.LeNet()
+        path = str(tmp_path / "m.pdparams")
+        paddle.save(net.state_dict(), path)
+        loaded = paddle.load(path)
+        net2 = models.LeNet()
+        net2.set_state_dict(loaded)
+        x = paddle.randn([1, 1, 28, 28])
+        np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = paddle.metric.Accuracy(topk=(1, 2))
+        pred = paddle.to_tensor(np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]], np.float32))
+        label = paddle.to_tensor(np.array([[1], [2]]))
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert abs(top1 - 0.5) < 1e-6
+        assert abs(top2 - 0.5) < 1e-6
+
+    def test_functional_accuracy(self):
+        pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+        label = paddle.to_tensor(np.array([[1], [1]]))
+        acc = paddle.metric.accuracy(pred, label, k=1)
+        assert abs(float(acc.numpy()) - 0.5) < 1e-6
+
+    def test_precision_recall(self):
+        m = paddle.metric.Precision()
+        m.update(np.array([0.9, 0.9, 0.1]), np.array([1, 0, 1]))
+        assert abs(m.accumulate() - 0.5) < 1e-6
+        r = paddle.metric.Recall()
+        r.update(np.array([0.9, 0.9, 0.1]), np.array([1, 0, 1]))
+        assert abs(r.accumulate() - 0.5) < 1e-6
+
+
+class TestJit:
+    def test_to_static_function(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def f(x):
+            calls.append(1)
+            return x * 2 + 1
+
+        a = f(paddle.to_tensor([1.0, 2.0]))
+        b = f(paddle.to_tensor([3.0, 4.0]))
+        np.testing.assert_allclose(b.numpy(), [7.0, 9.0])
+        assert len(calls) == 1  # traced once, cached second call
+
+    def test_to_static_layer_matches_eager(self):
+        paddle.seed(1)
+        net = models.LeNet()
+        net.eval()
+        x = paddle.randn([2, 1, 28, 28])
+        eager_out = net(x).numpy()
+        jnet = paddle.jit.to_static(net)
+        np.testing.assert_allclose(jnet(x).numpy(), eager_out, rtol=1e-5, atol=1e-5)
+
+    def test_translated_layer_updates_buffers(self):
+        bn = paddle.nn.BatchNorm1D(4, data_format="NCL")
+        jbn = paddle.jit.to_static(bn)
+        before = bn._mean.numpy().copy()
+        jbn(paddle.randn([8, 4, 5]) + 3.0)
+        assert not np.allclose(bn._mean.numpy(), before)
+
+    def test_functional_call_pure(self):
+        from paddle_tpu.jit import functional_call, state_of
+        lin = paddle.nn.Linear(3, 2)
+        st = state_of(lin)
+        x = paddle.randn([2, 3])
+        out, _ = functional_call(lin, st, x)
+        np.testing.assert_allclose(out.numpy(), lin(x).numpy(), rtol=1e-6)
