@@ -1,0 +1,87 @@
+"""Benchmark: flagship Llama training step on one chip → MFU + tokens/sec.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured MFU / 40% (the BASELINE.json north-star floor;
+the reference publishes no numbers — BASELINE.md).
+
+Sized for a single chip's HBM (the driver benches on one real TPU); the
+model is a scaled Llama (same arch as the 8B flagship: GQA + SwiGLU + RoPE +
+flash attention + remat), params/opt f32, compute bf16.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# bf16 peak TFLOP/s per chip by TPU generation (public spec sheets)
+PEAK_TFLOPS = {
+    "v6": 918.0, "v5p": 459.0, "v5 lite": 197.0, "v5e": 197.0,
+    "v4": 275.0, "v3": 123.0, "v2": 46.0, "cpu": 0.5,
+}
+
+
+def peak_for(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for k, v in PEAK_TFLOPS.items():
+        if k in kind:
+            return v * 1e12
+    return 0.5e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nlp import llama, train
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if on_tpu:
+        # ~470M-param Llama: fits one chip's HBM with f32 Adam state + remat
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=2048)
+        batch, seq, timed_steps = 8, 2048, 10
+    else:
+        cfg = llama.LlamaConfig.tiny()
+        batch, seq, timed_steps = 4, 128, 3
+
+    tx = train.make_optimizer(1e-4)
+    state = train.init_state(jax.random.key(0), cfg, tx, mesh=None)
+    step = train.make_train_step(cfg, tx, mesh=None)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+
+    # warmup (compile) then timed loop. Sync via host transfer (float()):
+    # block_until_ready alone does not drain the axon remote queue.
+    for _ in range(2):
+        state, m = step(state, tokens)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        state, m = step(state, tokens)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * timed_steps / dt
+    flops_tok = llama.flops_per_token(cfg, seq)
+    mfu = tok_s * flops_tok / peak_for(dev)
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "mfu": round(mfu, 4),
+        "device": getattr(dev, "device_kind", str(dev)),
+        "model_params": llama.num_params(cfg),
+        "batch": batch, "seq": seq,
+        "loss": round(float(m["loss"]), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

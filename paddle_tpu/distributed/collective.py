@@ -1,0 +1,309 @@
+"""paddle.distributed collective API — TPU-native facade.
+
+Reference analog: `python/paddle/distributed/communication/*` →
+`ProcessGroup` (NCCL/Gloo) → vendor lib (SURVEY.md §2.3, §5 'Distributed
+communication backend'; upstream-canonical, unverified §0).
+
+TPU-native design — there is NO user-space comm library; three contexts:
+
+1. **Inside `shard_map`/`pmap` tracing** (axis names in scope): collectives
+   lower to XLA ops (`lax.psum`, `all_gather`, `ppermute`, `all_to_all`)
+   scheduled over ICI — this is the hot path, and the only one that touches
+   device interconnect.
+2. **Eager, multi-process** (one controller per host): host-level collectives
+   via `jax.experimental.multihost_utils` (backed by the same coordination
+   service that replaced TCPStore).
+3. **Eager, single process**: "rank" == the one process, so group size is 1
+   and collectives are identities — device-level parallelism is expressed by
+   sharding, not per-rank tensors.
+
+A `group` names mesh axes (CommGroup in parallel.topology); in context 1 the
+axis names are the XLA `axis_name`s.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..parallel.topology import CommGroup, get_mesh
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class _Task:
+    """ProcessGroup Task parity: collectives here are either compiled (async
+    by XLA's scheduler) or host-blocking, so wait() is trivially done."""
+
+    def __init__(self, result=None):
+        self.result = result
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _axes(group: Optional[CommGroup]):
+    if group is None:
+        return None  # world
+    return group.axis_names if len(group.axis_names) > 1 else group.axis_names[0]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _rewrap(data, like):
+    if isinstance(like, Tensor):
+        like._data = data
+        return like
+    return data
+
+
+def _in_trace(x) -> bool:
+    return isinstance(_unwrap(x), jax.core.Tracer)
+
+
+def _world_axes():
+    return tuple(get_mesh().axis_names)
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax, ReduceOp.MIN: lax.pmin,
+}
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[CommGroup] = None,
+               sync_op=True):
+    """In shard_map: lax.psum/pmax/pmin over the group's mesh axes.
+    Eager single-process: identity (group of one process)."""
+    x = _unwrap(tensor)
+    if _in_trace(tensor):
+        axes = _axes(group) or _world_axes()
+        if op == ReduceOp.AVG:
+            n = 1
+            mesh = get_mesh()
+            for a in (axes if isinstance(axes, tuple) else (axes,)):
+                n *= mesh.shape[a]
+            out = lax.psum(x, axes) / n
+        elif op == ReduceOp.PROD:
+            out = jnp.exp(lax.psum(jnp.log(x.astype(jnp.float32)), axes)).astype(x.dtype)
+        else:
+            out = _REDUCERS[op](x, axes)
+        _rewrap(out, tensor)
+        return _Task(out)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        out = multihost_utils.process_allgather(x)
+        if op == ReduceOp.SUM:
+            out = out.sum(0)
+        elif op == ReduceOp.MAX:
+            out = out.max(0)
+        elif op == ReduceOp.MIN:
+            out = out.min(0)
+        elif op == ReduceOp.AVG:
+            out = out.mean(0)
+        _rewrap(jnp.asarray(out), tensor)
+        return _Task(out)
+    return _Task(x)
+
+
+def all_gather(tensor_list: Optional[List], tensor, group=None, sync_op=True,
+               axis: int = 0):
+    """In shard_map: lax.all_gather (tiled). Appends per-rank slices to
+    tensor_list when given (paddle convention) or returns stacked array."""
+    x = _unwrap(tensor)
+    if _in_trace(tensor):
+        axes = _axes(group) or _world_axes()
+        out = lax.all_gather(x, axes, axis=axis, tiled=False)
+        if tensor_list is not None:
+            n = out.shape[axis]
+            for i in range(n):
+                tensor_list.append(Tensor(lax.index_in_dim(out, i, axis, keepdims=False)))
+            return _Task(out)
+        return Tensor(out)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        out = multihost_utils.process_allgather(x)
+        if tensor_list is not None:
+            for i in range(out.shape[0]):
+                tensor_list.append(Tensor(jnp.asarray(out[i])))
+            return _Task(out)
+        return Tensor(jnp.asarray(out))
+    if tensor_list is not None:
+        tensor_list.append(Tensor(x) if not isinstance(tensor, Tensor) else tensor)
+        return _Task(x)
+    return Tensor(x[None] if hasattr(x, "ndim") else jnp.asarray([x]))
+
+
+def all_gather_object(object_list: List, obj, group=None):
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        raise NotImplementedError(
+            "all_gather_object across hosts: serialize via arrays")
+    object_list.append(obj)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        src = jnp.concatenate([_unwrap(t) for t in src], axis=0)
+    else:
+        src = _unwrap(src)
+    if _in_trace(tensor_or_tensor_list if not isinstance(tensor_or_tensor_list, (list, tuple)) else tensor_or_tensor_list[0]) or isinstance(src, jax.core.Tracer):
+        axes = _axes(group) or _world_axes()
+        out = lax.psum_scatter(src, axes, scatter_dimension=0, tiled=True)
+        _rewrap(out, tensor)
+        return _Task(out)
+    _rewrap(src, tensor)  # single process: scatter of one == itself
+    return _Task(src)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Paddle alltoall: rank i sends in_tensor_list[j] to rank j."""
+    xs = [_unwrap(t) for t in in_tensor_list]
+    x = jnp.stack(xs, axis=0)
+    if isinstance(x, jax.core.Tracer):
+        axes = _axes(group) or _world_axes()
+        out = lax.all_to_all(x, axes, split_axis=0, concat_axis=0, tiled=False)
+        for i in range(out.shape[0]):
+            out_tensor_list.append(Tensor(out[i]))
+        return _Task(out)
+    out_tensor_list.extend(in_tensor_list)  # single process
+    return _Task(x)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    x = _unwrap(in_tensor)
+    if isinstance(x, jax.core.Tracer):
+        axes = _axes(group) or _world_axes()
+        out = lax.all_to_all(x, axes, split_axis=0, concat_axis=0, tiled=True)
+        _rewrap(out, out_tensor)
+        return _Task(out)
+    _rewrap(x, out_tensor)
+    return _Task(x)
+
+
+def _linear_axis_index(axes):
+    """Flat rank within a (possibly multi-axis) group, row-major over axes."""
+    if isinstance(axes, str):
+        return lax.axis_index(axes)
+    mesh = get_mesh()
+    idx = lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * mesh.shape[a] + lax.axis_index(a)
+    return idx
+
+
+def broadcast(tensor, src: int = 0, group=None, sync_op=True):
+    """Single-controller: every device already sees the one global value; in
+    shard_map, select src's value via psum of a masked term over ALL group
+    axes (multi-axis groups use the flat group rank)."""
+    x = _unwrap(tensor)
+    if _in_trace(tensor):
+        axes = _axes(group) or _world_axes()
+        idx = _linear_axis_index(axes)
+        masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+        out = lax.psum(masked, axes)
+        _rewrap(out, tensor)
+        return _Task(out)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        out = multihost_utils.broadcast_one_to_all(x)
+        _rewrap(jnp.asarray(out), tensor)
+        return _Task(out)
+    return _Task(x)
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # all ranks compute the reduction; dst semantics are moot single-controller
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
+    if tensor_list is None:
+        return _Task(_unwrap(tensor))
+    x = jnp.stack([_unwrap(t) for t in tensor_list], axis=0)
+    if isinstance(x, jax.core.Tracer):
+        axes = _axes(group) or _world_axes()
+        idx = _linear_axis_index(axes)
+        out = jnp.take(x, idx, axis=0)
+        _rewrap(out, tensor)
+        return _Task(out)
+    _rewrap(_unwrap(tensor_list[src]), tensor)
+    return _Task(tensor)
+
+
+def send(tensor, dst: int = 0, group=None, sync_op=True):
+    """P2P inside shard_map: ppermute ring hop (used by our PP). Eager
+    cross-process send has no XLA path — raise with guidance."""
+    x = _unwrap(tensor)
+    if _in_trace(tensor):
+        axes = _axes(group) or _world_axes()
+        if not isinstance(axes, str):
+            if len(axes) > 1:
+                raise ValueError(
+                    "send/recv requires a single-axis group (a P2P ring "
+                    "lives on one mesh axis); got axes " + repr(axes))
+            axes = axes[0]
+        n = get_mesh().shape[axes]
+        perm = [(i, dst) for i in range(n)]  # all-to-one; PP uses rings
+        out = lax.ppermute(x, axes, perm)
+        _rewrap(out, tensor)
+        return _Task(out)
+    raise NotImplementedError(
+        "eager cross-process send/recv: use shard_map collectives "
+        "(paddle_tpu PP schedules do) — XLA has no host-driven P2P")
+
+
+def recv(tensor, src: int = 0, group=None, sync_op=True):
+    if _in_trace(tensor):
+        return _Task(_unwrap(tensor))  # paired with send's ppermute
+    raise NotImplementedError("see send()")
+
+
+isend = send
+irecv = recv
+
+
+def barrier(group=None):
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    return _Task()
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """Reference: creates an NCCL communicator over `ranks`. Here a group is
+    a mesh-axis view; arbitrary rank subsets map onto the world axes."""
+    return CommGroup(tuple(get_mesh().axis_names), ranks=ranks)
+
+
+def get_group(gid: int = 0):
+    return new_group()
+
+
+def destroy_process_group(group=None):
+    pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(_unwrap(tensor))
+
+
+def stream_synchronize():
+    pass

@@ -48,7 +48,7 @@ class Model:
             for m in self._metrics:
                 pred = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
                 corr = m.compute(pred, _as_tensor(labels if not isinstance(labels, (list, tuple)) else labels[0]))
-                metrics.append(m.update(corr))
+                metrics.append(_metric_update(m, corr))
         return (losses, metrics) if metrics else losses
 
     def eval_batch(self, inputs, labels=None):
@@ -67,7 +67,7 @@ class Model:
             for m in self._metrics:
                 pred = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
                 corr = m.compute(pred, _as_tensor(labels if not isinstance(labels, (list, tuple)) else labels[0]))
-                metrics.append(m.update(corr))
+                metrics.append(_metric_update(m, corr))
         return (losses, metrics) if metrics else losses
 
     def predict_batch(self, inputs):
@@ -185,6 +185,15 @@ class Model:
         out = "\n".join(lines) + f"\nTotal params: {total}"
         print(out)
         return {"total_params": total}
+
+
+
+def _metric_update(m, corr):
+    """compute() may return one array (e.g. Accuracy's correct matrix) or the
+    passthrough (pred, label) tuple of the Metric base; update() may return
+    the running value or None (Precision/Recall/Auc accumulate silently)."""
+    res = m.update(*corr) if isinstance(corr, tuple) else m.update(corr)
+    return m.accumulate() if res is None else res
 
 
 def _as_tensor(x):

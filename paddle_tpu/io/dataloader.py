@@ -3,11 +3,11 @@ blocking-queue buffer reader — upstream-canonical, unverified, SURVEY.md §0).
 
 TPU-native design (SURVEY.md §2.6 #7): the host-side input pipeline is the one
 place a native component is warranted. Transport is pluggable: num_workers=0
-runs in-process; num_workers>0 uses multiprocessing workers feeding a queue,
-with a background prefetch thread double-buffering batches so host collation
-overlaps device compute (the reference's C++ BufferedReader role). The C++
-shared-memory ring buffer (paddle_tpu/io/_shm_ring.cpp) accelerates the
-worker→main copy path when built; the python queue path is the fallback.
+runs in-process with a background prefetch thread double-buffering batches so
+host collation overlaps device compute (the reference's C++ BufferedReader
+role); num_workers>0 uses multiprocessing workers (numpy-only in the child —
+forked children must never touch the parent's JAX runtime) feeding a queue
+with an in-order lookahead window.
 """
 from __future__ import annotations
 
@@ -45,6 +45,26 @@ def default_collate_fn(batch):
     return list(batch)
 
 
+def numpy_collate_fn(batch):
+    """default_collate_fn's structure, numpy-only — safe in forked workers
+    (never builds jax arrays; the main process tensorizes via
+    _to_tensor_tree)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: numpy_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return [numpy_collate_fn(list(items)) for items in zip(*batch)]
+    return list(batch)
+
+
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn,
                  worker_id, seed):
     np.random.seed((seed + worker_id) % (2 ** 31))
@@ -56,7 +76,10 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn,
             break
         job_id, indices = job
         try:
-            samples = [dataset[i] for i in indices]
+            # numpy-ify BEFORE collating so the default collate never builds
+            # jax arrays here — a forked child must not touch the parent's
+            # JAX runtime (fork-after-threads deadlocks).
+            samples = [_to_numpy_tree(dataset[i]) for i in indices]
             batch = collate_fn(samples) if collate_fn else samples
             batch = _to_numpy_tree(batch)
             data_queue.put((job_id, batch, None))
@@ -121,7 +144,8 @@ class _MultiProcessIter:
     def __init__(self, loader):
         self.loader = loader
         self.sampler_iter = enumerate(iter(loader.batch_sampler))
-        ctx = mp.get_context("fork")
+        methods = mp.get_all_start_methods()
+        ctx = mp.get_context("fork" if "fork" in methods else "spawn")
         self.index_queues = []
         self.data_queue = ctx.Queue()
         self.workers = []
@@ -129,9 +153,12 @@ class _MultiProcessIter:
         seed = prandom.default_generator().initial_seed
         for wid in range(loader.num_workers):
             iq = ctx.Queue()
+            worker_collate = (numpy_collate_fn
+                              if loader.collate_fn is default_collate_fn
+                              else loader.collate_fn)
             w = ctx.Process(
                 target=_worker_loop,
-                args=(loader.dataset, iq, self.data_queue, loader.collate_fn,
+                args=(loader.dataset, iq, self.data_queue, worker_collate,
                       loader.worker_init_fn, wid, seed),
                 daemon=True)
             w.start()

@@ -125,11 +125,16 @@ def flash_attention_pallas(q, k, v, causal=False, scale=None, block_q=256,
 def _use_pallas(x):
     from ..core.flags import flag
 
+    if not flag("FLAGS_use_pallas"):
+        return False
+    # Concrete arrays know their devices; tracers (inside jit) compile for
+    # the default backend — probing x.devices() on a tracer raises, which
+    # previously disabled the Pallas path in every jitted step.
     try:
         plat = next(iter(x.devices())).platform
     except Exception:
-        return False
-    return bool(flag("FLAGS_use_pallas")) and plat not in ("cpu",)
+        plat = jax.default_backend()
+    return plat not in ("cpu",)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

@@ -67,8 +67,11 @@ def rms_norm(x, weight=None, epsilon: float = 1e-6):
     """Dispatch: Pallas on TPU (when enabled + weight present), ref otherwise."""
     from ..core.flags import flag
 
-    on_tpu = x.devices() and next(iter(x.devices())).platform != "cpu" \
-        if hasattr(x, "devices") else False
+    try:
+        plat = next(iter(x.devices())).platform
+    except Exception:  # tracer inside jit: compiles for the default backend
+        plat = jax.default_backend()
+    on_tpu = plat not in ("cpu",)
     if flag("FLAGS_use_pallas") and on_tpu and weight is not None and x.shape[-1] % 128 == 0:
         try:
             return rms_norm_pallas(x, weight, epsilon)

@@ -1,0 +1,254 @@
+"""Llama-family flagship model — the BASELINE 'Llama-3-8B (TP+DP)' workload.
+
+Reference analog: the PaddleNLP `llm/` Llama recipes the reference's BASELINE
+configs point at (out-of-repo, SURVEY.md §1 Lx row; upstream-canonical,
+unverified — SURVEY.md §0). The reference builds Llama out of
+ColumnParallelLinear/RowParallelLinear mpu layers + fused rope/rms_norm/flash
+attention kernels and runs it under fleet hybrid parallelism.
+
+TPU-native design (SURVEY.md §7 M5): a pure-functional transformer whose
+params are one pytree; layers are STACKED (leading [L] dim) and the decoder
+runs as one `lax.scan` over layer params — one XLA while-loop instead of L
+unrolled blocks (compile time O(1) in depth, same MXU schedule). Parallelism
+is not code: `param_specs`/`act_specs` return PartitionSpec trees for the
+hybrid mesh axes (dp, sharding=FSDP/ZeRO-3, sep=context, mp=tensor) and GSPMD
+partitions the one program — the reference's mpu layer zoo collapses into
+these tables. Compute in bf16 on the MXU, params/master state in f32,
+softmax/loss in f32.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..kernels.flash_attention import flash_attention_fwd
+from ..kernels.rms_norm import rms_norm_ref
+from ..kernels.rope import rope_freqs, apply_rope_half
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32       # < heads → GQA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16           # compute dtype (MXU)
+    param_dtype: Any = jnp.float32      # storage dtype (master weights)
+    remat: bool = True                  # jax.checkpoint each layer body
+    use_flash: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def tiny(**over) -> "LlamaConfig":
+        """Test/dryrun-sized config."""
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=128)
+        base.update(over)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def llama3_8b(**over) -> "LlamaConfig":
+        base = dict(vocab_size=128256, hidden_size=4096,
+                    intermediate_size=14336, num_hidden_layers=32,
+                    num_attention_heads=32, num_key_value_heads=8,
+                    max_position_embeddings=8192, rope_theta=500000.0)
+        base.update(over)
+        return LlamaConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# Params
+# ---------------------------------------------------------------------------
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Initialize the parameter pytree. Layer weights are stacked on a
+    leading [L] axis for the scan. Init matches the reference recipes:
+    normal(0, 0.02) for projections/embeddings, ones for norm scales."""
+    D, F, V, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+                  cfg.num_hidden_layers)
+    H, KV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    pd = cfg.param_dtype
+    ks = jax.random.split(key, 8)
+
+    def norm(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(pd)
+
+    params = {
+        "embed_tokens": norm(ks[0], (V, D)),
+        "layers": {
+            "input_layernorm": jnp.ones((L, D), pd),
+            "q_proj": norm(ks[1], (L, D, H * hd)),
+            "k_proj": norm(ks[2], (L, D, KV * hd)),
+            "v_proj": norm(ks[3], (L, D, KV * hd)),
+            "o_proj": norm(ks[4], (L, H * hd, D)),
+            "post_attention_layernorm": jnp.ones((L, D), pd),
+            "gate_proj": norm(ks[5], (L, D, F)),
+            "up_proj": norm(ks[6], (L, D, F)),
+            "down_proj": norm(ks[7], (L, F, D)),
+        },
+        "norm": jnp.ones((D,), pd),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm(jax.random.fold_in(key, 99), (D, V))
+    return params
+
+
+def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+    """PartitionSpec tree matching init_params. This table IS the reference's
+    TP layer zoo + GroupSharded stage-3 (SURVEY.md §2.3 TP/sharding rows):
+      mp       = Megatron TP: qkv/gate/up column-split, o/down row-split,
+                 embeddings vocab-split (VocabParallelEmbedding).
+      sharding = ZeRO-3/FSDP: the *other* matmul dim, so every big weight is
+                 2D-sharded and all-gathers ride ICI.
+    Layer stack dim [L] stays unsharded (it is scanned, and pp uses it)."""
+    return {
+        "embed_tokens": P("mp", "sharding"),
+        "layers": {
+            "input_layernorm": P(None, None),
+            "q_proj": P(None, "sharding", "mp"),
+            "k_proj": P(None, "sharding", "mp"),
+            "v_proj": P(None, "sharding", "mp"),
+            "o_proj": P(None, "mp", "sharding"),
+            "post_attention_layernorm": P(None, None),
+            "gate_proj": P(None, "sharding", "mp"),
+            "up_proj": P(None, "sharding", "mp"),
+            "down_proj": P(None, "mp", "sharding"),
+        },
+        "norm": P(None),
+        "lm_head": P("sharding", "mp"),
+    } if not cfg.tie_word_embeddings else {
+        "embed_tokens": P("mp", "sharding"),
+        "layers": param_specs(dataclasses.replace(cfg, tie_word_embeddings=False))["layers"],
+        "norm": P(None),
+    }
+
+
+def act_spec() -> P:
+    """Activation sharding [B, S, D]: batch over (dp, sharding) — ZeRO data
+    axes — and sequence over sep (context parallel). Megatron-SP falls out of
+    GSPMD: XLA converts the surrounding collectives (SURVEY.md §2.3 SP row)."""
+    return P(("dp", "sharding"), "sep", None)
+
+
+def batch_spec() -> P:
+    """Token batch [B, S]."""
+    return P(("dp", "sharding"), "sep")
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _attention(x, lp, cfg: LlamaConfig, cos, sin, layer_mesh_axes=None):
+    """x: [B,S,D] (compute dtype); lp: this layer's param slice."""
+    B, S, D = x.shape
+    H, KV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    cd = cfg.dtype
+    q = (x @ lp["q_proj"].astype(cd)).reshape(B, S, H, hd)
+    k = (x @ lp["k_proj"].astype(cd)).reshape(B, S, KV, hd)
+    v = (x @ lp["v_proj"].astype(cd)).reshape(B, S, KV, hd)
+    q, k = apply_rope_half(q, k, cos, sin)
+    if cfg.use_flash:
+        o = flash_attention_fwd(q, k, v, True, None)
+    else:
+        from .. kernels.flash_attention import mha_ref
+        o = mha_ref(q, k, v, causal=True)
+    o = o.reshape(B, S, H * hd)
+    return o @ lp["o_proj"].astype(cd)
+
+
+def _mlp(x, lp, cfg: LlamaConfig):
+    cd = cfg.dtype
+    g = x @ lp["gate_proj"].astype(cd)
+    u = x @ lp["up_proj"].astype(cd)
+    return (jax.nn.silu(g) * u) @ lp["down_proj"].astype(cd)
+
+
+def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin):
+    h = rms_norm_ref(x, lp["input_layernorm"], cfg.rms_norm_eps)
+    x = x + _attention(h, lp, cfg, cos, sin)
+    h = rms_norm_ref(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
+    x = x + _mlp(h, lp, cfg)
+    return x
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
+            mesh=None) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, V] (f32).
+
+    The decoder is one lax.scan over the stacked layer params; each body is
+    optionally jax.checkpoint-ed (the reference's recompute_sequential,
+    SURVEY.md §2.4 recompute row, as a remat policy instead of a PyLayer).
+    With a mesh, activations carry sharding constraints (act_spec)."""
+    cd = cfg.dtype
+    x = jnp.take(params["embed_tokens"], tokens, axis=0).astype(cd)
+    cos, sin = rope_freqs(cfg.head_dim, tokens.shape[1], cfg.rope_theta, jnp.float32)
+
+    def maybe_constrain(h):
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            h = jax.lax.with_sharding_constraint(
+                h, NamedSharding(mesh, act_spec()))
+        return h
+
+    x = maybe_constrain(x)
+
+    def body(h, lp):
+        h = _decoder_layer(h, lp, cfg, cos, sin)
+        return maybe_constrain(h), None
+
+    if cfg.remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm_ref(x, params["norm"], cfg.rms_norm_eps)
+    head = (params["embed_tokens"].T if cfg.tie_word_embeddings
+            else params["lm_head"])
+    logits = x.astype(cd) @ head.astype(cd)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params, tokens, cfg: LlamaConfig, mesh=None):
+    """Next-token cross entropy, masked at the final position. f32 softmax."""
+    logits = forward(params, tokens, cfg, mesh)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    D, F, V, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+                  cfg.num_hidden_layers)
+    H, KV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    per_layer = 2 * D + D * H * hd + 2 * D * KV * hd + H * hd * D + 3 * D * F
+    total = V * D + L * per_layer + D
+    if not cfg.tie_word_embeddings:
+        total += D * V
+    return total
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Approx. train FLOPs/token (fwd+bwd = 6·params_matmul + attention)."""
+    D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+    H, KV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    matmul = L * (D * (H + 2 * KV) * hd + H * hd * D + 3 * D * F) \
+        + cfg.vocab_size * D * (1 if cfg.tie_word_embeddings else 2)
+    attn = L * 2 * H * hd * seq_len  # QK^T + PV per token (causal ≈ /2 *2)
+    return 6.0 * (matmul + attn)

@@ -1,0 +1,125 @@
+"""Sharded training step for the flagship models.
+
+Reference analog: the fleet hybrid-parallel train loop —
+`fleet.distributed_model` + `distributed_optimizer` + per-strategy wrappers
+(SURVEY.md §3.2, upstream-canonical, unverified §0). TPU-native: ONE jitted
+train step whose in/out shardings carry the whole strategy; XLA inserts every
+collective (grad psum over dp, FSDP all-gathers over 'sharding', TP
+collectives over 'mp') — the reference's reducer/GroupSharded/mp_ops code
+has no runtime equivalent here by design.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import llama
+
+
+class TrainState(NamedTuple):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def make_optimizer(learning_rate=3e-4, weight_decay=0.1, b1=0.9, b2=0.95,
+                   grad_clip=1.0, warmup_steps=0, total_steps=10000):
+    """AdamW + cosine schedule + global-norm clip — the reference's Llama
+    recipe optimizer (paddle.optimizer.AdamW + LinearWarmup/Cosine)."""
+    if warmup_steps:
+        sched = optax.warmup_cosine_decay_schedule(
+            0.0, learning_rate, warmup_steps, total_steps)
+    else:
+        sched = learning_rate
+    tx = optax.chain(
+        optax.clip_by_global_norm(grad_clip) if grad_clip else optax.identity(),
+        optax.adamw(sched, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+    return tx
+
+
+def state_specs(cfg: llama.LlamaConfig, tx) -> TrainState:
+    """PartitionSpec tree for the full TrainState: optimizer moments inherit
+    each param's spec (= ZeRO: opt state sharded exactly like params)."""
+    pspecs = llama.param_specs(cfg)
+    params_shape = jax.eval_shape(
+        functools.partial(llama.init_params, cfg=cfg), jax.random.key(0))
+    opt_state_shape = jax.eval_shape(tx.init, params_shape)
+    opt_specs = _opt_specs_like(opt_state_shape, params_shape, pspecs)
+    return TrainState(step=P(), params=pspecs, opt_state=opt_specs)
+
+
+def _opt_specs_like(opt_state_shape, params_shape, pspecs):
+    """Map an optax state pytree to specs: any subtree that is structurally
+    identical to the param tree gets the param specs; other leaves P()."""
+    params_treedef = jax.tree.structure(params_shape)
+
+    def rec(node):
+        try:
+            if jax.tree.structure(node) == params_treedef:
+                return pspecs
+        except Exception:
+            pass
+        if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
+            return type(node)(*[rec(c) for c in node])
+        if isinstance(node, tuple):
+            return tuple(rec(c) for c in node)
+        if isinstance(node, list):
+            return [rec(c) for c in node]
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        return P()
+
+    return rec(opt_state_shape)
+
+
+def init_state(key, cfg: llama.LlamaConfig, tx, mesh: Optional[Mesh] = None):
+    """Initialize params + opt state, jitted with out_shardings so big models
+    materialize directly sharded (never replicated on one chip)."""
+    def init():
+        params = llama.init_params(key, cfg)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=tx.init(params))
+
+    if mesh is None:
+        return init()
+    specs = state_specs(cfg, tx)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    return jax.jit(init, out_shardings=shardings)()
+
+
+def make_train_step(cfg: llama.LlamaConfig, tx, mesh: Optional[Mesh] = None,
+                    donate: bool = True) -> Callable:
+    """Build the jitted train step. With a mesh: full GSPMD shardings on
+    state and batch; without: plain jit (single device)."""
+
+    def step_fn(state: TrainState, tokens):
+        loss, grads = jax.value_and_grad(llama.loss_fn)(
+            state.params, tokens, cfg, mesh)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss,
+                   "grad_norm": optax.global_norm(grads),
+                   "step": state.step}
+        return TrainState(state.step + 1, new_params, new_opt), metrics
+
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+    specs = state_specs(cfg, tx)
+    state_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))
+    batch_sh = NamedSharding(mesh, llama.batch_spec())
+    metric_sh = {"loss": NamedSharding(mesh, P()),
+                 "grad_norm": NamedSharding(mesh, P()),
+                 "step": NamedSharding(mesh, P())}
+    return jax.jit(step_fn,
+                   in_shardings=(state_sh, batch_sh),
+                   out_shardings=(state_sh, metric_sh),
+                   donate_argnums=(0,) if donate else ())

@@ -166,12 +166,15 @@ def placements_to_spec(placements, ndim: int, mesh: Mesh) -> P:
 
 def with_sharding_constraint(x, spec: P, mesh: Optional[Mesh] = None):
     """Annotate an intermediate (activation sharding — Megatron-SP is exactly
-    'seq dim gets the mp axis here')."""
-    arr = x._data if isinstance(x, Tensor) else x
-    out = jax.lax.with_sharding_constraint(
-        arr, NamedSharding(mesh or get_mesh(), spec))
-    return Tensor(out, stop_gradient=getattr(x, "stop_gradient", True)) \
-        if isinstance(x, Tensor) else out
+    'seq dim gets the mp axis here'). Tensor inputs go through the eager tape
+    so the constraint is transparent to backward()."""
+    sharding = NamedSharding(mesh or get_mesh(), spec)
+    if isinstance(x, Tensor):
+        from ..ops._registry import eager
+        return eager(
+            lambda a: jax.lax.with_sharding_constraint(a, sharding),
+            (x,), {}, name="sharding_constraint")
+    return jax.lax.with_sharding_constraint(x, sharding)
 
 
 # canonical strategy rule-sets ------------------------------------------------
