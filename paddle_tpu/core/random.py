@@ -91,7 +91,19 @@ class RNGStatesTracker:
     def get_states_tracker(self):
         return {k: g.get_state() for k, g in self._gens.items()}
 
-    def rng_state(self, name: str = "global_seed"):
+    def set_states_tracker(self, states) -> None:
+        for name, st in states.items():
+            if name not in self._gens:
+                self.add(name, 0)
+            self._gens[name].set_state(st)
+
+    def reset(self) -> None:
+        """Drop all named streams (and their paddle.seed registrations)."""
+        for name in self._gens:
+            _named.pop(name, None)
+        self._gens.clear()
+
+    def rng_state(self, name: str = "model_parallel_rng"):
         import contextlib
 
         @contextlib.contextmanager

@@ -85,6 +85,23 @@ _REDUCERS = {
 }
 
 
+def _group_size(axes) -> int:
+    mesh = get_mesh()
+    n = 1
+    for a in (axes if isinstance(axes, tuple) else (axes,)):
+        n *= mesh.shape[a]
+    return n
+
+
+def _pprod(x, axes):
+    """Exact product over group axes (handles non-positive values): gather
+    all contributions, multiply on-device."""
+    gathered = lax.all_gather(x, axes, axis=0, tiled=False)
+    if not isinstance(axes, str):  # multi-axis gather stacks per axis
+        gathered = gathered.reshape((-1,) + x.shape)
+    return jnp.prod(gathered, axis=0).astype(x.dtype)
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[CommGroup] = None,
                sync_op=True):
     """In shard_map: lax.psum/pmax/pmin over the group's mesh axes.
@@ -93,13 +110,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[CommGroup] = None,
     if _in_trace(tensor):
         axes = _axes(group) or _world_axes()
         if op == ReduceOp.AVG:
-            n = 1
-            mesh = get_mesh()
-            for a in (axes if isinstance(axes, tuple) else (axes,)):
-                n *= mesh.shape[a]
-            out = lax.psum(x, axes) / n
+            out = lax.psum(x, axes) / _group_size(axes)
         elif op == ReduceOp.PROD:
-            out = jnp.exp(lax.psum(jnp.log(x.astype(jnp.float32)), axes)).astype(x.dtype)
+            out = _pprod(x, axes)
         else:
             out = _REDUCERS[op](x, axes)
         _rewrap(out, tensor)
@@ -115,6 +128,10 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[CommGroup] = None,
             out = out.min(0)
         elif op == ReduceOp.AVG:
             out = out.mean(0)
+        elif op == ReduceOp.PROD:
+            out = out.prod(0)
+        else:
+            raise ValueError(f"unknown reduce op {op!r}")
         _rewrap(jnp.asarray(out), tensor)
         return _Task(out)
     return _Task(x)
@@ -165,7 +182,23 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
         src = _unwrap(src)
     if _in_trace(tensor_or_tensor_list if not isinstance(tensor_or_tensor_list, (list, tuple)) else tensor_or_tensor_list[0]) or isinstance(src, jax.core.Tracer):
         axes = _axes(group) or _world_axes()
-        out = lax.psum_scatter(src, axes, scatter_dimension=0, tiled=True)
+        if op in (ReduceOp.SUM, ReduceOp.AVG):
+            out = lax.psum_scatter(src, axes, scatter_dimension=0, tiled=True)
+            if op == ReduceOp.AVG:
+                out = out / _group_size(axes)
+        else:
+            # MAX/MIN/PROD: reduce fully, then keep this rank's chunk
+            if op == ReduceOp.MAX:
+                red = lax.pmax(src, axes)
+            elif op == ReduceOp.MIN:
+                red = lax.pmin(src, axes)
+            elif op == ReduceOp.PROD:
+                red = _pprod(src, axes)
+            else:
+                raise ValueError(f"unknown reduce op {op!r}")
+            idx = _linear_axis_index(axes)
+            chunk = red.shape[0] // _group_size(axes)
+            out = lax.dynamic_slice_in_dim(red, idx * chunk, chunk, axis=0)
         _rewrap(out, tensor)
         return _Task(out)
     _rewrap(src, tensor)  # single process: scatter of one == itself
@@ -189,6 +222,11 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     x = _unwrap(in_tensor)
+    for splits in (in_split_sizes, out_split_sizes):
+        if splits is not None and len(set(splits)) > 1:
+            raise NotImplementedError(
+                "alltoall_single with uneven split sizes: XLA all_to_all is "
+                "even-tiled; pad to equal chunks (lax.all_to_all, tiled)")
     if isinstance(x, jax.core.Tracer):
         axes = _axes(group) or _world_axes()
         out = lax.all_to_all(x, axes, split_axis=0, concat_axis=0, tiled=True)
@@ -223,7 +261,8 @@ def broadcast(tensor, src: int = 0, group=None, sync_op=True):
         return _Task(out)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
-        out = multihost_utils.broadcast_one_to_all(x)
+        out = multihost_utils.broadcast_one_to_all(
+            x, is_source=jax.process_index() == src)
         _rewrap(jnp.asarray(out), tensor)
         return _Task(out)
     return _Task(x)
@@ -248,31 +287,51 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
     return _Task(tensor)
 
 
+# pending send payloads: the single-controller trace executes BOTH sides of a
+# paddle send/recv pair, so send() queues its (traced, per-device) value and
+# the matching recv() delivers src's copy via a masked psum. ppermute cannot
+# express all-to-one perms (destinations must be unique), and P2P delivery to
+# one rank is indistinguishable from a broadcast under SPMD anyway.
+_pending_sends: list = []
+
+
 def send(tensor, dst: int = 0, group=None, sync_op=True):
-    """P2P inside shard_map: ppermute ring hop (used by our PP). Eager
-    cross-process send has no XLA path — raise with guidance."""
+    """P2P facade. In a traced (shard_map) context the value is queued and the
+    paired recv() selects the sender's copy; rings in our PP schedules use
+    ppermute directly. Eager cross-process send has no XLA path — raise."""
     x = _unwrap(tensor)
     if _in_trace(tensor):
-        axes = _axes(group) or _world_axes()
-        if not isinstance(axes, str):
-            if len(axes) > 1:
-                raise ValueError(
-                    "send/recv requires a single-axis group (a P2P ring "
-                    "lives on one mesh axis); got axes " + repr(axes))
-            axes = axes[0]
-        n = get_mesh().shape[axes]
-        perm = [(i, dst) for i in range(n)]  # all-to-one; PP uses rings
-        out = lax.ppermute(x, axes, perm)
-        _rewrap(out, tensor)
-        return _Task(out)
+        _pending_sends.append(x)
+        return _Task(x)
     raise NotImplementedError(
         "eager cross-process send/recv: use shard_map collectives "
         "(paddle_tpu PP schedules do) — XLA has no host-driven P2P")
 
 
 def recv(tensor, src: int = 0, group=None, sync_op=True):
+    """Deliver the pending send()'s value from rank `src` (masked psum over
+    the group axes — every device computes; dst keeps it)."""
     if _in_trace(tensor):
-        return _Task(_unwrap(tensor))  # paired with send's ppermute
+        if not _pending_sends:
+            raise RuntimeError(
+                "recv() without a pending send() in the SAME traced function "
+                "— the single-controller P2P facade pairs send/recv within "
+                "one trace (a send queued in another jit would leak its "
+                "tracer). Structure the schedule so both sides are traced "
+                "together, as the PP schedules do.")
+        axes = _axes(group) or _world_axes()
+        x = _pending_sends.pop(0)
+        idx = _linear_axis_index(axes)
+        try:
+            out = lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)), axes)
+        except jax.errors.UnexpectedTracerError as e:
+            _pending_sends.clear()  # drop stale entries from the dead trace
+            raise RuntimeError(
+                "recv() popped a send() payload queued by a DIFFERENT trace "
+                "(the earlier traced function exited without a matching "
+                "recv). Pair send/recv within one traced function.") from e
+        _rewrap(out, tensor)
+        return _Task(out)
     raise NotImplementedError("see send()")
 
 

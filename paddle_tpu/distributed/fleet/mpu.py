@@ -16,10 +16,8 @@ single-card-compatible, which the reference needs merge scripts for.
 """
 from __future__ import annotations
 
-import contextlib
 from typing import Optional
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -169,48 +167,17 @@ def _mp_allreduce(x, group=None, use_calc_stream=True, use_model_parallel=True):
 
 
 # --- random.py parity: TP-aware RNG state tracking ------------------------
+# The named-stream tracker lives in core.random (generator-swap based, so
+# dropout inside a tracked region draws from the named stream); this module
+# re-exports it under the fleet.meta_parallel names.
 
-class RNGStatesTracker:
-    """The reference tracks per-name cuRAND states so dropout inside TP
-    regions is identical (or decorrelated) across mp ranks as required.
-    TPU-native:名 states are jax PRNG keys; 'local' states fold in the mp
-    axis index when inside shard_map."""
-
-    def __init__(self):
-        self.states = {}
-
-    def add(self, name, seed):
-        self.states[name] = jax.random.key(seed)
-
-    def get_states_tracker(self):
-        return dict(self.states)
-
-    def set_states_tracker(self, states):
-        self.states = dict(states)
-
-    @contextlib.contextmanager
-    def rng_state(self, name="model_parallel_rng"):
-        from ...core import random as prandom
-        if name not in self.states:
-            self.add(name, np.random.randint(0, 2**31 - 1))
-        old = prandom.get_rng_state()
-        prandom.set_rng_state(self.states[name])
-        try:
-            yield
-        finally:
-            self.states[name] = prandom.get_rng_state()
-            prandom.set_rng_state(old)
-
-
-_RNG_STATE_TRACKER = RNGStatesTracker()
-
-
-def get_rng_state_tracker():
-    return _RNG_STATE_TRACKER
+from ...core.random import (  # noqa: E402
+    RNGStatesTracker, get_rng_state_tracker)
 
 
 def model_parallel_random_seed(seed=None):
     import random as pyrandom
     seed = seed if seed is not None else pyrandom.randint(0, 2**31 - 1)
-    _RNG_STATE_TRACKER.states.clear()
-    _RNG_STATE_TRACKER.add("model_parallel_rng", seed)
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("model_parallel_rng", seed)

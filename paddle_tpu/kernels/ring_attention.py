@@ -1,0 +1,177 @@
+"""Ring attention + Ulysses all_to_all attention — long-context context
+parallelism over the `sep` mesh axis.
+
+Reference analog: PaddleNLP's ring_flash_attention.py + the `sep` axis of
+fleet's HybridCommunicateGroup with Ulysses-style all_to_all of attention
+heads (SURVEY.md §2.3 SEP/CP rows, §5 'Long-context' — upstream-canonical,
+unverified §0). The reference drives these with NCCL send/recv and all_to_all
+ops from a host-side Python loop.
+
+TPU-native design (SURVEY.md §7 M5): both schedules are COMPILED — a
+`shard_map` over the `sep` axis whose body is a `lax.scan`/`lax.all_to_all`,
+so XLA overlaps the `ppermute` KV rotation with the block compute
+(double-buffering falls out of XLA's async collective scheduling on ICI).
+
+* Ring attention: each device owns one sequence shard of Q and rotates the
+  compact KV shard around the ring, folding each block into an online-softmax
+  accumulator (m, l, acc) in f32 — memory O(S_local), full-sequence exact
+  attention. Differentiable by construction (ppermute + jnp ops), so
+  `jax.grad` of the surrounding loss re-derives the ring backward pass.
+* Ulysses: all_to_all swaps the sharded dim from sequence to heads
+  (seq-sharded [B, S/n, H, D] → head-sharded [B, S, H/n, D]), runs exact
+  local attention over the FULL sequence, and swaps back. Cheaper collectives
+  than ring for moderate S; requires n | H.
+
+Both accept GQA (fewer KV heads); KV stays compact on the wire and is
+expanded per block at compute time.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def _expand_gqa(k, q_heads):
+    if k.shape[2] != q_heads:
+        k = jnp.repeat(k, q_heads // k.shape[2], axis=2)
+    return k
+
+
+def _block_attn_stats(q, k, v, mask):
+    """One KV block of online softmax. q: [B,Sq,H,hd] (f32, pre-scaled);
+    k/v: [B,Sk,Hkv,hd]; mask: [Sq,Sk] bool or None (True = keep).
+    Returns (m, l, pv): rowmax [B,H,Sq], rowsum [B,H,Sq], p@v [B,Sq,H,hd]."""
+    k = _expand_gqa(k, q.shape[2]).astype(jnp.float32)
+    v = _expand_gqa(v, q.shape[2]).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return m, l, pv
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """shard_map body. q,k,v: LOCAL shards [B, S/n, H(.kv), hd], sequence
+    sharded over `axis_name`. Exact attention over the full sequence."""
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    sq = q.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qf = q.astype(jnp.float32) * scale
+    q_pos = my_idx * sq + jnp.arange(sq)
+
+    def fold(carry, kb, vb, t):
+        """Fold one KV block (held after t rotations) into the accumulator."""
+        m_prev, l_prev, acc = carry
+        # after t forward rotations device i holds the block of (i - t) mod n
+        kv_idx = (my_idx - t) % n
+        if causal:
+            k_pos = kv_idx * sq + jnp.arange(sq)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        m_blk, l_blk, pv = _block_attn_stats(qf, kb, vb, mask)
+        m_new = jnp.maximum(m_prev, m_blk)
+        alpha = jnp.exp(m_prev - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        l_new = l_prev * alpha + l_blk * beta
+        # acc is [B,Sq,H,hd]; alpha/beta are [B,H,Sq]
+        acc = (acc * alpha.transpose(0, 2, 1)[..., None]
+               + pv * beta.transpose(0, 2, 1)[..., None])
+        return m_new, l_new, acc
+
+    def step(carry, t):
+        m_prev, l_prev, acc, kb, vb = carry
+        # rotate first, fold second → exactly n-1 ICI hops for n blocks
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        m_new, l_new, acc = fold((m_prev, l_prev, acc), kb, vb, t)
+        return (m_new, l_new, acc, kb, vb), None
+
+    b, _, h, hd = q.shape
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    a0 = jnp.zeros((b, sq, h, hd), jnp.float32)
+    carry0 = fold((m0, l0, a0), k, v, 0)  # local block, no rotation
+    (m, l, acc, _, _), _ = lax.scan(
+        step, carry0 + (k, v), jnp.arange(1, n))
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def _ulysses_attention_local(q, k, v, axis_name: str, causal: bool,
+                             scale: Optional[float]):
+    """shard_map body for Ulysses. Local shards [B, S/n, H, hd] seq-sharded →
+    all_to_all to [B, S, H/n, hd] head-sharded → exact local attention →
+    all_to_all back. GQA KV with fewer than n heads is expanded first."""
+    from .flash_attention import mha_ref
+
+    n = lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(
+            f"ulysses attention needs sep | num_heads: {n} heads-per-device "
+            f"split of {h} query heads is uneven — use impl='ring' instead")
+    if k.shape[2] % n != 0:
+        k = jnp.repeat(k, h // k.shape[2], axis=2)
+        v = jnp.repeat(v, h // v.shape[2], axis=2)
+
+    def swap_to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def swap_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = swap_to_heads(q), swap_to_heads(k), swap_to_heads(v)
+    out = mha_ref(qh, kh, vh, causal=causal, scale=scale)
+    return swap_to_seq(out)
+
+
+def _sep_specs(mesh: Mesh):
+    """q/k/v/out specs: batch over the data axes, sequence over sep, heads
+    over mp (Megatron TP composes with context parallelism)."""
+    head = "mp" if "mp" in mesh.axis_names and mesh.shape.get("mp", 1) > 1 else None
+    batch = tuple(a for a in ("dp", "sharding") if a in mesh.axis_names) or None
+    return P(batch, "sep", head, None)
+
+
+def sep_attention(q, k, v, mesh: Mesh, impl: str = "ring",
+                  causal: bool = True, scale: Optional[float] = None):
+    """Context-parallel attention over the mesh's `sep` axis.
+
+    q,k,v: GLOBAL [B, S, H(.kv), hd] arrays (sharded or not — shard_map
+    partitions per `_sep_specs`). `impl`: "ring" | "ulysses". Works inside an
+    enclosing jit (GSPMD) or eagerly.
+    """
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sep attention impl {impl!r}")
+    if "sep" not in mesh.axis_names or mesh.shape["sep"] == 1:
+        from .flash_attention import flash_attention_fwd
+        return flash_attention_fwd(q, k, v, causal, scale)
+    spec = _sep_specs(mesh)
+    body = (_ring_attention_local if impl == "ring"
+            else _ulysses_attention_local)
+    fn = shard_map(
+        functools.partial(body, axis_name="sep", causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

@@ -47,6 +47,11 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32      # storage dtype (master weights)
     remat: bool = True                  # jax.checkpoint each layer body
     use_flash: bool = True
+    # attention schedule: "flash" (single-device / GSPMD-sharded), or the
+    # context-parallel schedules over the sep mesh axis — "ring"
+    # (ppermute KV rotation, SURVEY.md §2.3 CP row) / "ulysses" (all_to_all
+    # head<->seq swap, SEP row). Ignored when mesh is None or sep == 1.
+    attn_impl: str = "flash"
 
     @property
     def head_dim(self) -> int:
@@ -154,7 +159,7 @@ def batch_spec() -> P:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _attention(x, lp, cfg: LlamaConfig, cos, sin, layer_mesh_axes=None):
+def _attention(x, lp, cfg: LlamaConfig, cos, sin, mesh=None):
     """x: [B,S,D] (compute dtype); lp: this layer's param slice."""
     B, S, D = x.shape
     H, KV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -163,7 +168,11 @@ def _attention(x, lp, cfg: LlamaConfig, cos, sin, layer_mesh_axes=None):
     k = (x @ lp["k_proj"].astype(cd)).reshape(B, S, KV, hd)
     v = (x @ lp["v_proj"].astype(cd)).reshape(B, S, KV, hd)
     q, k = apply_rope_half(q, k, cos, sin)
-    if cfg.use_flash:
+    if (cfg.attn_impl in ("ring", "ulysses") and mesh is not None
+            and "sep" in mesh.axis_names and mesh.shape["sep"] > 1):
+        from ..kernels.ring_attention import sep_attention
+        o = sep_attention(q, k, v, mesh, impl=cfg.attn_impl, causal=True)
+    elif cfg.use_flash:
         o = flash_attention_fwd(q, k, v, True, None)
     else:
         from .. kernels.flash_attention import mha_ref
@@ -179,9 +188,9 @@ def _mlp(x, lp, cfg: LlamaConfig):
     return (jax.nn.silu(g) * u) @ lp["down_proj"].astype(cd)
 
 
-def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin):
+def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, mesh=None):
     h = rms_norm_ref(x, lp["input_layernorm"], cfg.rms_norm_eps)
-    x = x + _attention(h, lp, cfg, cos, sin)
+    x = x + _attention(h, lp, cfg, cos, sin, mesh)
     h = rms_norm_ref(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
     x = x + _mlp(h, lp, cfg)
     return x
@@ -209,7 +218,7 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
     x = maybe_constrain(x)
 
     def body(h, lp):
-        h = _decoder_layer(h, lp, cfg, cos, sin)
+        h = _decoder_layer(h, lp, cfg, cos, sin, mesh)
         return maybe_constrain(h), None
 
     if cfg.remat:
@@ -224,13 +233,19 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
 
 
 def loss_fn(params, tokens, cfg: LlamaConfig, mesh=None):
-    """Next-token cross entropy, masked at the final position. f32 softmax."""
+    """Next-token cross entropy, masked at the final position. f32 softmax.
+
+    Shapes stay [B, S] throughout (targets via roll + mask, not slicing):
+    S-1 is generally not divisible by the sep axis, and uneven seq sharding
+    of the embedding-grad scatter aborts XLA's SPMD partitioner
+    (PadBaseShapeBeforeUnevenTiledSharding CHECK) — beyond being slower."""
     logits = forward(params, tokens, cfg, mesh)
-    targets = tokens[:, 1:]
-    logits = logits[:, :-1]
+    targets = jnp.roll(tokens, -1, axis=1)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    seq = tokens.shape[1]
+    valid = (jnp.arange(seq) < seq - 1).astype(logits.dtype)
+    return jnp.sum((logz - gold) * valid[None]) / (tokens.shape[0] * (seq - 1))
 
 
 def num_params(cfg: LlamaConfig) -> int:
@@ -248,7 +263,9 @@ def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
     """Approx. train FLOPs/token (fwd+bwd = 6·params_matmul + attention)."""
     D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
     H, KV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    # vocab term: only the OUTPUT projection is a matmul (the input
+    # embedding is a gather — ~zero MXU FLOPs, tied or not)
     matmul = L * (D * (H + 2 * KV) * hd + H * hd * D + 3 * D * F) \
-        + cfg.vocab_size * D * (1 if cfg.tie_word_embeddings else 2)
+        + cfg.vocab_size * D
     attn = L * 2 * H * hd * seq_len  # QK^T + PV per token (causal ≈ /2 *2)
     return 6.0 * (matmul + attn)
