@@ -49,6 +49,7 @@ from . import optimizer  # noqa: F401
 from . import amp  # noqa: F401
 from .nn.layer import ParamAttr  # noqa: F401
 
+from . import distributed  # noqa: F401
 from . import io  # noqa: F401
 from . import vision  # noqa: F401
 from . import jit  # noqa: F401

@@ -110,7 +110,12 @@ class RNGStatesTracker:
         def _ctx():
             global _default_generator
             if name not in self._gens:
-                self.add(name, _default_generator.initial_seed)
+                # decorrelate from the global stream (same rule as seed()):
+                # an auto-added stream seeded with initial_seed verbatim
+                # would replay the global generator's draws exactly
+                import zlib
+                self.add(name, _default_generator.initial_seed
+                         ^ zlib.crc32(name.encode()))
             prev = _default_generator
             _default_generator = self._gens[name]
             try:

@@ -1,0 +1,86 @@
+"""paddle.distributed — collective API, topology, fleet, launch.
+
+Reference analog: python/paddle/distributed/ (communication/ ops over
+ProcessGroups, parallel.py init_parallel_env, fleet/, launch/) —
+upstream-canonical, unverified, SURVEY.md §0, §2.3.
+
+TPU-native: collectives lower to XLA ops inside shard_map and to
+multihost_utils eagerly (collective.py); topology is the mesh
+(parallel.topology); process bootstrap is jax.distributed.initialize.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from .collective import (  # noqa: F401
+    ReduceOp, all_reduce, all_gather, all_gather_object, reduce_scatter,
+    alltoall, alltoall_single, broadcast, reduce, scatter, send, recv,
+    isend, irecv, barrier, new_group, get_group, destroy_process_group,
+    wait, stream_synchronize)
+from ..parallel.topology import (  # noqa: F401
+    build_mesh, get_mesh, set_mesh, HybridCommunicateGroup,
+    get_hybrid_communicate_group, CommGroup)
+from . import fleet  # noqa: F401
+from .fleet import DistributedStrategy  # noqa: F401
+
+
+def get_rank(group=None) -> int:
+    """Process rank (single-controller: one process per host; device-level
+    rank has no meaning outside shard_map — use lax.axis_index there)."""
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    return jax.process_count()
+
+
+def is_initialized() -> bool:
+    return True
+
+
+def init_parallel_env():
+    """Reference: TCPStore rendezvous + NCCL group bootstrap. TPU-native:
+    jax.distributed.initialize (coordination service) when the standard env
+    (JAX_COORDINATOR_ADDRESS / PADDLE_MASTER) names a multi-process job;
+    single process is a no-op."""
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or \
+        os.environ.get("PADDLE_MASTER")
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if coord and nproc > 1 and jax.process_count() == 1:
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=nproc,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    return ParallelEnv()
+
+
+class ParallelEnv:
+    """paddle.distributed.ParallelEnv parity."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return 0
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+    @property
+    def dev_id(self) -> int:
+        return 0
+
+
+def spawn(func, args=(), nprocs=-1, **options):
+    """Reference: multiprocess GPU spawn. Single-controller SPMD needs no
+    per-device processes — run func once; device parallelism comes from
+    sharding (SURVEY.md §3.2 'TPU translation')."""
+    return func(*args)

@@ -261,8 +261,10 @@ def broadcast(tensor, src: int = 0, group=None, sync_op=True):
         return _Task(out)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
+        # paddle's src is a device rank; map it to the owning process
+        src_proc = src // max(jax.local_device_count(), 1)
         out = multihost_utils.broadcast_one_to_all(
-            x, is_source=jax.process_index() == src)
+            x, is_source=jax.process_index() == src_proc)
         _rewrap(jnp.asarray(out), tensor)
         return _Task(out)
     return _Task(x)

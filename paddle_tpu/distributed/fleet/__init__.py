@@ -1,0 +1,179 @@
+"""paddle.distributed.fleet facade — hybrid-parallel entry points.
+
+Reference analog: python/paddle/distributed/fleet/ (fleet.py Fleet singleton,
+base/distributed_strategy.py protobuf-backed DistributedStrategy,
+meta_parallel wrappers) — upstream-canonical, unverified, SURVEY.md §0, §2.3.
+
+TPU-native design: `fleet.init` builds THE mesh from the strategy's
+hybrid_configs and installs it as the global topology; `distributed_model` /
+`distributed_optimizer` are mostly identity — parallelism is carried by
+sharding specs, not wrapper modules (SURVEY.md §3.2 'TPU translation').
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+
+from ...parallel.topology import (
+    build_mesh, set_mesh, get_mesh, HybridCommunicateGroup,
+    set_hybrid_communicate_group, get_hybrid_communicate_group, CommGroup)
+from .mpu import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, RNGStatesTracker, get_rng_state_tracker,
+    model_parallel_random_seed)
+from .pipeline_layer import (  # noqa: F401
+    LayerDesc, SharedLayerDesc, PipelineLayer, PipelineParallel)
+
+
+@dataclasses.dataclass
+class PpConfigs:
+    accumulate_steps: int = 1
+    schedule_mode: str = "1F1B"   # metadata; compiled schedule is GPipe-scan
+
+
+class DistributedStrategy:
+    """fleet.DistributedStrategy parity: a plain config tree instead of the
+    reference's protobuf (distributed_strategy.proto — SURVEY.md §5 flags).
+    Only fields the TPU path consumes are interpreted; the rest are stored
+    verbatim so reference training scripts run unmodified."""
+
+    def __init__(self):
+        self.hybrid_configs: Dict[str, Any] = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+            "pp_configs": PpConfigs(),
+        }
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {}
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {}
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {}
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = {}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs: Dict[str, Any] = {}
+        self.find_unused_parameters = False
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict[str, Any] = {}
+
+    def __setattr__(self, k, v):
+        if k == "hybrid_configs" and isinstance(v, dict) and hasattr(
+                self, "hybrid_configs"):
+            merged = dict(self.hybrid_configs)
+            merged.update(v)
+            pc = merged.get("pp_configs")
+            if isinstance(pc, dict):
+                merged["pp_configs"] = PpConfigs(**pc)
+            object.__setattr__(self, k, merged)
+        else:
+            object.__setattr__(self, k, v)
+
+
+class Fleet:
+    """The fleet singleton (reference: fleet.fleet.Fleet)."""
+
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._initialized = False
+
+    def init(self, role_maker=None, is_collective: bool = True,
+             strategy: Optional[DistributedStrategy] = None, log_level=None):
+        strategy = strategy or DistributedStrategy()
+        self._strategy = strategy
+        hc = strategy.hybrid_configs
+        degrees = dict(
+            dp=int(hc.get("dp_degree", 1)),
+            sharding=int(hc.get("sharding_degree", 1)),
+            pp=int(hc.get("pp_degree", 1)),
+            sep=int(hc.get("sep_degree", 1)),
+            mp=int(hc.get("mp_degree", 1)),
+        )
+        n_dev = len(jax.devices())
+        total = 1
+        for v in degrees.values():
+            total *= v
+        if total != n_dev:
+            # paddle convention: dp fills the remainder (-1 semantics)
+            if n_dev % max(total // max(degrees["dp"], 1), 1) == 0:
+                degrees["dp"] = n_dev // max(total // max(degrees["dp"], 1), 1)
+        mesh = build_mesh(**degrees)
+        set_mesh(mesh)
+        self._hcg = HybridCommunicateGroup(mesh=mesh)
+        set_hybrid_communicate_group(self._hcg)
+        self._initialized = True
+        return self
+
+    def is_first_worker(self) -> bool:
+        return jax.process_index() == 0
+
+    def worker_index(self) -> int:
+        return jax.process_index()
+
+    def worker_num(self) -> int:
+        return jax.process_count()
+
+    @property
+    def worker_endpoints(self):
+        return [f"process:{i}" for i in range(jax.process_count())]
+
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        return self._hcg or get_hybrid_communicate_group()
+
+    def distributed_model(self, model):
+        """Reference: wraps in DataParallel / PipelineParallel / GroupSharded
+        per strategy. TPU-native: parallelism is sharding specs — the model
+        passes through; PipelineLayer gets its PipelineParallel shell so
+        train_batch exists."""
+        if isinstance(model, PipelineLayer):
+            return PipelineParallel(model, self.get_hybrid_communicate_group(),
+                                    self._strategy)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return optimizer
+
+
+fleet = Fleet()
+
+
+def init(role_maker=None, is_collective: bool = True, strategy=None,
+         log_level=None):
+    return fleet.init(role_maker, is_collective, strategy, log_level)
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def get_hybrid_communicate_group_():
+    return fleet.get_hybrid_communicate_group()
+
+
+# recompute lives here in the reference (fleet.utils.recompute)
+from .recompute import recompute, recompute_sequential  # noqa: F401,E402
+
+
+class utils:  # namespace parity: fleet.utils.recompute
+    recompute = staticmethod(recompute)
+    recompute_sequential = staticmethod(recompute_sequential)
+
+
+class meta_parallel:
+    """fleet.meta_parallel namespace parity."""
+    PipelineLayer = PipelineLayer
+    PipelineParallel = PipelineParallel
+    LayerDesc = LayerDesc
+    SharedLayerDesc = SharedLayerDesc
+    ColumnParallelLinear = ColumnParallelLinear
+    RowParallelLinear = RowParallelLinear
+    VocabParallelEmbedding = VocabParallelEmbedding
+    ParallelCrossEntropy = ParallelCrossEntropy
+    get_rng_state_tracker = staticmethod(get_rng_state_tracker)

@@ -1,0 +1,225 @@
+"""PipelineLayer / LayerDesc / PipelineParallel — fleet.meta_parallel parity.
+
+Reference analog: python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py (PipelineLayer builds per-stage sublayers from
+LayerDescs) and pipeline_parallel.py (PipelineParallel.train_batch runs the
+host-side 1F1B NCCL schedule) — upstream-canonical, unverified, SURVEY.md §0,
+§3.3.
+
+TPU-native design: under a single controller there are no per-rank processes,
+so PipelineLayer materializes the FULL model and forward runs it end-to-end —
+the stage partition is metadata. The COMPILED pipeline schedule (microbatch
+scan + ppermute inside shard_map) lives in parallel.pipeline and is used by
+the functional train paths (nlp.train); this class exists so fleet-style
+model code ports unchanged. train_batch keeps the reference's semantics:
+microbatch split + gradient accumulation + one optimizer step.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+from ...nn.layer import Layer
+from ...core.tensor import Tensor
+from ...parallel.topology import get_hybrid_communicate_group
+
+
+class LayerDesc:
+    """Deferred layer construction (reference: pp_layers.LayerDesc)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        if not issubclass(layer_func, Layer):
+            raise TypeError("The input(layer_func) should be a derived "
+                            "class of Layer.")
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer shared between stages (e.g. tied embeddings). Single-controller:
+    sharing is literal python object sharing — the first build wins and later
+    stages reuse it, which IS the reference's weight-tie semantics without
+    the broadcast."""
+
+    _shared_instances: dict = {}
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+    def build_layer(self) -> Layer:
+        if self.layer_name not in SharedLayerDesc._shared_instances:
+            SharedLayerDesc._shared_instances[self.layer_name] = \
+                super().build_layer()
+        return SharedLayerDesc._shared_instances[self.layer_name]
+
+
+class PipelineLayer(Layer):
+    """Builds the layer list and records the stage partition.
+
+    seg_method: 'uniform' (equal layer count per stage) or
+    'layer:<ClassName>' (stage boundaries before each named layer class —
+    reference's seg_method='layer:TransformerBlock' convention).
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn: Optional[Callable] = None,
+                 seg_method: str = "uniform", recompute_interval: int = 0,
+                 recompute_ctx=None, num_virtual_pipeline_stages: int = 1):
+        super().__init__()
+        SharedLayerDesc._shared_instances.clear()
+        self._loss_fn = loss_fn
+        self._topology = topology
+        if num_stages is None:
+            try:
+                num_stages = (topology.get_dim("pipe") if topology
+                              else get_hybrid_communicate_group()
+                              .get_pipe_parallel_world_size())
+            except Exception:
+                num_stages = 1
+        self._num_stages = max(int(num_stages), 1)
+        self._descs = list(layers)
+
+        # materialize every layer (single controller holds the whole model)
+        self.run_function: List[Any] = []
+        for idx, d in enumerate(self._descs):
+            if isinstance(d, SharedLayerDesc):
+                built = d.build_layer()
+                self.add_sublayer(f"shared_{d.layer_name}", built)
+                fwd = d.forward_func
+                self.run_function.append(
+                    (lambda b, f: (lambda *x: f(b, *x)))(built, fwd)
+                    if fwd is not None else built)
+            elif isinstance(d, LayerDesc):
+                built = d.build_layer()
+                self.add_sublayer(str(idx), built)
+                self.run_function.append(built)
+            elif isinstance(d, Layer):
+                self.add_sublayer(str(idx), d)
+                self.run_function.append(d)
+            elif callable(d):
+                self.run_function.append(d)  # plain function segment
+            else:
+                raise TypeError(f"unsupported pipeline segment {d!r}")
+
+        self._stage_bounds = self._segment(seg_method)
+
+    def _segment(self, seg_method: str):
+        n, total = self._num_stages, len(self.run_function)
+        if seg_method.startswith("layer:"):
+            cls_name = seg_method.split(":", 1)[1]
+            marks = [i for i, f in enumerate(self.run_function)
+                     if type(f).__name__ == cls_name]
+            if len(marks) >= n:
+                # distribute marked layers uniformly; bounds at mark indices
+                import numpy as np
+                idxs = np.array_split(marks, n)
+                bounds = [0] + [g[0] for g in idxs[1:]] + [total]
+                return list(zip(bounds[:-1], bounds[1:]))
+        # uniform by count
+        per = [total // n + (1 if i < total % n else 0) for i in range(n)]
+        bounds, acc = [], 0
+        for p in per:
+            bounds.append((acc, acc + p))
+            acc += p
+        return bounds
+
+    def get_num_stages(self) -> int:
+        return self._num_stages
+
+    def stage_layers(self, stage: int):
+        lo, hi = self._stage_bounds[stage]
+        return self.run_function[lo:hi]
+
+    def forward(self, *args):
+        x = args if len(args) > 1 else args[0]
+        for fn in self.run_function:
+            x = fn(*x) if isinstance(x, tuple) else fn(x)
+        return x
+
+
+class PipelineParallel(Layer):
+    """meta_parallel.PipelineParallel parity: wraps a PipelineLayer and runs
+    microbatched train steps with gradient accumulation.
+
+    The reference schedules 1F1B over NCCL here; single-controller the
+    schedule degenerates to sequential microbatches (identical math), and
+    the COMPILED pp schedule is parallel.pipeline used by nlp.train."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("pipeline", layers)
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._strategy = strategy
+        acc = 1
+        if strategy is not None:
+            hybrid = getattr(strategy, "hybrid_configs", None) or {}
+            pp_cfg = hybrid.get("pp_configs") if isinstance(hybrid, dict) else None
+            acc = getattr(pp_cfg, "accumulate_steps", None) or \
+                (pp_cfg.get("accumulate_steps", 1) if isinstance(pp_cfg, dict) else 1)
+        self.accumulate_steps = max(int(acc), 1)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def _split_microbatch(self, data, n):
+        def split(t):
+            if isinstance(t, Tensor):
+                b = t.shape[0]
+                if b % n:
+                    raise ValueError(f"batch {b} not divisible by "
+                                     f"accumulate_steps {n}")
+                return [t[i * (b // n):(i + 1) * (b // n)] for i in range(n)]
+            return [t] * n
+        if isinstance(data, (tuple, list)):
+            parts = [split(t) for t in data]
+            return [tuple(p[i] for p in parts) for i in range(n)]
+        return split(data)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Microbatch split → forward/backward each (grads accumulate on the
+        tape) → one optimizer step. Returns the averaged loss."""
+        if self._layers._loss_fn is None:
+            raise ValueError("PipelineLayer needs loss_fn for train_batch")
+        n = self.accumulate_steps
+        micro = self._split_microbatch(data, n)
+        total = None
+        for mb in micro:
+            inp, label = mb if isinstance(mb, tuple) else (mb, None)
+            out = self._layers(inp)
+            loss = (self._layers._loss_fn(out, label) if label is not None
+                    else self._layers._loss_fn(out))
+            scaled = loss * (1.0 / n)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total = scaled.detach() if total is None else total + scaled.detach()
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total
+
+    def eval_batch(self, data, compute_loss=True):
+        inp, label = data if isinstance(data, (tuple, list)) else (data, None)
+        out = self._layers(inp)
+        if compute_loss and self._layers._loss_fn is not None:
+            return (self._layers._loss_fn(out, label) if label is not None
+                    else self._layers._loss_fn(out))
+        return out

@@ -1,0 +1,57 @@
+"""Activation recomputation — fleet.recompute parity.
+
+Reference analog: python/paddle/distributed/fleet/recompute/recompute.py — a
+PyLayer that frees activations in forward and re-runs the block in backward
+(upstream-canonical, unverified, SURVEY.md §0, §2.4 recompute row).
+
+TPU-native design: `jax.checkpoint` (remat) IS recompute, applied to the
+traced function. Under `jit` the rematerialization is compiled in; in plain
+eager the call is a passthrough (the tape holds Python references, so there
+is nothing to free deterministically — memory behavior belongs to the
+compiled path, which is where it matters on TPU).
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core.tensor import Tensor
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    """paddle.distributed.fleet.utils.recompute."""
+    datas = [_unwrap(a) for a in args]
+    if any(isinstance(d, jax.core.Tracer) for d in datas):
+        def pure(*xs):
+            out = function(*[Tensor(x) if isinstance(a, Tensor) else x
+                             for x, a in zip(xs, args)], **kwargs)
+            return _unwrap(out)
+
+        out = jax.checkpoint(pure)(*datas)
+        return Tensor(out)
+    return function(*args, **kwargs)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """recompute_sequential parity: checkpoint each segment of a Sequential.
+
+    ctx: {'segments': k} — splits `functions` into k recomputed chunks."""
+    segments = int((ctx or {}).get("segments", 1))
+    fns = list(functions)
+    n = len(fns)
+    per = max(n // max(segments, 1), 1)
+    x = args[0] if len(args) == 1 else args
+
+    def run_chunk(chunk, x):
+        for f in chunk:
+            x = f(*x) if isinstance(x, tuple) else f(x)
+        return x
+
+    for s in range(0, n, per):
+        chunk = fns[s:s + per]
+        x = recompute(lambda t, _c=chunk: run_chunk(_c, t), x, **kwargs)
+    return x

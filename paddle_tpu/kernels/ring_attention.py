@@ -167,6 +167,16 @@ def sep_attention(q, k, v, mesh: Mesh, impl: str = "ring",
     if "sep" not in mesh.axis_names or mesh.shape["sep"] == 1:
         from .flash_attention import flash_attention_fwd
         return flash_attention_fwd(q, k, v, causal, scale)
+    # nested inside another (partial-manual) shard_map — e.g. the pp
+    # pipeline — the inner shard_map must be built from the context's
+    # AbstractMesh (whose pp axis is already Manual), not the concrete mesh
+    try:
+        ctx = jax.sharding.get_abstract_mesh()
+        if ctx is not None and ctx.shape_tuple and any(
+                t == jax.sharding.AxisType.Manual for t in ctx.axis_types):
+            mesh = ctx
+    except Exception:
+        pass
     spec = _sep_specs(mesh)
     body = (_ring_attention_local if impl == "ring"
             else _ulysses_attention_local)

@@ -113,32 +113,37 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
     return params
 
 
-def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+def param_specs(cfg: LlamaConfig, pp: bool = False) -> Dict[str, Any]:
     """PartitionSpec tree matching init_params. This table IS the reference's
     TP layer zoo + GroupSharded stage-3 (SURVEY.md §2.3 TP/sharding rows):
       mp       = Megatron TP: qkv/gate/up column-split, o/down row-split,
                  embeddings vocab-split (VocabParallelEmbedding).
       sharding = ZeRO-3/FSDP: the *other* matmul dim, so every big weight is
                  2D-sharded and all-gathers ride ICI.
-    Layer stack dim [L] stays unsharded (it is scanned, and pp uses it)."""
+    Layer stack dim [L]: unsharded when pp=False (it is scanned); sharded
+    over 'pp' when pp=True — contiguous L/pp layer blocks per stage, which
+    IS the pipeline stage partition (reference: PipelineLayer LayerDesc
+    partition-by-layer, SURVEY.md §2.3 PP row)."""
+    lspec = "pp" if pp else None
     return {
         "embed_tokens": P("mp", "sharding"),
         "layers": {
-            "input_layernorm": P(None, None),
-            "q_proj": P(None, "sharding", "mp"),
-            "k_proj": P(None, "sharding", "mp"),
-            "v_proj": P(None, "sharding", "mp"),
-            "o_proj": P(None, "mp", "sharding"),
-            "post_attention_layernorm": P(None, None),
-            "gate_proj": P(None, "sharding", "mp"),
-            "up_proj": P(None, "sharding", "mp"),
-            "down_proj": P(None, "mp", "sharding"),
+            "input_layernorm": P(lspec, None),
+            "q_proj": P(lspec, "sharding", "mp"),
+            "k_proj": P(lspec, "sharding", "mp"),
+            "v_proj": P(lspec, "sharding", "mp"),
+            "o_proj": P(lspec, "mp", "sharding"),
+            "post_attention_layernorm": P(lspec, None),
+            "gate_proj": P(lspec, "sharding", "mp"),
+            "up_proj": P(lspec, "sharding", "mp"),
+            "down_proj": P(lspec, "mp", "sharding"),
         },
         "norm": P(None),
         "lm_head": P("sharding", "mp"),
     } if not cfg.tie_word_embeddings else {
         "embed_tokens": P("mp", "sharding"),
-        "layers": param_specs(dataclasses.replace(cfg, tie_word_embeddings=False))["layers"],
+        "layers": param_specs(
+            dataclasses.replace(cfg, tie_word_embeddings=False), pp)["layers"],
         "norm": P(None),
     }
 
@@ -225,6 +230,12 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.nothing_saveable)
     x, _ = jax.lax.scan(body, x, params["layers"])
+    return _final_head(params, x, cfg)
+
+
+def _final_head(params, x, cfg: LlamaConfig):
+    """Final RMSNorm + LM head: x [B,S,D] → logits [B,S,V] (f32)."""
+    cd = cfg.dtype
     x = rms_norm_ref(x, params["norm"], cfg.rms_norm_eps)
     head = (params["embed_tokens"].T if cfg.tie_word_embeddings
             else params["lm_head"])
@@ -232,14 +243,63 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
     return logits.astype(jnp.float32)
 
 
-def loss_fn(params, tokens, cfg: LlamaConfig, mesh=None):
+def forward_pp(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
+               mesh, num_microbatches: int) -> jax.Array:
+    """Pipeline-parallel forward: the decoder stack runs as a compiled GPipe
+    schedule over the mesh's `pp` axis (parallel.pipeline), embed/head stay
+    GSPMD (replicated compute over pp, sharded over mp/sharding).
+
+    Reference analog: PipelineParallel.train_batch's forward half
+    (SURVEY.md §3.3) — here the microbatch loop is a lax.scan and the stage
+    hops are ppermute, all inside one XLA program."""
+    from ..parallel.pipeline import pipelined
+
+    n = mesh.shape["pp"]
+    B, S = tokens.shape
+    M = num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    cd = cfg.dtype
+    x = jnp.take(params["embed_tokens"], tokens, axis=0).astype(cd)
+    cos, sin = rope_freqs(cfg.head_dim, S, cfg.rope_theta, jnp.float32)
+
+    # [L,...] → [n, L/n, ...]: a LOCAL no-op when layers are sharded
+    # P('pp') (contiguous blocks), i.e. param_specs(cfg, pp=True)
+    L = cfg.num_hidden_layers
+    if L % n:
+        raise ValueError(
+            f"{L} decoder layers not divisible by pp={n} stages")
+    stage_params = jax.tree.map(
+        lambda p: p.reshape((n, L // n) + p.shape[1:]), params["layers"])
+
+    def stage_fn(local_layers, h):
+        def body(h, lp):
+            return _decoder_layer(h, lp, cfg, cos, sin, mesh), None
+        h, _ = jax.lax.scan(body, h, local_layers)
+        return h
+
+    mb = x.reshape((M, B // M) + x.shape[1:])
+    outs = pipelined(stage_fn, mesh, remat=cfg.remat)(stage_params, mb)
+    x = outs.reshape(B, S, -1)
+    return _final_head(params, x, cfg)
+
+
+def loss_fn(params, tokens, cfg: LlamaConfig, mesh=None,
+            pp_microbatches: Optional[int] = None):
     """Next-token cross entropy, masked at the final position. f32 softmax.
 
     Shapes stay [B, S] throughout (targets via roll + mask, not slicing):
     S-1 is generally not divisible by the sep axis, and uneven seq sharding
     of the embedding-grad scatter aborts XLA's SPMD partitioner
-    (PadBaseShapeBeforeUnevenTiledSharding CHECK) — beyond being slower."""
-    logits = forward(params, tokens, cfg, mesh)
+    (PadBaseShapeBeforeUnevenTiledSharding CHECK) — beyond being slower.
+
+    pp_microbatches: with a mesh whose pp axis > 1, run the decoder through
+    the compiled GPipe schedule with this many microbatches."""
+    if (pp_microbatches and mesh is not None
+            and "pp" in mesh.axis_names and mesh.shape["pp"] > 1):
+        logits = forward_pp(params, tokens, cfg, mesh, pp_microbatches)
+    else:
+        logits = forward(params, tokens, cfg, mesh)
     targets = jnp.roll(tokens, -1, axis=1)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]

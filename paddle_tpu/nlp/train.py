@@ -43,10 +43,10 @@ def make_optimizer(learning_rate=3e-4, weight_decay=0.1, b1=0.9, b2=0.95,
     return tx
 
 
-def state_specs(cfg: llama.LlamaConfig, tx) -> TrainState:
+def state_specs(cfg: llama.LlamaConfig, tx, pp: bool = False) -> TrainState:
     """PartitionSpec tree for the full TrainState: optimizer moments inherit
     each param's spec (= ZeRO: opt state sharded exactly like params)."""
-    pspecs = llama.param_specs(cfg)
+    pspecs = llama.param_specs(cfg, pp=pp)
     params_shape = jax.eval_shape(
         functools.partial(llama.init_params, cfg=cfg), jax.random.key(0))
     opt_state_shape = jax.eval_shape(tx.init, params_shape)
@@ -78,6 +78,11 @@ def _opt_specs_like(opt_state_shape, params_shape, pspecs):
     return rec(opt_state_shape)
 
 
+def _use_pp(mesh: Optional[Mesh]) -> bool:
+    return (mesh is not None and "pp" in mesh.axis_names
+            and mesh.shape["pp"] > 1)
+
+
 def init_state(key, cfg: llama.LlamaConfig, tx, mesh: Optional[Mesh] = None):
     """Initialize params + opt state, jitted with out_shardings so big models
     materialize directly sharded (never replicated on one chip)."""
@@ -88,20 +93,25 @@ def init_state(key, cfg: llama.LlamaConfig, tx, mesh: Optional[Mesh] = None):
 
     if mesh is None:
         return init()
-    specs = state_specs(cfg, tx)
+    specs = state_specs(cfg, tx, pp=_use_pp(mesh))
     shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                              is_leaf=lambda x: isinstance(x, P))
     return jax.jit(init, out_shardings=shardings)()
 
 
 def make_train_step(cfg: llama.LlamaConfig, tx, mesh: Optional[Mesh] = None,
-                    donate: bool = True) -> Callable:
+                    donate: bool = True,
+                    num_microbatches: Optional[int] = None) -> Callable:
     """Build the jitted train step. With a mesh: full GSPMD shardings on
-    state and batch; without: plain jit (single device)."""
+    state and batch; without: plain jit (single device). A mesh with pp > 1
+    runs the decoder through the compiled GPipe schedule —
+    `num_microbatches` (default 2·pp) microbatches per step."""
+    pp = _use_pp(mesh)
+    mb = (num_microbatches or 2 * mesh.shape["pp"]) if pp else None
 
     def step_fn(state: TrainState, tokens):
         loss, grads = jax.value_and_grad(llama.loss_fn)(
-            state.params, tokens, cfg, mesh)
+            state.params, tokens, cfg, mesh, mb)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss,
@@ -112,7 +122,7 @@ def make_train_step(cfg: llama.LlamaConfig, tx, mesh: Optional[Mesh] = None,
     if mesh is None:
         return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 
-    specs = state_specs(cfg, tx)
+    specs = state_specs(cfg, tx, pp=pp)
     state_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                             is_leaf=lambda x: isinstance(x, P))
     batch_sh = NamedSharding(mesh, llama.batch_spec())

@@ -1,0 +1,138 @@
+"""Pipeline parallelism — a COMPILED schedule over the `pp` mesh axis.
+
+Reference analog: fleet.meta_parallel.PipelineParallel.train_batch — a
+host-side Python 1F1B scheduler issuing NCCL send/recv per microbatch hop
+(SURVEY.md §3.3; pipeline_parallel.py / pp_layers.py / p2p_communication.py,
+upstream-canonical, unverified §0).
+
+TPU-native design (SURVEY.md §7 M7): the schedule is not host code — it is a
+`lax.scan` inside a `shard_map` that is MANUAL OVER `pp` ONLY (other mesh
+axes stay GSPMD-auto, so dp/sharding/mp composition is free). Each device
+holds one stage's layer slice; every scan step each stage applies its slice
+to its current buffer and hands the result one hop down the ring
+(`ppermute`). M microbatches drain in M + n - 1 steps (GPipe); the backward
+pipeline falls out of `jax.grad` through the scan — XLA transposes ppermute
+to the reverse hop — so there is no hand-written backward scheduler at all.
+Bubble fraction (n-1)/(M+n-1), same as the reference's GPipe mode; 1F1B's
+memory advantage is approximated with per-step remat (`jax.checkpoint`)
+instead of schedule surgery.
+
+Layout contract: stage-stacked params have a leading [n_stages] dim sharded
+P("pp"); microbatches enter [M, mb, ...] replicated over pp.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _select_tree(pred, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def gpipe_apply(stage_fn: Callable, stage_params: Any, microbatches: jax.Array,
+                n_stages: int, axis_name: str = "pp",
+                remat: bool = True) -> jax.Array:
+    """Run the pipeline INSIDE a shard_map manual over `axis_name`.
+
+    stage_fn(local_params, x) -> y, with y.shape == x.shape (a transformer
+    stage). stage_params: this device's slice, leading dim 1 (from the
+    [n_stages, ...] stack). microbatches: [M, mb...] identical on every pp
+    rank. Returns [M, mb...] outputs of the LAST stage, replicated over pp.
+    """
+    i = lax.axis_index(axis_name)
+    n = n_stages
+    M = microbatches.shape[0]
+    local = jax.tree.map(lambda p: p[0], stage_params)
+    body = (jax.checkpoint(lambda x: stage_fn(local, x)) if remat
+            else (lambda x: stage_fn(local, x)))
+
+    def step(carry, t):
+        buf, outs = carry
+        # stage 0 ingests microbatch t (clipped past the end; masked anyway)
+        inp0 = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        x = jnp.where(i == 0, inp0, buf)
+        y = body(x)
+        # one hop down the pipeline (last stage's hop is dropped by the mask
+        # next step; ring wrap keeps the perm legal)
+        nxt = lax.ppermute(y, axis_name, [(s, (s + 1) % n) for s in range(n)])
+        # the last stage finished microbatch t-(n-1) this step
+        m_idx = t - (n - 1)
+        safe = jnp.clip(m_idx, 0, M - 1)
+        cur = lax.dynamic_index_in_dim(outs, safe, 0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(m_idx >= 0, y, cur), safe, 0)
+        return (nxt, outs), None
+
+    buf0 = jnp.zeros(microbatches.shape[1:], microbatches.dtype)
+    outs0 = jnp.zeros_like(microbatches)
+    (_, outs), _ = lax.scan(step, (buf0, outs0), jnp.arange(M + n - 1))
+    # every rank wrote its own stage outputs; keep only the last stage's.
+    # psum in f32: a bf16 all-reduce aborts XLA-CPU's AllReducePromotion
+    # pass ("Invalid binary instruction opcode copy" CHECK) as of jax 0.9.
+    dt = outs.dtype
+    outs = lax.psum(jnp.where(i == n - 1, outs, jnp.zeros_like(outs))
+                    .astype(jnp.float32), axis_name)
+    return outs.astype(dt)
+
+
+def pipelined(stage_fn: Callable, mesh: Mesh, n_stages: Optional[int] = None,
+              axis_name: str = "pp", remat: bool = True,
+              extra_spec: P = P()) -> Callable:
+    """Wrap gpipe_apply in the partial-manual shard_map.
+
+    Returns fn(stage_params, microbatches) -> outputs usable under an
+    enclosing jit. stage_params leading dim = n_stages, sharded over pp;
+    microbatch array replicated over pp (its dp/sep sharding, if any, stays
+    GSPMD-auto because the shard_map is manual over pp only).
+    """
+    n = n_stages or mesh.shape[axis_name]
+    if mesh.shape[axis_name] != n:
+        raise ValueError(
+            f"mesh {axis_name} axis is {mesh.shape[axis_name]}, need {n}")
+
+    param_specs = P(axis_name)  # leading stage dim; rest auto
+
+    def call(stage_params, microbatches):
+        # f32 at the shard_map boundary: the transpose of a replicated-over-pp
+        # input is a psum of its cotangent, and a bf16 all-reduce aborts
+        # XLA-CPU's AllReducePromotion pass (jax 0.9). Inside the pipeline the
+        # original dtype is restored, so stage compute / ppermute stay bf16.
+        dt = microbatches.dtype
+
+        def body(sp, mb):
+            out = gpipe_apply(stage_fn, sp, mb.astype(dt), n_stages=n,
+                              axis_name=axis_name, remat=remat)
+            return out.astype(jnp.float32)
+
+        fn = shard_map(body, mesh=mesh, in_specs=(param_specs, P()),
+                       out_specs=P(), axis_names={axis_name}, check_vma=False)
+        return fn(stage_params,
+                  microbatches.astype(jnp.float32)).astype(dt)
+
+    return call
+
+
+def stack_stages(layer_params: Any, n_stages: int) -> Any:
+    """Reshape layer-stacked params [L, ...] → stage-stacked
+    [n_stages, L/n_stages, ...] (the reference's LayerDesc partition-by-layer
+    with equal counts; partition-by-cost is a no-op here because stages are
+    homogeneous transformer blocks)."""
+    def reshape(p):
+        L = p.shape[0]
+        if L % n_stages:
+            raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+        return p.reshape((n_stages, L // n_stages) + p.shape[1:])
+    return jax.tree.map(reshape, layer_params)
+
+
+def unstack_stages(stage_params: Any) -> Any:
+    """Inverse of stack_stages."""
+    return jax.tree.map(
+        lambda p: p.reshape((-1,) + p.shape[2:]), stage_params)
