@@ -90,6 +90,7 @@ class Fleet:
             sharding=int(hc.get("sharding_degree", 1)),
             pp=int(hc.get("pp_degree", 1)),
             sep=int(hc.get("sep_degree", 1)),
+            ep=int(hc.get("ep_degree", hc.get("moe_degree", 1))),
             mp=int(hc.get("mp_degree", 1)),
         )
         n_dev = len(jax.devices())

@@ -1,0 +1,2 @@
+"""paddle.incubate namespace — experimental API parity surface."""
+from . import distributed  # noqa: F401

@@ -1,0 +1,117 @@
+"""paddle.incubate.distributed.models.moe — MoELayer API parity.
+
+Reference analog: python/paddle/incubate/distributed/models/moe/
+(moe_layer.py MoELayer over a moe_group; gate/ gshard_gate.py,
+switch_gate.py, naive_gate.py; capacity + all_to_all dispatch with fused
+CUDA kernels) — upstream-canonical, unverified, SURVEY.md §0, §2.3 EP row.
+
+TPU-native design: gating/dispatch reuse the functional GShard core
+(nlp.moe.top_k_gating — static [T,E,C] dispatch einsums; GSPMD inserts the
+EP all_to_all from the 'ep' sharding). Experts here are arbitrary user
+Layers, so the expert loop runs per-expert on its capacity slice — under
+jit this unrolls into E parallel branches XLA schedules freely.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+from .....nn.layer import Layer
+from .....nn.layers_common import LayerList
+from .....ops._registry import eager
+from .....nlp.moe import top_k_gating, gshard_capacity
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 top_k: int = 2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.world_size = world_size
+        self.top_k = top_k
+        self.weight = self.create_parameter([d_model, num_expert])
+
+
+class NaiveGate(BaseGate):
+    """Plain softmax top-k gate."""
+
+
+class GShardGate(BaseGate):
+    """GShard gate (top-2 + capacity + load-balance aux)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        super().__init__(d_model, num_expert, world_size, top_k)
+        self.capacity_factor = capacity[0]
+
+
+class SwitchGate(BaseGate):
+    """Switch gate (top-1)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=1,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, top_k)
+        self.capacity_factor = capacity[0]
+
+
+class MoELayer(Layer):
+    """Mixture-of-experts layer over a list of expert Layers.
+
+    moe.MoELayer parity: y[t] = Σ_{e ∈ topk(t)} gate_e(t) · expert_e(x[t]),
+    capacity-dropped tokens contribute 0 (residual passes them through).
+    """
+
+    def __init__(self, d_model: int, experts: List[Layer],
+                 gate: Optional[BaseGate] = None, moe_group=None,
+                 mp_group=None, recompute_interval: int = 0, top_k: int = 2,
+                 capacity_factor: float = 1.25, **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = LayerList(experts)
+        self.num_expert = len(experts)
+        self.gate = gate or NaiveGate(d_model, self.num_expert, top_k=top_k)
+        self.top_k = getattr(self.gate, "top_k", top_k)
+        self.capacity_factor = getattr(self.gate, "capacity_factor",
+                                       capacity_factor)
+        self.l_aux = None  # reference exposes the load-balance aux loss here
+
+    def forward(self, x: Tensor) -> Tensor:
+        orig_shape = list(x.shape)
+        d = orig_shape[-1]
+        T = 1
+        for s in orig_shape[:-1]:
+            T *= s
+        capacity = gshard_capacity(T, self.top_k, self.num_expert,
+                                   self.capacity_factor)
+        xt = x.reshape([T, d])
+        logits = xt.matmul(self.gate.weight)
+
+        experts = list(self.experts)
+        top_k = self.top_k
+
+        # gating runs through the op registry so it lands on the autograd
+        # tape (differentiable wrt gate weight via the combine probs)
+        def gate_fn(lg):
+            dispatch, combine, aux = top_k_gating(lg, top_k, capacity)
+            return dispatch, combine, aux["load_balance_loss"]
+
+        dispatch, combine, self.l_aux = eager(
+            gate_fn, (logits,), {}, name="moe_gate")
+
+        # [T,E,C] x [T,D] -> per-expert [C, D]
+        expert_in = eager(
+            lambda dsp, xv: jnp.einsum("tec,td->ecd", dsp, xv),
+            (dispatch, xt), {}, name="moe_dispatch")
+        outs = []
+        for e, expert in enumerate(experts):
+            outs.append(expert(expert_in[e]))
+        expert_out = eager(
+            lambda *ys: jnp.stack(ys, axis=0), tuple(outs), {},
+            name="moe_stack")
+        y = eager(
+            lambda cmb, eo: jnp.einsum("tec,ecd->td", cmb, eo),
+            (combine, expert_out), {}, name="moe_combine")
+        return y.reshape(orig_shape)

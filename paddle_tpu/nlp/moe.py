@@ -1,0 +1,350 @@
+"""Mixture-of-Experts: gating, capacity dispatch, expert parallelism, and
+the flagship MoE transformer (DeepSeekMoE/Qwen2-MoE-style — BASELINE
+config 4).
+
+Reference analog: python/paddle/incubate/distributed/models/moe/
+(moe_layer.py with gshard/switch/naive gates, capacity + all_to_all dispatch
+over the moe_group, fused dispatch CUDA kernels) and the PaddleNLP
+DeepSeekMoE recipes — upstream-canonical, unverified, SURVEY.md §0, §2.3 EP
+row.
+
+TPU-native design (SURVEY.md §7 M7): GShard-style STATIC-SHAPE dispatch —
+top-k gating builds [T, E, C] one-hot dispatch/combine tensors (cumsum
+position assignment, capacity-dropped tokens fall through the residual);
+dispatch and combine are einsums, so under GSPMD with experts sharded
+P('ep', ...) XLA inserts the all_to_all the reference hand-codes. The whole
+MoE block stays differentiable jnp — no host-side routing, no ragged shapes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..kernels.rms_norm import rms_norm_ref
+from ..kernels.rope import rope_freqs
+from . import llama as _llama
+
+
+def gshard_capacity(tokens: int, k: int, num_experts: int,
+                    factor: float) -> int:
+    """GShard expert capacity: ceil-ish share of k·T routed slots per
+    expert, scaled by the capacity factor (single source of the rounding
+    rule for MoeConfig and the incubate MoELayer facade)."""
+    per = tokens * k / num_experts
+    return max(int(per * factor + 0.5), 1)
+
+
+def top_k_gating(gate_logits: jax.Array, k: int, capacity: int,
+                 renormalize: bool = True
+                 ) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """GShard top-k gating with capacity.
+
+    gate_logits: [T, E] (f32). Returns (dispatch [T,E,C] bool-ish f32,
+    combine [T,E,C] f32, aux) where combine = gate prob at the token's
+    assigned (expert, slot) and aux carries the Switch/GShard load-balance
+    loss and router z-loss.
+    """
+    T, E = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    # iterative top-k: mask out chosen experts each round
+    masked = probs
+    sel_masks = []          # k × [T, E] one-hot
+    sel_probs = []          # k × [T]
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        sel_masks.append(onehot)
+        sel_probs.append(jnp.sum(probs * onehot, axis=-1))
+        masked = masked * (1.0 - onehot)
+
+    if renormalize:
+        denom = sum(sel_probs)
+        sel_probs = [p / jnp.maximum(denom, 1e-9) for p in sel_probs]
+
+    # capacity slots: cumulative position of each token within its expert,
+    # later-k choices stack after earlier-k occupancy (GShard ordering)
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    prior_count = jnp.zeros((E,), jnp.float32)
+    for mask, p in zip(sel_masks, sel_probs):
+        pos = jnp.cumsum(mask, axis=0) - 1.0 + prior_count[None, :]
+        prior_count = prior_count + jnp.sum(mask, axis=0)
+        in_cap = (pos < capacity) & (mask > 0)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=jnp.float32)      # [T, E, C]
+        d = slot * (in_cap.astype(jnp.float32))[..., None]
+        dispatch = dispatch + d
+        combine = combine + d * p[:, None, None]
+
+    # Switch load-balance loss: E * Σ_e fraction_tokens_e · mean_prob_e
+    # (fraction from the FIRST choice, the standard formulation)
+    frac = jnp.mean(sel_masks[0], axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    aux = {
+        "load_balance_loss": E * jnp.sum(frac * mean_p),
+        "router_z_loss": jnp.mean(
+            jax.scipy.special.logsumexp(gate_logits, axis=-1) ** 2),
+    }
+    return dispatch, combine, aux
+
+
+@dataclasses.dataclass
+class MoeConfig:
+    """Flagship MoE transformer (Qwen2-MoE/DeepSeekMoE shape: routed experts
+    + optional always-on shared expert)."""
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632       # dense (shared) FFN width
+    moe_intermediate_size: int = 1408   # per-expert FFN width
+    num_experts: int = 8
+    num_experts_per_tok: int = 2
+    num_shared_experts: int = 1         # 0 disables the shared expert
+    capacity_factor: float = 1.25
+    num_hidden_layers: int = 4
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    router_aux_loss_coef: float = 0.01
+    router_z_loss_coef: float = 0.001
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    attn_impl: str = "flash"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    def capacity(self, tokens: int) -> int:
+        return gshard_capacity(tokens, self.num_experts_per_tok,
+                               self.num_experts, self.capacity_factor)
+
+    @staticmethod
+    def tiny(**over) -> "MoeConfig":
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    moe_intermediate_size=32, num_experts=4,
+                    num_experts_per_tok=2, num_hidden_layers=2,
+                    num_attention_heads=4, num_key_value_heads=2,
+                    max_position_embeddings=128)
+        base.update(over)
+        return MoeConfig(**base)
+
+    @staticmethod
+    def qwen2_moe_a14b(**over) -> "MoeConfig":
+        """Qwen2-57B-A14B-shaped config (public card numbers)."""
+        base = dict(vocab_size=151936, hidden_size=3584,
+                    intermediate_size=18944, moe_intermediate_size=2560,
+                    num_experts=64, num_experts_per_tok=8,
+                    num_shared_experts=1, num_hidden_layers=28,
+                    num_attention_heads=28, num_key_value_heads=4,
+                    max_position_embeddings=32768, rope_theta=1000000.0)
+        base.update(over)
+        return MoeConfig(**base)
+
+    @staticmethod
+    def deepseek_moe_16b(**over) -> "MoeConfig":
+        """DeepSeekMoE-16B-shaped config (public card numbers)."""
+        base = dict(vocab_size=102400, hidden_size=2048,
+                    intermediate_size=10944, moe_intermediate_size=1408,
+                    num_experts=64, num_experts_per_tok=6,
+                    num_shared_experts=2, num_hidden_layers=28,
+                    num_attention_heads=16, num_key_value_heads=16,
+                    max_position_embeddings=4096)
+        base.update(over)
+        return MoeConfig(**base)
+
+
+def _llama_cfg(cfg: MoeConfig) -> _llama.LlamaConfig:
+    """Attention reuses the llama block implementation."""
+    return _llama.LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads,
+        max_position_embeddings=cfg.max_position_embeddings,
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype, remat=cfg.remat,
+        attn_impl=cfg.attn_impl, use_flash=True)
+
+
+def init_params(key: jax.Array, cfg: MoeConfig) -> Dict[str, Any]:
+    """Parameter pytree; layers stacked [L], experts stacked [E]."""
+    D, V, L = cfg.hidden_size, cfg.vocab_size, cfg.num_hidden_layers
+    E, Fm = cfg.num_experts, cfg.moe_intermediate_size
+    H, KV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    pd = cfg.param_dtype
+    ks = jax.random.split(key, 12)
+
+    def norm(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(pd)
+
+    layers = {
+        "input_layernorm": jnp.ones((L, D), pd),
+        "q_proj": norm(ks[1], (L, D, H * hd)),
+        "k_proj": norm(ks[2], (L, D, KV * hd)),
+        "v_proj": norm(ks[3], (L, D, KV * hd)),
+        "o_proj": norm(ks[4], (L, H * hd, D)),
+        "post_attention_layernorm": jnp.ones((L, D), pd),
+        "gate": norm(ks[5], (L, D, E)),
+        "expert_gate_proj": norm(ks[6], (L, E, D, Fm)),
+        "expert_up_proj": norm(ks[7], (L, E, D, Fm)),
+        "expert_down_proj": norm(ks[8], (L, E, Fm, D)),
+    }
+    if cfg.num_shared_experts:
+        Fs = cfg.moe_intermediate_size * cfg.num_shared_experts
+        layers.update({
+            "shared_gate_proj": norm(ks[9], (L, D, Fs)),
+            "shared_up_proj": norm(ks[10], (L, D, Fs)),
+            "shared_down_proj": norm(ks[11], (L, Fs, D)),
+        })
+    return {
+        "embed_tokens": norm(ks[0], (V, D)),
+        "layers": layers,
+        "norm": jnp.ones((D,), pd),
+        "lm_head": norm(jax.random.fold_in(key, 99), (D, V)),
+    }
+
+
+def param_specs(cfg: MoeConfig, pp: bool = False) -> Dict[str, Any]:
+    """Sharding table: experts over 'ep' (expert parallelism — the
+    reference's moe_group), expert matrices 2D-sharded over
+    (sharding, mp) like dense weights; attention same as llama."""
+    lspec = "pp" if pp else None
+    layers = {
+        "input_layernorm": P(lspec, None),
+        "q_proj": P(lspec, "sharding", "mp"),
+        "k_proj": P(lspec, "sharding", "mp"),
+        "v_proj": P(lspec, "sharding", "mp"),
+        "o_proj": P(lspec, "mp", "sharding"),
+        "post_attention_layernorm": P(lspec, None),
+        "gate": P(lspec, None, None),
+        "expert_gate_proj": P(lspec, "ep", "sharding", "mp"),
+        "expert_up_proj": P(lspec, "ep", "sharding", "mp"),
+        "expert_down_proj": P(lspec, "ep", "mp", "sharding"),
+    }
+    if cfg.num_shared_experts:
+        layers.update({
+            "shared_gate_proj": P(lspec, "sharding", "mp"),
+            "shared_up_proj": P(lspec, "sharding", "mp"),
+            "shared_down_proj": P(lspec, "mp", "sharding"),
+        })
+    return {
+        "embed_tokens": P("mp", "sharding"),
+        "layers": layers,
+        "norm": P(None),
+        "lm_head": P("sharding", "mp"),
+    }
+
+
+def moe_block(x: jax.Array, lp: Dict[str, jax.Array], cfg: MoeConfig
+              ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """x: [B, S, D] → (y, aux). Routed experts + optional shared expert."""
+    B, S, D = x.shape
+    T = B * S
+    cd = cfg.dtype
+    xt = x.reshape(T, D)
+    C = cfg.capacity(T)
+
+    logits = xt.astype(jnp.float32) @ lp["gate"].astype(jnp.float32)
+    dispatch, combine, aux = top_k_gating(
+        logits, cfg.num_experts_per_tok, C)
+
+    # dispatch: [T,E,C] × [T,D] → [E,C,D]; GSPMD turns the contraction into
+    # the EP all_to_all when experts are sharded over 'ep'
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cd), xt)
+    g = jnp.einsum("ecd,edf->ecf", expert_in, lp["expert_gate_proj"].astype(cd))
+    u = jnp.einsum("ecd,edf->ecf", expert_in, lp["expert_up_proj"].astype(cd))
+    expert_out = jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u,
+                            lp["expert_down_proj"].astype(cd))
+    y = jnp.einsum("tec,ecd->td", combine.astype(cd), expert_out)
+
+    if cfg.num_shared_experts:
+        sg = xt @ lp["shared_gate_proj"].astype(cd)
+        su = xt @ lp["shared_up_proj"].astype(cd)
+        y = y + (jax.nn.silu(sg) * su) @ lp["shared_down_proj"].astype(cd)
+    return y.reshape(B, S, D), aux
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig,
+            mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """tokens [B,S] → (logits [B,S,V] f32, aux losses)."""
+    lcfg = _llama_cfg(cfg)
+    cd = cfg.dtype
+    x = jnp.take(params["embed_tokens"], tokens, axis=0).astype(cd)
+    cos, sin = rope_freqs(cfg.head_dim, tokens.shape[1], cfg.rope_theta,
+                          jnp.float32)
+
+    def maybe_constrain(h):
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            h = jax.lax.with_sharding_constraint(
+                h, NamedSharding(mesh, _llama.act_spec()))
+        return h
+
+    x = maybe_constrain(x)
+
+    def body(carry, lp):
+        h, lb, zl = carry
+        a = rms_norm_ref(h, lp["input_layernorm"], cfg.rms_norm_eps)
+        h = h + _llama._attention(a, lp, lcfg, cos, sin, mesh)
+        a = rms_norm_ref(h, lp["post_attention_layernorm"], cfg.rms_norm_eps)
+        y, aux = moe_block(a, lp, cfg)
+        h = maybe_constrain(h + y)
+        return (h, lb + aux["load_balance_loss"],
+                zl + aux["router_z_loss"]), None
+
+    if cfg.remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable)
+    (x, lb, zl), _ = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        params["layers"])
+    x = rms_norm_ref(x, params["norm"], cfg.rms_norm_eps)
+    logits = (x.astype(cd) @ params["lm_head"].astype(cd)).astype(jnp.float32)
+    L = cfg.num_hidden_layers
+    return logits, {"load_balance_loss": lb / L, "router_z_loss": zl / L}
+
+
+def loss_fn(params, tokens, cfg: MoeConfig, mesh=None):
+    """Next-token CE + router aux losses (full-shape roll+mask, same
+    rationale as llama.loss_fn)."""
+    logits, aux = forward(params, tokens, cfg, mesh)
+    targets = jnp.roll(tokens, -1, axis=1)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    seq = tokens.shape[1]
+    valid = (jnp.arange(seq) < seq - 1).astype(logits.dtype)
+    ce = jnp.sum((logz - gold) * valid[None]) / (tokens.shape[0] * (seq - 1))
+    return (ce + cfg.router_aux_loss_coef * aux["load_balance_loss"]
+            + cfg.router_z_loss_coef * aux["router_z_loss"])
+
+
+def num_params(cfg: MoeConfig) -> int:
+    D, V, L = cfg.hidden_size, cfg.vocab_size, cfg.num_hidden_layers
+    E, Fm = cfg.num_experts, cfg.moe_intermediate_size
+    H, KV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    per = (2 * D + D * (H + 2 * KV) * hd + H * hd * D
+           + D * E + 3 * E * D * Fm)
+    if cfg.num_shared_experts:
+        per += 3 * D * Fm * cfg.num_shared_experts
+    return V * D + L * per + D + D * V
+
+
+def active_params(cfg: MoeConfig) -> int:
+    """Parameters touched per token (the 'A14B' in Qwen2-57B-A14B)."""
+    D, V, L = cfg.hidden_size, cfg.vocab_size, cfg.num_hidden_layers
+    Fm = cfg.moe_intermediate_size
+    H, KV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    per = (2 * D + D * (H + 2 * KV) * hd + H * hd * D + D * cfg.num_experts
+           + 3 * D * Fm * cfg.num_experts_per_tok)
+    if cfg.num_shared_experts:
+        per += 3 * D * Fm * cfg.num_shared_experts
+    return V * D + L * per + D + D * V

@@ -43,12 +43,14 @@ def make_optimizer(learning_rate=3e-4, weight_decay=0.1, b1=0.9, b2=0.95,
     return tx
 
 
-def state_specs(cfg: llama.LlamaConfig, tx, pp: bool = False) -> TrainState:
+def state_specs(cfg, tx, pp: bool = False, model=llama) -> TrainState:
     """PartitionSpec tree for the full TrainState: optimizer moments inherit
-    each param's spec (= ZeRO: opt state sharded exactly like params)."""
-    pspecs = llama.param_specs(cfg, pp=pp)
+    each param's spec (= ZeRO: opt state sharded exactly like params).
+    `model` is the model module (llama or moe) — both expose init_params/
+    param_specs/loss_fn with the same signatures."""
+    pspecs = model.param_specs(cfg, pp=pp)
     params_shape = jax.eval_shape(
-        functools.partial(llama.init_params, cfg=cfg), jax.random.key(0))
+        functools.partial(model.init_params, cfg=cfg), jax.random.key(0))
     opt_state_shape = jax.eval_shape(tx.init, params_shape)
     opt_specs = _opt_specs_like(opt_state_shape, params_shape, pspecs)
     return TrainState(step=P(), params=pspecs, opt_state=opt_specs)
@@ -83,35 +85,41 @@ def _use_pp(mesh: Optional[Mesh]) -> bool:
             and mesh.shape["pp"] > 1)
 
 
-def init_state(key, cfg: llama.LlamaConfig, tx, mesh: Optional[Mesh] = None):
+def init_state(key, cfg, tx, mesh: Optional[Mesh] = None, model=llama):
     """Initialize params + opt state, jitted with out_shardings so big models
     materialize directly sharded (never replicated on one chip)."""
     def init():
-        params = llama.init_params(key, cfg)
+        params = model.init_params(key, cfg)
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                           opt_state=tx.init(params))
 
     if mesh is None:
         return init()
-    specs = state_specs(cfg, tx, pp=_use_pp(mesh))
+    pp = _use_pp(mesh) and hasattr(model, "forward_pp")
+    specs = state_specs(cfg, tx, pp=pp, model=model)
     shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                              is_leaf=lambda x: isinstance(x, P))
     return jax.jit(init, out_shardings=shardings)()
 
 
-def make_train_step(cfg: llama.LlamaConfig, tx, mesh: Optional[Mesh] = None,
+def make_train_step(cfg, tx, mesh: Optional[Mesh] = None,
                     donate: bool = True,
-                    num_microbatches: Optional[int] = None) -> Callable:
+                    num_microbatches: Optional[int] = None,
+                    model=llama) -> Callable:
     """Build the jitted train step. With a mesh: full GSPMD shardings on
     state and batch; without: plain jit (single device). A mesh with pp > 1
     runs the decoder through the compiled GPipe schedule —
-    `num_microbatches` (default 2·pp) microbatches per step."""
-    pp = _use_pp(mesh)
+    `num_microbatches` (default 2·pp) microbatches per step (models without
+    a forward_pp, e.g. moe, ignore it)."""
+    pp = _use_pp(mesh) and hasattr(model, "forward_pp")
     mb = (num_microbatches or 2 * mesh.shape["pp"]) if pp else None
 
     def step_fn(state: TrainState, tokens):
-        loss, grads = jax.value_and_grad(llama.loss_fn)(
-            state.params, tokens, cfg, mesh, mb)
+        if pp:
+            lfn = lambda p, t: model.loss_fn(p, t, cfg, mesh, mb)  # noqa: E731
+        else:
+            lfn = lambda p, t: model.loss_fn(p, t, cfg, mesh)  # noqa: E731
+        loss, grads = jax.value_and_grad(lfn)(state.params, tokens)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss,
@@ -122,10 +130,11 @@ def make_train_step(cfg: llama.LlamaConfig, tx, mesh: Optional[Mesh] = None,
     if mesh is None:
         return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 
-    specs = state_specs(cfg, tx, pp=pp)
+    specs = state_specs(cfg, tx, pp=pp, model=model)
     state_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                             is_leaf=lambda x: isinstance(x, P))
-    batch_sh = NamedSharding(mesh, llama.batch_spec())
+    batch_sh = NamedSharding(
+        mesh, getattr(model, "batch_spec", llama.batch_spec)())
     metric_sh = {"loss": NamedSharding(mesh, P()),
                  "grad_norm": NamedSharding(mesh, P()),
                  "step": NamedSharding(mesh, P())}

@@ -23,17 +23,18 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
-AXES = ("dp", "sharding", "pp", "sep", "mp")
+AXES = ("dp", "sharding", "pp", "sep", "ep", "mp")
 _global_mesh: Optional[Mesh] = None
 _global_topo: Optional["HybridCommunicateGroup"] = None
 
 
 def build_mesh(dp: int = 1, sharding: int = 1, pp: int = 1, sep: int = 1,
-               mp: int = 1, devices: Optional[Sequence] = None,
+               ep: int = 1, mp: int = 1, devices: Optional[Sequence] = None,
                dcn_dp: int = 1) -> Mesh:
     """Create the hybrid mesh. `dcn_dp` > 1 splits dp over DCN for
-    multi-slice (hybrid mesh via mesh_utils)."""
-    shape = dict(dp=dp, sharding=sharding, pp=pp, sep=sep, mp=mp)
+    multi-slice (hybrid mesh via mesh_utils). `ep` is the expert-parallel
+    axis (reference: the moe_group in incubate MoE — SURVEY.md §2.3 EP row)."""
+    shape = dict(dp=dp, sharding=sharding, pp=pp, sep=sep, ep=ep, mp=mp)
     total = int(np.prod(list(shape.values())))
     if devices is None:
         devices = jax.devices()
@@ -45,7 +46,8 @@ def build_mesh(dp: int = 1, sharding: int = 1, pp: int = 1, sep: int = 1,
         per_slice = dict(shape)
         per_slice["dp"] = dp // dcn_dp
         dev_mesh = mesh_utils.create_hybrid_device_mesh(
-            tuple(per_slice.values()), (dcn_dp, 1, 1, 1, 1), devices=devices)
+            tuple(per_slice.values()), (dcn_dp,) + (1,) * (len(AXES) - 1),
+            devices=devices)
         return Mesh(dev_mesh, AXES)
     dev_array = np.asarray(devices).reshape(tuple(shape.values()))
     return Mesh(dev_array, AXES)
@@ -110,8 +112,9 @@ class CommunicateTopology:
     """fleet.base.topology.CommunicateTopology parity: named-dim cartesian
     coordinate math over the mesh shape."""
 
-    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
-                 dims=(1, 1, 1, 1, 1)):
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                           "expert", "model"),
+                 dims=(1, 1, 1, 1, 1, 1)):
         self._parallel_names = list(hybrid_group_names)
         self._dims = list(dims)
         self.coordinate = collections.namedtuple("Coordinate", self._parallel_names)
@@ -149,13 +152,18 @@ class HybridCommunicateGroup:
         self._pp_degree = sh["pp"]
         self._sharding_degree = sh["sharding"]
         self._sep_degree = sh["sep"]
+        self._ep_degree = sh.get("ep", 1)
         self._mp_degree = sh["mp"]
         self._topo = topology or CommunicateTopology(
-            dims=(sh["dp"], sh["pp"], sh["sharding"], sh["sep"], sh["mp"]))
+            dims=(sh["dp"], sh["pp"], sh["sharding"], sh["sep"],
+                  sh.get("ep", 1), sh["mp"]))
         self._dp_group = CommGroup("dp", self.mesh)
         self._pp_group = CommGroup("pp", self.mesh)
         self._sharding_group = CommGroup("sharding", self.mesh)
         self._sep_group = CommGroup("sep", self.mesh)
+        # pre-ep 5-axis meshes: an empty-axes group (nranks 1)
+        self._ep_group = CommGroup(
+            "ep" if "ep" in self.mesh.axis_names else (), self.mesh)
         self._mp_group = CommGroup("mp", self.mesh)
 
     # degree getters (paddle names)
@@ -173,6 +181,9 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_world_size(self):
         return self._sep_degree
+
+    def get_expert_parallel_world_size(self):
+        return self._ep_degree
 
     # ranks: single-controller — callers that branch on rank are running the
     # one global program; return 0 (the reference uses these to split work
@@ -207,6 +218,9 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self):
         return self._sep_group
+
+    def get_expert_parallel_group(self):
+        return self._ep_group
 
     def get_check_parallel_group(self, *a):
         return CommGroup(AXES, self.mesh)

@@ -1,0 +1,151 @@
+"""MoE: gating, capacity dispatch, expert parallelism, model family, and the
+incubate MoELayer facade.
+
+Reference test analog: the incubate moe tests + DeepSeekMoE/Qwen2-MoE
+BASELINE config 4 (SURVEY.md §4, §6).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nlp import moe, llama, train
+from paddle_tpu.parallel.topology import build_mesh, set_mesh
+
+
+class TestTopKGating:
+    def test_each_token_routed_at_most_k(self):
+        logits = jnp.asarray(np.random.RandomState(0).randn(16, 4), jnp.float32)
+        d, c, aux = moe.top_k_gating(logits, 2, 8)
+        per_tok = np.asarray(d.sum(axis=(1, 2)))
+        assert per_tok.max() <= 2.0 + 1e-6
+        comb = np.asarray(c.sum(axis=(1, 2)))
+        assert comb.max() <= 1.0 + 1e-5
+
+    def test_capacity_enforced(self):
+        # all tokens prefer expert 0 → only C fit
+        logits = jnp.tile(jnp.asarray([[10.0, 0.0, 0.0, 0.0]]), (16, 1))
+        d, c, aux = moe.top_k_gating(logits, 1, 4)
+        per_e = np.asarray(d.sum(axis=(0, 2)))
+        assert per_e[0] == 4.0  # capacity, not 16
+        # dropped tokens have zero combine weight
+        assert np.asarray(c.sum(axis=(1, 2))).sum() == pytest.approx(4.0, abs=1e-4)
+
+    def test_load_balance_loss_uniform_is_one(self):
+        # perfectly uniform router → loss ≈ 1 (E · E⁻¹·E⁻¹ · E)
+        logits = jnp.zeros((64, 8), jnp.float32)
+        _, _, aux = moe.top_k_gating(logits, 1, 64)
+        assert float(aux["load_balance_loss"]) == pytest.approx(1.0, rel=1e-3)
+
+
+class TestMoeBlock:
+    def test_identical_experts_equals_dense(self):
+        cfg = moe.MoeConfig.tiny(num_shared_experts=0, capacity_factor=8.0)
+        params = moe.init_params(jax.random.PRNGKey(0), cfg)
+        lp = jax.tree.map(lambda p: p[0], params["layers"])
+        for nm in ("expert_gate_proj", "expert_up_proj", "expert_down_proj"):
+            lp[nm] = jnp.broadcast_to(lp[nm][0:1], lp[nm].shape)
+        x = jnp.asarray(np.random.RandomState(1).randn(2, 8, cfg.hidden_size),
+                        jnp.float32).astype(jnp.bfloat16)
+        y, _ = moe.moe_block(x, lp, cfg)
+        xt = x.reshape(-1, cfg.hidden_size)
+        g = xt @ lp["expert_gate_proj"][0].astype(x.dtype)
+        u = xt @ lp["expert_up_proj"][0].astype(x.dtype)
+        ref = ((jax.nn.silu(g) * u)
+               @ lp["expert_down_proj"][0].astype(x.dtype)).reshape(x.shape)
+        np.testing.assert_allclose(
+            np.asarray(y, jnp.float32), np.asarray(ref, jnp.float32),
+            atol=0.05)
+
+    def test_shared_expert_added(self):
+        cfg = moe.MoeConfig.tiny(num_shared_experts=1)
+        params = moe.init_params(jax.random.PRNGKey(0), cfg)
+        assert "shared_gate_proj" in params["layers"]
+        lp = jax.tree.map(lambda p: p[0], params["layers"])
+        x = jnp.ones((1, 4, cfg.hidden_size), jnp.bfloat16)
+        y, _ = moe.moe_block(x, lp, cfg)
+        assert y.shape == x.shape
+
+
+class TestMoeModel:
+    def test_loss_and_grad_finite(self):
+        cfg = moe.MoeConfig.tiny()
+        params = moe.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (4, 32)), jnp.int32)
+        l = moe.loss_fn(params, toks, cfg)
+        assert np.isfinite(float(l))
+        g = jax.grad(moe.loss_fn)(params, toks, cfg)
+        assert jax.tree_util.tree_all(
+            jax.tree.map(lambda a: bool(jnp.all(jnp.isfinite(a))), g))
+
+    def test_expert_parallel_train_step(self):
+        """EP×TP×DP sharded MoE train step on the 8-device mesh."""
+        mesh = build_mesh(dp=2, ep=2, mp=2)
+        set_mesh(mesh)
+        cfg = moe.MoeConfig.tiny()
+        tx = train.make_optimizer(1e-3)
+        state = train.init_state(jax.random.key(0), cfg, tx, mesh,
+                                 model=moe)
+        step = train.make_train_step(cfg, tx, mesh, model=moe)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (8, 32)), jnp.int32)
+        toks = jax.device_put(toks, NamedSharding(mesh, llama.batch_spec()))
+        state, m0 = step(state, toks)
+        for _ in range(3):
+            state, m = step(state, toks)
+        assert float(m["loss"]) < float(m0["loss"])
+        assert np.isfinite(float(m["grad_norm"]))
+
+    def test_sharded_matches_unsharded(self):
+        mesh = build_mesh(dp=2, ep=4)
+        cfg = moe.MoeConfig.tiny(remat=False)
+        params = moe.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (4, 32)), jnp.int32)
+        ref = moe.loss_fn(params, toks, cfg, mesh=None)
+        sh = jax.jit(lambda p, t: moe.loss_fn(p, t, cfg, mesh))(params, toks)
+        assert abs(float(ref) - float(sh)) < 1e-3
+
+    def test_param_counts(self):
+        cfg = moe.MoeConfig.tiny()
+        params = moe.init_params(jax.random.PRNGKey(0), cfg)
+        total = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+        assert total == moe.num_params(cfg)
+        assert moe.active_params(cfg) < moe.num_params(cfg)
+
+
+class TestMoELayerFacade:
+    def test_forward_backward_train(self):
+        from paddle_tpu.incubate.distributed.models.moe import (
+            MoELayer, GShardGate)
+        d = 16
+        experts = [nn.Sequential(nn.Linear(d, 32), nn.GELU(),
+                                 nn.Linear(32, d)) for _ in range(4)]
+        layer = MoELayer(d_model=d, experts=experts,
+                         gate=GShardGate(d, 4, top_k=2, capacity=(8.0, 8.0)))
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8, d).astype("float32"),
+            stop_gradient=False)
+        y = layer(x)
+        assert list(y.shape) == [2, 8, d]
+        assert layer.l_aux is not None
+        loss = (y * y).mean() + layer.l_aux * 0.01
+        loss.backward()
+        assert layer.gate.weight.grad is not None
+        assert experts[0][0].weight.grad is not None
+
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=layer.parameters())
+        l0 = None
+        for _ in range(5):
+            opt.clear_grad()
+            y = layer(x)
+            loss = ((y - 1.0) ** 2).mean()
+            loss.backward()
+            opt.step()
+            l0 = l0 if l0 is not None else float(loss.numpy())
+        assert float(loss.numpy()) < l0
