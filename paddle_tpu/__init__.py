@@ -50,6 +50,8 @@ from . import amp  # noqa: F401
 from .nn.layer import ParamAttr  # noqa: F401
 
 from . import distributed  # noqa: F401
+from . import incubate  # noqa: F401
+from . import profiler  # noqa: F401
 from . import io  # noqa: F401
 from . import vision  # noqa: F401
 from . import jit  # noqa: F401

@@ -23,6 +23,7 @@ from ..parallel.topology import (  # noqa: F401
     build_mesh, get_mesh, set_mesh, HybridCommunicateGroup,
     get_hybrid_communicate_group, CommGroup)
 from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
 from .fleet import DistributedStrategy  # noqa: F401
 
 

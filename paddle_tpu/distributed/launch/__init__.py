@@ -1,0 +1,16 @@
+"""paddle.distributed.launch — multi-host launcher + elastic restart.
+
+Reference analog: python/paddle/distributed/launch/ (Context → Controller
+spawning one subprocess per GPU rank, TCP/etcd rendezvous, elastic manager
+restarting on membership change) — upstream-canonical, unverified, SURVEY.md
+§0, §2.3 launch row, §5 'Failure detection'.
+
+TPU-native design (SURVEY.md §2.3): ONE process per HOST (single-controller
+SPMD — devices don't get processes), bootstrapped by
+jax.distributed.initialize via env the launcher sets. Elasticity is
+checkpoint-restart: XLA's world is fixed-size, so instead of the reference's
+membership-resize protocol the watchdog restarts the training script (which
+resumes from its latest checkpoint) up to --max_restarts times, classifying
+exit codes like the reference's controller does.
+"""
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,112 @@
+"""Launcher implementation. Usage (reference-compatible surface):
+
+    python -m paddle_tpu.distributed.launch \
+        --nnodes 2 --master 10.0.0.1:8090 --rank 0 \
+        [--max_restarts 3] [--log_dir log] train.py --args...
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="TPU-native launcher: one controller process per host")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="number of hosts (N or N:M elastic range; the upper "
+                        "bound is ignored — XLA worlds are fixed-size)")
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator ip:port (reference: TCP store master)")
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+                   help="this host's process index")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="kept for CLI parity; must be 1 (single controller "
+                        "per host — devices are not processes)")
+    p.add_argument("--devices", "--gpus", "--xpus", type=str, default=None,
+                   help="kept for CLI parity; TPU chips are auto-discovered")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--max_restarts", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_MAX_RESTARTS",
+                                              "0")),
+                   help="elastic: restart the script on failure this many "
+                        "times (training resumes from its checkpoint)")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+# exit-code classification (reference: launch controllers' watch loop)
+_FATAL_CODES = {2}  # usage errors don't deserve a restart
+
+
+def _child_env(args) -> dict:
+    env = dict(os.environ)
+    nnodes = int(str(args.nnodes).split(":")[0])
+    env["PADDLE_TRAINERS_NUM"] = str(nnodes)
+    env["PADDLE_TRAINER_ID"] = str(args.rank)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+        env["JAX_COORDINATOR_ADDRESS"] = args.master
+        # jax.distributed.initialize picks these up directly too
+        env["JAX_NUM_PROCESSES"] = str(nnodes)
+        env["JAX_PROCESS_ID"] = str(args.rank)
+    return env
+
+
+def launch(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    if args.nproc_per_node != 1:
+        print("[launch] --nproc_per_node ignored: single-controller SPMD "
+              "runs one process per host; device parallelism comes from "
+              "the mesh", file=sys.stderr)
+    os.makedirs(args.log_dir, exist_ok=True)
+    env = _child_env(args)
+    cmd = [sys.executable, args.training_script, *args.training_script_args]
+
+    attempts = 0
+    while True:
+        log_path = os.path.join(
+            args.log_dir, f"workerlog.{args.rank}"
+            + (f".restart{attempts}" if attempts else ""))
+        with open(log_path, "ab") as log:
+            print(f"[launch] starting (attempt {attempts}): "
+                  f"{' '.join(cmd)} → {log_path}")
+            proc = subprocess.Popen(cmd, env=env, stdout=log,
+                                    stderr=subprocess.STDOUT)
+            try:
+                code = proc.wait()
+            except KeyboardInterrupt:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                raise
+        if code == 0:
+            print("[launch] training finished")
+            return 0
+        if code in _FATAL_CODES or attempts >= args.max_restarts:
+            print(f"[launch] training failed (exit {code}); "
+                  f"{attempts} restarts used", file=sys.stderr)
+            return code
+        attempts += 1
+        print(f"[launch] exit {code} — elastic restart "
+              f"{attempts}/{args.max_restarts} (resume from checkpoint)",
+              file=sys.stderr)
+        time.sleep(min(2 ** attempts, 30))
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -36,7 +36,9 @@ class BaseGate(Layer):
 
 
 class NaiveGate(BaseGate):
-    """Plain softmax top-k gate."""
+    """Plain softmax top-k gate — NO capacity limit (reference parity:
+    naive_gate routes every token)."""
+    capacity_factor = None
 
 
 class GShardGate(BaseGate):
@@ -84,8 +86,11 @@ class MoELayer(Layer):
         T = 1
         for s in orig_shape[:-1]:
             T *= s
-        capacity = gshard_capacity(T, self.top_k, self.num_expert,
-                                   self.capacity_factor)
+        if self.capacity_factor is None:
+            capacity = T  # unbounded: an expert can hold every token
+        else:
+            capacity = gshard_capacity(T, self.top_k, self.num_expert,
+                                       self.capacity_factor)
         xt = x.reshape([T, d])
         logits = xt.matmul(self.gate.weight)
 

@@ -246,31 +246,39 @@ def param_specs(cfg: MoeConfig, pp: bool = False) -> Dict[str, Any]:
 
 def moe_block(x: jax.Array, lp: Dict[str, jax.Array], cfg: MoeConfig
               ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """x: [B, S, D] → (y, aux). Routed experts + optional shared expert."""
+    """x: [B, S, D] → (y, aux). Routed experts + optional shared expert.
+
+    GShard GROUPED dispatch: capacity is per group (group = batch row), so
+    the dispatch tensor is [B, S, E, C(S)] — linear in total tokens. A
+    global-batch capacity would make dispatch O(T²) (C itself scales with
+    T), which OOMs at flagship scale. Groups also align with the dp/sharding
+    batch axes, so each data shard routes independently — the same locality
+    the reference gets from per-rank all_to_all over the moe_group."""
     B, S, D = x.shape
-    T = B * S
     cd = cfg.dtype
-    xt = x.reshape(T, D)
-    C = cfg.capacity(T)
+    C = cfg.capacity(S)
 
-    logits = xt.astype(jnp.float32) @ lp["gate"].astype(jnp.float32)
-    dispatch, combine, aux = top_k_gating(
-        logits, cfg.num_experts_per_tok, C)
+    logits = x.astype(jnp.float32) @ lp["gate"].astype(jnp.float32)  # [B,S,E]
+    dispatch, combine, aux = jax.vmap(
+        lambda lg: top_k_gating(lg, cfg.num_experts_per_tok, C))(logits)
+    aux = jax.tree.map(jnp.mean, aux)
 
-    # dispatch: [T,E,C] × [T,D] → [E,C,D]; GSPMD turns the contraction into
-    # the EP all_to_all when experts are sharded over 'ep'
-    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cd), xt)
-    g = jnp.einsum("ecd,edf->ecf", expert_in, lp["expert_gate_proj"].astype(cd))
-    u = jnp.einsum("ecd,edf->ecf", expert_in, lp["expert_up_proj"].astype(cd))
-    expert_out = jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u,
+    # [B,S,E,C] × [B,S,D] → [B,E,C,D]; with experts sharded over 'ep' GSPMD
+    # inserts the EP collective the reference hand-codes as all_to_all
+    expert_in = jnp.einsum("bsec,bsd->becd", dispatch.astype(cd), x)
+    g = jnp.einsum("becd,edf->becf", expert_in,
+                   lp["expert_gate_proj"].astype(cd))
+    u = jnp.einsum("becd,edf->becf", expert_in,
+                   lp["expert_up_proj"].astype(cd))
+    expert_out = jnp.einsum("becf,efd->becd", jax.nn.silu(g) * u,
                             lp["expert_down_proj"].astype(cd))
-    y = jnp.einsum("tec,ecd->td", combine.astype(cd), expert_out)
+    y = jnp.einsum("bsec,becd->bsd", combine.astype(cd), expert_out)
 
     if cfg.num_shared_experts:
-        sg = xt @ lp["shared_gate_proj"].astype(cd)
-        su = xt @ lp["shared_up_proj"].astype(cd)
+        sg = x @ lp["shared_gate_proj"].astype(cd)
+        su = x @ lp["shared_up_proj"].astype(cd)
         y = y + (jax.nn.silu(sg) * su) @ lp["shared_down_proj"].astype(cd)
-    return y.reshape(B, S, D), aux
+    return y, aux
 
 
 def forward(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig,

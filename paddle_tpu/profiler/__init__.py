@@ -1,0 +1,182 @@
+"""paddle.profiler — profiling facade over jax.profiler.
+
+Reference analog: python/paddle/profiler/ (Profiler with scheduler
+wait/warmup/active windows, RecordEvent RAII spans, Chrome-trace export,
+summary tables) over the C++ host tracer + CUPTI device tracer
+(paddle/fluid/platform/profiler/) — upstream-canonical, unverified,
+SURVEY.md §0, §5 'Tracing/profiling'.
+
+TPU-native design: jax.profiler is the host+device tracer — XPlane traces
+capture XLA executions, TPU kernels, and host annotations; the output dir is
+TensorBoard/Perfetto/xprof-compatible (the reference exports Chrome trace;
+XPlane supersedes it). RecordEvent maps to jax.profiler.TraceAnnotation,
+the scheduler windows are re-implemented on step_begin/step_end since XLA
+needs no warmup distinction beyond compilation (already cached by step 1).
+"""
+from __future__ import annotations
+
+import enum
+import os
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """paddle.profiler.make_scheduler parity: step → state."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """Returns an on_trace_ready callback. The trace lands as XPlane protos
+    under dir_name (readable by TensorBoard's profile plugin / xprof, which
+    render the same timeline Chrome tracing did for the reference)."""
+    def handler(prof):
+        pass  # trace already written to prof._dir by stop_trace
+    handler._dir = dir_name
+    return handler
+
+
+export_protobuf_tracing = export_chrome_tracing
+
+
+class Profiler:
+    """paddle.profiler.Profiler parity.
+
+    with Profiler(targets=[...], scheduler=(2, 5)) as p:
+        for batch in loader:
+            train_step(batch)
+            p.step()
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready=None, record_shapes=False,
+                 profile_memory=False, timer_only=False, **kwargs):
+        self._dir = getattr(on_trace_ready, "_dir", None) or \
+            os.environ.get("PADDLE_PROFILER_DIR", "/tmp/paddle_tpu_profile")
+        if isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(lo, 0), ready=0, record=hi - lo, repeat=1)
+        elif scheduler is None:
+            self._scheduler = None  # record everything between start/stop
+        else:
+            self._scheduler = scheduler
+        self._step = 0
+        self._tracing = False
+        self._timer_only = timer_only
+
+    # --- lifecycle -------------------------------------------------------
+    def start(self):
+        if self._scheduler is None:
+            self._start_trace()
+        else:
+            self._apply_state(self._scheduler(self._step))
+        return self
+
+    def stop(self):
+        if self._tracing:
+            self._stop_trace()
+
+    def step(self, num_samples: Optional[int] = None):
+        self._step += 1
+        if self._scheduler is not None:
+            self._apply_state(self._scheduler(self._step))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # --- internals -------------------------------------------------------
+    def _apply_state(self, state: ProfilerState):
+        recording = state in (ProfilerState.RECORD,
+                              ProfilerState.RECORD_AND_RETURN)
+        if recording and not self._tracing:
+            self._start_trace()
+        elif not recording and self._tracing:
+            self._stop_trace()
+
+    def _start_trace(self):
+        if self._timer_only:
+            self._tracing = True
+            return
+        os.makedirs(self._dir, exist_ok=True)
+        jax.profiler.start_trace(self._dir)
+        self._tracing = True
+
+    def _stop_trace(self):
+        if not self._timer_only:
+            jax.profiler.stop_trace()
+        self._tracing = False
+
+    # --- reporting -------------------------------------------------------
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        return (f"[paddle_tpu profiler] trace written to {self._dir} — "
+                "open with TensorBoard's profile plugin or xprof")
+
+    def export(self, path: Optional[str] = None, format: str = "json"):
+        return self._dir
+
+
+class RecordEvent:
+    """RAII span recorded into the device/host trace
+    (reference: platform::RecordEvent; here jax.profiler.TraceAnnotation)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def begin(self):
+        self._ann.__enter__()
+
+    def end(self):
+        self._ann.__exit__(None, None, None)
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def load_profiler_result(filename: str):
+    raise NotImplementedError(
+        "XPlane traces are read by TensorBoard/xprof, not reloaded in-process"
+        " (paddle_tpu/profiler/__init__.py)")

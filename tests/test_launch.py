@@ -1,0 +1,56 @@
+"""paddle.distributed.launch CLI: env wiring + elastic restart.
+
+Reference analog: launch controller tests (SURVEY.md §2.3 launch row) — the
+subprocess-on-localhost pattern from §4.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launch(tmp_path, script_body, extra_args=()):
+    script = tmp_path / "train.py"
+    script.write_text(script_body)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TRAINER_ID", None)
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--log_dir", str(tmp_path / "log"), *extra_args, str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+
+
+class TestLaunch:
+    def test_env_wiring(self, tmp_path):
+        r = _run_launch(tmp_path, (
+            "import os\n"
+            "assert os.environ['PADDLE_TRAINERS_NUM'] == '2'\n"
+            "assert os.environ['PADDLE_TRAINER_ID'] == '1'\n"
+            "assert os.environ['PADDLE_MASTER'] == 'h0:8090'\n"
+            "assert os.environ['JAX_COORDINATOR_ADDRESS'] == 'h0:8090'\n"),
+            extra_args=["--nnodes", "2", "--rank", "1",
+                        "--master", "h0:8090"])
+        assert r.returncode == 0, r.stderr
+
+    def test_elastic_restart_resumes(self, tmp_path):
+        marker = tmp_path / "marker"
+        r = _run_launch(tmp_path, (
+            f"import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            f"if not os.path.exists(m):\n"
+            f"    open(m, 'w').close(); sys.exit(1)\n"
+            f"print('resumed')\n"),
+            extra_args=["--max_restarts", "2"])
+        assert r.returncode == 0, r.stderr
+        assert "elastic restart 1/2" in r.stderr + r.stdout
+        logs = list((tmp_path / "log").glob("workerlog.0.restart1"))
+        assert logs and "resumed" in logs[0].read_text()
+
+    def test_restart_budget_exhausted(self, tmp_path):
+        r = _run_launch(tmp_path, "import sys; sys.exit(3)\n",
+                        extra_args=["--max_restarts", "1"])
+        assert r.returncode == 3
+        assert "1 restarts used" in r.stderr
