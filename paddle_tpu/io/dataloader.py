@@ -65,11 +65,42 @@ def numpy_collate_fn(batch):
     return list(batch)
 
 
+def _picklable(obj) -> bool:
+    import pickle
+    try:
+        pickle.dumps(obj)
+        return True
+    except Exception:
+        return False
+
+
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn,
-                 worker_id, seed):
+                 worker_id, seed, ring_name=None):
     np.random.seed((seed + worker_id) % (2 ** 31))
+    ring = None
+    if ring_name is not None:
+        from .shm_ring import ShmRing
+        try:
+            ring = ShmRing.attach(ring_name)
+        except Exception:
+            ring = None  # fall back to the queue transport
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
+
+    def emit(job_id, batch, err):
+        if err is not None and not _picklable(err):
+            # exceptions can hold unpicklable members (locks, sockets);
+            # neither transport can carry those, and a silently-dropped
+            # Queue item would hang the main process forever
+            err = RuntimeError(f"{type(err).__name__}: {err}")
+        if ring is not None:
+            try:
+                ring.send(job_id, (job_id, batch, err))
+                return
+            except Exception:
+                pass  # ring stopped/raced at shutdown → last-resort queue
+        data_queue.put((job_id, batch, err))
+
     while True:
         job = index_queue.get()
         if job is None:
@@ -82,9 +113,12 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn,
             samples = [_to_numpy_tree(dataset[i]) for i in indices]
             batch = collate_fn(samples) if collate_fn else samples
             batch = _to_numpy_tree(batch)
-            data_queue.put((job_id, batch, None))
+            emit(job_id, batch, None)
         except Exception as e:  # surface worker errors to the main process
-            data_queue.put((job_id, None, e))
+            try:
+                emit(job_id, None, e)
+            except Exception:
+                data_queue.put((job_id, None, RuntimeError(str(e))))
 
 
 def _to_numpy_tree(x):
@@ -139,7 +173,13 @@ class _IterableDatasetIter:
 
 
 class _MultiProcessIter:
-    """Out-of-order worker pool with in-order delivery + lookahead window."""
+    """Out-of-order worker pool with in-order delivery + lookahead window.
+
+    Transport: with use_shared_memory (and the native lib buildable), worker
+    batches travel through the C++ shared-memory ring (io/native/shm_ring.cc)
+    instead of the pickling multiprocessing.Queue — the queue stays as a
+    control/fallback channel only.
+    """
 
     def __init__(self, loader):
         self.loader = loader
@@ -149,6 +189,13 @@ class _MultiProcessIter:
         self.index_queues = []
         self.data_queue = ctx.Queue()
         self.workers = []
+        self.ring = None
+        if loader.use_shared_memory:
+            from . import shm_ring
+            if shm_ring.native_available():
+                self.ring = shm_ring.ShmRing(
+                    n_slots=max(8, 2 * loader.num_workers
+                                * loader.prefetch_factor))
         from ..core import random as prandom
         seed = prandom.default_generator().initial_seed
         for wid in range(loader.num_workers):
@@ -159,7 +206,8 @@ class _MultiProcessIter:
             w = ctx.Process(
                 target=_worker_loop,
                 args=(loader.dataset, iq, self.data_queue, worker_collate,
-                      loader.worker_init_fn, wid, seed),
+                      loader.worker_init_fn, wid, seed,
+                      self.ring.name if self.ring is not None else None),
                 daemon=True)
             w.start()
             self.index_queues.append(iq)
@@ -187,7 +235,7 @@ class _MultiProcessIter:
             self._shutdown()
             raise StopIteration
         while self.next_deliver not in self.cache:
-            job_id, batch, err = self.data_queue.get()
+            job_id, batch, err = self._recv()
             self.outstanding -= 1
             if err is not None:
                 self._shutdown()
@@ -198,16 +246,35 @@ class _MultiProcessIter:
         self._dispatch()
         return _to_tensor_tree(batch)
 
+    def _recv(self):
+        if self.ring is None:
+            return self.data_queue.get()
+        while True:
+            got = self.ring.recv(timeout_ms=100)
+            if got is not None:
+                return got[1]
+            try:  # fallback channel (ring send failed in a worker)
+                return self.data_queue.get_nowait()
+            except queue.Empty:
+                if not any(w.is_alive() for w in self.workers):
+                    raise RuntimeError(
+                        "DataLoader workers exited unexpectedly")
+
     def _shutdown(self):
         for iq in self.index_queues:
             try:
                 iq.put(None)
             except Exception:
                 pass
+        if self.ring is not None:
+            self.ring.stop()
         for w in self.workers:
             w.join(timeout=1.0)
             if w.is_alive():
                 w.terminate()
+        if self.ring is not None:
+            self.ring.close(unlink=True)
+            self.ring = None
 
     def __del__(self):
         self._shutdown()
@@ -258,6 +325,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
         self.worker_init_fn = worker_init_fn
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
