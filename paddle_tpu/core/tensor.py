@@ -337,6 +337,12 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
             arr = arr.astype(dtypes.convert_dtype(dtype))
         t = Tensor(arr, stop_gradient=stop_gradient)
         return t
+    if isinstance(data, jax.Array):
+        # keep the array (and its sharding) as-is — round-tripping through
+        # numpy would gather a sharded array onto one device
+        arr = data if dtype is None else \
+            data.astype(dtypes.convert_dtype(dtype))
+        return Tensor(arr, stop_gradient=stop_gradient)
     if dtype is not None:
         arr = jnp.asarray(data, dtype=dtypes.convert_dtype(dtype))
     else:

@@ -25,6 +25,11 @@ from ..parallel.topology import (  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .fleet import DistributedStrategy  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Placement, Replicate, Shard, Partial, ProcessMesh,
+    shard_tensor, dtensor_from_fn, reshard, unshard_dtensor,
+    shard_layer, shard_optimizer)
 
 
 def get_rank(group=None) -> int:

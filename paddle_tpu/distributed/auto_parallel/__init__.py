@@ -1,0 +1,13 @@
+"""paddle.distributed.auto_parallel — semi-auto sharding API.
+
+Reference: python/paddle/distributed/auto_parallel/ (upstream-canonical,
+unverified — SURVEY.md §0, §2.3 auto-parallel row, §3.4). The reference's
+completion/partitioner/reshard static pipeline is natively GSPMD here; this
+package is the user-facing metadata surface.
+"""
+from .placement import (Placement, Replicate, Shard, Partial,  # noqa: F401
+                        to_partition_spec, from_partition_spec)
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+from .api import (shard_tensor, dtensor_from_fn, reshard,  # noqa: F401
+                  unshard_dtensor, shard_layer, shard_optimizer,
+                  get_placements, get_placement_mesh)

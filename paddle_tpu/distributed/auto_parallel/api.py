@@ -1,0 +1,166 @@
+"""Semi-auto parallel API — paddle.distributed.{shard_tensor, reshard, ...}.
+
+Reference: python/paddle/distributed/auto_parallel/api.py — shard_tensor
+builds a DistTensor carrying (process_mesh, placements); the static pipeline
+(completion → partitioner → reshard) then turns placement mismatches into
+communication (upstream-canonical, unverified — SURVEY.md §0, §2.3, §3.4).
+
+TPU-native: that whole pipeline IS GSPMD. shard_tensor = jax.device_put with
+a NamedSharding; "completion" is XLA sharding propagation; "partitioner +
+reshard" is the SPMD partitioner. The functions here only translate the
+Paddle-shaped metadata and keep it attached to the Tensor facade so
+placements/process_mesh round-trip through user code.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+from ...core.tensor import Tensor
+from .placement import (Partial, Placement, Replicate, Shard,
+                        from_partition_spec, to_partition_spec)
+from .process_mesh import ProcessMesh
+
+
+def _normalize(placements, mesh: ProcessMesh, ndim: int):
+    if placements is None:
+        placements = [Replicate() for _ in range(mesh.ndim)]
+    placements = list(placements)
+    if len(placements) > mesh.ndim:
+        raise ValueError(
+            f"{len(placements)} placements for a {mesh.ndim}-d mesh")
+    while len(placements) < mesh.ndim:
+        placements.append(Replicate())
+    # Partial is resolved to Replicate for materialized values (placement.py)
+    placements = [Replicate() if p.is_partial() else p for p in placements]
+    return placements
+
+
+def _named_sharding(mesh: ProcessMesh, placements, ndim: int):
+    spec = to_partition_spec(placements, ndim, mesh.dim_names)
+    return NamedSharding(mesh.jax_mesh(), spec)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements=None,
+                 dtype=None, stop_gradient: bool = True) -> Tensor:
+    """Place `data` on the mesh per `placements`; returns a Tensor whose
+    jax.Array carries the NamedSharding (the DistTensor of this framework)."""
+    t = data if isinstance(data, Tensor) else Tensor(data)
+    arr = t._data if dtype is None else t._data.astype(dtype)
+    placements = _normalize(placements, mesh, arr.ndim)
+    arr = jax.device_put(arr, _named_sharding(mesh, placements, arr.ndim))
+    out = Tensor(arr, stop_gradient=stop_gradient
+                 if not isinstance(data, Tensor) else data.stop_gradient)
+    out.process_mesh = mesh
+    out.placements = placements
+    return out
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh, placements,
+                    *args, **kwargs) -> Tensor:
+    """Build then shard (reference: dtensor_from_fn). The construction runs
+    replicated; XLA dead-code-eliminates the unsharded build under jit."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x, mesh: ProcessMesh, placements) -> Tensor:
+    """Re-place a tensor: mesh and/or placements change. In the reference
+    this inserts collectives (auto_parallel/static/reshard/); here it is one
+    resharding device_put — XLA picks the collective."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    placements = _normalize(placements, mesh, t.ndim)
+    arr = jax.device_put(t._data, _named_sharding(mesh, placements, t.ndim))
+    out = Tensor(arr, stop_gradient=t.stop_gradient)
+    out.process_mesh = mesh
+    out.placements = placements
+    return out
+
+
+def unshard_dtensor(x) -> Tensor:
+    """Gather to a fully-replicated dense tensor (reference helper). Works
+    for any sharded value, including op outputs that carry a NamedSharding
+    but no ProcessMesh metadata (sharding propagated by XLA)."""
+    mesh = get_placement_mesh(x)
+    if mesh is None:
+        data = getattr(x, "_data", x)
+        sharding = getattr(data, "sharding", None)
+        if not isinstance(sharding, NamedSharding):
+            return x if isinstance(x, Tensor) else Tensor(x)
+        dev_index = {d: i for i, d in enumerate(jax.devices())}
+        ids = np.empty(sharding.mesh.devices.shape, dtype=np.int64)
+        for idx, d in np.ndenumerate(sharding.mesh.devices):
+            ids[idx] = dev_index[d]
+        mesh = ProcessMesh(ids, list(sharding.mesh.axis_names))
+    return reshard(x, mesh, [Replicate() for _ in range(mesh.ndim)])
+
+
+def get_placement_mesh(x) -> Optional[ProcessMesh]:
+    return getattr(x, "process_mesh", None)
+
+
+def get_placements(x) -> Optional[list]:
+    explicit = getattr(x, "placements", None)
+    if explicit is not None:
+        return list(explicit)
+    data = getattr(x, "_data", x)
+    sharding = getattr(data, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        names = list(sharding.mesh.axis_names)
+        return from_partition_spec(sharding.spec, len(names), names)
+    return None
+
+
+def shard_layer(layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None):
+    """Shard a Layer's parameters in place (reference: dist.shard_layer).
+
+    shard_fn(name, sublayer, mesh) assigns shardings by mutating sublayer
+    parameters (e.g. via shard_tensor); default replicates every parameter
+    onto the mesh. input_fn/output_fn wrap forward pre/post hooks, as in the
+    reference API.
+    """
+    def default_shard_fn(name, sub, mesh):
+        for pname, p in list(sub.named_parameters(include_sublayers=False)):
+            sharded = shard_tensor(p, mesh)
+            p._rebind(sharded._data)
+            p.process_mesh = mesh
+            p.placements = sharded.placements
+
+    fn = shard_fn or default_shard_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn: Optional[Callable] = None):
+    """Align optimizer state sharding with (possibly resharded) parameters
+    (reference: dist.shard_optimizer; its ShardOptimizer re-places moments).
+    Our optimizers create state lazily per-parameter; jnp ops on sharded
+    params already propagate shardings, so this re-places any state created
+    before the params were sharded and returns the same optimizer.
+    shard_fn(param, state_name, state_value) may override the placement and
+    must return the re-placed jax value."""
+    for p in getattr(optimizer, "_parameter_list", []):
+        st = optimizer._state.get(id(p))
+        if not st:
+            continue
+        sharding = getattr(p._data, "sharding", None)
+        for key, val in list(st.items()):
+            if shard_fn is not None:
+                st[key] = shard_fn(p, key, val)
+            elif sharding is not None and getattr(val, "shape", None) == \
+                    p._data.shape:
+                st[key] = jax.device_put(val, sharding)
+    return optimizer

@@ -1,0 +1,125 @@
+"""Placements — paddle.distributed.{Shard, Replicate, Partial} parity.
+
+Reference: python/paddle/distributed/auto_parallel/placement_type.py and the
+C++ Placement hierarchy under paddle/phi/core/distributed/auto_parallel/
+(upstream-canonical, unverified — SURVEY.md §0, §2.3 auto-parallel row).
+
+TPU-native: a placements list (one entry per mesh dim) is exactly a
+jax.sharding PartitionSpec transposed — Shard(d) on mesh dim i puts mesh
+axis i into the spec entry of tensor dim d. `to_partition_spec` performs
+that transposition; it is the entire "dist_attr" translation layer.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self) -> int:
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Partial(Placement):
+    """Pending-reduction placement. Materialized arrays are never partial in
+    this framework (XLA resolves partials inside compiled programs); Partial
+    is accepted in specs for API parity and resolved to Replicate by
+    shard_tensor/reshard, which is numerically the reference's
+    Partial→Replicate reshard (the sum has already happened by the time a
+    value is observable outside jit)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and \
+            other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+
+def to_partition_spec(placements, ndim: int, dim_names) -> PartitionSpec:
+    """[per-mesh-dim placements] → PartitionSpec over tensor dims.
+
+    Multiple mesh dims sharding one tensor dim nest in mesh-dim order
+    (matches the reference's multi-mesh-dim Shard semantics and XLA's
+    tuple-of-axes spec entries).
+    """
+    per_dim: list = [[] for _ in range(ndim)]
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            d = p.dim if p.dim >= 0 else p.dim + ndim
+            if not 0 <= d < ndim:
+                raise ValueError(
+                    f"Shard(dim={p.dim}) out of range for ndim={ndim}")
+            per_dim[d].append(dim_names[mesh_dim])
+    entries = []
+    for axes in per_dim:
+        if not axes:
+            entries.append(None)
+        elif len(axes) == 1:
+            entries.append(axes[0])
+        else:
+            entries.append(tuple(axes))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def from_partition_spec(spec, n_mesh_dims: int, dim_names) -> list:
+    """PartitionSpec → placements list (inverse of to_partition_spec)."""
+    placements = [Replicate() for _ in range(n_mesh_dims)]
+    name_to_mesh_dim = {n: i for i, n in enumerate(dim_names)}
+    for tdim, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for name in axes:
+            placements[name_to_mesh_dim[name]] = Shard(tdim)
+    return placements

@@ -1,0 +1,127 @@
+"""ProcessMesh — paddle.distributed.ProcessMesh parity over jax.sharding.Mesh.
+
+Reference: python/paddle/distributed/auto_parallel/process_mesh.py (an
+N-D array of process ids + dim_names; every dist_tensor carries one) —
+upstream-canonical, unverified, SURVEY.md §0, §2.3.
+
+TPU-native: the reference's "process id" grid maps onto the device grid of a
+jax.sharding.Mesh (single-controller SPMD: one process drives all devices, so
+mesh entries index jax.devices() rather than OS processes). The jax Mesh is
+built lazily and cached; ProcessMesh is the user-facing, picklable identity.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None,
+                 process_ids: Optional[Sequence[int]] = None):
+        if mesh is not None:
+            arr = np.asarray(mesh, dtype=np.int64)
+        else:
+            arr = np.asarray(process_ids, dtype=np.int64).reshape(shape)
+        self._mesh = arr
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"{len(dim_names)} dim_names for a {arr.ndim}-d mesh")
+        self._dim_names = list(dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+
+    # -- paddle surface -----------------------------------------------------
+    @property
+    def mesh(self) -> np.ndarray:
+        return self._mesh
+
+    @property
+    def shape(self):
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._mesh.flatten().tolist()
+
+    @property
+    def size(self) -> int:
+        return int(self._mesh.size)
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index=None):
+        """Sub-mesh views along one named dim (reference helper)."""
+        axis = self._dim_names.index(dim_name)
+        moved = np.moveaxis(self._mesh, axis, 0)
+        names = [self._dim_names[axis]] + \
+            [n for i, n in enumerate(self._dim_names) if i != axis]
+        if index is None:
+            return ProcessMesh(moved, names)
+        return ProcessMesh(moved[index], names[1:])
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh, other._mesh)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+    def __getstate__(self):
+        return {"mesh": self._mesh, "dim_names": self._dim_names}
+
+    def __setstate__(self, state):
+        self.__init__(state["mesh"], state["dim_names"])
+
+    # -- TPU-native side ----------------------------------------------------
+    def jax_mesh(self) -> Mesh:
+        """The backing jax.sharding.Mesh (device grid = process-id grid)."""
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            if self._mesh.max() >= len(devices):
+                raise ValueError(
+                    f"ProcessMesh refers to process {self._mesh.max()} but "
+                    f"only {len(devices)} devices are available")
+            grid = np.empty(self._mesh.shape, dtype=object)
+            for idx, pid in np.ndenumerate(self._mesh):
+                grid[idx] = devices[pid]
+            self._jax_mesh = Mesh(grid, tuple(self._dim_names))
+        return self._jax_mesh
+
+
+_global_process_mesh: Optional[ProcessMesh] = None
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_process_mesh
+
+
+def set_mesh(mesh) -> None:
+    global _global_process_mesh
+    if isinstance(mesh, Mesh):
+        dev_index = {d: i for i, d in enumerate(jax.devices())}
+        ids = np.empty(mesh.devices.shape, dtype=np.int64)
+        for idx, d in np.ndenumerate(mesh.devices):
+            ids[idx] = dev_index[d]
+        mesh = ProcessMesh(ids, list(mesh.axis_names))
+    elif not isinstance(mesh, ProcessMesh):
+        mesh = ProcessMesh(mesh)
+    _global_process_mesh = mesh

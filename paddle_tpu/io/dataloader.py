@@ -115,10 +115,7 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn,
             batch = _to_numpy_tree(batch)
             emit(job_id, batch, None)
         except Exception as e:  # surface worker errors to the main process
-            try:
-                emit(job_id, None, e)
-            except Exception:
-                data_queue.put((job_id, None, RuntimeError(str(e))))
+            emit(job_id, None, e)
 
 
 def _to_numpy_tree(x):

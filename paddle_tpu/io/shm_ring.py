@@ -56,7 +56,13 @@ def get_lib():
         return _lib
     try:
         _build_lib()
-        lib = ctypes.CDLL(_SO)
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # stale binary from another arch/glibc — force one rebuild
+            os.remove(_SO)
+            _build_lib()
+            lib = ctypes.CDLL(_SO)
         lib.ring_create.restype = ctypes.c_void_p
         lib.ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                     ctypes.c_uint32]
@@ -110,6 +116,8 @@ def encode(obj, out: bytearray) -> None:
             return
         a = np.ascontiguousarray(obj)
         dt = a.dtype.str.encode()
+        # (np scalars — np.generic — are handled below via pickle so their
+        # exact type survives, matching the queue transport)
         out += struct.pack("<BB", _T_ARR, len(dt))
         out += dt
         out += struct.pack("<B", a.ndim)
@@ -119,12 +127,16 @@ def encode(obj, out: bytearray) -> None:
         out += struct.pack("<Q", a.nbytes | (pad << 56))
         out += b"\x00" * pad
         out += a.tobytes()
-    elif isinstance(obj, bool) or isinstance(obj, np.bool_):
-        out += struct.pack("<B?", _T_BOOL, bool(obj))
-    elif isinstance(obj, (int, np.integer)):
-        out += struct.pack("<Bq", _T_INT, int(obj))
-    elif isinstance(obj, (float, np.floating)):
-        out += struct.pack("<Bd", _T_FLOAT, float(obj))
+    elif isinstance(obj, np.generic):
+        b = pickle.dumps(obj)
+        out += struct.pack("<BI", _T_PICKLE, len(b))
+        out += b
+    elif isinstance(obj, bool):
+        out += struct.pack("<B?", _T_BOOL, obj)
+    elif isinstance(obj, int):
+        out += struct.pack("<Bq", _T_INT, obj)
+    elif isinstance(obj, float):
+        out += struct.pack("<Bd", _T_FLOAT, obj)
     elif obj is None:
         out += struct.pack("<B", _T_NONE)
     elif isinstance(obj, str):
@@ -289,7 +301,11 @@ class ShmRing:
 
     # -- consumer side ------------------------------------------------------
     def recv_bytes(self, timeout_ms: int = -1):
-        """Next complete message → (msg_id, bytearray); None on timeout."""
+        """Next complete message → (msg_id, bytearray); None on timeout.
+
+        Single-chunk messages (the common case) take exactly one copy:
+        slot payload → result bytearray.
+        """
         nbytes = ctypes.c_uint64()
         while True:
             rc = self._lib.ring_consumer_wait(
@@ -297,12 +313,19 @@ class ShmRing:
             if rc != 0:
                 return None
             src = self._lib.ring_payload(self._h, self._read_ticket)
-            raw = ctypes.string_at(src, nbytes.value)
+            msg_id, idx, n_chunks = _CHUNK_HDR.unpack(
+                ctypes.string_at(src, _CHUNK_HDR.size))
+            body_len = nbytes.value - _CHUNK_HDR.size
+            body = bytearray(body_len)
+            if body_len:
+                ctypes.memmove((ctypes.c_char * body_len).from_buffer(body),
+                               src + _CHUNK_HDR.size, body_len)
             self._read_ticket += 1
             self._lib.ring_consumer_release(self._h)
-            msg_id, idx, n_chunks = _CHUNK_HDR.unpack_from(raw)
+            if n_chunks == 1:
+                return msg_id, body
             parts = self._partial.setdefault(msg_id, [])
-            parts.append(raw[_CHUNK_HDR.size:])
+            parts.append(body)
             if len(parts) == n_chunks:
                 del self._partial[msg_id]
                 return msg_id, bytearray(b"".join(parts))

@@ -1,0 +1,156 @@
+"""Semi-auto parallel API tests (ProcessMesh/shard_tensor/reshard/shard_layer)
+on the 8-virtual-device CPU platform (conftest).
+
+Mirrors the reference's test/auto_parallel/ approach (SURVEY.md §4): assert on
+sharding metadata and on resharded numerics without real multi-host.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import ProcessMesh, Replicate, Shard, Partial
+
+
+@pytest.fixture
+def mesh2x4():
+    return ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+
+
+class TestProcessMesh:
+    def test_basic(self, mesh2x4):
+        assert mesh2x4.shape == [2, 4]
+        assert mesh2x4.ndim == 2
+        assert mesh2x4.dim_names == ["x", "y"]
+        assert mesh2x4.process_ids == list(range(8))
+        assert mesh2x4.size == 8
+        assert mesh2x4.get_dim_size("y") == 4
+
+    def test_equality_and_pickle(self, mesh2x4):
+        import pickle
+        other = pickle.loads(pickle.dumps(mesh2x4))
+        assert other == mesh2x4
+        assert hash(other) == hash(mesh2x4)
+
+    def test_submesh(self, mesh2x4):
+        sub = mesh2x4.get_mesh_with_dim("x", 0)
+        assert sub.shape == [4]
+        assert sub.process_ids == [0, 1, 2, 3]
+
+    def test_jax_mesh(self, mesh2x4):
+        m = mesh2x4.jax_mesh()
+        assert m.axis_names == ("x", "y")
+        assert m.devices.shape == (2, 4)
+
+
+class TestShardTensor:
+    def test_shard_dim0(self, mesh2x4):
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        d = dist.shard_tensor(x, mesh2x4, [Shard(0), Replicate()])
+        sh = d._data.sharding
+        assert isinstance(sh, NamedSharding)
+        assert sh.spec == P("x")
+        # each x-shard holds 4 rows, replicated over y
+        shard_shapes = {s.data.shape for s in d._data.addressable_shards}
+        assert shard_shapes == {(4, 8)}
+        np.testing.assert_array_equal(np.asarray(d._data), x)
+
+    def test_shard_both_dims(self, mesh2x4):
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        d = dist.shard_tensor(x, mesh2x4, [Shard(0), Shard(1)])
+        assert d._data.sharding.spec == P("x", "y")
+        assert {s.data.shape for s in d._data.addressable_shards} == {(4, 2)}
+
+    def test_default_replicate_and_partial_resolution(self, mesh2x4):
+        x = np.ones((4, 4), np.float32)
+        d = dist.shard_tensor(x, mesh2x4)
+        assert all(p.is_replicate() for p in d.placements)
+        d2 = dist.shard_tensor(x, mesh2x4, [Partial(), Shard(1)])
+        assert d2.placements[0].is_replicate()
+        assert d2.placements[1] == Shard(1)
+
+    def test_negative_dim_and_errors(self, mesh2x4):
+        x = np.ones((4, 8), np.float32)
+        d = dist.shard_tensor(x, mesh2x4, [Replicate(), Shard(-1)])
+        assert d._data.sharding.spec == P(None, "y")
+        with pytest.raises(ValueError):
+            dist.shard_tensor(x, mesh2x4, [Shard(5)])
+        with pytest.raises(ValueError):
+            dist.shard_tensor(x, mesh2x4, [Shard(0)] * 3)
+
+    def test_dtensor_from_fn(self, mesh2x4):
+        d = dist.dtensor_from_fn(paddle.ones, mesh2x4, [Shard(0)], [8, 4])
+        assert d._data.sharding.spec == P("x")
+        np.testing.assert_array_equal(np.asarray(d._data), np.ones((8, 4)))
+
+
+class TestReshard:
+    def test_round_trip(self, mesh2x4):
+        x = np.random.default_rng(0).standard_normal((8, 8)).astype(np.float32)
+        d = dist.shard_tensor(x, mesh2x4, [Shard(0), Replicate()])
+        d2 = dist.reshard(d, mesh2x4, [Replicate(), Shard(1)])
+        assert d2._data.sharding.spec == P(None, "y")
+        np.testing.assert_array_equal(np.asarray(d2._data), x)
+        d3 = dist.unshard_dtensor(d2)
+        assert all(p.is_replicate() for p in d3.placements)
+        np.testing.assert_array_equal(np.asarray(d3._data), x)
+
+    def test_unshard_op_output_without_metadata(self, mesh2x4):
+        """Op outputs carry only the jax NamedSharding — unshard must still
+        gather them (review regression)."""
+        x = np.random.default_rng(2).standard_normal((8, 8)).astype(np.float32)
+        d = dist.shard_tensor(x, mesh2x4, [Shard(0)])
+        y = jax.jit(lambda a: a * 2.0)(d._data)
+        out = dist.unshard_dtensor(paddle.to_tensor(y))
+        assert all(p.is_replicate() for p in out.placements)
+        np.testing.assert_allclose(np.asarray(out._data), x * 2.0, rtol=1e-6)
+
+    def test_sharded_compute(self, mesh2x4):
+        """Sharded operands: XLA propagates shardings through jit compute."""
+        rng = np.random.default_rng(1)
+        a = rng.standard_normal((8, 16)).astype(np.float32)
+        b = rng.standard_normal((16, 8)).astype(np.float32)
+        da = dist.shard_tensor(a, mesh2x4, [Shard(0), Replicate()])
+        db = dist.shard_tensor(b, mesh2x4, [Replicate(), Shard(1)])
+        out = jax.jit(jnp.matmul)(da._data, db._data)
+        np.testing.assert_allclose(np.asarray(out), a @ b, rtol=2e-5)
+
+
+class TestShardLayerOptimizer:
+    def test_shard_layer_default(self, mesh2x4):
+        layer = paddle.nn.Linear(8, 8)
+        dist.shard_layer(layer, mesh2x4)
+        for _, p in layer.named_parameters():
+            assert isinstance(p._data.sharding, NamedSharding)
+            assert p.process_mesh == mesh2x4
+
+    def test_shard_layer_custom_fn(self, mesh2x4):
+        layer = paddle.nn.Linear(8, 8)
+
+        def megatron_col(name, sub, mesh):
+            if hasattr(sub, "weight") and sub.weight is not None:
+                s = dist.shard_tensor(sub.weight, mesh,
+                                      [Replicate(), Shard(1)])
+                sub.weight._rebind(s._data)
+                sub.weight.placements = s.placements
+
+        dist.shard_layer(layer, mesh2x4, megatron_col)
+        assert layer.weight._data.sharding.spec == P(None, "y")
+
+    def test_shard_optimizer_replaces_state(self, mesh2x4):
+        layer = paddle.nn.Linear(8, 8)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=layer.parameters())
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        loss = layer(x).mean()
+        loss.backward()
+        opt.step()
+        dist.shard_layer(layer, mesh2x4)
+        dist.shard_optimizer(opt)
+        st = opt._state[id(layer.weight)]
+        for k, v in st.items():
+            if getattr(v, "shape", None) == layer.weight._data.shape:
+                assert v.sharding == layer.weight._data.sharding

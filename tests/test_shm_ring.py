@@ -43,6 +43,13 @@ class TestCodec:
         np.testing.assert_array_equal(out["y"][1]["z"], obj["y"][1]["z"])
         assert out["s"] == "label"
 
+    def test_numpy_scalar_types_preserved(self):
+        # must match the queue transport: np scalars keep their exact type
+        for s in [np.float32(1.5), np.float16(2.0), np.int32(7),
+                  np.uint8(255), np.bool_(True)]:
+            out = self.round_trip(s)
+            assert type(out) is type(s) and out == s
+
     def test_pickle_fallback(self):
         err = ValueError("boom")
         out = self.round_trip((1, None, err))
