@@ -71,18 +71,19 @@ def is_grad_enabled():
     return autograd.is_grad_enabled()
 
 
+from . import static  # noqa: E402
+
+
 def disable_static(*a, **k):
-    return None  # eager is the only mode; kept for script parity
+    return static.disable_static()
 
 
 def enable_static(*a, **k):
-    raise NotImplementedError(
-        "paddle_tpu is eager+jit only; use paddle_tpu.jit.to_static "
-        "(see SURVEY.md §7 'What we deliberately do NOT rebuild')")
+    return static.enable_static()
 
 
 def in_dynamic_mode():
-    return True
+    return not static.in_static_mode()
 
 
 # linalg namespace (paddle.linalg.*)

@@ -45,18 +45,33 @@ def _named_sharding(mesh: ProcessMesh, placements, ndim: int):
     return NamedSharding(mesh.jax_mesh(), spec)
 
 
-def shard_tensor(data, mesh: ProcessMesh, placements=None,
-                 dtype=None, stop_gradient: bool = True) -> Tensor:
-    """Place `data` on the mesh per `placements`; returns a Tensor whose
-    jax.Array carries the NamedSharding (the DistTensor of this framework)."""
-    t = data if isinstance(data, Tensor) else Tensor(data)
-    arr = t._data if dtype is None else t._data.astype(dtype)
-    placements = _normalize(placements, mesh, arr.ndim)
-    arr = jax.device_put(arr, _named_sharding(mesh, placements, arr.ndim))
-    out = Tensor(arr, stop_gradient=stop_gradient
-                 if not isinstance(data, Tensor) else data.stop_gradient)
+def _placed(t: Tensor, mesh: ProcessMesh, placements, name: str) -> Tensor:
+    """device_put through the eager dispatch so gradients flow through the
+    re-placement (device_put is differentiable; its vjp is the inverse
+    resharding — paddle's dygraph reshard is differentiable the same way)."""
+    from ...ops._registry import eager
+    sharding = _named_sharding(mesh, placements, t.ndim)
+    out = eager(lambda a: jax.device_put(a, sharding), (t,), {}, name=name)
     out.process_mesh = mesh
     out.placements = placements
+    return out
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements=None,
+                 dtype=None, stop_gradient: Optional[bool] = None) -> Tensor:
+    """Place `data` on the mesh per `placements`; returns a Tensor whose
+    jax.Array carries the NamedSharding (the DistTensor of this framework).
+    stop_gradient=None inherits from `data` (Tensor inputs) or defaults True
+    (raw data); an explicit value always wins."""
+    t = data if isinstance(data, Tensor) else Tensor(data)
+    if stop_gradient is None:
+        stop_gradient = t.stop_gradient if isinstance(data, Tensor) else True
+    if dtype is not None:
+        from ... import ops
+        t = ops.cast(t, dtype)
+    placements = _normalize(placements, mesh, t.ndim)
+    out = _placed(t, mesh, placements, "shard_tensor")
+    out.stop_gradient = stop_gradient
     return out
 
 
@@ -70,13 +85,11 @@ def dtensor_from_fn(fn: Callable, mesh: ProcessMesh, placements,
 def reshard(x, mesh: ProcessMesh, placements) -> Tensor:
     """Re-place a tensor: mesh and/or placements change. In the reference
     this inserts collectives (auto_parallel/static/reshard/); here it is one
-    resharding device_put — XLA picks the collective."""
+    resharding device_put — XLA picks the collective. Differentiable."""
     t = x if isinstance(x, Tensor) else Tensor(x)
     placements = _normalize(placements, mesh, t.ndim)
-    arr = jax.device_put(t._data, _named_sharding(mesh, placements, t.ndim))
-    out = Tensor(arr, stop_gradient=t.stop_gradient)
-    out.process_mesh = mesh
-    out.placements = placements
+    out = _placed(t, mesh, placements, "reshard")
+    out.stop_gradient = t.stop_gradient
     return out
 
 
@@ -90,11 +103,7 @@ def unshard_dtensor(x) -> Tensor:
         sharding = getattr(data, "sharding", None)
         if not isinstance(sharding, NamedSharding):
             return x if isinstance(x, Tensor) else Tensor(x)
-        dev_index = {d: i for i, d in enumerate(jax.devices())}
-        ids = np.empty(sharding.mesh.devices.shape, dtype=np.int64)
-        for idx, d in np.ndenumerate(sharding.mesh.devices):
-            ids[idx] = dev_index[d]
-        mesh = ProcessMesh(ids, list(sharding.mesh.axis_names))
+        mesh = ProcessMesh.from_jax_mesh(sharding.mesh)
     return reshard(x, mesh, [Replicate() for _ in range(mesh.ndim)])
 
 

@@ -92,6 +92,14 @@ class ProcessMesh:
         self.__init__(state["mesh"], state["dim_names"])
 
     # -- TPU-native side ----------------------------------------------------
+    @classmethod
+    def from_jax_mesh(cls, mesh: Mesh) -> "ProcessMesh":
+        dev_index = {d: i for i, d in enumerate(jax.devices())}
+        ids = np.empty(mesh.devices.shape, dtype=np.int64)
+        for idx, d in np.ndenumerate(mesh.devices):
+            ids[idx] = dev_index[d]
+        return cls(ids, list(mesh.axis_names))
+
     def jax_mesh(self) -> Mesh:
         """The backing jax.sharding.Mesh (device grid = process-id grid)."""
         if self._jax_mesh is None:
@@ -117,11 +125,7 @@ def get_mesh() -> Optional[ProcessMesh]:
 def set_mesh(mesh) -> None:
     global _global_process_mesh
     if isinstance(mesh, Mesh):
-        dev_index = {d: i for i, d in enumerate(jax.devices())}
-        ids = np.empty(mesh.devices.shape, dtype=np.int64)
-        for idx, d in np.ndenumerate(mesh.devices):
-            ids[idx] = dev_index[d]
-        mesh = ProcessMesh(ids, list(mesh.axis_names))
+        mesh = ProcessMesh.from_jax_mesh(mesh)
     elif not isinstance(mesh, ProcessMesh):
         mesh = ProcessMesh(mesh)
     _global_process_mesh = mesh

@@ -28,6 +28,10 @@ from ..autograd.tape import GradNode, grad_enabled
 
 REGISTRY: Dict[str, Callable] = {}
 
+# paddle.static capture hook: when set (static mode), eager dispatch routes
+# every op into the current Program instead of the tape (static/__init__.py)
+_capture_hook = None
+
 _FLOAT_KINDS = ("f", "c", "V")  # V covers bfloat16/fp8 (numpy void-backed ml_dtypes)
 
 
@@ -71,6 +75,8 @@ def eager(raw: Callable, args, kwargs, name: str = "op"):
     (positional or keyword); all other args pass through unchanged. Returns
     Tensor or tuple of Tensors.
     """
+    if _capture_hook is not None:
+        return _capture_hook(raw, args, kwargs, name)
     arrs = []
     tins = []
     for a in args:

@@ -141,3 +141,25 @@ class _FFT:
 
 
 fft = _FFT()
+
+
+def tensordot(x, y, axes=2, name=None):
+    """paddle.tensordot. axes: int | flat list of ints (contract the SAME
+    dims of both operands — paddle semantics) | [axes_x, axes_y]."""
+    if isinstance(axes, (list, tuple)):
+        if len(axes) and isinstance(axes[0], (list, tuple)):
+            ax = tuple(axes[0])
+            ay = tuple(axes[1]) if len(axes) > 1 else ax
+            axes = (ax, ay)
+        else:  # flat int list: same dims on both sides
+            axes = (tuple(int(a) for a in axes),) * 2
+    return eager(lambda a, b: jnp.tensordot(a, b, axes=axes), (x, y), {},
+                 name="tensordot")
+
+
+_FFT.rfftn = staticmethod(defop(
+    "fft.rfftn", lambda x, s=None, axes=None, norm="backward", name=None:
+    jnp.fft.rfftn(x, s=s, axes=axes, norm=norm)))
+_FFT.irfftn = staticmethod(defop(
+    "fft.irfftn", lambda x, s=None, axes=None, norm="backward", name=None:
+    jnp.fft.irfftn(x, s=s, axes=axes, norm=norm)))

@@ -497,3 +497,17 @@ numel = defop("numel", lambda x, name=None: jnp.asarray(x.size, dtype=np.int64))
 shard_index = defop("shard_index", lambda input, index_num, nshards, shard_id, ignore_value=-1, name=None:
                     jnp.where((input // (index_num // nshards)) == shard_id,
                               input % (index_num // nshards), ignore_value))
+
+
+def _as_strided_raw(x, shape, stride, offset=0, name=None):
+    # XLA has no strided views — materialize via flat gather (paddle
+    # as_strided returns a view; ours is a copy with identical values)
+    flat = x.reshape(-1)
+    if len(shape) == 0:
+        return flat[offset]
+    grids = jnp.meshgrid(*[jnp.arange(int(s)) for s in shape], indexing="ij")
+    lin = offset + sum(g * int(st) for g, st in zip(grids, stride))
+    return flat[lin]
+
+
+as_strided = defop("as_strided", _as_strided_raw)

@@ -205,3 +205,20 @@ bitwise_xor = defop("bitwise_xor", lambda x, y, name=None: jnp.bitwise_xor(x, as
 bitwise_not = defop("bitwise_not", lambda x, name=None: jnp.bitwise_not(x))
 bitwise_left_shift = defop("bitwise_left_shift", lambda x, y, name=None: jnp.left_shift(x, as_array(y)))
 bitwise_right_shift = defop("bitwise_right_shift", lambda x, y, name=None: jnp.right_shift(x, as_array(y)))
+
+
+def _renorm_raw(x, p, axis, max_norm, name=None):
+    # per-slice p-norm along every dim except `axis`, clamp to max_norm
+    dims = tuple(d for d in range(x.ndim) if d != (axis % x.ndim))
+    norms = jnp.sum(jnp.abs(x) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+renorm = defop("renorm", _renorm_raw)
+igamma = defop("igamma", lambda x, a, name=None:
+               jax.scipy.special.gammaincc(x, as_array(a)))
+igammac = defop("igammac", lambda x, a, name=None:
+                jax.scipy.special.gammainc(x, as_array(a)))
+vander = defop("vander", lambda x, n=None, increasing=False, name=None:
+               jnp.vander(x, N=n, increasing=increasing))

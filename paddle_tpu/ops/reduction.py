@@ -127,3 +127,34 @@ histogram = defop("histogram", _histogram_raw)
 bincount = defop("bincount", lambda x, weights=None, minlength=0, name=None:
                  jnp.bincount(x, weights=None if weights is None else as_array(weights),
                               minlength=minlength, length=None))
+
+
+def _nanquantile_raw(x, q, axis=None, keepdim=False, interpolation="linear",
+                     name=None):
+    return jnp.nanquantile(x, as_array(q), axis=_axis(axis), keepdims=keepdim,
+                           method=interpolation)
+
+
+nanquantile = defop("nanquantile", _nanquantile_raw)
+
+
+def _histogramdd_raw(x, bins=10, ranges=None, density=False, weights=None,
+                     name=None):
+    if ranges is not None:
+        # paddle passes a flat [min0, max0, min1, max1, ...] list
+        flat = [float(v) for v in ranges]
+        ranges = [tuple(flat[i:i + 2]) for i in range(0, len(flat), 2)]
+    h, edges = jnp.histogramdd(
+        x, bins=bins, range=ranges, density=density,
+        weights=None if weights is None else as_array(weights))
+    return (h,) + tuple(edges)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """paddle.histogramdd → (hist, list_of_edges)."""
+    from ._registry import eager
+    outs = eager(_histogramdd_raw, (x,), dict(
+        bins=bins, ranges=ranges, density=density, weights=weights),
+        name="histogramdd")
+    return outs[0], list(outs[1:])

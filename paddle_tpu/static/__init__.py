@@ -1,0 +1,402 @@
+"""paddle.static — Program/Executor graph mode over traced replay + jax.jit.
+
+Reference parity: python/paddle/static/ (Program/Executor API, data(),
+save/load_inference_model) over ProgramDesc/PIR + InterpreterCore —
+upstream-canonical, unverified, SURVEY.md §0, §2.4, §3.4-3.5.
+
+TPU-native design: there is no IR to rebuild — XLA's HLO is the IR. A
+Program is a replayable op-record list captured from the SAME eager op layer
+(ops/_registry.eager routes here in static mode), and Executor.run compiles
+the pruned record graph with jax.jit per feed-shape signature. Parameters are
+leaves read at run time (so set_state_dict/opt updates are visible), which is
+exactly the reference's scope-variable semantics; initializer records that
+produced a Parameter are pruned like a startup program that already ran.
+save/load_inference_model serialize the jitted callable with jax.export.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import dtype as dtypes
+from ..ops import _registry
+
+__all__ = [
+    "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Executor", "InputSpec",
+    "save_inference_model", "load_inference_model", "global_scope",
+    "name_scope", "enable_static", "disable_static", "in_static_mode",
+]
+
+
+class _Record:
+    __slots__ = ("raw", "arg_slots", "kw_slots", "out_ids", "name")
+
+    def __init__(self, raw, arg_slots, kw_slots, out_ids, name):
+        self.raw = raw
+        self.arg_slots = arg_slots    # list of ("var", id) | ("lit", value)
+        self.kw_slots = kw_slots      # dict key → slot
+        self.out_ids = out_ids        # list of tensor ids
+        self.name = name
+
+
+class Program:
+    """An op-record list + feed-variable table (ProgramDesc analog)."""
+
+    def __init__(self):
+        self.records: List[_Record] = []
+        self.feed_vars: Dict[str, Tensor] = {}
+        self._vars: Dict[int, Tensor] = {}  # keep captured tensors alive
+        self._cache: Dict = {}
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        p.records = list(self.records)
+        p.feed_vars = dict(self.feed_vars)
+        p._vars = dict(self._vars)
+        return p
+
+    def global_block(self):
+        return self
+
+    @property
+    def ops(self):
+        return self.records
+
+    def var(self, name: str):
+        for t in self._vars.values():
+            if getattr(t, "name", None) == name:
+                return t
+        raise KeyError(name)
+
+    def list_vars(self):
+        return list(self._vars.values())
+
+    def __repr__(self):
+        lines = [f"Program({len(self.records)} ops)"]
+        for r in self.records:
+            lines.append(f"  {r.name}")
+        return "\n".join(lines)
+
+    # -- capture ------------------------------------------------------------
+    def _track(self, t: Tensor):
+        self._vars[id(t)] = t
+
+    def _record(self, raw, args, kwargs, outs, name):
+        def slot(v):
+            if isinstance(v, Tensor):
+                self._track(v)
+                return ("var", id(v))
+            return ("lit", v)
+
+        rec = _Record(raw, [slot(a) for a in args],
+                      {k: slot(v) for k, v in kwargs.items()},
+                      [id(o) for o in outs], name)
+        for o in outs:
+            self._track(o)
+        self.records.append(rec)
+
+    # -- replay -------------------------------------------------------------
+    def _live_records(self, fetch_ids, feed_ids):
+        """Backward slice from fetches; Parameters and eager tensors are
+        leaves (their records, e.g. initializers, are pruned — the reference
+        runs those once in the startup program)."""
+        produced_by = {}
+        for rec in self.records:
+            for oid in rec.out_ids:
+                produced_by[oid] = rec
+        needed, live, stack = set(), [], list(fetch_ids)
+        seen = set()
+        while stack:
+            vid = stack.pop()
+            if vid in seen or vid in feed_ids:
+                continue
+            seen.add(vid)
+            var = self._vars.get(vid)
+            if isinstance(var, Parameter):
+                continue  # leaf: read current value at run time
+            rec = produced_by.get(vid)
+            if rec is None or id(rec) in needed:
+                continue
+            needed.add(id(rec))
+            for s in list(rec.arg_slots) + list(rec.kw_slots.values()):
+                if s[0] == "var":
+                    stack.append(s[1])
+        return [r for r in self.records if id(r) in needed]
+
+    def _build_fn(self, feed_names, fetch_ids):
+        feed_ids = {id(self.feed_vars[n]): n for n in feed_names}
+        live = self._live_records(fetch_ids, set(feed_ids))
+        leaf_ids = set()
+        produced = set()
+        for rec in live:
+            produced.update(rec.out_ids)
+        for rec in live:
+            for s in list(rec.arg_slots) + list(rec.kw_slots.values()):
+                if s[0] == "var" and s[1] not in produced and \
+                        s[1] not in feed_ids:
+                    leaf_ids.add(s[1])
+        for fid in fetch_ids:
+            if fid not in produced and fid not in feed_ids:
+                leaf_ids.add(fid)
+        leaf_ids = sorted(leaf_ids)
+
+        def fn(feed_arrays, leaf_arrays):
+            env = {}
+            for n, a in feed_arrays.items():
+                env[id(self.feed_vars[n])] = a
+            env.update(zip(leaf_ids, leaf_arrays))
+
+            def resolve(s):
+                return env[s[1]] if s[0] == "var" else s[1]
+
+            for rec in live:
+                out = rec.raw(*[resolve(s) for s in rec.arg_slots],
+                              **{k: resolve(s)
+                                 for k, s in rec.kw_slots.items()})
+                outs = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+                env.update(zip(rec.out_ids, outs))
+            return [env[fid] for fid in fetch_ids]
+
+        return jax.jit(fn), leaf_ids
+
+    def run(self, feed: Dict[str, np.ndarray], fetch_list: Sequence):
+        fetch_ids = tuple(id(f if isinstance(f, Tensor) else self.var(f))
+                          for f in (fetch_list or []))
+        feed = feed or {}
+        key = (tuple(sorted(feed)), fetch_ids)
+        if key not in self._cache:
+            self._cache[key] = self._build_fn(sorted(feed), fetch_ids)
+        fn, leaf_ids = self._cache[key]
+        feed_arrays = {}
+        for n, v in feed.items():
+            var = self.feed_vars.get(n)
+            want = None if var is None else np.dtype(var.dtype)
+            a = jnp.asarray(v, dtype=want)
+            feed_arrays[n] = a
+        leaf_arrays = [self._vars[i]._data for i in leaf_ids]
+        outs = fn(feed_arrays, leaf_arrays)
+        return [np.asarray(o) for o in outs]
+
+
+_main_program = Program()
+_startup_program = Program()
+_static_mode = False
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+class program_guard:
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        global _main_program, _startup_program
+        self._saved = (_main_program, _startup_program)
+        _main_program = self.main
+        if self.startup is not None:
+            _startup_program = self.startup
+        return self.main
+
+    def __exit__(self, *exc):
+        global _main_program, _startup_program
+        _main_program, _startup_program = self._saved
+        return False
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+def _capture(raw, args, kwargs, name):
+    """ops/_registry capture hook: run on placeholder values for shape/dtype
+    propagation (InferMeta analog), record into the current program."""
+    arrs = [a._data if isinstance(a, Tensor) else a for a in args]
+    kw = {k: (v._data if isinstance(v, Tensor) else v)
+          for k, v in kwargs.items()}
+    out = raw(*arrs, **kw)
+    multi = isinstance(out, (tuple, list))
+    outs = tuple(out) if multi else (out,)
+    wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
+    _main_program._record(raw, args, kwargs, wrapped, name)
+    return wrapped if multi else wrapped[0]
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+    _registry._capture_hook = _capture
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+    _registry._capture_hook = None
+
+
+def data(name: str, shape: Sequence[Optional[int]], dtype="float32",
+         lod_level: int = 0) -> Tensor:
+    """Feed placeholder. Dynamic dims (None/-1) capture as size 1; Executor
+    re-jits per concrete feed shape, so replay stays shape-polymorphic."""
+    concrete = [1 if (d is None or d < 0) else int(d) for d in shape]
+    dt = dtypes.convert_dtype(dtype)
+    t = Tensor(jnp.zeros(concrete, dtype=dt), name=name)
+    t.is_data = True
+    t.declared_shape = list(shape)
+    _main_program.feed_vars[name] = t
+    _main_program._track(t)
+    return t
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity (used by jit.save / to_static)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+class Executor:
+    """paddle.static.Executor parity; the 'place' is decorative (XLA owns
+    placement; SURVEY.md §2.6 item 4)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy: bool = True):
+        program = program if program is not None else _main_program
+        if isinstance(program, CompiledInferenceProgram):
+            return program.run(feed, fetch_list)
+        if not fetch_list:
+            return []  # startup programs: initializers already ran eagerly
+        return program.run(feed or {}, fetch_list)
+
+    def close(self):
+        pass
+
+
+class _Scope:
+    def var(self, name):
+        return None
+
+    def find_var(self, name):
+        return None
+
+
+_scope = _Scope()
+
+
+def global_scope():
+    return _scope
+
+
+class name_scope:
+    def __init__(self, prefix=""):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Inference save/load: jax.export of the pruned, jitted program.
+# ---------------------------------------------------------------------------
+
+class CompiledInferenceProgram:
+    """What load_inference_model returns in place of a Program."""
+
+    def __init__(self, exported, feed_names, fetch_names):
+        self._exported = exported
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+
+    def run(self, feed, fetch_list=None):
+        args = [jnp.asarray(feed[n]) for n in self.feed_names]
+        outs = self._exported.call(*args)
+        return [np.asarray(o) for o in outs]
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
+                         program: Optional[Program] = None):
+    """Serialize feed→fetch as a jax.export artifact (.pdmodel analog;
+    reference: save_inference_model → ProgramDesc + params, SURVEY.md §3.5 —
+    here params are baked into the exported HLO as constants)."""
+    from jax import export as jax_export
+    program = program if program is not None else _main_program
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    feed_names = [v.name for v in feed_vars]
+    fetch_ids = tuple(id(v) for v in fetch_vars)
+    key = (tuple(sorted(feed_names)), fetch_ids)
+    if key not in program._cache:
+        program._cache[key] = program._build_fn(sorted(feed_names), fetch_ids)
+    fn, leaf_ids = program._cache[key]
+    leaf_arrays = [program._vars[i]._data for i in leaf_ids]
+
+    def infer_fn(*feed_arrays):
+        by_name = dict(zip(sorted(feed_names), feed_arrays))
+        return fn(by_name, leaf_arrays)
+
+    # dims declared dynamic (None/-1) export as symbolic dims so the loaded
+    # model accepts any batch size, like the reference's -1 feed dims
+    scope = jax_export.SymbolicScope()
+    specs = []
+    for i, n in enumerate(sorted(feed_names)):
+        var = program.feed_vars[n]
+        declared = getattr(var, "declared_shape",
+                           list(var._data.shape))
+        dims = ",".join(
+            f"_dyn{i}_{j}" if (d is None or int(d) < 0) else str(int(d))
+            for j, d in enumerate(declared))
+        if "_dyn" in dims:
+            shape = jax_export.symbolic_shape(dims, scope=scope)
+        else:
+            shape = tuple(var._data.shape)
+        specs.append(jax.ShapeDtypeStruct(shape, var._data.dtype))
+    exported = jax_export.export(jax.jit(infer_fn))(*specs)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdmeta", "wb") as f:
+        pickle.dump({"feed_names": sorted(feed_names),
+                     "fetch_names": [getattr(v, "name", str(i))
+                                     for i, v in enumerate(fetch_vars)]}, f)
+
+
+def load_inference_model(path_prefix: str, executor):
+    from jax import export as jax_export
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path_prefix + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    prog = CompiledInferenceProgram(exported, meta["feed_names"],
+                                    meta["fetch_names"])
+    return prog, meta["feed_names"], meta["fetch_names"]

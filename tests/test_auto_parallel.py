@@ -119,6 +119,27 @@ class TestReshard:
         np.testing.assert_allclose(np.asarray(out), a @ b, rtol=2e-5)
 
 
+class TestDifferentiableReshard:
+    def test_grad_flows_through_reshard(self, mesh2x4):
+        """reshard inside a forward pass must not detach the graph
+        (review regression)."""
+        w = paddle.to_tensor(np.ones((8, 8), np.float32),
+                             stop_gradient=False)
+        y = dist.reshard(w * 2.0, mesh2x4, [Shard(0)])
+        loss = y.sum()
+        loss.backward()
+        assert w.grad is not None
+        np.testing.assert_allclose(w.grad.numpy(),
+                                   np.full((8, 8), 2.0), rtol=1e-6)
+
+    def test_shard_tensor_stop_gradient_override(self, mesh2x4):
+        t = paddle.to_tensor(np.ones((4, 4), np.float32))  # stop_grad True
+        d = dist.shard_tensor(t, mesh2x4, stop_gradient=False)
+        assert not d.stop_gradient
+        d2 = dist.shard_tensor(t, mesh2x4)  # inherit
+        assert d2.stop_gradient
+
+
 class TestShardLayerOptimizer:
     def test_shard_layer_default(self, mesh2x4):
         layer = paddle.nn.Linear(8, 8)

@@ -140,3 +140,93 @@ class TestBitwise:
         check_output(paddle.bitwise_and, np.bitwise_and, [a, b])
         check_output(paddle.bitwise_or, np.bitwise_or, [a, b])
         check_output(paddle.bitwise_xor, np.bitwise_xor, [a, b])
+
+
+class TestNewOps:
+    """renorm/nanquantile/vander/tensordot/histogramdd/igamma/as_strided
+    (op-surface widening, SURVEY.md §2.4 tensor-methods row)."""
+
+    def test_renorm(self):
+        x = fdata(3, 4)
+        out = paddle.renorm(paddle.to_tensor(x), 2.0, 0, 1.0).numpy()
+        norms = np.linalg.norm(out.reshape(3, -1), axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+        # rows already under the cap are untouched
+        small = x / (np.abs(x).sum() + 10)
+        out2 = paddle.renorm(paddle.to_tensor(small), 2.0, 0, 1.0).numpy()
+        np.testing.assert_allclose(out2, small, rtol=1e-6)
+
+    def test_renorm_grad(self):
+        check_grad(lambda t: paddle.renorm(t, 2.0, 0, 1.0), [fdata(3, 4)])
+
+    def test_nanquantile(self):
+        x = fdata(4, 5)
+        x[0, 0] = np.nan
+        out = paddle.nanquantile(paddle.to_tensor(x), 0.5).numpy()
+        np.testing.assert_allclose(out, np.nanquantile(x, 0.5), rtol=1e-6)
+        out_ax = paddle.nanquantile(paddle.to_tensor(x), 0.25, axis=1).numpy()
+        np.testing.assert_allclose(out_ax, np.nanquantile(x, 0.25, axis=1),
+                                   rtol=1e-5)
+
+    def test_vander(self):
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        check_output(lambda t: paddle.vander(t, 4), lambda a: np.vander(a, 4),
+                     [x])
+        check_output(lambda t: paddle.vander(t, 3, increasing=True),
+                     lambda a: np.vander(a, 3, increasing=True), [x])
+
+    def test_tensordot(self):
+        a, b = fdata(3, 4, 5), fdata(4, 5, 6)
+        out = paddle.tensordot(paddle.to_tensor(a), paddle.to_tensor(b),
+                               axes=2).numpy()
+        np.testing.assert_allclose(out, np.tensordot(a, b, axes=2), rtol=1e-4)
+        out2 = paddle.tensordot(paddle.to_tensor(a), paddle.to_tensor(b),
+                                axes=[[1, 2], [0, 1]]).numpy()
+        np.testing.assert_allclose(
+            out2, np.tensordot(a, b, axes=[[1, 2], [0, 1]]), rtol=1e-4)
+
+    def test_histogramdd(self):
+        pts = RNG.random((50, 2)).astype(np.float32)
+        h, edges = paddle.histogramdd(paddle.to_tensor(pts), bins=5)
+        ref_h, ref_edges = np.histogramdd(pts, bins=5)
+        np.testing.assert_allclose(h.numpy(), ref_h)
+        assert len(edges) == 2
+        np.testing.assert_allclose(edges[0].numpy(), ref_edges[0], rtol=1e-5)
+
+    def test_igamma_igammac(self):
+        from scipy import special as sp  # scipy ships with the image? guard
+        x = np.array([1.0, 2.0, 4.0], np.float32)
+        out = paddle.igamma(paddle.to_tensor(x), 1.5).numpy()
+        np.testing.assert_allclose(out, sp.gammaincc(x, 1.5), rtol=1e-5)
+        outc = paddle.igammac(paddle.to_tensor(x), 1.5).numpy()
+        np.testing.assert_allclose(outc, sp.gammainc(x, 1.5), rtol=1e-5)
+        np.testing.assert_allclose(out + outc, np.ones_like(x), rtol=1e-6)
+
+    def test_as_strided(self):
+        x = np.arange(12, dtype=np.float32)
+        out = paddle.as_strided(paddle.to_tensor(x), [3, 2], [4, 1], 1).numpy()
+        ref = np.lib.stride_tricks.as_strided(
+            x[1:], shape=(3, 2), strides=(16, 4))
+        np.testing.assert_array_equal(out, ref)
+
+    def test_fft_rfftn_irfftn(self):
+        x = fdata(4, 8)
+        out = paddle.fft.rfftn(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, np.fft.rfftn(x), rtol=1e-4, atol=1e-5)
+        back = paddle.fft.irfftn(paddle.to_tensor(out), s=[4, 8]).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+    def test_tensordot_flat_axes(self):
+        # paddle flat-list form: contract the SAME dims of both operands
+        a, b = fdata(3, 4, 5), fdata(3, 4, 6)
+        out = paddle.tensordot(paddle.to_tensor(a), paddle.to_tensor(b),
+                               axes=[0, 1]).numpy()
+        np.testing.assert_allclose(
+            out, np.tensordot(a, b, axes=[[0, 1], [0, 1]]), rtol=1e-4)
+
+    def test_histogramdd_flat_ranges(self):
+        pts = RNG.random((40, 2)).astype(np.float32)
+        h, edges = paddle.histogramdd(paddle.to_tensor(pts), bins=4,
+                                      ranges=[0.0, 1.0, 0.0, 1.0])
+        ref_h, _ = np.histogramdd(pts, bins=4, range=[(0, 1), (0, 1)])
+        np.testing.assert_allclose(h.numpy(), ref_h)

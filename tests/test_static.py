@@ -1,0 +1,132 @@
+"""paddle.static Program/Executor/save-load tests (SURVEY.md §2.4 row
+'paddle.static'; reference test style: build program, exe.run feed/fetch,
+compare vs dygraph numerics)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def reset_static():
+    yield
+    paddle.disable_static()
+
+
+def test_enable_disable_static():
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    assert not paddle.in_dynamic_mode()
+    paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+
+
+def test_program_run_matches_eager():
+    # build the layer eagerly so weights are real constants
+    layer = paddle.nn.Sequential(
+        paddle.nn.Linear(4, 8), paddle.nn.ReLU(), paddle.nn.Linear(8, 3))
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((6, 4)).astype(np.float32)
+    eager_out = layer(paddle.to_tensor(xs)).numpy()
+
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        y = layer(x)
+    exe = static.Executor()
+    out, = exe.run(main, feed={"x": xs}, fetch_list=[y])
+    np.testing.assert_allclose(out, eager_out, rtol=1e-5, atol=1e-6)
+
+
+def test_program_shape_polymorphic_refeed():
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        y = (x * 2.0 + 1.0).sum(axis=1)
+    exe = static.Executor()
+    for batch in (2, 5):
+        xs = np.ones((batch, 3), np.float32)
+        out, = exe.run(main, feed={"x": xs}, fetch_list=[y])
+        np.testing.assert_allclose(out, np.full((batch,), 9.0), rtol=1e-6)
+
+
+def test_parameter_update_visible_between_runs():
+    """Parameters are leaves read at run time — mutating them (opt.step /
+    set_state_dict) must change the next exe.run without recapture."""
+    layer = paddle.nn.Linear(2, 2)
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        y = layer(x)
+    exe = static.Executor()
+    xs = np.eye(2, dtype=np.float32)
+    out1, = exe.run(main, feed={"x": xs}, fetch_list=[y])
+    import jax.numpy as jnp
+    layer.weight._rebind(jnp.zeros_like(layer.weight._data))
+    layer.bias._rebind(jnp.ones_like(layer.bias._data))
+    out2, = exe.run(main, feed={"x": xs}, fetch_list=[y])
+    np.testing.assert_allclose(out2, np.ones((2, 2), np.float32))
+    assert not np.allclose(out1, out2)
+
+
+def test_program_guard_isolation():
+    paddle.enable_static()
+    p1, p2 = static.Program(), static.Program()
+    with static.program_guard(p1):
+        a = static.data("a", [2], "float32")
+        _ = a + 1.0
+    with static.program_guard(p2):
+        b = static.data("b", [2], "float32")
+        _ = b * 3.0
+    assert len(p1.records) == 1 and len(p2.records) == 1
+    assert "a" in p1.feed_vars and "a" not in p2.feed_vars
+
+
+def test_multiple_fetches_and_fetch_by_name():
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4], "float32")
+        s = x.sum()
+        m = x.mean()
+    exe = static.Executor()
+    xs = np.arange(4, dtype=np.float32)
+    outs = exe.run(main, feed={"x": xs}, fetch_list=[s, m])
+    np.testing.assert_allclose(outs[0], 6.0)
+    np.testing.assert_allclose(outs[1], 1.5)
+
+
+def test_save_load_inference_model(tmp_path):
+    layer = paddle.nn.Linear(4, 2)
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        y = layer(x)
+    exe = static.Executor()
+    prefix = os.path.join(str(tmp_path), "model")
+    static.save_inference_model(prefix, [x], [y], exe, program=main)
+    assert os.path.exists(prefix + ".pdmodel")
+
+    paddle.disable_static()
+    prog, feed_names, fetch_names = static.load_inference_model(prefix, exe)
+    for batch in (8, 3):  # dynamic batch survives export (symbolic dims)
+        xs = np.random.default_rng(1).standard_normal(
+            (batch, 4)).astype(np.float32)
+        out, = exe.run(prog, feed={"x": xs}, fetch_list=None)
+        expected = layer(paddle.to_tensor(xs)).numpy()
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_input_spec():
+    spec = static.InputSpec([None, 16], "float32", name="inp")
+    assert spec.shape == [None, 16]
+    t = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    s2 = static.InputSpec.from_tensor(t)
+    assert s2.shape == [2, 3]
