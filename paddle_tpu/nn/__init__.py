@@ -12,24 +12,27 @@ from .layers_common import (  # noqa: F401
     Flatten, Unflatten, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
     PixelShuffle, PixelUnshuffle, ChannelShuffle, Pad1D, Pad2D, Pad3D,
     ZeroPad2D, CosineSimilarity, PairwiseDistance, Sequential, LayerList,
-    ParameterList, LayerDict,
+    ParameterList, LayerDict, Bilinear, Fold, Unfold,
 )
 from .layers_conv import (  # noqa: F401
     Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
-    MaxPool1D, MaxPool2D, AvgPool1D, AvgPool2D, AdaptiveAvgPool1D,
-    AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool2D,
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
     LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
     SyncBatchNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
     LocalResponseNorm, SpectralNorm,
 )
 from .layers_act_loss import (  # noqa: F401
-    ReLU, ReLU6, GELU, SiLU, Swish, ELU, SELU, CELU, LeakyReLU, Hardshrink,
-    Softshrink, Tanhshrink, Hardtanh, Hardsigmoid, Hardswish, Mish, Softplus,
-    Softsign, LogSigmoid, Tanh, Sigmoid, LogSoftmax, Softmax, Maxout, PReLU,
-    ThresholdedReLU,
+    ReLU, ReLU6, GELU, SiLU, Silu, Swish, ELU, SELU, CELU, LeakyReLU,
+    Hardshrink, Softshrink, Tanhshrink, Hardtanh, Hardsigmoid, Hardswish,
+    Mish, Softplus, Softsign, LogSigmoid, Tanh, Sigmoid, LogSoftmax, Softmax,
+    Softmax2D, Maxout, PReLU, ThresholdedReLU, RReLU, GLU,
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     SmoothL1Loss, KLDivLoss, MarginRankingLoss, TripletMarginLoss,
-    CosineEmbeddingLoss, HingeEmbeddingLoss,
+    TripletMarginWithDistanceLoss, CosineEmbeddingLoss, HingeEmbeddingLoss,
+    HuberLoss, SoftMarginLoss, MultiLabelSoftMarginLoss, MultiMarginLoss,
+    PoissonNLLLoss, GaussianNLLLoss, CTCLoss, AdaptiveLogSoftmaxWithLoss,
 )
 from .layers_transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
@@ -37,6 +40,7 @@ from .layers_transformer import (  # noqa: F401
 )
 from .layers_rnn import (  # noqa: F401
     SimpleRNNCell, LSTMCell, GRUCell, SimpleRNN, LSTM, GRU, RNN, BiRNN,
+    RNNCellBase,
 )
 
 from ..ops._registry import adopt_inplace as _  # noqa: F401  (import check)

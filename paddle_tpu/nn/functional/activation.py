@@ -43,8 +43,20 @@ def _prelu_raw(x, w, data_format):
     return jnp.where(x >= 0, x, slope * x)
 
 
-rrelu = defop("rrelu", lambda x, lower=1. / 8., upper=1. / 3., training=True, name=None:
-              jnp.where(x >= 0, x, x * ((lower + upper) / 2)))
+def _rrelu_raw(x, lower, upper, training, key):
+    if training:
+        slope = jax.random.uniform(key, x.shape, jnp.float32, lower, upper) \
+            .astype(x.dtype)
+    else:
+        slope = (lower + upper) / 2
+    return jnp.where(x >= 0, x, x * slope)
+
+
+def rrelu(x, lower=1. / 8., upper=1. / 3., training=True, name=None):
+    from ...core import random as prandom
+    key = prandom.next_key()
+    return eager(lambda a: _rrelu_raw(a, lower, upper, training, key),
+                 (x,), {}, name="rrelu")
 hardshrink = defop("hardshrink", lambda x, threshold=0.5, name=None:
                    jnp.where(jnp.abs(x) > threshold, x, 0.0))
 softshrink = defop("softshrink", lambda x, threshold=0.5, name=None:

@@ -259,3 +259,159 @@ pairwise_distance = defop("pairwise_distance", lambda x, y, p=2.0, epsilon=1e-6,
 def zeropad2d(x, padding, data_format="NCHW", name=None):
     from ...ops.manipulation import pad as _pad
     return _pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def _bilinear_raw(x1, x2, weight, bias=None, name=None):
+    # out[n,o] = x1[n,i] W[o,i,j] x2[n,j] (+ b[o])
+    out = jnp.einsum("ni,oij,nj->no", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    return eager(_bilinear_raw, (x1, x2, weight, bias), {}, name="bilinear")
+
+
+def _sequence_mask_raw(x, maxlen=None, dtype="int64"):
+    ml = int(maxlen) if maxlen is not None else int(jnp.max(x))
+    steps = jnp.arange(ml)
+    mask = steps < x[..., None]
+    return mask.astype(np.dtype(dtype) if dtype != "bool" else bool)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    return eager(lambda a: _sequence_mask_raw(a, maxlen, dtype), (x,), {},
+                 name="sequence_mask")
+
+
+def _temporal_shift_raw(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    # TSM: shift 1/ratio of channels one step along the segment axis
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate(
+        [x5[:, 1:, :fold], jnp.zeros_like(x5[:, :1, :fold])], axis=1)
+    right = jnp.concatenate(
+        [jnp.zeros_like(x5[:, :1, fold:2 * fold]), x5[:, :-1, fold:2 * fold]],
+        axis=1)
+    out = jnp.concatenate([left, right, x5[:, :, 2 * fold:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    return eager(lambda a: _temporal_shift_raw(a, seg_num, shift_ratio,
+                                               data_format), (x,), {},
+                 name="temporal_shift")
+
+
+def _affine_grid_raw(theta, out_shape, align_corners=True):
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = axis_coords(h)
+    xs = axis_coords(w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # H,W,3
+    # (N,2,3) @ (H*W,3)^T → N,H,W,2
+    out = jnp.einsum("nij,hwj->nhwi", theta.astype(jnp.float32), base)
+    return out
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    return eager(lambda t: _affine_grid_raw(t, out_shape, align_corners),
+                 (theta,), {}, name="affine_grid")
+
+
+def _grid_sample_raw(x, grid, mode="bilinear", padding_mode="zeros",
+                     align_corners=True):
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) * (size - 1) / 2.0
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    fx = unnormalize(gx, w)
+    fy = unnormalize(gy, h)
+
+    def reflect(coord, lo, hi):
+        rng = hi - lo
+        coord = jnp.abs((coord - lo) % (2 * rng) - rng) + lo
+        return coord
+
+    if padding_mode == "reflection":
+        if align_corners:
+            fx = reflect(fx, 0.0, w - 1.0)
+            fy = reflect(fy, 0.0, h - 1.0)
+        else:
+            fx = jnp.clip(reflect(fx, -0.5, w - 0.5), 0, w - 1)
+            fy = jnp.clip(reflect(fy, -0.5, h - 0.5), 0, h - 1)
+
+    def gather2d(ix, iy):
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        flat = x.reshape(n, c, h * w)
+        lin = (iyc * w + ixc).reshape(n, -1)  # N,HW'
+        vals = jnp.take_along_axis(flat, lin[:, None, :], axis=2)
+        vals = vals.reshape((n, c) + ix.shape[1:])
+        if padding_mode == "zeros":
+            inb = ((ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1))
+            vals = vals * inb[:, None].astype(vals.dtype)
+        return vals
+
+    if mode == "nearest":
+        return gather2d(jnp.round(fx).astype(jnp.int32),
+                        jnp.round(fy).astype(jnp.int32))
+    x0 = jnp.floor(fx)
+    y0 = jnp.floor(fy)
+    wx = (fx - x0).astype(x.dtype)[:, None]
+    wy = (fy - y0).astype(x.dtype)[:, None]
+    x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+    v00 = gather2d(x0i, y0i)
+    v01 = gather2d(x0i + 1, y0i)
+    v10 = gather2d(x0i, y0i + 1)
+    v11 = gather2d(x0i + 1, y0i + 1)
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return top * (1 - wy) + bot * wy
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    return eager(lambda a, g: _grid_sample_raw(a, g, mode, padding_mode,
+                                               align_corners), (x, grid), {},
+                 name="grid_sample")
+
+
+def _gather_tree_raw(ids, parents):
+    # beam-search backtrace: ids/parents [T, N, B] → sequences re-threaded
+    # through parent pointers, walked from the last step backward
+    t, n, b = ids.shape
+
+    def step(beams, inp):
+        step_ids, step_parents = inp
+        out = jnp.take_along_axis(step_ids, beams, axis=1)
+        prev = jnp.take_along_axis(step_parents, beams, axis=1)
+        return prev, out
+
+    init = jnp.broadcast_to(jnp.arange(b, dtype=ids.dtype), (n, b))
+    _, rev = jax.lax.scan(step, init, (ids[::-1], parents[::-1]))
+    return rev[::-1]
+
+
+def gather_tree(ids, parents):
+    return eager(_gather_tree_raw, (ids, parents), {}, name="gather_tree")

@@ -188,32 +188,16 @@ def _pool_raw(x, ksize, strides, padding, ndim, op, data_format="NCHW",
     return summed / counts
 
 
-def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
-               ceil_mode=False, data_format="NCL", name=None):
-    return eager(lambda a: _pool_raw(a, kernel_size, stride, padding, 1, "max",
-                                     data_format, ceil_mode), (x,), {}, name="max_pool1d")
-
-
-def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
-               ceil_mode=False, data_format="NCHW", name=None):
-    out = eager(lambda a: _pool_raw(a, kernel_size, stride, padding, 2, "max",
-                                    data_format, ceil_mode), (x,), {}, name="max_pool2d")
-    if return_mask:
-        # indices within each window, flattened HW index (parity shape only)
-        idx = eager(lambda a: _max_pool_indices(a, kernel_size, stride, padding),
-                    (x,), {}, name="max_pool2d_mask")
-        return out, idx
-    return out
-
-
-def _max_pool_indices(x, ksize, stride, padding):
-    n, c, h, w = x.shape
-    hw_idx = jnp.arange(h * w, dtype=jnp.float64).reshape(1, 1, h, w)
-    hw_idx = jnp.broadcast_to(hw_idx, x.shape)
-    # argmax via reduce: encode value+index (value in high part)
-    k = _ntuple(ksize, 2)
-    s = _ntuple(stride if stride is not None else ksize, 2)
-    pad = _conv_padding(padding, 2)
+def _max_pool_indices(x, ksize, stride, padding, nd):
+    """Flat-spatial argmax index per window (paddle return_mask parity),
+    NCHW-family layouts, any spatial rank."""
+    spatial = x.shape[2:]
+    size = int(np.prod(spatial))
+    flat_idx = jnp.arange(size, dtype=jnp.float64).reshape((1, 1) + spatial)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    k = _ntuple(ksize, nd)
+    s = _ntuple(stride if stride is not None else ksize, nd)
+    pad = _conv_padding(padding, nd)
     pad_full = [(0, 0), (0, 0)] + pad if not isinstance(pad, str) else pad
 
     def sel(a, b):
@@ -222,17 +206,47 @@ def _max_pool_indices(x, ksize, stride, padding):
         take_b = bv > av
         return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
 
-    vals, idxs = jax.lax.reduce_window(
-        (x, hw_idx), (-jnp.inf, 0.0),
-        lambda a, b: sel(a, b),
+    neg = jnp.asarray(-jnp.inf if np.dtype(x.dtype).kind == "f"
+                      else np.iinfo(np.dtype(x.dtype)).min, x.dtype)
+    _, idxs = jax.lax.reduce_window(
+        (x, flat_idx), (neg, jnp.asarray(0.0, flat_idx.dtype)), sel,
         (1, 1) + k, (1, 1) + s, pad_full)
     return idxs.astype(jnp.int64)
 
 
+def _max_pool_nd(x, kernel_size, stride, padding, return_mask, ceil_mode,
+                 data_format, nd, name):
+    out = eager(lambda a: _pool_raw(a, kernel_size, stride, padding, nd,
+                                    "max", data_format, ceil_mode),
+                (x,), {}, name=name)
+    if return_mask:
+        if data_format.endswith("C"):
+            raise NotImplementedError(
+                f"{name}: return_mask with channels-last layout "
+                "(paddle_tpu/nn/functional/conv.py)")
+        idx = eager(lambda a: _max_pool_indices(a, kernel_size, stride,
+                                                padding, nd),
+                    (x,), {}, name=name + "_mask")
+        return out, idx
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _max_pool_nd(x, kernel_size, stride, padding, return_mask,
+                        ceil_mode, data_format, 1, "max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _max_pool_nd(x, kernel_size, stride, padding, return_mask,
+                        ceil_mode, data_format, 2, "max_pool2d")
+
+
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
-    return eager(lambda a: _pool_raw(a, kernel_size, stride, padding, 3, "max",
-                                     data_format, ceil_mode), (x,), {}, name="max_pool3d")
+    return _max_pool_nd(x, kernel_size, stride, padding, return_mask,
+                        ceil_mode, data_format, 3, "max_pool3d")
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -310,11 +324,55 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
                  name="adaptive_avg_pool3d")
 
 
+def _adaptive_max_indices(x, output_size, ndim):
+    """Flat-spatial argmax per adaptive bin — divisible sizes only (the
+    common unpooling case; general bins would need per-bin unrolled argmax)."""
+    spatial = x.shape[2:]
+    out_size = _ntuple(output_size, ndim)
+    out_size = tuple(spatial[i] if out_size[i] is None else out_size[i]
+                     for i in range(ndim))
+    if not all(spatial[i] % out_size[i] == 0 for i in range(ndim)):
+        raise NotImplementedError(
+            "adaptive_max_pool return_mask needs input sizes divisible by "
+            "output sizes (paddle_tpu/nn/functional/conv.py)")
+    size = int(np.prod(spatial))
+    flat_idx = jnp.arange(size, dtype=jnp.int64).reshape((1, 1) + spatial)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    shape = list(x.shape[:2])
+    for i in range(ndim):
+        shape += [out_size[i], spatial[i] // out_size[i]]
+    # bring window axes last, flatten, joint argmax
+    perm = [0, 1] + [2 + 2 * i for i in range(ndim)] + \
+        [3 + 2 * i for i in range(ndim)]
+    xr = jnp.transpose(x.reshape(shape), perm)
+    ir = jnp.transpose(flat_idx.reshape(shape), perm)
+    win = int(np.prod(xr.shape[2 + ndim:]))
+    xr = xr.reshape(xr.shape[:2 + ndim] + (win,))
+    ir = ir.reshape(ir.shape[:2 + ndim] + (win,))
+    am = jnp.argmax(xr, axis=-1)
+    return jnp.take_along_axis(ir, am[..., None], axis=-1)[..., 0]
+
+
+def _adaptive_max_pool(x, output_size, return_mask, ndim, name):
+    out = eager(lambda a: _adaptive_pool_raw(a, output_size, ndim, "max"),
+                (x,), {}, name=name)
+    if return_mask:
+        idx = eager(lambda a: _adaptive_max_indices(a, output_size, ndim),
+                    (x,), {}, name=name + "_mask")
+        return out, idx
+    return out
+
+
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
-    return eager(lambda a: _adaptive_pool_raw(a, output_size, 1, "max"), (x,), {},
-                 name="adaptive_max_pool1d")
+    return _adaptive_max_pool(x, output_size, return_mask, 1,
+                              "adaptive_max_pool1d")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    return eager(lambda a: _adaptive_pool_raw(a, output_size, 2, "max"), (x,), {},
-                 name="adaptive_max_pool2d")
+    return _adaptive_max_pool(x, output_size, return_mask, 2,
+                              "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool(x, output_size, return_mask, 3,
+                              "adaptive_max_pool3d")

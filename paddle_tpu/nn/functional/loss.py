@@ -250,8 +250,216 @@ def square_error_cost(input, label, name=None):
                  {}, name="square_error_cost")
 
 
+_CTC_NEG_INF = -1e30  # -inf breeds nans through where/grad; huge-negative is safe
+
+
+def _ctc_raw(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC forward algorithm (alpha recursion in log space) under lax.scan.
+
+    log_probs: [T, N, C] log-softmax outputs; labels: [N, S] padded targets.
+    Reference: phi fused warpctc kernel + python/paddle/nn/functional/loss.py
+    ctc_loss (upstream-canonical, unverified — SURVEY.md §0); TPU-native as a
+    compiled scan rather than a CPU/CUDA warpctc call.
+    """
+    t_max, n, _ = log_probs.shape
+    s_max = labels.shape[1]
+    labels = labels.astype(jnp.int32)
+    input_lengths = input_lengths.astype(jnp.int32)
+    label_lengths = label_lengths.astype(jnp.int32)
+
+    # extended target sequence: blank, l1, blank, l2, ... blank  (2S+1)
+    ext = jnp.full((n, 2 * s_max + 1), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    positions = jnp.arange(2 * s_max + 1)[None, :]
+    valid = positions < (2 * label_lengths[:, None] + 1)
+    # s→s-2 skip allowed only onto a non-blank that differs from ext[s-2]
+    skip_ok = jnp.concatenate(
+        [jnp.zeros((n, 2), bool),
+         (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+
+    def emit(lp_t):
+        return jnp.take_along_axis(lp_t, ext, axis=1)  # [N, 2S+1]
+
+    alpha0 = jnp.full((n, 2 * s_max + 1), _CTC_NEG_INF, log_probs.dtype)
+    alpha0 = alpha0.at[:, 0:2].set(emit(log_probs[0])[:, 0:2])
+    alpha0 = jnp.where(valid, alpha0, _CTC_NEG_INF)
+
+    def logaddexp3(a, b, c):
+        m = jnp.maximum(jnp.maximum(a, b), c)
+        return m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m) + jnp.exp(c - m))
+
+    def step(alpha, inp):
+        lp_t, t = inp
+        prev1 = jnp.concatenate(
+            [jnp.full((n, 1), _CTC_NEG_INF, alpha.dtype), alpha[:, :-1]], 1)
+        prev2 = jnp.concatenate(
+            [jnp.full((n, 2), _CTC_NEG_INF, alpha.dtype), alpha[:, :-2]], 1)
+        prev2 = jnp.where(skip_ok, prev2, _CTC_NEG_INF)
+        new = emit(lp_t) + logaddexp3(alpha, prev1, prev2)
+        new = jnp.where(valid, new, _CTC_NEG_INF)
+        # freeze alpha once past each sequence's input length
+        new = jnp.where((t < input_lengths)[:, None], new, alpha)
+        return new, None
+
+    ts = jnp.arange(1, t_max)
+    alpha, _ = jax.lax.scan(step, alpha0, (log_probs[1:], ts))
+
+    # total log-likelihood: last blank (2L) + last label (2L-1)
+    end = 2 * label_lengths
+    a_end = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+    a_end1 = jnp.where(
+        label_lengths > 0,
+        jnp.take_along_axis(alpha, jnp.maximum(end - 1, 0)[:, None],
+                            axis=1)[:, 0],
+        _CTC_NEG_INF)
+    ll = jnp.logaddexp(a_end, a_end1)
+    loss = -ll
+    if norm_by_times:
+        loss = loss / input_lengths.astype(loss.dtype)
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(label_lengths, 1)
+                        .astype(loss.dtype))
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
-    raise NotImplementedError(
-        "ctc_loss: deferred (paddle_tpu/nn/functional/loss.py) — needs a "
-        "lax.scan forward-backward; planned with the audio model family")
+    return eager(lambda lp, lb, il, ll: _ctc_raw(lp, lb, il, ll, blank,
+                                                 reduction, norm_by_times),
+                 (log_probs, labels, input_lengths, label_lengths), {},
+                 name="ctc_loss")
+
+
+def _poisson_nll_raw(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:  # Stirling approximation for label! term
+        stirling = label * jnp.log(label) - label + \
+            0.5 * jnp.log(2 * jnp.pi * label)
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    return eager(lambda i, l: _poisson_nll_raw(i, l, log_input, full, epsilon,
+                                               reduction), (input, label), {},
+                 name="poisson_nll_loss")
+
+
+def _gaussian_nll_raw(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    var = jnp.clip(variance, min=epsilon)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, loss.dtype))
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    return eager(lambda i, l, v: _gaussian_nll_raw(i, l, v, full, epsilon,
+                                                   reduction),
+                 (input, label, variance), {}, name="gaussian_nll_loss")
+
+
+def _dice_loss_raw(input, label, epsilon=1e-5):
+    # input: [N, ..., C] probabilities; label: [N, ..., 1] class ids
+    n_class = input.shape[-1]
+    onehot = jax.nn.one_hot(jnp.squeeze(label, -1), n_class,
+                            dtype=input.dtype)
+    flat_i = input.reshape(input.shape[0], -1)
+    flat_l = onehot.reshape(onehot.shape[0], -1)
+    intersect = jnp.sum(flat_i * flat_l, axis=1)
+    union = jnp.sum(flat_i, axis=1) + jnp.sum(flat_l, axis=1)
+    return jnp.mean(1.0 - (2.0 * intersect + epsilon) / (union + epsilon))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    return eager(lambda i, l: _dice_loss_raw(i, l, epsilon), (input, label),
+                 {}, name="dice_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return eager(
+        lambda i, l: -l * jnp.log(i + epsilon) -
+        (1.0 - l) * jnp.log(1.0 - i + epsilon),
+        (input, label), {}, name="log_loss")
+
+
+def _npair_loss_raw(anchor, positive, labels, l2_reg=0.002):
+    labels = labels.reshape(-1)
+    same = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    target = same / jnp.sum(same, axis=1, keepdims=True)
+    sim = anchor @ positive.T
+    logp = jax.nn.log_softmax(sim, axis=1)
+    xent = jnp.mean(jnp.sum(-target * logp, axis=1))
+    reg = l2_reg * 0.25 * (jnp.mean(jnp.sum(anchor * anchor, axis=1)) +
+                           jnp.mean(jnp.sum(positive * positive, axis=1)))
+    return xent + reg
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    return eager(lambda a, p, l: _npair_loss_raw(a, p, l, l2_reg),
+                 (anchor, positive, labels), {}, name="npair_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return eager(
+        lambda i, l: _reduce(jnp.log1p(jnp.exp(-l.astype(i.dtype) * i)),
+                             reduction),
+        (input, label), {}, name="soft_margin_loss")
+
+
+def _mlsm_raw(input, label, weight=None, reduction="mean"):
+    l = label.astype(input.dtype)
+    loss = -(l * jax.nn.log_sigmoid(input) +
+             (1.0 - l) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    return eager(lambda i, l: _mlsm_raw(i, l, weight, reduction),
+                 (input, label), {}, name="multi_label_soft_margin_loss")
+
+
+def _multi_margin_raw(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    n, c = input.shape
+    lbl = label.astype(jnp.int32).reshape(-1)
+    correct = jnp.take_along_axis(input, lbl[:, None], axis=1)
+    m = jnp.maximum(0.0, margin - correct + input) ** p
+    if weight is not None:
+        m = m * weight[lbl][:, None]
+    m = m * (1.0 - jax.nn.one_hot(lbl, c, dtype=input.dtype))
+    loss = jnp.sum(m, axis=1) / c
+    return _reduce(loss, reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    return eager(lambda i, l: _multi_margin_raw(i, l, p, margin,
+                                                None if weight is None
+                                                else as_array(weight),
+                                                reduction),
+                 (input, label), {}, name="multi_margin_loss")
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def raw(i, l):
+        d = i - l
+        ad = jnp.abs(d)
+        loss = jnp.where(ad <= delta, 0.5 * d * d,
+                         delta * (ad - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return eager(raw, (input, label), {}, name="huber_loss")

@@ -209,3 +209,176 @@ class HingeEmbeddingLoss(Layer):
 
     def forward(self, input, label):
         return F.hinge_embedding_loss(input, label, self.margin, self.reduction)
+
+
+GLU = _act_layer("glu")
+Silu = SiLU  # paddle spells it Silu; keep both
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU: slope ~ U(lower, upper) in training, the mean
+    slope in eval (paddle.nn.RReLU)."""
+
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class HuberLoss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self.reduction, self.delta = reduction, delta
+
+    def forward(self, input, label):
+        return F.huber_loss(input, label, self.delta, self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.args = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, *self.args)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self.args = (full, epsilon, reduction)
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, *self.args)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean", name=None):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction, norm_by_times)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        dist = self.distance_function or (
+            lambda a, b: ((a - b) ** 2).sum(-1).sqrt())
+        dp = dist(input, positive)
+        dn = dist(input, negative)
+        if self.swap:
+            from .. import ops
+            dn = ops.minimum(dn, dist(positive, negative))
+        loss = F.relu(dp - dn + self.margin)
+        if self.reduction == "mean":
+            return loss.mean()
+        if self.reduction == "sum":
+            return loss.sum()
+        return loss
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax (Grave et al.): frequent classes in the head,
+    rare classes in down-projected tail clusters (paddle.nn parity)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if not cutoffs or cutoffs != sorted(cutoffs) or \
+                cutoffs[-1] >= n_classes:
+            raise ValueError("cutoffs must be increasing ints < n_classes")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.cutoffs[0] + self.n_clusters
+        from . import layers_common as LC
+        self.head = LC.Linear(in_features, self.head_size,
+                              bias_attr=head_bias if head_bias else False)
+        self.tail = LC.LayerList()
+        for i in range(self.n_clusters):
+            hsz = int(in_features // (div_value ** (i + 1)))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = LC.Sequential(
+                LC.Linear(in_features, max(hsz, 1), bias_attr=False),
+                LC.Linear(max(hsz, 1), osz, bias_attr=False))
+            self.tail.append(proj)
+
+    def _full_log_prob(self, input):
+        from .. import ops
+        head_out = self.head(input)
+        head_logp = F.log_softmax(head_out, axis=-1)
+        pieces = [head_logp[:, :self.cutoffs[0]]]
+        for i in range(self.n_clusters):
+            cluster_logp = F.log_softmax(self.tail[i](input), axis=-1)
+            gate = head_logp[:, self.cutoffs[0] + i:self.cutoffs[0] + i + 1]
+            pieces.append(cluster_logp + gate)
+        return ops.concat(pieces, axis=-1)
+
+    def forward(self, input, label):
+        from .. import ops
+        logp = self._full_log_prob(input)
+        picked = ops.take_along_axis(
+            logp, ops.reshape(label, [-1, 1]).astype("int64"), 1)
+        output = ops.reshape(picked, [-1])
+        loss = -output.mean()
+        return output, loss
+
+    def log_prob(self, input):
+        return self._full_log_prob(input)
+
+    def predict(self, input):
+        from .. import ops
+        return ops.argmax(self._full_log_prob(input), axis=-1)

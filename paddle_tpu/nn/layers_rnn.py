@@ -294,3 +294,6 @@ class BiRNN(Layer):
         ob, stb = self.bw(inputs, sb)
         from ..ops.manipulation import concat
         return concat([of, ob], axis=-1), (stf, stb)
+
+
+RNNCellBase = _RNNCellBase  # public name (paddle.nn.RNNCellBase)

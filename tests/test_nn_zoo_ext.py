@@ -1,0 +1,300 @@
+"""Tests for the nn layer-zoo extension (pooling 3D, Bilinear, Fold/Unfold,
+loss zoo additions, grid_sample/affine_grid, adaptive log softmax).
+Reference test style: eager asserts vs numpy/torch-consistent formulas
+(SURVEY.md §4 API/layer tests row)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.default_rng(11)
+
+
+def t(*shape, dtype=np.float32):
+    return paddle.to_tensor(RNG.standard_normal(shape).astype(dtype))
+
+
+class TestPool3D:
+    def test_max_avg_pool3d(self):
+        x = t(2, 3, 8, 8, 8)
+        out = nn.MaxPool3D(2, 2)(x)
+        assert out.shape == [2, 3, 4, 4, 4]
+        ref = np.asarray(x.numpy()).reshape(2, 3, 4, 2, 4, 2, 4, 2) \
+            .max(axis=(3, 5, 7))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+        avg = nn.AvgPool3D(2, 2)(x)
+        ref_a = np.asarray(x.numpy()).reshape(2, 3, 4, 2, 4, 2, 4, 2) \
+            .mean(axis=(3, 5, 7))
+        np.testing.assert_allclose(avg.numpy(), ref_a, rtol=1e-5)
+
+    def test_adaptive_max(self):
+        x = t(2, 3, 12)
+        out = nn.AdaptiveMaxPool1D(4)(x)
+        assert out.shape == [2, 3, 4]
+        ref = x.numpy().reshape(2, 3, 4, 3).max(-1)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+        x3 = t(1, 2, 8, 8, 8)
+        assert nn.AdaptiveMaxPool3D(2)(x3).shape == [1, 2, 2, 2, 2]
+
+
+class TestBilinearFold:
+    def test_bilinear(self):
+        layer = nn.Bilinear(3, 4, 5)
+        x1, x2 = t(6, 3), t(6, 4)
+        out = layer(x1, x2)
+        assert out.shape == [6, 5]
+        w = layer.weight.numpy()
+        b = layer.bias.numpy()
+        ref = np.einsum("ni,oij,nj->no", x1.numpy(), w, x2.numpy()) + b
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_unfold_fold_round_trip(self):
+        x = t(1, 2, 6, 6)
+        cols = nn.Unfold(2, strides=2)(x)
+        assert cols.shape == [1, 2 * 2 * 2, 9]
+        back = nn.Fold([6, 6], 2, strides=2)(cols)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-6)
+
+
+class TestActZoo:
+    def test_glu(self):
+        x = t(4, 8)
+        out = nn.GLU()(x)
+        a, b = np.split(x.numpy(), 2, axis=-1)
+        np.testing.assert_allclose(out.numpy(), a / (1 + np.exp(-b)),
+                                   rtol=1e-5)
+
+    def test_rrelu_eval_uses_mean_slope(self):
+        layer = nn.RReLU(0.1, 0.3)
+        layer.eval()
+        x = paddle.to_tensor(np.array([-10.0, 10.0], np.float32))
+        np.testing.assert_allclose(layer(x).numpy(), [-2.0, 10.0], rtol=1e-5)
+
+    def test_softmax2d(self):
+        x = t(2, 3, 4, 4)
+        out = nn.Softmax2D()(x)
+        np.testing.assert_allclose(out.numpy().sum(axis=1),
+                                   np.ones((2, 4, 4)), rtol=1e-5)
+
+    def test_silu_alias(self):
+        assert nn.Silu is nn.SiLU
+
+
+class TestLossZoo:
+    def test_huber(self):
+        i, l = t(8), t(8)
+        out = nn.HuberLoss(delta=0.5)(i, l).numpy()
+        d = i.numpy() - l.numpy()
+        ref = np.where(np.abs(d) <= 0.5, 0.5 * d * d,
+                       0.5 * (np.abs(d) - 0.25)).mean()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_soft_margin(self):
+        i = t(6)
+        lbl = paddle.to_tensor(
+            np.sign(RNG.standard_normal(6)).astype(np.float32))
+        out = nn.SoftMarginLoss()(i, lbl).numpy()
+        ref = np.log1p(np.exp(-lbl.numpy() * i.numpy())).mean()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_multi_margin(self):
+        logits = t(4, 5)
+        labels = paddle.to_tensor(np.array([0, 2, 1, 4]))
+        out = nn.MultiMarginLoss()(logits, labels).numpy()
+        lg = logits.numpy()
+        ref = 0.0
+        for n in range(4):
+            c = labels.numpy()[n]
+            margins = np.maximum(0, 1 - lg[n, c] + lg[n])
+            margins[c] = 0
+            ref += margins.sum() / 5
+        np.testing.assert_allclose(out, ref / 4, rtol=1e-5)
+
+    def test_poisson_gaussian_nll(self):
+        i, lbl = t(6).abs(), t(6).abs()
+        out = nn.PoissonNLLLoss()(i, lbl).numpy()
+        ref = (np.exp(i.numpy()) - lbl.numpy() * i.numpy()).mean()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+        var = t(6).abs() + 0.5
+        g = nn.GaussianNLLLoss()(i, lbl, var).numpy()
+        ref_g = 0.5 * (np.log(var.numpy()) +
+                       (i.numpy() - lbl.numpy()) ** 2 / var.numpy())
+        np.testing.assert_allclose(g, ref_g.mean(), rtol=1e-5)
+
+    def test_multilabel_soft_margin(self):
+        i = t(3, 4)
+        lbl = paddle.to_tensor((RNG.random((3, 4)) > 0.5).astype(np.float32))
+        out = nn.MultiLabelSoftMarginLoss()(i, lbl).numpy()
+        x, y = i.numpy(), lbl.numpy()
+        ref = -(y * np.log(1 / (1 + np.exp(-x))) +
+                (1 - y) * np.log(1 - 1 / (1 + np.exp(-x)))).mean(-1).mean()
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    def test_triplet_with_distance(self):
+        a, p, n = t(4, 8), t(4, 8), t(4, 8)
+        out = nn.TripletMarginWithDistanceLoss(margin=0.5)(a, p, n).numpy()
+        dp = np.linalg.norm(a.numpy() - p.numpy(), axis=1)
+        dn = np.linalg.norm(a.numpy() - n.numpy(), axis=1)
+        ref = np.maximum(0, dp - dn + 0.5).mean()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_dice_log_npair(self):
+        probs = paddle.nn.functional.softmax(t(4, 3), axis=-1)
+        lbl = paddle.to_tensor(np.array([[0], [1], [2], [1]]))
+        d = F.dice_loss(probs, lbl).numpy()
+        assert 0.0 < d < 1.0
+        pred = paddle.to_tensor(np.clip(RNG.random(5), 0.01, 0.99)
+                                .astype(np.float32))
+        y = paddle.to_tensor((RNG.random(5) > 0.5).astype(np.float32))
+        ll = F.log_loss(pred, y).numpy()
+        ref = -(y.numpy() * np.log(pred.numpy() + 1e-4) +
+                (1 - y.numpy()) * np.log(1 - pred.numpy() + 1e-4))
+        np.testing.assert_allclose(ll, ref, rtol=1e-4)
+        anchor, pos = t(4, 6), t(4, 6)
+        labels = paddle.to_tensor(np.array([0, 0, 1, 1]))
+        npl = F.npair_loss(anchor, pos, labels).numpy()
+        assert np.isfinite(npl)
+
+    def test_ctc_loss_layer(self):
+        logp = F.log_softmax(t(6, 2, 5), axis=-1)  # T,N,C
+        labels = paddle.to_tensor(np.array([[1, 2, 3], [2, 3, 1]]))
+        ilen = paddle.to_tensor(np.array([6, 6]))
+        llen = paddle.to_tensor(np.array([3, 3]))
+        loss = nn.CTCLoss()(logp, labels, ilen, llen)
+        assert np.isfinite(loss.numpy())
+
+
+class TestGridOps:
+    def test_affine_grid_identity(self):
+        theta = paddle.to_tensor(
+            np.tile(np.array([[1, 0, 0], [0, 1, 0]], np.float32), (2, 1, 1)))
+        grid = F.affine_grid(theta, [2, 3, 4, 4])
+        assert grid.shape == [2, 4, 4, 2]
+        np.testing.assert_allclose(grid.numpy()[0, 0, :, 0],
+                                   np.linspace(-1, 1, 4), rtol=1e-6)
+
+    def test_grid_sample_identity(self):
+        x = t(2, 3, 5, 5)
+        theta = paddle.to_tensor(
+            np.tile(np.array([[1, 0, 0], [0, 1, 0]], np.float32), (2, 1, 1)))
+        grid = F.affine_grid(theta, [2, 3, 5, 5])
+        out = F.grid_sample(x, grid)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_grid_sample_nearest_and_zeros(self):
+        x = paddle.to_tensor(np.arange(16, np.float32).reshape(1, 1, 4, 4)
+                             if False else
+                             np.arange(16, dtype=np.float32)
+                             .reshape(1, 1, 4, 4))
+        # grid entirely out of range → zeros padding
+        grid = paddle.to_tensor(np.full((1, 2, 2, 2), 5.0, np.float32))
+        out = F.grid_sample(x, grid, mode="nearest", padding_mode="zeros")
+        np.testing.assert_allclose(out.numpy(), np.zeros((1, 1, 2, 2)))
+
+    def test_sequence_mask_and_temporal_shift(self):
+        lens = paddle.to_tensor(np.array([1, 3]))
+        m = F.sequence_mask(lens, maxlen=4)
+        np.testing.assert_array_equal(
+            m.numpy(), [[1, 0, 0, 0], [1, 1, 1, 0]])
+        x = t(4, 8, 2, 2)  # nt=4 = n2*seg2
+        out = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+        assert out.shape == [4, 8, 2, 2]
+        # last channels pass through unshifted
+        np.testing.assert_allclose(out.numpy()[:, 4:], x.numpy()[:, 4:])
+
+
+class TestAdaptiveLogSoftmax:
+    def test_log_prob_normalized_and_loss(self):
+        m = nn.AdaptiveLogSoftmaxWithLoss(16, 20, [5, 10], div_value=2.0)
+        x = t(8, 16)
+        logp = m.log_prob(x)
+        assert logp.shape == [8, 20]
+        np.testing.assert_allclose(np.exp(logp.numpy()).sum(-1),
+                                   np.ones(8), rtol=1e-4)
+        lbl = paddle.to_tensor(RNG.integers(0, 20, 8))
+        out, loss = m(x, lbl)
+        np.testing.assert_allclose(
+            -out.numpy().mean(), loss.numpy(), rtol=1e-5)
+        pred = m.predict(x)
+        np.testing.assert_array_equal(pred.numpy(),
+                                      logp.numpy().argmax(-1))
+
+
+class TestCTCAgainstTorch:
+    def test_ctc_matches_torch(self):
+        import torch
+        T, N, C, S = 8, 3, 6, 4
+        lp = RNG.standard_normal((T, N, C)).astype(np.float32)
+        lp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+        labels = RNG.integers(1, C, (N, S))
+        ilen = np.array([8, 7, 5])
+        llen = np.array([4, 2, 3])
+        ours = F.ctc_loss(
+            paddle.to_tensor(lp), paddle.to_tensor(labels),
+            paddle.to_tensor(ilen), paddle.to_tensor(llen),
+            reduction="none").numpy()
+        ref = torch.nn.functional.ctc_loss(
+            torch.tensor(lp), torch.tensor(labels),
+            torch.tensor(ilen), torch.tensor(llen),
+            blank=0, reduction="none").numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+    def test_ctc_grad_finite(self):
+        lp = paddle.to_tensor(
+            RNG.standard_normal((6, 2, 5)).astype(np.float32),
+            stop_gradient=False)
+        logp = F.log_softmax(lp, axis=-1)
+        loss = F.ctc_loss(logp, paddle.to_tensor(RNG.integers(1, 5, (2, 3))),
+                          paddle.to_tensor(np.array([6, 6])),
+                          paddle.to_tensor(np.array([3, 3])))
+        loss.backward()
+        assert np.isfinite(lp.grad.numpy()).all()
+
+
+class TestReviewRegressions:
+    def test_max_pool3d_return_mask(self):
+        x = t(1, 2, 4, 4, 4)
+        out, mask = nn.MaxPool3D(2, 2, return_mask=True)(x)
+        assert out.shape == [1, 2, 2, 2, 2] and mask.shape == out.shape
+        flat = x.numpy().reshape(1, 2, -1)
+        np.testing.assert_allclose(
+            np.take_along_axis(flat, mask.numpy().reshape(1, 2, -1),
+                               axis=2).reshape(out.shape),
+            out.numpy())
+
+    def test_adaptive_max_pool_return_mask(self):
+        x = t(2, 3, 12)
+        out, mask = nn.AdaptiveMaxPool1D(4, return_mask=True)(x)
+        flat = x.numpy().reshape(2, 3, -1)
+        np.testing.assert_allclose(
+            np.take_along_axis(flat, mask.numpy().reshape(2, 3, -1),
+                               axis=2).reshape(out.shape), out.numpy())
+
+    def test_avg_pool3d_channels_last(self):
+        x = t(1, 4, 4, 4, 2)  # NDHWC
+        out = nn.AvgPool3D(2, 2, data_format="NDHWC")(x)
+        assert out.shape == [1, 2, 2, 2, 2]
+        ref = x.numpy().reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(2, 4, 6))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_rrelu_training_randomizes(self):
+        layer = nn.RReLU(0.1, 0.9)
+        layer.train()
+        x = paddle.to_tensor(np.full((1000,), -1.0, np.float32))
+        out = layer(x).numpy()
+        assert out.std() > 0.01  # random slopes, not the fixed mean
+        assert ((-out >= 0.1 - 1e-6) & (-out <= 0.9 + 1e-6)).all()
+
+    def test_ctc_norm_by_times(self):
+        lp = F.log_softmax(t(8, 2, 5), axis=-1)
+        labels = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        il = paddle.to_tensor(np.array([8, 4]))
+        ll = paddle.to_tensor(np.array([2, 2]))
+        plain = F.ctc_loss(lp, labels, il, ll, reduction="none").numpy()
+        normed = F.ctc_loss(lp, labels, il, ll, reduction="none",
+                            norm_by_times=True).numpy()
+        np.testing.assert_allclose(normed, plain / np.array([8, 4]),
+                                   rtol=1e-6)
