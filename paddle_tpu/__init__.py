@@ -54,6 +54,7 @@ from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from . import io  # noqa: F401
 from . import vision  # noqa: F401
+from . import mix  # noqa: F401
 from . import jit  # noqa: F401
 from . import utils  # noqa: F401
 from .utils import metrics as metric  # noqa: F401

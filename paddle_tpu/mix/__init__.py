@@ -1,0 +1,6 @@
+"""paddle_tpu.mix — diffusion/multimodal model families.
+
+Reference analog: PaddleMIX (DiT/SD3 recipes the reference's BASELINE
+config 3 points at — out-of-repo domain suite, SURVEY.md §1 Lx row).
+"""
+from . import dit  # noqa: F401

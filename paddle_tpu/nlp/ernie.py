@@ -1,0 +1,251 @@
+"""ERNIE-family encoder — the BASELINE 'ERNIE-3.0 finetune (DP)' workload.
+
+Reference analog: PaddleNLP's ERNIE/BERT encoder stack (out-of-repo domain
+suite, SURVEY.md §1 Lx row; upstream-canonical, unverified — SURVEY.md §0):
+a bidirectional transformer encoder with learned position + token-type
+embeddings, post-LN blocks, a pooler, and MLM/classification heads, trained
+under fleet data parallelism.
+
+TPU-native design (mirrors nlp/llama.py): pure-functional params pytree with
+layers stacked on a leading [L] dim and scanned; `param_specs` carries the
+TP (mp) + ZeRO-3 (sharding) PartitionSpec table; DP finetune is just batch
+sharding over (dp, sharding). bf16 compute, f32 params/softmax.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 4
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2                 # classification head width
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def tiny(**over) -> "ErnieConfig":
+        base = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=64, type_vocab_size=2)
+        base.update(over)
+        return ErnieConfig(**base)
+
+    @staticmethod
+    def ernie3_base(**over) -> "ErnieConfig":
+        base = dict(vocab_size=40000, hidden_size=768, num_hidden_layers=12,
+                    num_attention_heads=12, intermediate_size=3072)
+        base.update(over)
+        return ErnieConfig(**base)
+
+
+def init_params(key: jax.Array, cfg: ErnieConfig) -> Dict[str, Any]:
+    D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+    pd = cfg.param_dtype
+    ks = jax.random.split(key, 12)
+
+    def norm(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(pd)
+
+    return {
+        "word_embeddings": norm(ks[0], (cfg.vocab_size, D)),
+        "position_embeddings": norm(ks[1], (cfg.max_position_embeddings, D)),
+        "token_type_embeddings": norm(ks[2], (cfg.type_vocab_size, D)),
+        "embed_norm_scale": jnp.ones((D,), pd),
+        "embed_norm_bias": jnp.zeros((D,), pd),
+        "layers": {
+            "qkv_w": norm(ks[3], (L, D, 3 * D)),
+            "qkv_b": jnp.zeros((L, 3 * D), pd),
+            "out_w": norm(ks[4], (L, D, D)),
+            "out_b": jnp.zeros((L, D), pd),
+            "attn_norm_scale": jnp.ones((L, D), pd),
+            "attn_norm_bias": jnp.zeros((L, D), pd),
+            "ffn_in_w": norm(ks[5], (L, D, F)),
+            "ffn_in_b": jnp.zeros((L, F), pd),
+            "ffn_out_w": norm(ks[6], (L, F, D)),
+            "ffn_out_b": jnp.zeros((L, D), pd),
+            "ffn_norm_scale": jnp.ones((L, D), pd),
+            "ffn_norm_bias": jnp.zeros((L, D), pd),
+        },
+        "pooler_w": norm(ks[7], (D, D)),
+        "pooler_b": jnp.zeros((D,), pd),
+        "classifier_w": norm(ks[8], (D, cfg.num_labels)),
+        "classifier_b": jnp.zeros((cfg.num_labels,), pd),
+        "mlm_transform_w": norm(ks[9], (D, D)),
+        "mlm_transform_b": jnp.zeros((D,), pd),
+        "mlm_norm_scale": jnp.ones((D,), pd),
+        "mlm_norm_bias": jnp.zeros((D,), pd),
+        "mlm_bias": jnp.zeros((cfg.vocab_size,), pd),
+    }
+
+
+def param_specs(cfg: ErnieConfig) -> Dict[str, Any]:
+    """TP (mp) + ZeRO-3 (sharding) table; the DP finetune config runs with
+    mp=1 and this degenerates to pure FSDP (SURVEY.md §2.3 DP/sharding)."""
+    return {
+        "word_embeddings": P("mp", "sharding"),
+        "position_embeddings": P(None, "sharding"),
+        "token_type_embeddings": P(None, "sharding"),
+        "embed_norm_scale": P(None),
+        "embed_norm_bias": P(None),
+        "layers": {
+            "qkv_w": P(None, "sharding", "mp"),
+            "qkv_b": P(None, "mp"),
+            "out_w": P(None, "mp", "sharding"),
+            "out_b": P(None, None),
+            "attn_norm_scale": P(None, None),
+            "attn_norm_bias": P(None, None),
+            "ffn_in_w": P(None, "sharding", "mp"),
+            "ffn_in_b": P(None, "mp"),
+            "ffn_out_w": P(None, "mp", "sharding"),
+            "ffn_out_b": P(None, None),
+            "ffn_norm_scale": P(None, None),
+            "ffn_norm_bias": P(None, None),
+        },
+        "pooler_w": P("sharding", "mp"),
+        "pooler_b": P("mp"),
+        "classifier_w": P("sharding", None),
+        "classifier_b": P(None),
+        "mlm_transform_w": P("sharding", "mp"),
+        "mlm_transform_b": P("mp"),
+        "mlm_norm_scale": P(None),
+        "mlm_norm_bias": P(None),
+        "mlm_bias": P("mp"),
+    }
+
+
+def batch_spec() -> P:
+    return P(("dp", "sharding"), None)
+
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32) +
+            bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _encoder_layer(x, lp, cfg: ErnieConfig, mask):
+    dt = cfg.dtype
+    B, S, D = x.shape
+    H, hd = cfg.num_attention_heads, cfg.head_dim
+    qkv = x @ lp["qkv_w"].astype(dt) + lp["qkv_b"].astype(dt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    scores = (q @ k.transpose(0, 1, 3, 2)).astype(jnp.float32) / \
+        math.sqrt(hd)
+    if mask is not None:
+        scores = scores + jnp.where(mask[:, None, None, :], 0.0, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+    ctx = (probs @ v).transpose(0, 2, 1, 3).reshape(B, S, D)
+    attn_out = ctx @ lp["out_w"].astype(dt) + lp["out_b"].astype(dt)
+    x = _layer_norm(x + attn_out, lp["attn_norm_scale"],
+                    lp["attn_norm_bias"], cfg.layer_norm_eps)
+    h = jax.nn.gelu(x @ lp["ffn_in_w"].astype(dt) +
+                    lp["ffn_in_b"].astype(dt), approximate=True)
+    h = h @ lp["ffn_out_w"].astype(dt) + lp["ffn_out_b"].astype(dt)
+    return _layer_norm(x + h, lp["ffn_norm_scale"], lp["ffn_norm_bias"],
+                       cfg.layer_norm_eps)
+
+
+def encode(params, input_ids, token_type_ids=None, attention_mask=None,
+           cfg: ErnieConfig = None):
+    """→ sequence output [B, S, D] (compute dtype)."""
+    dt = cfg.dtype
+    B, S = input_ids.shape
+    x = params["word_embeddings"][input_ids] + \
+        params["position_embeddings"][jnp.arange(S)][None] + \
+        params["token_type_embeddings"][
+            token_type_ids if token_type_ids is not None
+            else jnp.zeros_like(input_ids)]
+    x = _layer_norm(x.astype(dt), params["embed_norm_scale"],
+                    params["embed_norm_bias"], cfg.layer_norm_eps)
+
+    def body(h, lp):
+        fn = _encoder_layer
+        if cfg.remat:
+            fn = jax.checkpoint(fn, static_argnums=(2,))
+        return fn(h, lp, cfg, attention_mask), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
+
+
+def forward(params, input_ids, token_type_ids=None, attention_mask=None,
+            cfg: ErnieConfig = None):
+    """→ (sequence_output [B,S,D], pooled_output [B,D]) like the reference's
+    ErnieModel.forward."""
+    seq = encode(params, input_ids, token_type_ids, attention_mask, cfg)
+    pooled = jnp.tanh(seq[:, 0] @ params["pooler_w"].astype(cfg.dtype) +
+                      params["pooler_b"].astype(cfg.dtype))
+    return seq, pooled
+
+
+def cls_logits(params, pooled, cfg: ErnieConfig):
+    return (pooled.astype(jnp.float32) @
+            params["classifier_w"].astype(jnp.float32) +
+            params["classifier_b"].astype(jnp.float32))
+
+
+def mlm_logits(params, seq, cfg: ErnieConfig):
+    h = jax.nn.gelu(seq @ params["mlm_transform_w"].astype(cfg.dtype) +
+                    params["mlm_transform_b"].astype(cfg.dtype),
+                    approximate=True)
+    h = _layer_norm(h, params["mlm_norm_scale"], params["mlm_norm_bias"],
+                    cfg.layer_norm_eps)
+    # decoder tied to word embeddings (reference ties MLM head weights)
+    return (h.astype(jnp.float32) @
+            params["word_embeddings"].T.astype(jnp.float32) +
+            params["mlm_bias"].astype(jnp.float32))
+
+
+def finetune_loss(params, input_ids, labels, cfg: ErnieConfig,
+                  token_type_ids=None, attention_mask=None):
+    """Sequence-classification CE (the BASELINE finetune objective)."""
+    _, pooled = forward(params, input_ids, token_type_ids, attention_mask,
+                        cfg)
+    logits = cls_logits(params, pooled, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], 1))
+
+
+def mlm_loss(params, input_ids, mlm_labels, cfg: ErnieConfig,
+             token_type_ids=None, attention_mask=None, ignore_index=-100):
+    seq = encode(params, input_ids, token_type_ids, attention_mask, cfg)
+    logits = mlm_logits(params, seq, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    mask = mlm_labels != ignore_index
+    safe = jnp.where(mask, mlm_labels, 0)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
+def num_params(cfg: ErnieConfig) -> int:
+    D, F, L, V = (cfg.hidden_size, cfg.intermediate_size,
+                  cfg.num_hidden_layers, cfg.vocab_size)
+    per_layer = 3 * D * D + 3 * D + D * D + D + 2 * D * F + F + D + 4 * D
+    emb = V * D + cfg.max_position_embeddings * D + cfg.type_vocab_size * D
+    return emb + L * per_layer + 2 * D + (D * D + D) + \
+        (D * cfg.num_labels + cfg.num_labels) + (D * D + D + 2 * D + V)

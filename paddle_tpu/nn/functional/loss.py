@@ -411,8 +411,9 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
 
 
 def soft_margin_loss(input, label, reduction="mean", name=None):
+    # log(1+exp(-z)) = -log_sigmoid(z), the overflow-free form
     return eager(
-        lambda i, l: _reduce(jnp.log1p(jnp.exp(-l.astype(i.dtype) * i)),
+        lambda i, l: _reduce(-jax.nn.log_sigmoid(l.astype(i.dtype) * i),
                              reduction),
         (input, label), {}, name="soft_margin_loss")
 

@@ -368,11 +368,26 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
         return ops.concat(pieces, axis=-1)
 
     def forward(self, input, label):
+        """Routed target log-prob: head plus only each label's own cluster
+        entry is gathered — never materializes the [N, n_classes] matrix.
+        (Under static-shape XLA every cluster projection still runs for the
+        whole batch, but the tail's div_value down-projection keeps total
+        FLOPs ≪ a flat softmax; the dense form stays in log_prob().)"""
         from .. import ops
-        logp = self._full_log_prob(input)
-        picked = ops.take_along_axis(
-            logp, ops.reshape(label, [-1, 1]).astype("int64"), 1)
-        output = ops.reshape(picked, [-1])
+        label = label.astype("int64")
+        head_logp = F.log_softmax(self.head(input), axis=-1)
+        cut0 = self.cutoffs[0]
+        clipped = ops.clip(label, 0, cut0 - 1)
+        output = ops.take_along_axis(
+            head_logp, ops.reshape(clipped, [-1, 1]), 1).reshape([-1])
+        for i in range(self.n_clusters):
+            lo, hi = self.cutoffs[i], self.cutoffs[i + 1]
+            in_cluster = (label >= lo).logical_and(label < hi)
+            rel = ops.clip(label - lo, 0, hi - lo - 1)
+            c_logp = F.log_softmax(self.tail[i](input), axis=-1)
+            val = head_logp[:, cut0 + i] + ops.take_along_axis(
+                c_logp, ops.reshape(rel, [-1, 1]), 1).reshape([-1])
+            output = ops.where(in_cluster, val, output)
         loss = -output.mean()
         return output, loss
 

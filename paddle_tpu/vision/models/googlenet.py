@@ -1,0 +1,97 @@
+"""GoogLeNet (Inception v1) — python/paddle/vision/models/googlenet.py parity
+(upstream-canonical, unverified — SURVEY.md §0). Like the reference, forward
+returns (main, aux1, aux2) logits."""
+from ... import nn
+from ... import ops
+
+
+class _ConvReLU(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding),
+            nn.ReLU())
+
+
+class _Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _ConvReLU(in_c, c1, 1)
+        self.b3 = nn.Sequential(_ConvReLU(in_c, c3r, 1),
+                                _ConvReLU(c3r, c3, 3, padding=1))
+        self.b5 = nn.Sequential(_ConvReLU(in_c, c5r, 1),
+                                _ConvReLU(c5r, c5, 5, padding=2))
+        self.proj = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                  _ConvReLU(in_c, proj, 1))
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b3(x), self.b5(x), self.proj(x)],
+                          axis=1)
+
+
+class _AuxHead(nn.Layer):
+    def __init__(self, in_c, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(4)
+        self.conv = _ConvReLU(in_c, 128, 1)
+        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.drop = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x)).flatten(1)
+        x = self.drop(nn.functional.relu(self.fc1(x)))
+        return self.fc2(x)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvReLU(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, 2, padding=1),
+            _ConvReLU(64, 64, 1), _ConvReLU(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.inc3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, padding=1)
+        self.inc4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, padding=1)
+        self.inc5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        self.drop = nn.Dropout(0.4)
+        if num_classes > 0:
+            self.fc = nn.Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.inc3b(self.inc3a(x)))
+        x = self.inc4a(x)
+        aux1 = self.aux1(x) if self.num_classes > 0 else None
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        aux2 = self.aux2(x) if self.num_classes > 0 else None
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        x = self.drop(x.flatten(1))
+        if self.num_classes > 0:
+            return self.fc(x), aux1, aux2
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable offline "
+            "(paddle_tpu/vision/models/googlenet.py)")
+    return GoogLeNet(**kwargs)

@@ -185,10 +185,8 @@ class TestGridOps:
                                    atol=1e-5)
 
     def test_grid_sample_nearest_and_zeros(self):
-        x = paddle.to_tensor(np.arange(16, np.float32).reshape(1, 1, 4, 4)
-                             if False else
-                             np.arange(16, dtype=np.float32)
-                             .reshape(1, 1, 4, 4))
+        x = paddle.to_tensor(
+            np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
         # grid entirely out of range → zeros padding
         grid = paddle.to_tensor(np.full((1, 2, 2, 2), 5.0, np.float32))
         out = F.grid_sample(x, grid, mode="nearest", padding_mode="zeros")
@@ -298,3 +296,9 @@ class TestReviewRegressions:
                             norm_by_times=True).numpy()
         np.testing.assert_allclose(normed, plain / np.array([8, 4]),
                                    rtol=1e-6)
+
+    def test_soft_margin_large_logits_stable(self):
+        out = nn.SoftMarginLoss()(
+            paddle.to_tensor(np.array([200.0], np.float32)),
+            paddle.to_tensor(np.array([-1.0], np.float32)))
+        assert np.isfinite(out.numpy()) and out.numpy() == 200.0

@@ -1,0 +1,62 @@
+"""Vision model-zoo smoke tests (SURVEY.md §2.4 paddle.vision row): tiny
+inputs, output shapes, param sanity, one grad step per family."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+RNG = np.random.default_rng(3)
+
+
+def img(n=1, size=64):
+    return paddle.to_tensor(RNG.standard_normal((n, 3, size, size))
+                            .astype(np.float32))
+
+
+class TestZooForward:
+    @pytest.mark.parametrize("ctor,kw,size", [
+        (models.mobilenet_v3_small, dict(num_classes=10), 64),
+        (models.mobilenet_v3_large, dict(num_classes=10), 64),
+        (models.densenet121, dict(num_classes=10), 64),
+        (models.shufflenet_v2_x0_25, dict(num_classes=10), 64),
+        (models.shufflenet_v2_swish, dict(num_classes=10), 64),
+        (models.squeezenet1_0, dict(num_classes=10), 96),
+        (models.squeezenet1_1, dict(num_classes=10), 96),
+        (models.inception_v3, dict(num_classes=10), 128),
+    ])
+    def test_forward_shape(self, ctor, kw, size):
+        m = ctor(**kw)
+        m.eval()
+        out = m(img(2, size))
+        assert out.shape == [2, 10]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_googlenet_three_heads(self):
+        m = models.googlenet(num_classes=7)
+        m.eval()
+        out, aux1, aux2 = m(img(1, 96))
+        assert out.shape == [1, 7] and aux1.shape == [1, 7] \
+            and aux2.shape == [1, 7]
+
+    def test_pretrained_raises(self):
+        with pytest.raises(NotImplementedError):
+            models.densenet121(pretrained=True)
+
+    def test_one_train_step(self):
+        m = models.shufflenet_v2_x0_25(num_classes=4)
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=m.parameters())
+        loss = paddle.nn.CrossEntropyLoss()(
+            m(img(2, 64)), paddle.to_tensor(np.array([1, 3])))
+        loss.backward()
+        opt.step()
+        assert np.isfinite(loss.numpy())
+
+    def test_scaled_variants(self):
+        m = models.mobilenet_v3_small(scale=0.5, num_classes=5)
+        m.eval()
+        assert m(img(1, 64)).shape == [1, 5]
+        m2 = models.DenseNet(layers=169, num_classes=5)
+        m2.eval()
+        assert m2(img(1, 64)).shape == [1, 5]
