@@ -55,6 +55,8 @@ from . import profiler  # noqa: F401
 from . import io  # noqa: F401
 from . import vision  # noqa: F401
 from . import mix  # noqa: F401
+from . import sparse  # noqa: F401
+from . import quantization  # noqa: F401
 from . import jit  # noqa: F401
 from . import utils  # noqa: F401
 from .utils import metrics as metric  # noqa: F401

@@ -374,7 +374,7 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
         whole batch, but the tail's div_value down-projection keeps total
         FLOPs ≪ a flat softmax; the dense form stays in log_prob().)"""
         from .. import ops
-        label = label.astype("int64")
+        label = ops.reshape(label, [-1]).astype("int64")
         head_logp = F.log_softmax(self.head(input), axis=-1)
         cut0 = self.cutoffs[0]
         clipped = ops.clip(label, 0, cut0 - 1)

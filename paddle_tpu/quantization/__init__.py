@@ -1,0 +1,254 @@
+"""paddle.quantization — QAT fake-quant + PTQ observer calibration.
+
+Reference parity: python/paddle/quantization/ (QuantConfig, QAT, PTQ,
+observers, quanted layer wrappers — upstream-canonical, unverified,
+SURVEY.md §0, §2.4 quantization row).
+
+TPU-native design: fake-quant (quantize-dequantize) is a pure elementwise
+graph XLA fuses into the surrounding matmul; the straight-through estimator
+is the `x + stop_gradient(qdq(x) - x)` identity, which works unchanged under
+the eager tape and under jit. Observers are plain running-stat holders
+updated on host (calibration is a host-side loop in the reference too).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .. import nn
+from .. import ops
+
+__all__ = [
+    "BaseObserver", "AbsmaxObserver", "MinMaxObserver",
+    "ChannelWiseAbsmaxObserver", "FakeQuanterWithAbsMax", "QuantConfig",
+    "QAT", "PTQ", "QuantedLinear", "QuantedConv2D", "quant_dequant",
+]
+
+
+def quant_dequant(x, scale, bit_length=8):
+    """Symmetric quantize→dequantize with straight-through gradient."""
+    bound = float(2 ** (bit_length - 1) - 1)
+    s = scale if isinstance(scale, Tensor) else ops.full([1], float(scale))
+    s = ops.clip(s, 1e-9, 3.4e38)
+    q = ops.clip(ops.round(x / s * bound), -bound, bound) * s / bound
+    return x + (q - x.detach()).detach() if not x.stop_gradient else q
+
+
+class BaseObserver:
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._scale: Optional[np.ndarray] = None
+
+    def scales(self):
+        return self._scale
+
+    def observe(self, x: Tensor):
+        raise NotImplementedError
+
+    def __call__(self, x: Tensor) -> Tensor:
+        self.observe(x)
+        return x
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running absolute-max (per tensor)."""
+
+    def observe(self, x):
+        m = float(np.abs(x.numpy()).max())
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class MinMaxObserver(BaseObserver):
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._min = None
+        self._max = None
+
+    def observe(self, x):
+        a = x.numpy()
+        lo, hi = float(a.min()), float(a.max())
+        self._min = lo if self._min is None else min(self._min, lo)
+        self._max = hi if self._max is None else max(self._max, hi)
+        self._scale = max(abs(self._min), abs(self._max))
+
+
+class ChannelWiseAbsmaxObserver(BaseObserver):
+    """Per-output-channel absmax (weights; channel = last dim for Linear
+    [in, out], first dim for Conv2D [out, in, kh, kw])."""
+
+    def __init__(self, quant_bits=8, channel_axis=-1):
+        super().__init__(quant_bits)
+        self.channel_axis = channel_axis
+
+    def observe(self, x):
+        a = np.abs(x.numpy())
+        axes = tuple(i for i in range(a.ndim)
+                     if i != (self.channel_axis % a.ndim))
+        m = a.max(axis=axes)
+        self._scale = m if self._scale is None else np.maximum(
+            self._scale, m)
+
+
+class FakeQuanterWithAbsMax(nn.Layer):
+    """QAT activation/weight fake-quanter: tracks absmax, applies QDQ."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._scale = None
+
+    def forward(self, x):
+        if self.training:  # scales freeze at eval (reference behavior)
+            m = float(np.abs(x.numpy()).max())
+            if self._scale is None:
+                self._scale = m
+            else:
+                r = self.moving_rate
+                self._scale = r * self._scale + (1 - r) * m
+        if self._scale is None or self._scale <= 0:
+            return x
+        return quant_dequant(x, self._scale, self.quant_bits)
+
+    def scales(self):
+        return self._scale
+
+
+class QuantConfig:
+    """Simplified reference QuantConfig: one activation + one weight
+    quanter/observer factory, with per-layer-type overrides."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._type_configs = {}
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        for t in layer_types:
+            self._type_configs[t] = dict(activation=activation,
+                                         weight=weight)
+
+    def _for(self, layer):
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg["activation"], cfg["weight"]
+        return self.activation, self.weight
+
+
+def _make(factory):
+    if factory is None:
+        return None
+    return factory() if callable(factory) else copy.deepcopy(factory)
+
+
+def _qdq_weight(w, quanter, scale_shape=None):
+    """Shared observer→QDQ weight path for the quanted wrappers.
+    scale_shape reshapes a per-channel scale vector for broadcasting
+    (e.g. (-1, 1, 1, 1) for OIHW conv weights)."""
+    if quanter is None:
+        return w
+    if isinstance(quanter, BaseObserver):
+        quanter.observe(w)
+        sc = quanter.scales()
+        if sc is None:
+            return w
+        if np.ndim(sc):
+            arr = np.asarray(sc)
+            sc = Tensor(arr.reshape(scale_shape) if scale_shape else arr)
+        else:
+            sc = float(sc)
+        return quant_dequant(w, sc, quanter.quant_bits)
+    return quanter(w)
+
+
+class QuantedLinear(nn.Layer):
+    def __init__(self, layer: nn.Linear, act_q, w_q):
+        super().__init__()
+        self.inner = layer
+        self.activation_quanter = act_q
+        self.weight_quanter = w_q
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = _qdq_weight(self.inner.weight, self.weight_quanter)
+        return nn.functional.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(nn.Layer):
+    def __init__(self, layer: nn.Conv2D, act_q, w_q):
+        super().__init__()
+        self.inner = layer
+        self.activation_quanter = act_q
+        self.weight_quanter = w_q
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = _qdq_weight(self.inner.weight, self.weight_quanter,
+                        scale_shape=(-1, 1, 1, 1))
+        inner = self.inner
+        return nn.functional.conv2d(
+            x, w, inner.bias, inner._stride, inner._padding,
+            inner._dilation, inner._groups, inner._data_format)
+
+
+def _quanted(layer, act_q, w_q):
+    if isinstance(layer, nn.Linear):
+        return QuantedLinear(layer, act_q, w_q)
+    if isinstance(layer, nn.Conv2D):
+        return QuantedConv2D(layer, act_q, w_q)
+    return None
+
+
+class _Quantizer:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: nn.Layer, inplace: bool = False) -> nn.Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._swap(model)
+        return model
+
+    def _swap(self, layer: nn.Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            act_f, w_f = self._config._for(sub)
+            q = _quanted(sub, _make(act_f), _make(w_f))
+            if q is not None:
+                layer._sub_layers[name] = q
+            else:
+                self._swap(sub)
+
+
+class QAT(_Quantizer):
+    """Quantization-aware training: fake-quant in the forward, STE grads."""
+
+
+class PTQ(_Quantizer):
+    """Post-training quantization: run calibration batches through the
+    quantized model (observers record ranges), then convert() freezes
+    scales into plain fake-quant with fixed scale."""
+
+    def convert(self, model: nn.Layer, inplace: bool = False) -> nn.Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        for _, sub in model.named_sublayers(include_self=True):
+            for attr in ("activation_quanter", "weight_quanter"):
+                q = getattr(sub, attr, None)
+                if isinstance(q, BaseObserver) and q.scales() is not None:
+                    sc = q.scales()
+                    bits = q.quant_bits
+
+                    def frozen(x, _sc=sc, _b=bits):
+                        s = Tensor(np.asarray(_sc)) if np.ndim(_sc) else \
+                            float(_sc)
+                        return quant_dequant(x, s, _b)
+
+                    setattr(sub, attr, frozen)
+        return model

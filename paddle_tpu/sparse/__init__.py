@@ -1,0 +1,315 @@
+"""paddle.sparse — COO/CSR tensors and ops over jax.experimental.sparse.
+
+Reference parity: python/paddle/sparse/ + paddle/phi/kernels/sparse/
+(SparseCooTensor/SparseCsrTensor and the sparse op zoo — upstream-canonical,
+unverified, SURVEY.md §0, §2.1 sparse row, §2.4).
+
+TPU-native design: BCOO/BCSR are XLA-compilable sparse formats;
+`matmul` lowers to bcoo_dot_general (the hot path — sparse×dense on the
+MXU); elementwise ops run on the values buffer; binary sparse⊕sparse ops
+densify (the reference's CUDA pairwise-merge kernels have no XLA analog
+worth hand-writing at v1 scale).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..ops._registry import eager
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_sparse", "add", "subtract", "multiply",
+    "divide", "matmul", "masked_matmul", "relu", "abs", "sin", "tanh",
+    "sqrt", "pow", "neg", "cast", "transpose", "sum", "nn",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (phi::SparseCooTensor analog) over BCOO."""
+
+    def __init__(self, bcoo: jsparse.BCOO, stop_gradient: bool = True):
+        self._bcoo = bcoo
+        self.stop_gradient = stop_gradient
+
+    # -- paddle surface -----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._bcoo.dtype)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T)  # paddle: [sparse_dim, nnz]
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates(),
+                               self.stop_gradient)
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return False
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+            self._bcoo.sum_duplicates()), self.stop_gradient)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor over BCSR."""
+
+    def __init__(self, bcsr: jsparse.BCSR, stop_gradient: bool = True):
+        self._bcsr = bcsr
+        self.stop_gradient = stop_gradient
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._bcsr.dtype)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcsr.nse)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._bcsr.indices)
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcsr.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcsr.todense())
+
+    def is_sparse_coo(self) -> bool:
+        return False
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def to_sparse_coo(self, sparse_dim=None) -> SparseCooTensor:
+        return SparseCooTensor(self._bcsr.to_bcoo(), self.stop_gradient)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCooTensor:
+    """indices: [sparse_dim, nnz] (paddle layout); values: [nnz, ...]."""
+    idx = jnp.asarray(indices._data if isinstance(indices, Tensor)
+                      else indices, jnp.int32)
+    val = jnp.asarray(values._data if isinstance(values, Tensor) else values)
+    if dtype is not None:
+        val = val.astype(dtype)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
+        shape += val.shape[1:]
+    bcoo = jsparse.BCOO((val, idx.T), shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(bcoo, stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCsrTensor:
+    indptr = jnp.asarray(crows._data if isinstance(crows, Tensor) else crows,
+                         jnp.int32)
+    indices = jnp.asarray(cols._data if isinstance(cols, Tensor) else cols,
+                          jnp.int32)
+    val = jnp.asarray(values._data if isinstance(values, Tensor) else values)
+    if dtype is not None:
+        val = val.astype(dtype)
+    bcsr = jsparse.BCSR((val, indices, indptr),
+                        shape=tuple(int(s) for s in shape))
+    return SparseCsrTensor(bcsr, stop_gradient)
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def _coo(x) -> jsparse.BCOO:
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x._bcsr.to_bcoo()
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+def _dense(x):
+    if isinstance(x, Tensor):
+        return x._data
+    if is_sparse(x):
+        return _coo(x).todense()
+    return jnp.asarray(x)
+
+
+def _unary(x, fn) -> SparseCooTensor:
+    """Elementwise op that preserves zeros → apply to values only."""
+    b = _coo(x)
+    return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices),
+                                        shape=b.shape), x.stop_gradient)
+
+
+def relu(x):
+    return _unary(x, lambda v: jnp.maximum(v, 0))
+
+
+def abs(x):
+    return _unary(x, jnp.abs)
+
+
+def sin(x):
+    return _unary(x, jnp.sin)
+
+
+def tanh(x):
+    return _unary(x, jnp.tanh)
+
+
+def sqrt(x):
+    return _unary(x, jnp.sqrt)
+
+
+def neg(x):
+    return _unary(x, jnp.negative)
+
+
+def pow(x, factor):
+    return _unary(x, lambda v: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    b = _coo(x)
+    data = b.data if value_dtype is None else b.data.astype(value_dtype)
+    idx = b.indices if index_dtype is None else b.indices.astype(index_dtype)
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=b.shape),
+                           x.stop_gradient)
+
+
+def transpose(x, perm):
+    b = _coo(x)
+    return SparseCooTensor(b.transpose(tuple(perm)), x.stop_gradient)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    b = _coo(x)
+    out = b.sum() if axis is None else b.sum(axis)
+    out = getattr(out, "todense", lambda: out)()
+    out = jnp.asarray(out)
+    if dtype is not None:
+        out = out.astype(dtype)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return Tensor(out)
+
+
+def _binary_densify(x, y, fn):
+    out = fn(_dense(x), _dense(y))
+    # off-pattern artifacts (0/0 → NaN in divide) are zeros, not values
+    out = jnp.where(jnp.isnan(out) | jnp.isinf(out), 0.0, out)
+    nz = jnp.nonzero(out)  # dense result back to COO (v1 semantics)
+    idx = jnp.stack(nz, axis=1)
+    return SparseCooTensor(
+        jsparse.BCOO((out[nz], idx), shape=out.shape))
+
+
+def add(x, y):
+    if is_sparse(x) and is_sparse(y):
+        bx, by = _coo(x), _coo(y)
+        merged = jsparse.BCOO(
+            (jnp.concatenate([bx.data, by.data]),
+             jnp.concatenate([bx.indices, by.indices])),
+            shape=bx.shape).sum_duplicates()
+        return SparseCooTensor(merged)
+    return Tensor(_dense(x) + _dense(y))
+
+
+def subtract(x, y):
+    if is_sparse(x) and is_sparse(y):
+        return add(x, neg(y))
+    return Tensor(_dense(x) - _dense(y))
+
+
+def multiply(x, y):
+    return _binary_densify(x, y, jnp.multiply)
+
+
+def divide(x, y):
+    return _binary_densify(x, y, jnp.divide)
+
+
+def matmul(x, y):
+    """sparse @ dense (the hot op — bcoo_dot_general on the MXU) or
+    sparse @ sparse (densified result)."""
+    if is_sparse(x) and not is_sparse(y):
+        yd = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        out = jsparse.bcoo_dot_general(
+            _coo(x), yd,
+            dimension_numbers=(((len(x.shape) - 1,), (0,)), ((), ())))
+        return Tensor(out)
+    return Tensor(jnp.matmul(_dense(x), _dense(y)))
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense sampled at mask's sparsity pattern (SDDMM)."""
+    xd, yd = _dense(x), _dense(y)
+    b = _coo(mask)
+    rows, cols = b.indices[:, 0], b.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xd[rows], yd.T[cols])
+    return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))
+
+
+class _SparseNN:
+    """paddle.sparse.nn — layer-shaped wrappers."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class Softmax:
+        def __init__(self, axis=-1):
+            self.axis = axis
+
+        def __call__(self, x):
+            # softmax over the last dense axis of each row's nonzeros:
+            # densify with -inf fill (v1 semantics)
+            d = _dense(x)
+            filled = jnp.where(d == 0, -jnp.inf, d)
+            out = jax.nn.softmax(filled, axis=self.axis)
+            out = jnp.where(jnp.isnan(out) | (d == 0), 0.0, out)
+            nz = jnp.nonzero(d)
+            idx = jnp.stack(nz, axis=1)
+            return SparseCooTensor(
+                jsparse.BCOO((out[nz], idx), shape=out.shape))
+
+
+nn = _SparseNN()

@@ -1,0 +1,209 @@
+"""paddle.sparse + paddle.quantization tests (SURVEY.md §2.4 sparse /
+quantization rows)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse, quantization as Q
+
+RNG = np.random.default_rng(17)
+
+
+def rand_coo(shape=(4, 6), density=0.3, seed=0):
+    rng = np.random.default_rng(seed)
+    dense = rng.standard_normal(shape).astype(np.float32)
+    dense[rng.random(shape) > density] = 0.0
+    nz = np.nonzero(dense)
+    return sparse.sparse_coo_tensor(
+        np.stack(nz), dense[nz], shape=shape), dense
+
+
+class TestSparseCoo:
+    def test_create_and_to_dense(self):
+        s, dense = rand_coo()
+        assert s.is_sparse_coo() and not s.is_sparse_csr()
+        assert s.shape == [4, 6]
+        np.testing.assert_allclose(s.to_dense().numpy(), dense)
+        assert s.nnz == int((dense != 0).sum())
+        assert s.indices().shape[0] == 2
+        assert s.values().shape[0] == s.nnz
+
+    def test_coo_csr_round_trip(self):
+        s, dense = rand_coo(seed=1)
+        csr = s.to_sparse_csr()
+        assert csr.is_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.to_dense().numpy(), dense)
+
+    def test_csr_create(self):
+        # [[1, 0, 2], [0, 3, 0]]
+        csr = sparse.sparse_csr_tensor(
+            [0, 2, 3], [0, 2, 1], [1.0, 2.0, 3.0], shape=[2, 3])
+        np.testing.assert_allclose(
+            csr.to_dense().numpy(), [[1, 0, 2], [0, 3, 0]])
+        np.testing.assert_array_equal(csr.crows().numpy(), [0, 2, 3])
+
+    def test_unary_ops(self):
+        s, dense = rand_coo(seed=2)
+        np.testing.assert_allclose(sparse.relu(s).to_dense().numpy(),
+                                   np.maximum(dense, 0))
+        np.testing.assert_allclose(sparse.abs(s).to_dense().numpy(),
+                                   np.abs(dense))
+        np.testing.assert_allclose(sparse.sin(s).to_dense().numpy(),
+                                   np.sin(dense), rtol=1e-6)
+
+    def test_add_subtract_sparse(self):
+        a, da = rand_coo(seed=3)
+        b, db = rand_coo(seed=4)
+        np.testing.assert_allclose(sparse.add(a, b).to_dense().numpy(),
+                                   da + db, rtol=1e-6)
+        np.testing.assert_allclose(sparse.subtract(a, b).to_dense().numpy(),
+                                   da - db, rtol=1e-6)
+
+    def test_multiply_divide(self):
+        a, da = rand_coo(seed=5)
+        b, db = rand_coo(seed=6)
+        np.testing.assert_allclose(sparse.multiply(a, b).to_dense().numpy(),
+                                   da * db, rtol=1e-6)
+
+    def test_matmul_sparse_dense(self):
+        s, dense = rand_coo((4, 6), seed=7)
+        y = RNG.standard_normal((6, 3)).astype(np.float32)
+        out = sparse.matmul(s, paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5)
+
+    def test_masked_matmul(self):
+        mask, mdense = rand_coo((4, 4), seed=8)
+        x = RNG.standard_normal((4, 5)).astype(np.float32)
+        y = RNG.standard_normal((5, 4)).astype(np.float32)
+        out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                                   mask)
+        ref = (x @ y) * (mdense != 0)
+        np.testing.assert_allclose(out.to_dense().numpy(), ref, rtol=1e-5)
+
+    def test_transpose_sum(self):
+        s, dense = rand_coo(seed=9)
+        np.testing.assert_allclose(
+            sparse.transpose(s, [1, 0]).to_dense().numpy(), dense.T)
+        np.testing.assert_allclose(sparse.sum(s).numpy(), dense.sum(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(sparse.sum(s, axis=1).numpy(),
+                                   dense.sum(1), rtol=1e-6)
+
+
+class TestQuantization:
+    def test_quant_dequant_values(self):
+        x = paddle.to_tensor(np.array([0.0, 0.5, 1.0, -1.0], np.float32))
+        out = Q.quant_dequant(x, 1.0, bit_length=8).numpy()
+        np.testing.assert_allclose(out, [0.0, 0.5039, 1.0, -1.0], atol=1e-3)
+
+    def test_observers(self):
+        obs = Q.AbsmaxObserver()
+        obs.observe(paddle.to_tensor(np.array([1.0, -3.0], np.float32)))
+        obs.observe(paddle.to_tensor(np.array([2.0], np.float32)))
+        assert obs.scales() == 3.0
+        mm = Q.MinMaxObserver()
+        mm.observe(paddle.to_tensor(np.array([-5.0, 2.0], np.float32)))
+        assert mm.scales() == 5.0
+        cw = Q.ChannelWiseAbsmaxObserver(channel_axis=-1)
+        cw.observe(paddle.to_tensor(
+            np.array([[1.0, -2.0], [3.0, 0.5]], np.float32)))
+        np.testing.assert_allclose(cw.scales(), [3.0, 2.0])
+
+    def _model(self):
+        return paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+            paddle.nn.Linear(16, 4))
+
+    def test_qat_swaps_and_trains(self):
+        model = self._model()
+        cfg = Q.QuantConfig(activation=Q.FakeQuanterWithAbsMax,
+                            weight=Q.FakeQuanterWithAbsMax)
+        qmodel = Q.QAT(cfg).quantize(model)
+        assert isinstance(qmodel[0], Q.QuantedLinear)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=qmodel.parameters())
+        xs = RNG.standard_normal((16, 8)).astype(np.float32)
+        ys = RNG.integers(0, 4, 16)
+        first = last = None
+        for _ in range(15):
+            loss = paddle.nn.CrossEntropyLoss()(
+                qmodel(paddle.to_tensor(xs)), paddle.to_tensor(ys))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            v = float(loss.numpy())
+            first = first or v
+            last = v
+        assert last < first  # STE gradients flow through fake-quant
+
+    def test_ptq_calibrate_convert(self):
+        model = self._model()
+        cfg = Q.QuantConfig(activation=Q.AbsmaxObserver,
+                            weight=lambda: Q.ChannelWiseAbsmaxObserver(
+                                channel_axis=-1))
+        ptq = Q.PTQ(cfg)
+        qmodel = ptq.quantize(model)
+        xs = paddle.to_tensor(RNG.standard_normal((8, 8)).astype(np.float32))
+        qmodel.eval()
+        qmodel(xs)  # calibration pass populates observers
+        converted = ptq.convert(qmodel)
+        out = converted(xs)
+        ref = model(xs)
+        # int8 QDQ ≈ fp32 within quantization error
+        err = np.abs(out.numpy() - ref.numpy()).max()
+        assert err < 0.25, err
+        assert np.isfinite(out.numpy()).all()
+
+    def test_quanted_conv2d(self):
+        conv = paddle.nn.Conv2D(3, 8, 3, padding=1)
+        cfg = Q.QuantConfig(activation=None,
+                            weight=lambda: Q.ChannelWiseAbsmaxObserver(
+                                channel_axis=0))
+        q = Q.QAT(cfg).quantize(paddle.nn.Sequential(conv))
+        x = paddle.to_tensor(
+            RNG.standard_normal((1, 3, 8, 8)).astype(np.float32))
+        out = q(x)
+        ref = conv(x)
+        assert out.shape == ref.shape
+        assert np.abs(out.numpy() - ref.numpy()).max() < 0.2
+
+    def test_divide_same_pattern_no_nan(self):
+        a, da = rand_coo((3, 3), density=0.4, seed=10)
+        out = sparse.divide(a, a)
+        o = out.to_dense().numpy()
+        assert np.isfinite(o).all()
+        np.testing.assert_allclose(o, (da != 0).astype(np.float32))
+
+    def test_quant_bits_respected(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 100, dtype=np.float32))
+        q8 = Q.quant_dequant(x, 1.0, 8).numpy()
+        q4 = Q.quant_dequant(x, 1.0, 4).numpy()
+        assert len(np.unique(q4)) < len(np.unique(q8))
+        obs = Q.ChannelWiseAbsmaxObserver(quant_bits=4, channel_axis=-1)
+        lin = paddle.nn.Linear(4, 2)
+        ql = Q.QuantedLinear(lin, None, obs)
+        out = ql(paddle.to_tensor(np.eye(4, dtype=np.float32)))
+        # 4-bit grid: at most 15 distinct levels per channel
+        w = out.numpy()
+        for c in range(2):
+            assert len(np.unique(np.round(w[:, c], 6))) <= 15
+
+    def test_fake_quanter_frozen_at_eval(self):
+        fq = Q.FakeQuanterWithAbsMax()
+        fq.train()
+        fq(paddle.to_tensor(np.array([2.0], np.float32)))
+        s = fq.scales()
+        fq.eval()
+        fq(paddle.to_tensor(np.array([100.0], np.float32)))
+        assert fq.scales() == s  # eval must not mutate the scale
+
+    def test_adaptive_softmax_2d_label(self):
+        m = paddle.nn.AdaptiveLogSoftmaxWithLoss(8, 12, [4], div_value=2.0)
+        x = paddle.to_tensor(RNG.standard_normal((5, 8)).astype(np.float32))
+        lbl = paddle.to_tensor(RNG.integers(0, 12, (5, 1)))
+        out, loss = m(x, lbl)
+        assert out.shape == [5]
+        np.testing.assert_allclose(-out.numpy().mean(), loss.numpy(),
+                                   rtol=1e-5)
