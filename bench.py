@@ -43,7 +43,7 @@ def main():
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=8, num_attention_heads=16,
             num_key_value_heads=8, max_position_embeddings=2048)
-        batch, seq, timed_steps = 8, 2048, 10
+        batch, seq, timed_steps = 16, 2048, 10
     else:
         cfg = llama.LlamaConfig.tiny()
         batch, seq, timed_steps = 4, 128, 3

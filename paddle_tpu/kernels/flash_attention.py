@@ -48,12 +48,14 @@ def mha_ref(q, k, v, *, causal=False, bias=None, scale=None, mask=None):
 # streams KV blocks with an online-softmax accumulator in VMEM scratch.
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
-                      seq_k):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
+                      causal, scale, seq_k):
     from jax.experimental import pallas as pl
 
     # q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq_k, d]; o_ref: [1, block_q, d]
-    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    # int() coercion matters: np.int64 shape entries poison Mosaic's index
+    # arithmetic (i32*i64 muli) and dtype-conversion lowering
+    block_q, d = int(q_ref.shape[1]), int(q_ref.shape[2])
     q = q_ref[0].astype(jnp.float32) * scale
     q_idx = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
@@ -62,8 +64,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
 
     def body(kb, carry):
         m_prev, l_prev, acc = carry
-        k_blk = pl.load(k_ref, (0, pl.ds(kb * block_k, block_k), slice(None))).astype(jnp.float32)
-        v_blk = pl.load(v_ref, (0, pl.ds(kb * block_k, block_k), slice(None))).astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
             k_idx = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + kb * block_k
@@ -87,13 +89,25 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
     l0 = jnp.zeros((block_q,), dtype=jnp.float32)
     a0 = jnp.zeros((block_q, d), dtype=jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, n_iter, body, (m0, l0, a0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # log-sum-exp residual for the flash backward (softmax re-derivable as
+    # exp(s - lse) without the O(S^2) probs tensor). Kept [.., 1]-shaped:
+    # TPU block tiling wants >=2 trailing dims.
+    lse_ref[0] = (m + jnp.log(l_safe))[:, None]
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k"))
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret",
+                                             "return_lse"))
 def flash_attention_pallas(q, k, v, causal=False, scale=None, block_q=256,
-                           block_k=256):
-    """q,k,v: [B, S, H, D] (equal heads; GQA expanded by caller)."""
+                           block_k=256, interpret=False, return_lse=False):
+    """q,k,v: [B, S, H, D] (equal heads; GQA expanded by caller).
+
+    Traced with x64 disabled: the framework enables jax_enable_x64 globally
+    (paddle dtype parity), but 64-bit index arithmetic is untileable for
+    Mosaic (i64->f32 casts recurse in its lowering).
+    """
     from jax.experimental import pallas as pl
 
     b, sq, h, d = q.shape
@@ -107,19 +121,196 @@ def flash_attention_pallas(q, k, v, causal=False, scale=None, block_q=256,
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     grid = (b * h, sq // block_q)
-    out = pl.pallas_call(
+    with jax.enable_x64(False):
+        out, lse = _fwd_call(qt, kt, vt, grid, block_q, block_k, causal,
+                             scale, sk, b, h, sq, d, q.dtype, interpret)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    if return_lse:
+        return out, lse.reshape(b, h, sq)
+    return out
+
+
+def _fwd_call(qt, kt, vt, grid, block_q, block_k, causal, scale, sk, b, h,
+              sq, d, out_dtype, interpret):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_k=block_k, causal=causal,
                           scale=scale, seq_k=sk),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=[jax.ShapeDtypeStruct((b * h, sq, d), out_dtype),
+                   jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32)],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0)),
             pl.BlockSpec((1, sk, d), lambda bh, qb: (bh, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda bh, qb: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0)),
+        out_specs=[pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0)),
+                   pl.BlockSpec((1, block_q, 1), lambda bh, qb: (bh, qb, 0))],
+        interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernels (backward). Standard flash backward: softmax re-derived
+# per block from the LSE residual; D = rowsum(dO*O). Two kernels — one
+# produces dq (grid over q blocks, loop over kv), one produces dk/dv (grid
+# over kv blocks, loop over q) — so neither needs atomics.
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
+                         dq_ref, *, block_k, causal, scale, seq_k):
+    from jax.experimental import pallas as pl
+
+    block_q, d = int(q_ref.shape[1]), int(q_ref.shape[2])
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    dcap = dcap_ref[0, :, 0]
+    q_idx = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    q_offset = pl.program_id(1) * block_q
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_idx = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1) + kb * block_k
+            s = jnp.where((q_idx + q_offset) >= k_idx, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dcap[:, None]) * scale
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    n_kb = seq_k // block_k
+    if causal:
+        last = (q_offset + block_q + block_k - 1) // block_k
+        n_iter = jnp.minimum(last, n_kb)
+    else:
+        n_iter = n_kb
+    dq = jax.lax.fori_loop(0, n_iter,
+                           body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
+                          dk_ref, dv_ref, *, block_q, causal, scale, seq_q):
+    from jax.experimental import pallas as pl
+
+    block_k, d = int(k_ref.shape[1]), int(k_ref.shape[2])
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    k_offset = pl.program_id(1) * block_k
+    k_idx = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0]
+        dcap = dcap_ref[0, pl.ds(qb * block_q, block_q), 0]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + qb * block_q
+            s = jnp.where(q_idx >= (k_idx + k_offset), s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dcap[:, None]) * scale
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    n_qb = seq_q // block_q
+    if causal:
+        # q blocks before the diagonal see nothing of this kv block
+        start = k_offset // block_q
+    else:
+        start = 0
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, n_qb, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def flash_attention_pallas_bwd(q, k, v, out, lse, g, causal=False,
+                               scale=None, block_q=256, block_k=256,
+                               interpret=False):
+    """Blocked flash backward. q,k,v,out,g: [B,S,H,D]; lse: [B,H,S].
+    Returns (dq, dk, dv) with O(S) memory per block row."""
+    from jax.experimental import pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    dot = g.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    ot = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    lse_t = lse.reshape(b * h, sq, 1)
+    # D_i = rowsum(dO * O) — cheap, fused by XLA
+    dcap = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                   axis=-1, keepdims=True)
+    with jax.enable_x64(False):  # see flash_attention_pallas docstring
+        return _bwd_call(qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d,
+                         block_q, block_k, causal, scale, q.dtype, k.dtype,
+                         v.dtype, interpret)
+
+
+def _bwd_call(qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d, block_q,
+              block_k, causal, scale, q_dtype, k_dtype, v_dtype, interpret):
+    from jax.experimental import pallas as pl
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          causal=causal, scale=scale, seq_k=sk),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q_dtype),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qb: (bh, qb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0)),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse_t, dcap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          causal=causal, scale=scale, seq_q=sq),
+        out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k_dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), v_dtype)],
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, sq, d), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda bh, kb: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse_t, dcap)
+
+    def back(x):
+        return x.reshape(b, h, -1, d).transpose(0, 2, 1, 3)
+
+    return back(dq), back(dk), back(dv)
 
 
 def _use_pallas(x):
@@ -139,33 +330,70 @@ def _use_pallas(x):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention_fwd(q, k, v, causal=False, scale=None):
-    """Differentiable flash attention entry. Forward may run the Pallas
-    kernel; backward uses the exact reference (recomputed — flash-style
-    memory behavior, O(S) residuals instead of O(S^2))."""
+    """Differentiable flash attention entry. When the Pallas forward runs,
+    the backward runs the blocked Pallas flash-backward kernels off the LSE
+    residual (O(S) memory); otherwise both directions use the exact
+    reference."""
     return _flash_impl(q, k, v, causal, scale)
 
 
+def _pallas_ok(q, k):
+    # sq == sk required: the kernels pin the causal diagonal at offset 0,
+    # while rectangular attention aligns it bottom-right (mha_ref tril
+    # k=sk-sq) — e.g. chunked prefill against a longer KV cache
+    return (_use_pallas(q) and q.shape[1] == k.shape[1]
+            and q.shape[1] % 256 == 0)
+
+
 def _flash_impl(q, k, v, causal, scale):
-    hq, hkv = q.shape[2], k.shape[2]
-    if _use_pallas(q) and q.shape[1] % 256 == 0 and k.shape[1] % 256 == 0:
-        if hq != hkv:
-            rep = hq // hkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+    if _pallas_ok(q, k):
+        ke, ve = _expand_gqa(q, k, v)
         try:
-            return flash_attention_pallas(q, k, v, causal=causal, scale=scale)
+            return flash_attention_pallas(q, ke, ve, causal=causal,
+                                          scale=scale)
         except Exception:
             pass
     return mha_ref(q, k, v, causal=causal, scale=scale)
 
 
+def _expand_gqa(q, k, v):
+    rep = q.shape[2] // k.shape[2]
+    if rep == 1:
+        return k, v
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
+
 def _flash_fwd_rule(q, k, v, causal, scale):
-    out = _flash_impl(q, k, v, causal, scale)
-    return out, (q, k, v)
+    if _pallas_ok(q, k):
+        ke, ve = _expand_gqa(q, k, v)
+        try:
+            out, lse = flash_attention_pallas(q, ke, ve, causal=causal,
+                                              scale=scale, return_lse=True)
+            # residuals keep the ORIGINAL k/v (their static head count tells
+            # the bwd how to reduce GQA grads); expansion is re-done there
+            return out, (q, k, v, out, lse)
+        except Exception:
+            pass
+    return mha_ref(q, k, v, causal=causal, scale=scale), (q, k, v, None,
+                                                          None)
 
 
 def _flash_bwd_rule(causal, scale, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
+    if lse is not None:
+        try:
+            hq, hkv = q.shape[2], k.shape[2]
+            ke, ve = _expand_gqa(q, k, v)
+            dq, dk, dv = flash_attention_pallas_bwd(
+                q, ke, ve, out, lse, g, causal=causal, scale=scale)
+            if hq != hkv:  # GQA: sum grads over each KV head's query group
+                rep = hq // hkv
+                b, s, _, d = dk.shape
+                dk = dk.reshape(b, s, hkv, rep, d).sum(axis=3)
+                dv = dv.reshape(b, s, hkv, rep, d).sum(axis=3)
+            return dq, dk, dv
+        except Exception:  # e.g. VMEM overflow at extreme seq — exact path
+            pass
     _, vjp = jax.vjp(lambda q_, k_, v_: mha_ref(q_, k_, v_, causal=causal,
                                                 scale=scale), q, k, v)
     return vjp(g)

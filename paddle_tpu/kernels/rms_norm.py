@@ -29,7 +29,7 @@ def _rms_norm_kernel(x_ref, w_ref, o_ref, *, eps):
     x = x_ref[:].astype(jnp.float32)
     ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     out = x * jax.lax.rsqrt(ms + eps)
-    o_ref[:] = (out * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    o_ref[:] = (out * w_ref[0].astype(jnp.float32)).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("epsilon", "block_rows"))
@@ -48,16 +48,18 @@ def rms_norm_pallas(x, weight, epsilon: float = 1e-6, block_rows: int = 256):
     if pad:
         xr = jnp.pad(xr, ((0, pad), (0, 0)))
     grid = (xr.shape[0] // blk,)
-    out = pl.pallas_call(
-        functools.partial(_rms_norm_kernel, eps=epsilon),
-        out_shape=jax.ShapeDtypeStruct(xr.shape, x.dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((blk, d), lambda i: (i, 0)),
-            pl.BlockSpec((d,), lambda i: (0,)),
-        ],
-        out_specs=pl.BlockSpec((blk, d), lambda i: (i, 0)),
-    )(xr, weight)
+    with jax.enable_x64(False):  # 64-bit index math breaks Mosaic lowering
+        out = pl.pallas_call(
+            functools.partial(_rms_norm_kernel, eps=epsilon),
+            out_shape=jax.ShapeDtypeStruct(xr.shape, x.dtype),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                # weight as a (1, d) block: TPU tiling wants 2D trailing dims
+                pl.BlockSpec((1, d), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((blk, d), lambda i: (i, 0)),
+        )(xr, weight.reshape(1, d))
     if pad:
         out = out[:n]
     return out.reshape(orig_shape)
