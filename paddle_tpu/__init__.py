@@ -57,6 +57,10 @@ from . import vision  # noqa: F401
 from . import mix  # noqa: F401
 from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401
+from . import distribution  # noqa: F401
+from . import signal  # noqa: F401
+from . import geometric  # noqa: F401
+from . import inference  # noqa: F401
 from . import jit  # noqa: F401
 from . import utils  # noqa: F401
 from .utils import metrics as metric  # noqa: F401

@@ -1,0 +1,893 @@
+"""paddle.distribution — probability distributions + kl_divergence.
+
+Reference parity: python/paddle/distribution/ (Distribution base with
+sample/rsample/log_prob/entropy, the distribution zoo, the kl registry and
+TransformedDistribution — upstream-canonical, unverified, SURVEY.md §0,
+§2.4 python-API row).
+
+TPU-native design: densities/entropies/KLs are raw jnp formulas routed
+through the eager op dispatch (`_e`), so Tensor-valued parameters stay on
+the autograd tape — log_prob(logits).backward() works for policy gradients,
+and Normal.rsample is the reparameterized pathwise estimator. Sampling draws
+from the framework RNG key chain; special functions come from
+jax.scipy.special and trace/fuse under jit like any other op.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..core.tensor import Tensor
+from ..core import random as prandom
+from ..ops._registry import eager
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical", "Beta",
+    "Dirichlet", "Exponential", "Gamma", "Laplace", "Gumbel", "LogNormal",
+    "Multinomial", "Poisson", "StudentT", "Geometric", "Independent",
+    "TransformedDistribution", "kl_divergence", "register_kl",
+    "AffineTransform", "ExpTransform", "SigmoidTransform", "TanhTransform",
+]
+
+_LOG_2PI = math.log(2 * math.pi)
+
+
+def _raw(x, dtype=jnp.float32):
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if jnp.issubdtype(a.dtype, jnp.integer) or a.dtype == jnp.float64:
+        a = a.astype(dtype)
+    return a
+
+
+def _param(x):
+    """Maybe-Tensor parameter: Tensors stay Tensors (tape-tracked through
+    `_e`); scalars/arrays become f32 jnp arrays."""
+    if isinstance(x, Tensor):
+        if jnp.issubdtype(x._data.dtype, jnp.integer) or \
+                x._data.dtype == jnp.float64:
+            from .. import ops
+            return ops.cast(x, "float32")
+        return x
+    return _raw(x)
+
+
+def _e(fn, *args, name="distribution"):
+    """eager-dispatch wrapper: Tensor args are differentiable inputs."""
+    return eager(fn, args, {}, name=name)
+
+
+def _key():
+    return prandom.next_key()
+
+
+def _shape(sample_shape, batch_shape, event_shape=()):
+    return tuple(sample_shape) + tuple(batch_shape) + tuple(event_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(int(s) for s in batch_shape)
+        self._event_shape = tuple(int(s) for s in event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self) -> Tensor:
+        raise NotImplementedError
+
+    @property
+    def variance(self) -> Tensor:
+        raise NotImplementedError
+
+    def sample(self, shape=()) -> Tensor:
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()) -> Tensor:
+        raise NotImplementedError
+
+    def log_prob(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def prob(self, value) -> Tensor:
+        return self.log_prob(value).exp()
+
+    def entropy(self) -> Tensor:
+        raise NotImplementedError
+
+    def kl_divergence(self, other) -> Tensor:
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(_raw(loc)),
+                                              jnp.shape(_raw(scale))))
+
+    @property
+    def mean(self):
+        return _e(lambda m: jnp.broadcast_to(m, self._batch_shape), self.loc)
+
+    @property
+    def variance(self):
+        return _e(lambda s: jnp.broadcast_to(s ** 2, self._batch_shape),
+                  self.scale)
+
+    @property
+    def stddev(self):
+        return _e(lambda s: jnp.broadcast_to(s, self._batch_shape),
+                  self.scale)
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(_key(), _shape(shape, self._batch_shape))
+        return _e(lambda m, s: m + s * eps, self.loc, self.scale,
+                  name="normal_rsample")
+
+    def log_prob(self, value):
+        return _e(lambda m, s, v: -((v - m) ** 2) / (2 * s ** 2)
+                  - jnp.log(s) - 0.5 * _LOG_2PI,
+                  self.loc, self.scale, value, name="normal_log_prob")
+
+    def entropy(self):
+        return _e(lambda s: jnp.broadcast_to(
+            0.5 + 0.5 * _LOG_2PI + jnp.log(s), self._batch_shape),
+            self.scale)
+
+    def cdf(self, value):
+        return _e(lambda m, s, v: jsp.ndtr((v - m) / s),
+                  self.loc, self.scale, value)
+
+    def icdf(self, value):
+        return _e(lambda m, s, v: m + s * jsp.ndtri(v),
+                  self.loc, self.scale, value)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _param(low)
+        self.high = _param(high)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(_raw(low)),
+                                              jnp.shape(_raw(high))))
+
+    @property
+    def mean(self):
+        return _e(lambda lo, hi: jnp.broadcast_to((lo + hi) / 2,
+                                                  self._batch_shape),
+                  self.low, self.high)
+
+    @property
+    def variance(self):
+        return _e(lambda lo, hi: jnp.broadcast_to((hi - lo) ** 2 / 12,
+                                                  self._batch_shape),
+                  self.low, self.high)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self._batch_shape))
+        return _e(lambda lo, hi: lo + (hi - lo) * u, self.low, self.high)
+
+    def log_prob(self, value):
+        return _e(lambda lo, hi, v: jnp.where(
+            (v >= lo) & (v < hi), -jnp.log(hi - lo), -jnp.inf),
+            self.low, self.high, value)
+
+    def entropy(self):
+        return _e(lambda lo, hi: jnp.broadcast_to(jnp.log(hi - lo),
+                                                  self._batch_shape),
+                  self.low, self.high)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _param(probs)
+        super().__init__(jnp.shape(_raw(probs)))
+
+    @property
+    def mean(self):
+        return _e(lambda p: p, self.probs)
+
+    @property
+    def variance(self):
+        return _e(lambda p: p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self._batch_shape))
+        return Tensor((u < _raw(self.probs)).astype(jnp.float32))
+
+    rsample = sample  # no reparameterization; paddle returns floats
+
+    def log_prob(self, value):
+        def raw(p, v):
+            pc = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(pc) + (1 - v) * jnp.log1p(-pc)
+        return _e(raw, self.probs, value, name="bernoulli_log_prob")
+
+    def entropy(self):
+        def raw(p):
+            pc = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(pc * jnp.log(pc) + (1 - pc) * jnp.log1p(-pc))
+        return _e(raw, self.probs)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self._logits = _param(logits)
+            self._from_logits = True
+        else:
+            self._logits = _param(probs)
+            self._from_logits = False
+        super().__init__(jnp.shape(_raw(self._logits))[:-1])
+
+    def _log_probs(self, raw_params):
+        if self._from_logits:
+            return jax.nn.log_softmax(raw_params, axis=-1)
+        lp = jnp.log(jnp.clip(raw_params, 1e-30, None))
+        return jax.nn.log_softmax(lp, axis=-1)
+
+    @property
+    def logits(self) -> Tensor:
+        return _e(self._log_probs, self._logits)
+
+    @property
+    def probs(self) -> Tensor:
+        return _e(lambda lg: jnp.exp(self._log_probs(lg)), self._logits)
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(
+            _key(), self._log_probs(_raw(self._logits)),
+            shape=_shape(shape, self._batch_shape))
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        idx = _raw(value, jnp.int32).astype(jnp.int32)
+        return _e(lambda lg: jnp.take_along_axis(
+            self._log_probs(lg), idx[..., None], axis=-1)[..., 0],
+            self._logits, name="categorical_log_prob")
+
+    def entropy(self):
+        def raw(lg):
+            lp = self._log_probs(lg)
+            return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+        return _e(raw, self._logits)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _param(alpha)
+        self.beta = _param(beta)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(_raw(alpha)),
+                                              jnp.shape(_raw(beta))))
+
+    @property
+    def mean(self):
+        return _e(lambda a, b: a / (a + b), self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        def raw(a, b):
+            s = a + b
+            return a * b / (s * s * (s + 1))
+        return _e(raw, self.alpha, self.beta)
+
+    def rsample(self, shape=()):
+        # gamma-ratio reparameterization (jax gamma sampler is
+        # implicitly differentiable)
+        sh = _shape(shape, self._batch_shape)
+        k1, k2 = jax.random.split(_key())
+
+        def raw(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, sh))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, sh))
+            return ga / (ga + gb)
+        return _e(raw, self.alpha, self.beta, name="beta_rsample")
+
+    def log_prob(self, value):
+        def raw(a, b, v):
+            lbeta = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+        return _e(raw, self.alpha, self.beta, value, name="beta_log_prob")
+
+    def entropy(self):
+        def raw(a, b):
+            lbeta = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+            return (lbeta - (a - 1) * jsp.digamma(a)
+                    - (b - 1) * jsp.digamma(b)
+                    + (a + b - 2) * jsp.digamma(a + b))
+        return _e(raw, self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _param(concentration)
+        shp = jnp.shape(_raw(concentration))
+        super().__init__(shp[:-1], shp[-1:])
+
+    @property
+    def mean(self):
+        return _e(lambda a: a / jnp.sum(a, -1, keepdims=True),
+                  self.concentration)
+
+    @property
+    def variance(self):
+        def raw(a):
+            a0 = jnp.sum(a, -1, keepdims=True)
+            m = a / a0
+            return m * (1 - m) / (a0 + 1)
+        return _e(raw, self.concentration)
+
+    def rsample(self, shape=()):
+        sh = _shape(shape, self._batch_shape)
+        key = _key()
+
+        def raw(a):
+            g = jax.random.gamma(key, jnp.broadcast_to(
+                a, sh + self._event_shape))
+            return g / jnp.sum(g, -1, keepdims=True)
+        return _e(raw, self.concentration, name="dirichlet_rsample")
+
+    def log_prob(self, value):
+        def raw(a, v):
+            norm = jnp.sum(jsp.gammaln(a), -1) - jsp.gammaln(jnp.sum(a, -1))
+            return jnp.sum((a - 1) * jnp.log(v), -1) - norm
+        return _e(raw, self.concentration, value, name="dirichlet_log_prob")
+
+    def entropy(self):
+        def raw(a):
+            a0 = jnp.sum(a, -1)
+            k = a.shape[-1]
+            lnB = jnp.sum(jsp.gammaln(a), -1) - jsp.gammaln(a0)
+            return (lnB + (a0 - k) * jsp.digamma(a0)
+                    - jnp.sum((a - 1) * jsp.digamma(a), -1))
+        return _e(raw, self.concentration)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _param(rate)
+        super().__init__(jnp.shape(_raw(rate)))
+
+    @property
+    def mean(self):
+        return _e(lambda r: 1.0 / r, self.rate)
+
+    @property
+    def variance(self):
+        return _e(lambda r: 1.0 / r ** 2, self.rate)
+
+    def rsample(self, shape=()):
+        e = jax.random.exponential(_key(), _shape(shape, self._batch_shape))
+        return _e(lambda r: e / r, self.rate, name="exponential_rsample")
+
+    def log_prob(self, value):
+        return _e(lambda r, v: jnp.log(r) - r * v, self.rate, value,
+                  name="exponential_log_prob")
+
+    def entropy(self):
+        return _e(lambda r: 1.0 - jnp.log(r), self.rate)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _param(concentration)
+        self.rate = _param(rate)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(_raw(concentration)), jnp.shape(_raw(rate))))
+
+    @property
+    def mean(self):
+        return _e(lambda a, r: a / r, self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return _e(lambda a, r: a / r ** 2, self.concentration, self.rate)
+
+    def rsample(self, shape=()):
+        sh = _shape(shape, self._batch_shape)
+        key = _key()
+        return _e(lambda a, r: jax.random.gamma(
+            key, jnp.broadcast_to(a, sh)) / r,
+            self.concentration, self.rate, name="gamma_rsample")
+
+    def log_prob(self, value):
+        return _e(lambda a, r, v: a * jnp.log(r) + (a - 1) * jnp.log(v)
+                  - r * v - jsp.gammaln(a),
+                  self.concentration, self.rate, value,
+                  name="gamma_log_prob")
+
+    def entropy(self):
+        return _e(lambda a, r: a - jnp.log(r) + jsp.gammaln(a)
+                  + (1 - a) * jsp.digamma(a),
+                  self.concentration, self.rate)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(_raw(loc)),
+                                              jnp.shape(_raw(scale))))
+
+    @property
+    def mean(self):
+        return _e(lambda m: jnp.broadcast_to(m, self._batch_shape), self.loc)
+
+    @property
+    def variance(self):
+        return _e(lambda s: jnp.broadcast_to(2 * s ** 2, self._batch_shape),
+                  self.scale)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self._batch_shape),
+                               minval=-0.5, maxval=0.5)
+        return _e(lambda m, s: m - s * jnp.sign(u)
+                  * jnp.log1p(-2 * jnp.abs(u)),
+                  self.loc, self.scale, name="laplace_rsample")
+
+    def log_prob(self, value):
+        return _e(lambda m, s, v: -jnp.abs(v - m) / s - jnp.log(2 * s),
+                  self.loc, self.scale, value, name="laplace_log_prob")
+
+    def entropy(self):
+        return _e(lambda s: jnp.broadcast_to(1 + jnp.log(2 * s),
+                                             self._batch_shape), self.scale)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(_raw(loc)),
+                                              jnp.shape(_raw(scale))))
+
+    @property
+    def mean(self):
+        return _e(lambda m, s: jnp.broadcast_to(
+            m + s * np.euler_gamma, self._batch_shape), self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return _e(lambda s: jnp.broadcast_to(
+            (math.pi ** 2 / 6) * s ** 2, self._batch_shape), self.scale)
+
+    def rsample(self, shape=()):
+        g = jax.random.gumbel(_key(), _shape(shape, self._batch_shape))
+        return _e(lambda m, s: m + s * g, self.loc, self.scale,
+                  name="gumbel_rsample")
+
+    def log_prob(self, value):
+        def raw(m, s, v):
+            z = (v - m) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _e(raw, self.loc, self.scale, value, name="gumbel_log_prob")
+
+    def entropy(self):
+        return _e(lambda s: jnp.broadcast_to(
+            jnp.log(s) + 1 + np.euler_gamma, self._batch_shape), self.scale)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(_raw(loc)),
+                                              jnp.shape(_raw(scale))))
+
+    @property
+    def mean(self):
+        return _e(lambda m, s: jnp.exp(m + s ** 2 / 2), self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return _e(lambda m, s: (jnp.exp(s ** 2) - 1)
+                  * jnp.exp(2 * m + s ** 2), self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(_key(), _shape(shape, self._batch_shape))
+        return _e(lambda m, s: jnp.exp(m + s * eps), self.loc, self.scale,
+                  name="lognormal_rsample")
+
+    def log_prob(self, value):
+        def raw(m, s, v):
+            lv = jnp.log(v)
+            return (-((lv - m) ** 2) / (2 * s ** 2) - jnp.log(s)
+                    - 0.5 * _LOG_2PI - lv)
+        return _e(raw, self.loc, self.scale, value,
+                  name="lognormal_log_prob")
+
+    def entropy(self):
+        return _e(lambda m, s: 0.5 + 0.5 * _LOG_2PI + jnp.log(s) + m,
+                  self.loc, self.scale)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _param(probs)
+        shp = jnp.shape(_raw(probs))
+        super().__init__(shp[:-1], shp[-1:])
+
+    @property
+    def mean(self):
+        n = self.total_count
+        return _e(lambda p: n * p / jnp.sum(p, -1, keepdims=True),
+                  self.probs)
+
+    @property
+    def variance(self):
+        n = self.total_count
+
+        def raw(p):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            return n * pn * (1 - pn)
+        return _e(raw, self.probs)
+
+    def sample(self, shape=()):
+        p = _raw(self.probs)
+        p = p / jnp.sum(p, -1, keepdims=True)
+        logits = jnp.log(jnp.clip(p, 1e-30, None))
+        draws = jax.random.categorical(
+            _key(), logits,
+            shape=(self.total_count,) + _shape(shape, self._batch_shape))
+        k = p.shape[-1]
+        return Tensor(jax.nn.one_hot(draws, k).sum(axis=0)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        n = self.total_count
+
+        def raw(p, v):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            logp = jnp.log(jnp.clip(pn, 1e-30, None))
+            coeff = jsp.gammaln(jnp.asarray(n + 1.0)) \
+                - jnp.sum(jsp.gammaln(v + 1.0), -1)
+            return coeff + jnp.sum(v * logp, -1)
+        return _e(raw, self.probs, value, name="multinomial_log_prob")
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _param(rate)
+        super().__init__(jnp.shape(_raw(rate)))
+
+    @property
+    def mean(self):
+        return _e(lambda r: r, self.rate)
+
+    @property
+    def variance(self):
+        return _e(lambda r: r, self.rate)
+
+    def sample(self, shape=()):
+        out = jax.random.poisson(_key(), _raw(self.rate),
+                                 _shape(shape, self._batch_shape))
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        return _e(lambda r, v: v * jnp.log(r) - r - jsp.gammaln(v + 1.0),
+                  self.rate, value, name="poisson_log_prob")
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc, scale, name=None):
+        self.df = _param(df)
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(_raw(df)), jnp.shape(_raw(loc)),
+            jnp.shape(_raw(scale))))
+
+    @property
+    def mean(self):
+        return _e(lambda df, m: jnp.broadcast_to(
+            jnp.where(df > 1, m, jnp.nan), self._batch_shape),
+            self.df, self.loc)
+
+    @property
+    def variance(self):
+        return _e(lambda df, s: jnp.broadcast_to(
+            jnp.where(df > 2, s ** 2 * df / (df - 2), jnp.inf),
+            self._batch_shape), self.df, self.scale)
+
+    def rsample(self, shape=()):
+        t = jax.random.t(_key(), _raw(self.df),
+                         _shape(shape, self._batch_shape))
+        return _e(lambda m, s: m + s * t, self.loc, self.scale,
+                  name="studentt_rsample")
+
+    def log_prob(self, value):
+        def raw(df, m, s, v):
+            z = (v - m) / s
+            return (jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+        return _e(raw, self.df, self.loc, self.scale, value,
+                  name="studentt_log_prob")
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _param(probs)
+        super().__init__(jnp.shape(_raw(probs)))
+
+    @property
+    def mean(self):
+        return _e(lambda p: (1 - p) / p, self.probs)
+
+    @property
+    def variance(self):
+        return _e(lambda p: (1 - p) / p ** 2, self.probs)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self._batch_shape),
+                               minval=1e-7, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u)
+                                / jnp.log1p(-_raw(self.probs))))
+
+    def log_prob(self, value):
+        return _e(lambda p, v: v * jnp.log1p(-p) + jnp.log(p),
+                  self.probs, value, name="geometric_log_prob")
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost batch dims as event dims (paddle parity)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base._batch_shape
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base._event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        for _ in range(self.rank):
+            lp = lp.sum(axis=-1)
+        return lp
+
+    def entropy(self):
+        e = self.base.entropy()
+        for _ in range(self.rank):
+            e = e.sum(axis=-1)
+        return e
+
+
+# ---------------------------------------------------------------------------
+# Transforms + TransformedDistribution (Tensor-level → tape-tracked)
+# ---------------------------------------------------------------------------
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+
+    def forward(self, x):
+        return _e(lambda m, s, v: m + s * v, self.loc, self.scale, x)
+
+    def inverse(self, y):
+        return _e(lambda m, s, v: (v - m) / s, self.loc, self.scale, y)
+
+    def forward_log_det_jacobian(self, x):
+        return _e(lambda s, v: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                                jnp.shape(v)),
+                  self.scale, x)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return _e(jnp.exp, x)
+
+    def inverse(self, y):
+        return _e(jnp.log, y)
+
+    def forward_log_det_jacobian(self, x):
+        return _e(lambda v: v, x)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return _e(jax.nn.sigmoid, x)
+
+    def inverse(self, y):
+        return _e(lambda v: jnp.log(v) - jnp.log1p(-v), y)
+
+    def forward_log_det_jacobian(self, x):
+        return _e(lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v), x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return _e(jnp.tanh, x)
+
+    def inverse(self, y):
+        return _e(jnp.arctanh, y)
+
+    def forward_log_det_jacobian(self, x):
+        return _e(lambda v: 2.0 * (math.log(2.0) - v
+                                   - jax.nn.softplus(-2.0 * v)), x)
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms: Sequence[Transform]):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base._batch_shape, base._event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = value if isinstance(value, Tensor) else Tensor(_raw(value))
+        log_det = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ld = t.forward_log_det_jacobian(x)
+            log_det = ld if log_det is None else log_det + ld
+            y = x
+        lp = self.base.log_prob(y)
+        return lp - log_det if log_det is not None else lp
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (all through `_e` → differentiable in params)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    for (tp, tq), fn in _KL_REGISTRY.items():
+        if isinstance(p, tp) and isinstance(q, tq):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"kl_divergence not registered for ({type(p).__name__}, "
+        f"{type(q).__name__}) — paddle_tpu/distribution/__init__.py")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    def raw(pm, ps, qm, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pm - qm) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return _e(raw, p.loc, p.scale, q.loc, q.scale, name="kl_normal")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    def raw(pl, ph, ql, qh):
+        result = jnp.log((qh - ql) / (ph - pl))
+        return jnp.where((ql > pl) | (qh < ph), jnp.inf, result)
+    return _e(raw, p.low, p.high, q.low, q.high, name="kl_uniform")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def raw(pa, pb):
+        a = jnp.clip(pa, 1e-7, 1 - 1e-7)
+        b = jnp.clip(pb, 1e-7, 1 - 1e-7)
+        return (a * (jnp.log(a) - jnp.log(b))
+                + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+    return _e(raw, p.probs, q.probs, name="kl_bernoulli")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def raw(pl, ql):
+        plog = p._log_probs(pl)
+        qlog = q._log_probs(ql)
+        return jnp.sum(jnp.exp(plog) * (plog - qlog), -1)
+    return _e(raw, p._logits, q._logits, name="kl_categorical")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def raw(pa, pb, qa, qb):
+        def lbeta(a, b):
+            return jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+        return (lbeta(qa, qb) - lbeta(pa, pb)
+                + (pa - qa) * jsp.digamma(pa)
+                + (pb - qb) * jsp.digamma(pb)
+                + (qa - pa + qb - pb) * jsp.digamma(pa + pb))
+    return _e(raw, p.alpha, p.beta, q.alpha, q.beta, name="kl_beta")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def raw(a, b):
+        a0 = jnp.sum(a, -1)
+        return (jsp.gammaln(a0) - jnp.sum(jsp.gammaln(a), -1)
+                - jsp.gammaln(jnp.sum(b, -1)) + jnp.sum(jsp.gammaln(b), -1)
+                + jnp.sum((a - b) * (jsp.digamma(a)
+                                     - jsp.digamma(a0)[..., None]), -1))
+    return _e(raw, p.concentration, q.concentration, name="kl_dirichlet")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return _e(lambda pr, qr: jnp.log(pr) - jnp.log(qr) + qr / pr - 1,
+              p.rate, q.rate, name="kl_exponential")
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    def raw(pc, pr, qc, qr):
+        return ((pc - qc) * jsp.digamma(pc) - jsp.gammaln(pc)
+                + jsp.gammaln(qc) + qc * (jnp.log(pr) - jnp.log(qr))
+                + pc * (qr / pr - 1))
+    return _e(raw, p.concentration, p.rate, q.concentration, q.rate,
+              name="kl_gamma")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def raw(pm, ps, qm, qs):
+        ratio = ps / qs
+        diff = jnp.abs(pm - qm) / qs
+        return -jnp.log(ratio) + ratio * jnp.exp(-diff / ratio) + diff - 1
+    return _e(raw, p.loc, p.scale, q.loc, q.scale, name="kl_laplace")
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    def raw(pp, qp):
+        return ((1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qp))
+                + jnp.log(pp) - jnp.log(qp))
+    return _e(raw, p.probs, q.probs, name="kl_geometric")

@@ -1,0 +1,108 @@
+"""paddle.geometric — graph message passing + segment ops.
+
+Reference parity: python/paddle/geometric/ (send_u_recv/send_ue_recv message
+passing, segment_sum/mean/max/min — upstream-canonical, unverified,
+SURVEY.md §0). TPU-native: everything lowers to jax segment reductions
+(sorted-scatter friendly on XLA); message passing is gather → combine →
+segment-reduce, one fused XLA graph.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._registry import eager, as_array
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv",
+]
+
+
+def _num_segments(ids, count):
+    if count is not None:
+        return int(count)
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+def _reduce_segments(msgs, ids, n, op):
+    """Shared segment reduce: mean divides by counts; max/min zero-fill
+    empty segments (paddle fills 0 where jax fills ±inf)."""
+    if op == "mean":
+        s = jax.ops.segment_sum(msgs, ids, n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(ids, s.dtype), ids, n)
+        return s / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (s.ndim - 1))
+    fn = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+          "min": jax.ops.segment_min}[op]
+    out = fn(msgs, ids, n)
+    if op in ("max", "min"):
+        has = jax.ops.segment_sum(jnp.ones_like(ids, out.dtype), ids, n)
+        out = jnp.where(has.reshape((-1,) + (1,) * (out.ndim - 1)) > 0,
+                        out, 0)
+    return out
+
+
+def _segment(op_name, data, segment_ids, num_segments=None):
+    ids = as_array(segment_ids).astype(jnp.int32)
+    n = _num_segments(ids, num_segments)
+    return eager(lambda x: _reduce_segments(x, ids, n, op_name), (data,), {},
+                 name=f"segment_{op_name}")
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("sum", data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment("mean", data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("max", data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("min", data, segment_ids)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and segment-reduce onto dst (graph message passing)."""
+    src = as_array(src_index).astype(jnp.int32)
+    dst = as_array(dst_index).astype(jnp.int32)
+
+    def raw(xa):
+        n = out_size if out_size is not None else xa.shape[0]
+        return _reduce_segments(xa[src], dst, n, reduce_op)
+
+    return eager(raw, (x,), {}, name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine x[src] with edge features y, then reduce onto dst."""
+    src = as_array(src_index).astype(jnp.int32)
+    dst = as_array(dst_index).astype(jnp.int32)
+
+    def raw(xa, ya):
+        msgs = xa[src]
+        msgs = {"add": msgs + ya, "sub": msgs - ya, "mul": msgs * ya,
+                "div": msgs / ya}[message_op]
+        n = out_size if out_size is not None else xa.shape[0]
+        return _reduce_segments(msgs, dst, n, reduce_op)
+
+    return eager(raw, (x, y), {}, name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] ∘ y[dst] (no reduction)."""
+    src = as_array(src_index).astype(jnp.int32)
+    dst = as_array(dst_index).astype(jnp.int32)
+
+    def raw(xa, ya):
+        xs, yd = xa[src], ya[dst]
+        return {"add": xs + yd, "sub": xs - yd, "mul": xs * yd,
+                "div": xs / yd}[message_op]
+
+    return eager(raw, (x, y), {}, name="send_uv")

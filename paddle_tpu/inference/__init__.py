@@ -1,0 +1,101 @@
+"""paddle.inference — Config / create_predictor facade.
+
+Reference parity: paddle/fluid/inference/api (AnalysisPredictor,
+paddle_infer::Config — upstream-canonical, unverified, SURVEY.md §0, §2.4
+inference row, §3.5). TPU-native: there is no pass pipeline to rebuild —
+the predictor wraps the jax.export artifact written by
+paddle.static.save_inference_model; XLA is the analysis/fusion stack
+(SURVEY.md §3.5 'TPU translation').
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Tensor", "Predictor", "create_predictor"]
+
+
+class Config:
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        # params are baked into the exported module; params_path kept for
+        # API parity
+        if model_path is not None and model_path.endswith(".pdmodel"):
+            model_path = model_path[:-len(".pdmodel")]
+        self._prefix = model_path
+        self._device = "tpu"
+
+    def set_prog_file(self, path: str):
+        self._prefix = path[:-len(".pdmodel")] if path.endswith(".pdmodel") \
+            else path
+
+    def enable_use_gpu(self, memory_pool_mb=0, device_id=0):
+        self._device = "tpu"  # accelerators are XLA's concern
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self, *a, **k):
+        pass  # XLA owns buffer reuse
+
+    def switch_ir_optim(self, *a, **k):
+        pass  # XLA owns the pass pipeline
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class Tensor:
+    """Input/output handle (paddle_infer::Tensor parity)."""
+
+    def __init__(self, name: str, predictor: "Predictor", is_input: bool):
+        self.name = name
+        self._p = predictor
+        self._is_input = is_input
+
+    def copy_from_cpu(self, data: np.ndarray):
+        self._p._feed[self.name] = np.asarray(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return self._p._fetch[self.name]
+
+    def shape(self):
+        v = self._p._feed.get(self.name) if self._is_input else \
+            self._p._fetch.get(self.name)
+        return list(v.shape) if v is not None else None
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..static import load_inference_model, Executor
+        if config._prefix is None:
+            raise ValueError("Config has no model path")
+        self._prog, self._feed_names, self._fetch_names = \
+            load_inference_model(config._prefix, Executor())
+        self._feed: Dict[str, np.ndarray] = {}
+        self._fetch: Dict[str, np.ndarray] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, True)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, False)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            for n, v in zip(self._feed_names, inputs):
+                self._feed[n] = np.asarray(v)
+        outs = self._prog.run(self._feed, None)
+        self._fetch = dict(zip(self._fetch_names, outs))
+        return [self._fetch[n] for n in self._fetch_names]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

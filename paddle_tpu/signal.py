@@ -1,0 +1,118 @@
+"""paddle.signal — stft / istft.
+
+Reference parity: python/paddle/signal.py (upstream-canonical, unverified —
+SURVEY.md §0). TPU-native: framing via gather into [*, frames, n_fft] then
+one batched FFT on the MXU-adjacent VPU; istft is the standard
+overlap-add with window-envelope normalization.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops._registry import eager
+
+__all__ = ["stft", "istft"]
+
+
+def _frame(x, frame_length, hop_length):
+    *batch, n = x.shape
+    n_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    return x[..., idx]  # [*batch, n_frames, frame_length]
+
+
+def _stft_raw(x, n_fft, hop_length, win_length, window, center, pad_mode,
+              normalized, onesided):
+    if hop_length is None:
+        hop_length = n_fft // 4
+    if win_length is None:
+        win_length = n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = window
+    if win_length < n_fft:  # center-pad the window to n_fft
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode if pad_mode != "constant" else "constant")
+    frames = _frame(x, n_fft, hop_length) * win.astype(x.dtype)
+    if onesided:
+        spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+    else:
+        spec = jnp.fft.fft(frames, n=n_fft, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    # paddle layout: [..., n_fft//2+1 | n_fft, num_frames]
+    return jnp.swapaxes(spec, -1, -2)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    w = window._data if isinstance(window, Tensor) else window
+    return eager(lambda a: _stft_raw(a, n_fft, hop_length, win_length, w,
+                                     center, pad_mode, normalized, onesided),
+                 (x,), {}, name="stft")
+
+
+def _istft_raw(spec, n_fft, hop_length, win_length, window, center,
+               normalized, onesided, length, return_complex=False):
+    if hop_length is None:
+        hop_length = n_fft // 4
+    if win_length is None:
+        win_length = n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = window
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    spec = jnp.swapaxes(spec, -1, -2)  # [..., frames, bins]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    elif return_complex:  # complex signal reconstruction keeps imag
+        frames = jnp.fft.ifft(spec, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, axis=-1).real
+    frames = frames * win
+    *batch, n_frames, _ = frames.shape
+    out_len = n_fft + hop_length * (n_frames - 1)
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :]).reshape(-1)
+    flatb = int(np.prod(batch)) if batch else 1
+    fr = frames.reshape(flatb, n_frames * n_fft)
+    out = jnp.zeros((flatb, out_len), frames.dtype)
+    out = out.at[:, idx].add(fr)
+    # window envelope for normalization
+    env = jnp.zeros((out_len,), jnp.float32)
+    env = env.at[idx].add(jnp.tile(win ** 2, n_frames))
+    out = out / jnp.maximum(env, 1e-10)
+    out = out.reshape(*batch, out_len)
+    if center:
+        pad = n_fft // 2
+        out = out[..., pad:out_len - pad]
+    if length is not None:
+        out = out[..., :length]
+    return out
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    w = window._data if isinstance(window, Tensor) else window
+    return eager(lambda a: _istft_raw(a, n_fft, hop_length, win_length, w,
+                                      center, normalized, onesided, length,
+                                      return_complex),
+                 (x,), {}, name="istft")

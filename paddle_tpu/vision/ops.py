@@ -1,0 +1,201 @@
+"""paddle.vision.ops — detection ops: nms, box utilities, roi_align/pool,
+PSRoIPool-free subset (upstream-canonical python/paddle/vision/ops.py,
+unverified — SURVEY.md §0).
+
+TPU-native: nms runs as a fixed-iteration lax.while-free masked loop
+(static shapes, no data-dependent python control flow); roi_align is
+bilinear gather (same machinery as grid_sample).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._registry import eager, as_array
+
+__all__ = ["box_area", "box_iou", "nms", "roi_align", "roi_pool",
+           "distribute_fpn_proposals", "generate_proposals", "DeformConv2D",
+           "deform_conv2d"]
+
+
+def _box_area_raw(boxes):
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+def box_area(boxes, name=None):
+    return eager(_box_area_raw, (boxes,), {}, name="box_area")
+
+
+def _box_iou_raw(a, b):
+    area_a = _box_area_raw(a)[:, None]
+    area_b = _box_area_raw(b)[None, :]
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area_a + area_b - inter + 1e-10)
+
+
+def box_iou(boxes1, boxes2, name=None):
+    return eager(_box_iou_raw, (boxes1, boxes2), {}, name="box_iou")
+
+
+def _nms_raw(boxes, iou_threshold, scores):
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores) if scores is not None else jnp.arange(n)
+    sb = boxes[order]
+    iou = _box_iou_raw(sb, sb)
+
+    def body(i, keep):
+        # drop i's lower-ranked overlaps iff i itself is still kept
+        sup = (iou[i] > iou_threshold) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~sup
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    return order, keep
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy NMS → kept indices (score-descending). Batched/categorical
+    form offsets boxes per category so classes never suppress each other
+    (the reference's batched_nms trick)."""
+    b = as_array(boxes)
+    s = None if scores is None else as_array(scores)
+    if category_idxs is not None:
+        cat = as_array(category_idxs).astype(b.dtype)
+        offset = (jnp.max(b) + 1.0) * cat
+        b = b + offset[:, None]
+    order, keep = _nms_raw(b, float(iou_threshold),
+                           None if s is None else s)
+    # kept original-box indices in score-descending order
+    idx = np.asarray(order)[np.asarray(keep)]
+    out = jnp.asarray(idx, jnp.int64)
+    if top_k is not None:
+        out = out[:top_k]
+    return Tensor(out)
+
+
+def _roi_align_raw(x, boxes, box_nums, output_size, spatial_scale,
+                   sampling_ratio, aligned):
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    num_rois = boxes.shape[0]
+    # batch index per roi from box_nums (rois are grouped by image)
+    batch_idx = jnp.repeat(jnp.arange(len(box_nums)),
+                           jnp.asarray(box_nums),
+                           total_repeat_length=num_rois)
+    offset = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - offset
+    y1 = boxes[:, 1] * spatial_scale - offset
+    x2 = boxes[:, 2] * spatial_scale - offset
+    y2 = boxes[:, 3] * spatial_scale - offset
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: [R, oh*sr, ow*sr]
+    ys = (y1[:, None] + rh[:, None] * (jnp.arange(oh * sr) + 0.5)
+          / (oh * sr))
+    xs = (x1[:, None] + rw[:, None] * (jnp.arange(ow * sr) + 0.5)
+          / (ow * sr))
+
+    def bilinear(img, yy, xx):
+        # img: [C, H, W]; yy: [P], xx: [Q] → [C, P, Q]
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        wy = jnp.clip(yy - y0, 0, 1)
+        wx = jnp.clip(xx - x0, 0, 1)
+        v00 = img[:, y0i][:, :, x0i]
+        v01 = img[:, y0i][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0i]
+        v11 = img[:, y1i][:, :, x1i]
+        top = v00 * (1 - wx)[None, None, :] + v01 * wx[None, None, :]
+        bot = v10 * (1 - wx)[None, None, :] + v11 * wx[None, None, :]
+        return top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+
+    def per_roi(bi, yy, xx):
+        img = x[bi]
+        samples = bilinear(img, yy, xx)  # [C, oh*sr, ow*sr]
+        return samples.reshape(c, oh, sr, ow, sr).mean(axis=(2, 4))
+
+    return jax.vmap(per_roi)(batch_idx, ys, xs)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    nums = [int(v) for v in np.asarray(
+        boxes_num._data if isinstance(boxes_num, Tensor) else boxes_num)]
+    return eager(lambda xa, ba: _roi_align_raw(
+        xa, ba, nums, output_size, spatial_scale, sampling_ratio, aligned),
+        (x, boxes), {}, name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Max-pool RoI (coarse reference semantics via dense sampling + max)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    nums = [int(v) for v in np.asarray(
+        boxes_num._data if isinstance(boxes_num, Tensor) else boxes_num)]
+
+    def raw(xa, ba):
+        n, c, h, w = xa.shape
+        oh, ow = output_size
+        num_rois = ba.shape[0]
+        batch_idx = jnp.repeat(jnp.arange(len(nums)), jnp.asarray(nums),
+                               total_repeat_length=num_rois)
+        x1 = jnp.floor(ba[:, 0] * spatial_scale)
+        y1 = jnp.floor(ba[:, 1] * spatial_scale)
+        x2 = jnp.ceil(ba[:, 2] * spatial_scale)
+        y2 = jnp.ceil(ba[:, 3] * spatial_scale)
+        sr = 4
+
+        def per_roi(bi, ax1, ay1, ax2, ay2):
+            rw = jnp.maximum(ax2 - ax1, 1.0)
+            rh = jnp.maximum(ay2 - ay1, 1.0)
+            ys = jnp.clip(ay1 + rh * (jnp.arange(oh * sr) + 0.5) / (oh * sr),
+                          0, h - 1).astype(jnp.int32)
+            xs = jnp.clip(ax1 + rw * (jnp.arange(ow * sr) + 0.5) / (ow * sr),
+                          0, w - 1).astype(jnp.int32)
+            img = xa[bi]
+            samples = img[:, ys][:, :, xs]
+            return samples.reshape(c, oh, sr, ow, sr).max(axis=(2, 4))
+
+        return jax.vmap(per_roi)(batch_idx, x1, y1, x2, y2)
+
+    return eager(raw, (x, boxes), {}, name="roi_pool")
+
+
+def distribute_fpn_proposals(*args, **kwargs):
+    raise NotImplementedError(
+        "distribute_fpn_proposals: detection-pipeline op deferred "
+        "(paddle_tpu/vision/ops.py)")
+
+
+def generate_proposals(*args, **kwargs):
+    raise NotImplementedError(
+        "generate_proposals: RPN op deferred (paddle_tpu/vision/ops.py)")
+
+
+def deform_conv2d(*args, **kwargs):
+    raise NotImplementedError(
+        "deform_conv2d: deferred (paddle_tpu/vision/ops.py) — needs a "
+        "Pallas gather-conv kernel")
+
+
+class DeformConv2D:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "DeformConv2D: deferred (paddle_tpu/vision/ops.py)")

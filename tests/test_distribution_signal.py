@@ -1,0 +1,325 @@
+"""paddle.distribution / paddle.signal / paddle.geometric /
+paddle.vision.ops / paddle.inference tests (SURVEY.md §2.4 inventory rows).
+Density/statistics checked against scipy; stft against numpy DFT; nms/roi
+against brute-force references."""
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+RNG = np.random.default_rng(23)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+class TestDistributions:
+    def test_normal(self):
+        d = D.Normal(t([0.0, 1.0]), t([1.0, 2.0]))
+        v = np.array([0.5, -1.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(v)).numpy(),
+            sps.norm.logpdf(v, [0, 1], [1, 2]), rtol=1e-5)
+        np.testing.assert_allclose(
+            d.entropy().numpy(), sps.norm.entropy([0, 1], [1, 2]), rtol=1e-5)
+        np.testing.assert_allclose(
+            d.cdf(t(v)).numpy(), sps.norm.cdf(v, [0, 1], [1, 2]), rtol=1e-5)
+        s = d.sample([10000])
+        assert abs(float(s.numpy()[:, 0].mean())) < 0.05
+
+    def test_kl_normal(self):
+        p = D.Normal(t(0.0), t(1.0))
+        q = D.Normal(t(1.0), t(2.0))
+        expected = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(D.kl_divergence(p, q).numpy(), expected,
+                                   rtol=1e-5)
+
+    @pytest.mark.parametrize("d,ref,vals", [
+        (lambda: D.Beta(t(2.0), t(3.0)), lambda v: sps.beta.logpdf(v, 2, 3),
+         [0.1, 0.5, 0.9]),  # in-support (0,1)
+        (lambda: D.Gamma(t(2.0), t(1.5)),
+         lambda v: sps.gamma.logpdf(v, 2, scale=1 / 1.5), [0.3, 1.1, 2.7]),
+        (lambda: D.Exponential(t(1.5)),
+         lambda v: sps.expon.logpdf(v, scale=1 / 1.5), [0.3, 1.1, 2.7]),
+        (lambda: D.Laplace(t(0.5), t(1.2)),
+         lambda v: sps.laplace.logpdf(v, 0.5, 1.2), [0.3, 1.1, 2.7]),
+        (lambda: D.Gumbel(t(0.0), t(1.0)),
+         lambda v: sps.gumbel_r.logpdf(v), [0.3, 1.1, 2.7]),
+        (lambda: D.LogNormal(t(0.0), t(1.0)),
+         lambda v: sps.lognorm.logpdf(v, 1.0), [0.3, 1.1, 2.7]),
+        (lambda: D.StudentT(t(4.0), t(0.0), t(1.0)),
+         lambda v: sps.t.logpdf(v, 4), [0.3, 1.1, 2.7]),
+        (lambda: D.Poisson(t(2.5)),
+         lambda v: sps.poisson.logpmf(v, 2.5), [0.0, 1.0, 4.0]),  # integers
+    ])
+    def test_log_prob_vs_scipy(self, d, ref, vals):
+        dist = d()
+        v = np.array(vals, np.float32)
+        np.testing.assert_allclose(dist.log_prob(t(v)).numpy(), ref(v),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_categorical(self):
+        logits = t([[0.1, 1.0, -0.5], [2.0, 0.0, 0.0]])
+        d = D.Categorical(logits=logits)
+        lp = d.log_prob(paddle.to_tensor(np.array([1, 0])))
+        ref = np.log(np.exp(logits.numpy())
+                     / np.exp(logits.numpy()).sum(-1, keepdims=True))
+        np.testing.assert_allclose(lp.numpy(), [ref[0, 1], ref[1, 0]],
+                                   rtol=1e-5)
+        s = d.sample([500])
+        assert s.numpy().shape == (500, 2)
+        e = d.entropy().numpy()
+        np.testing.assert_allclose(e, [-(np.exp(ref[i]) * ref[i]).sum()
+                                       for i in range(2)], rtol=1e-5)
+
+    def test_dirichlet_multinomial(self):
+        d = D.Dirichlet(t([2.0, 3.0, 4.0]))
+        x = np.array([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(d.log_prob(t(x)).numpy(),
+                                   sps.dirichlet.logpdf(x, [2, 3, 4]),
+                                   rtol=1e-5)
+        m = D.Multinomial(5, t([0.2, 0.3, 0.5]))
+        counts = np.array([1.0, 2.0, 2.0], np.float32)
+        np.testing.assert_allclose(
+            m.log_prob(t(counts)).numpy(),
+            sps.multinomial.logpmf(counts, 5, [0.2, 0.3, 0.5]), rtol=1e-5)
+        s = m.sample()
+        assert float(s.numpy().sum()) == 5.0
+
+    def test_bernoulli_uniform_geometric_kl(self):
+        b1, b2 = D.Bernoulli(t(0.3)), D.Bernoulli(t(0.6))
+        ref = 0.3 * np.log(0.3 / 0.6) + 0.7 * np.log(0.7 / 0.4)
+        np.testing.assert_allclose(D.kl_divergence(b1, b2).numpy(), ref,
+                                   rtol=1e-5)
+        u1 = D.Uniform(t(0.0), t(1.0))
+        u2 = D.Uniform(t(-1.0), t(2.0))
+        np.testing.assert_allclose(D.kl_divergence(u1, u2).numpy(),
+                                   np.log(3.0), rtol=1e-5)
+        assert np.isinf(D.kl_divergence(u2, u1).numpy())
+        g = D.Geometric(t(0.25))
+        np.testing.assert_allclose(g.mean.numpy(), 3.0, rtol=1e-5)
+
+    def test_independent_and_transformed(self):
+        base = D.Normal(t(np.zeros((3, 4))), t(np.ones((3, 4))))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == [3] and ind.event_shape == [4]
+        v = RNG.standard_normal((3, 4)).astype(np.float32)
+        np.testing.assert_allclose(ind.log_prob(t(v)).numpy(),
+                                   base.log_prob(t(v)).numpy().sum(-1),
+                                   rtol=1e-5)
+        # exp(Normal) == LogNormal
+        td = D.TransformedDistribution(D.Normal(t(0.0), t(1.0)),
+                                       [D.ExpTransform()])
+        x = np.array([0.5, 1.5], np.float32)
+        np.testing.assert_allclose(td.log_prob(t(x)).numpy(),
+                                   sps.lognorm.logpdf(x, 1.0), rtol=1e-5)
+
+    def test_rsample_gradient(self):
+        loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        # reparameterized sample: d(sample)/d(loc) == 1
+        d = D.Normal(loc, t(1.0))
+        s = d.rsample([8])
+        s.sum().backward()
+        np.testing.assert_allclose(loc.grad.numpy(), 8.0, rtol=1e-5)
+
+
+class TestSignal:
+    def test_stft_matches_naive_dft(self):
+        x = RNG.standard_normal(512).astype(np.float32)
+        n_fft, hop = 64, 16
+        out = paddle.signal.stft(t(x[None]), n_fft, hop_length=hop,
+                                 center=False).numpy()[0]
+        # naive reference
+        frames = np.stack([x[i * hop:i * hop + n_fft]
+                           for i in range(1 + (512 - n_fft) // hop)])
+        ref = np.fft.rfft(frames, axis=-1).T
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    def test_stft_istft_round_trip(self):
+        x = RNG.standard_normal((2, 1024)).astype(np.float32)
+        win = paddle.to_tensor(np.hanning(128).astype(np.float32))
+        spec = paddle.signal.stft(t(x), 128, hop_length=32, window=win)
+        back = paddle.signal.istft(spec, 128, hop_length=32, window=win,
+                                   length=1024)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-4)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = t([[1.0, 2], [3, 4], [5, 6], [7, 8]])
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1]))
+        np.testing.assert_allclose(
+            paddle.geometric.segment_sum(data, ids).numpy(),
+            [[4, 6], [12, 14]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_mean(data, ids).numpy(),
+            [[2, 3], [6, 7]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_max(data, ids).numpy(),
+            [[3, 4], [7, 8]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_min(data, ids).numpy(),
+            [[1, 2], [5, 6]])
+
+    def test_send_u_recv(self):
+        x = t([[1.0], [2.0], [3.0]])
+        src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+        out = paddle.geometric.send_u_recv(x, src, dst, "sum").numpy()
+        np.testing.assert_allclose(out, [[1.0], [4.0], [2.0]])
+        out_max = paddle.geometric.send_u_recv(x, src, dst, "max").numpy()
+        np.testing.assert_allclose(out_max, [[1.0], [3.0], [2.0]])
+
+    def test_send_ue_recv(self):
+        x = t([[1.0], [2.0]])
+        e = t([[10.0], [20.0]])
+        src = paddle.to_tensor(np.array([0, 1]))
+        dst = paddle.to_tensor(np.array([1, 0]))
+        out = paddle.geometric.send_ue_recv(x, e, src, dst, "add",
+                                            "sum").numpy()
+        np.testing.assert_allclose(out, [[22.0], [11.0]])
+
+
+class TestVisionOps:
+    def test_box_iou_area(self):
+        a = t([[0, 0, 2, 2], [1, 1, 3, 3]])
+        np.testing.assert_allclose(paddle.vision.ops.box_area(a).numpy(),
+                                   [4.0, 4.0])
+        iou = paddle.vision.ops.box_iou(a, a).numpy()
+        np.testing.assert_allclose(np.diag(iou), [1.0, 1.0], rtol=1e-5)
+        np.testing.assert_allclose(iou[0, 1], 1.0 / 7.0, rtol=1e-5)
+
+    def test_nms(self):
+        boxes = t([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]])
+        scores = t([0.9, 0.8, 0.7])
+        keep = paddle.vision.ops.nms(boxes, 0.5, scores).numpy()
+        np.testing.assert_array_equal(keep, [0, 2])
+
+    def test_nms_categories(self):
+        boxes = t([[0, 0, 10, 10], [1, 1, 11, 11]])
+        scores = t([0.9, 0.8])
+        cats = paddle.to_tensor(np.array([0, 1]))
+        keep = paddle.vision.ops.nms(boxes, 0.5, scores, category_idxs=cats,
+                                     categories=[0, 1]).numpy()
+        assert set(keep) == {0, 1}  # different classes never suppress
+
+    def test_roi_align_identity(self):
+        x = paddle.to_tensor(
+            np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        boxes = t([[0.0, 0.0, 4.0, 4.0]])
+        out = paddle.vision.ops.roi_align(
+            x, boxes, paddle.to_tensor(np.array([1])), output_size=2,
+            spatial_scale=1.0, aligned=False).numpy()
+        assert out.shape == (1, 1, 2, 2)
+
+        # exact bilinear reference at the sample points (sr=2 default)
+        def bil(v, y, xx):
+            y0, x0 = int(np.floor(y)), int(np.floor(xx))
+            y1, x1 = min(y0 + 1, 3), min(x0 + 1, 3)
+            wy, wx = y - y0, xx - x0
+            return (v[y0, x0] * (1 - wy) * (1 - wx)
+                    + v[y0, x1] * (1 - wy) * wx
+                    + v[y1, x0] * wy * (1 - wx) + v[y1, x1] * wy * wx)
+
+        pts = [0.5, 1.5, 2.5, 3.5]
+        v = x.numpy()[0, 0]
+        ref = np.array([[np.mean([bil(v, pts[2 * i + a], pts[2 * j + b])
+                                  for a in range(2) for b in range(2)])
+                         for j in range(2)] for i in range(2)])
+        np.testing.assert_allclose(out[0, 0], ref, rtol=1e-5)
+
+
+class TestInference:
+    def test_predictor_round_trip(self, tmp_path):
+        import os
+        layer = paddle.nn.Linear(4, 2)
+        paddle.enable_static()
+        from paddle_tpu import static
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            y = layer(x)
+        prefix = os.path.join(str(tmp_path), "m")
+        static.save_inference_model(prefix, [x], [y], static.Executor(),
+                                    program=main)
+        paddle.disable_static()
+
+        config = paddle.inference.Config(prefix + ".pdmodel")
+        pred = paddle.inference.create_predictor(config)
+        assert pred.get_input_names() == ["x"]
+        xs = RNG.standard_normal((3, 4)).astype(np.float32)
+        h = pred.get_input_handle("x")
+        h.copy_from_cpu(xs)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, layer(t(xs)).numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestDistributionGrads:
+    def test_kl_param_gradients_flow(self):
+        """VAE-style: KL(N(mu,exp(logsig)) || N(0,1)) must be trainable."""
+        mu = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        logsig = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.2,
+                                   parameters=[mu, logsig])
+        first = last = None
+        for _ in range(50):
+            q = D.Normal(mu, logsig.exp())
+            kl = D.kl_divergence(q, D.Normal(t(0.0), t(1.0)))
+            kl.backward()
+            opt.step()
+            opt.clear_grad()
+            v = float(kl.numpy())
+            first = first or v
+            last = v
+        assert first > 1.5 and last < 0.05, (first, last)
+
+    def test_categorical_policy_gradient(self):
+        logits = paddle.to_tensor(np.zeros(3, np.float32),
+                                  stop_gradient=False)
+        d = D.Categorical(logits=logits)
+        lp = d.log_prob(paddle.to_tensor(np.array(1)))
+        lp.backward()
+        g = logits.grad.numpy()
+        # d log_softmax[1] / d logits = onehot(1) - softmax
+        np.testing.assert_allclose(g, [-1 / 3, 2 / 3, -1 / 3], rtol=1e-5)
+
+    def test_normal_rsample_pathwise(self):
+        loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        d = D.Normal(loc, t(1.0))
+        s = d.rsample([8])
+        s.sum().backward()
+        np.testing.assert_allclose(loc.grad.numpy(), 8.0, rtol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_send_ue_recv_empty_segment_max(self):
+        x = t([[1.0], [2.0]])
+        e = t([[10.0], [20.0]])
+        src = paddle.to_tensor(np.array([0, 1]))
+        dst = paddle.to_tensor(np.array([0, 2]))
+        out = paddle.geometric.send_ue_recv(x, e, src, dst, "add", "max",
+                                            out_size=3).numpy()
+        np.testing.assert_allclose(out, [[11.0], [0.0], [22.0]])  # no -inf
+
+    def test_istft_complex_round_trip(self):
+        xr = RNG.standard_normal((1, 512)).astype(np.float32)
+        xi = RNG.standard_normal((1, 512)).astype(np.float32)
+        xc = paddle.to_tensor(xr + 1j * xi)
+        win = paddle.to_tensor(np.hanning(64).astype(np.float32))
+        spec = paddle.signal.stft(xc, 64, hop_length=16, window=win,
+                                  onesided=False)
+        back = paddle.signal.istft(spec, 64, hop_length=16, window=win,
+                                   onesided=False, return_complex=True,
+                                   length=512)
+        np.testing.assert_allclose(back.numpy(), xr + 1j * xi, rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_categorical_props_are_tensors(self):
+        d = D.Categorical(logits=t([0.0, 1.0, 2.0]))
+        assert hasattr(d.probs, "numpy") and hasattr(d.logits, "numpy")
+        np.testing.assert_allclose(d.probs.numpy().sum(), 1.0, rtol=1e-6)
