@@ -61,6 +61,7 @@ from . import distribution  # noqa: F401
 from . import signal  # noqa: F401
 from . import geometric  # noqa: F401
 from . import inference  # noqa: F401
+from . import onnx  # noqa: F401
 from . import jit  # noqa: F401
 from . import utils  # noqa: F401
 from .utils import metrics as metric  # noqa: F401

@@ -1,2 +1,3 @@
 """paddle.incubate namespace — experimental API parity surface."""
 from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

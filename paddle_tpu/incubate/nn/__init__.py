@@ -1,0 +1,8 @@
+"""paddle.incubate.nn — fused-op layer/functional surface.
+
+Reference parity: python/paddle/incubate/nn/ (FusedMultiHeadAttention and
+the fused functional ops backed by phi fusion kernels — upstream-canonical,
+unverified, SURVEY.md §0, §2.1 fused-kernels row). TPU-native: the
+"fused" ops ARE our Pallas kernels / XLA-fused jnp formulas.
+"""
+from . import functional  # noqa: F401

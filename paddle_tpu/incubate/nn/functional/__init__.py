@@ -1,0 +1,141 @@
+"""paddle.incubate.nn.functional — fused ops over the Pallas kernel set.
+
+Reference analogs (upstream-canonical, unverified — SURVEY.md §0):
+fused_rms_norm / fused_layer_norm (phi fusion kernels),
+fused_rotary_position_embedding (fused rope), variable-length flash
+attention entry points. Here they bind to kernels/ — the same code the
+flagship models run.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....ops._registry import eager
+from ....kernels.rms_norm import rms_norm
+from ....kernels import rope as _rope
+from ....kernels.flash_attention import flash_attention_fwd
+
+__all__ = ["fused_rms_norm", "fused_layer_norm",
+           "fused_rotary_position_embedding", "variable_length_memory_efficient_attention",
+           "fused_multi_head_attention"]
+
+
+def _check_last_axis(x, begin_norm_axis, op):
+    ndim = len(x.shape)
+    if begin_norm_axis not in (-1, ndim - 1):
+        raise NotImplementedError(
+            f"{op}: begin_norm_axis={begin_norm_axis} (multi-axis "
+            "normalization) not supported — flatten trailing dims first "
+            "(paddle_tpu/incubate/nn/functional/__init__.py)")
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kwargs):
+    """Last-axis RMSNorm; the Pallas rms_norm runs on TPU, the
+    f32-accumulating reference elsewhere."""
+    _check_last_axis(x, begin_norm_axis, "fused_rms_norm")
+
+    def raw(xa, w, b):
+        out = rms_norm(xa, w, epsilon)
+        if b is not None:
+            out = out + b.astype(out.dtype)
+        return out
+    return eager(raw, (x, norm_weight, norm_bias), {},
+                 name="fused_rms_norm")
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, **kwargs):
+    """Last-axis LayerNorm — delegates to nn.functional.layer_norm (the
+    formula lives once; XLA fuses it)."""
+    _check_last_axis(x, begin_norm_axis, "fused_layer_norm")
+    from ....nn import functional as F
+    return F.layer_norm(x, x.shape[-1], weight=norm_weight, bias=norm_bias,
+                        epsilon=epsilon)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True, **kwargs):
+    """Apply RoPE to q (and k) → (q, k, v) like the reference. sin/cos:
+    [max_pos, head_dim(/2)] tables (rows are position-indexed; only the
+    first seq rows — or position_ids rows — are read); built from
+    rope_freqs when omitted. use_neox_rotary_style picks rotate-half vs
+    interleaved pairing; position_ids supports KV-cache decode."""
+    pos = None if position_ids is None else \
+        (position_ids._data if hasattr(position_ids, "_data")
+         else jnp.asarray(position_ids))
+
+    def raw(qa, ka, s, c):
+        seq = qa.shape[1]
+        hd = qa.shape[-1]
+        if s is None or c is None:
+            max_pos = seq if pos is None else int(seq + 1024)
+            c2, s2 = _rope.rope_freqs(hd, max_pos)
+        else:
+            # keep the table's position axis; rows are selected by seq or
+            # position_ids inside apply_rope* (reshape-by-seq would scramble
+            # cached tables longer than the sequence)
+            c2, s2 = c.reshape(c.shape[0], -1), s.reshape(s.shape[0], -1)
+        apply = _rope.apply_rope_half if use_neox_rotary_style \
+            else _rope.apply_rope
+        if ka is None:
+            out_q, _ = apply(qa, qa, c2, s2, position_ids=pos)
+            return out_q
+        return apply(qa, ka, c2, s2, position_ids=pos)
+
+    if k is None:
+        return (eager(raw, (q, None, sin, cos), {}, name="fused_rope"),
+                None, v)
+    outs = eager(raw, (q, k, sin, cos), {}, name="fused_rope")
+    return (outs[0], outs[1], v)
+
+
+def variable_length_memory_efficient_attention(query, key, value,
+                                               seq_lens=None,
+                                               kv_seq_lens=None, mask=None,
+                                               scale=None, causal=False,
+                                               **kwargs):
+    """[B, H, S, D] layout entry (reference signature). With seq_lens /
+    kv_seq_lens / mask, padded key positions are masked out of the exact
+    attention; without them, the flash path runs."""
+    from .... import ops
+    from ....kernels.flash_attention import mha_ref
+    q = ops.transpose(query, [0, 2, 1, 3])
+    k = ops.transpose(key, [0, 2, 1, 3])
+    v = ops.transpose(value, [0, 2, 1, 3])
+    if seq_lens is None and kv_seq_lens is None and mask is None:
+        out = eager(lambda qa, ka, va: flash_attention_fwd(
+            qa, ka, va, causal, scale), (q, k, v),
+            {}, name="varlen_attention")
+        return ops.transpose(out, [0, 2, 1, 3])
+
+    def to_arr(x):
+        return None if x is None else \
+            (x._data if hasattr(x, "_data") else jnp.asarray(x))
+
+    sl = to_arr(kv_seq_lens if kv_seq_lens is not None else seq_lens)
+    m = to_arr(mask)
+
+    def raw(qa, ka, va):
+        sk = ka.shape[1]
+        full = None
+        if sl is not None:  # [B] valid-key counts → [B,1,1,Sk] key mask
+            full = (jnp.arange(sk)[None, :]
+                    < sl.reshape(-1)[:, None])[:, None, None, :]
+        if m is not None:
+            mm = m.astype(bool)
+            full = mm if full is None else (full & mm)
+        return mha_ref(qa, ka, va, causal=causal, scale=scale, mask=full)
+
+    out = eager(raw, (q, k, v), {}, name="varlen_attention_masked")
+    return ops.transpose(out, [0, 2, 1, 3])
+
+
+def fused_multi_head_attention(*args, **kwargs):
+    raise NotImplementedError(
+        "fused_multi_head_attention: use nn.MultiHeadAttention or "
+        "F.flash_attention (paddle_tpu/incubate/nn/functional/__init__.py)")

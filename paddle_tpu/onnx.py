@@ -1,0 +1,11 @@
+"""paddle.onnx — export stub (SURVEY.md §2.4 ONNX/program-format row:
+'our ckpt: orbax; provide converter stub')."""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "paddle.onnx.export: ONNX conversion is out of scope "
+        "(paddle_tpu/onnx.py). Use paddle_tpu.static.save_inference_model "
+        "(a jax.export StableHLO artifact) or jit.save for serving; "
+        "StableHLO→ONNX converters exist out-of-tree.")

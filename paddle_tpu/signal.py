@@ -111,6 +111,10 @@ def _istft_raw(spec, n_fft, hop_length, win_length, window, center,
 def istft(x, n_fft, hop_length=None, win_length=None, window=None,
           center=True, normalized=False, onesided=True, length=None,
           return_complex=False, name=None):
+    if return_complex and onesided:
+        raise ValueError(
+            "istft: onesided spectra cannot reconstruct a complex signal — "
+            "pass onesided=False with return_complex=True")
     w = window._data if isinstance(window, Tensor) else window
     return eager(lambda a: _istft_raw(a, n_fft, hop_length, win_length, w,
                                       center, normalized, onesided, length,

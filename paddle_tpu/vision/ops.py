@@ -99,7 +99,10 @@ def _roi_align_raw(x, boxes, box_nums, output_size, spatial_scale,
     if not aligned:
         rw = jnp.maximum(rw, 1.0)
         rh = jnp.maximum(rh, 1.0)
-    sr = sampling_ratio if sampling_ratio > 0 else 2
+    # the reference adapts samples-per-bin per ROI (ceil(roi/out)); XLA needs
+    # static shapes, so we use a fixed grid — 4x4 per bin covers typical
+    # detection ROIs well (deviation documented)
+    sr = sampling_ratio if sampling_ratio > 0 else 4
     # sample grid: [R, oh*sr, ow*sr]
     ys = (y1[:, None] + rh[:, None] * (jnp.arange(oh * sr) + 0.5)
           / (oh * sr))

@@ -212,7 +212,7 @@ class TestVisionOps:
         boxes = t([[0.0, 0.0, 4.0, 4.0]])
         out = paddle.vision.ops.roi_align(
             x, boxes, paddle.to_tensor(np.array([1])), output_size=2,
-            spatial_scale=1.0, aligned=False).numpy()
+            spatial_scale=1.0, sampling_ratio=2, aligned=False).numpy()
         assert out.shape == (1, 1, 2, 2)
 
         # exact bilinear reference at the sample points (sr=2 default)
@@ -323,3 +323,94 @@ class TestReviewRegressions:
         d = D.Categorical(logits=t([0.0, 1.0, 2.0]))
         assert hasattr(d.probs, "numpy") and hasattr(d.logits, "numpy")
         np.testing.assert_allclose(d.probs.numpy().sum(), 1.0, rtol=1e-6)
+
+
+class TestIncubateFused:
+    def test_fused_rms_norm(self):
+        import paddle_tpu.incubate as incubate
+        x = t(RNG.standard_normal((2, 8, 64)))
+        w = t(np.ones(64))
+        out = incubate.nn.functional.fused_rms_norm(x, w)
+        ref = x.numpy() / np.sqrt(
+            (x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_rope_and_varlen_attention(self):
+        import paddle_tpu.incubate as incubate
+        q = t(RNG.standard_normal((2, 16, 4, 32)))
+        k = t(RNG.standard_normal((2, 16, 4, 32)))
+        oq, ok, _ = incubate.nn.functional.fused_rotary_position_embedding(
+            q, k)
+        assert oq.shape == [2, 16, 4, 32] and ok.shape == [2, 16, 4, 32]
+        # norm-preserving rotation
+        np.testing.assert_allclose(
+            np.linalg.norm(oq.numpy(), axis=-1),
+            np.linalg.norm(q.numpy(), axis=-1), rtol=1e-4)
+        qb = t(RNG.standard_normal((1, 4, 16, 32)))  # B,H,S,D layout
+        out = incubate.nn.functional.\
+            variable_length_memory_efficient_attention(qb, qb, qb,
+                                                       causal=True)
+        assert out.shape == [1, 4, 16, 32]
+
+    def test_onnx_stub(self):
+        with pytest.raises(NotImplementedError):
+            paddle.onnx.export(None, "x")
+
+
+class TestIncubateRegressions:
+    def test_rope_long_cached_table(self):
+        """Tables longer than seq must be row-sliced, not reshaped."""
+        import jax.numpy as jnp
+        import paddle_tpu.incubate as incubate
+        from paddle_tpu.kernels.rope import rope_freqs, apply_rope_half
+        q = t(RNG.standard_normal((1, 16, 2, 32)))
+        cos, sin = rope_freqs(32, 64)  # max_pos=64 > seq=16
+        oq, _, _ = incubate.nn.functional.fused_rotary_position_embedding(
+            q, cos=paddle.to_tensor(np.asarray(cos)),
+            sin=paddle.to_tensor(np.asarray(sin)))
+        ref, _ = apply_rope_half(jnp.asarray(q.numpy()),
+                                 jnp.asarray(q.numpy()), cos, sin)
+        np.testing.assert_allclose(oq.numpy(), np.asarray(ref), rtol=1e-5)
+
+    def test_rope_position_ids(self):
+        import paddle_tpu.incubate as incubate
+        q = t(RNG.standard_normal((1, 4, 2, 16)))
+        base, _, _ = incubate.nn.functional.fused_rotary_position_embedding(q)
+        shifted, _, _ = incubate.nn.functional.\
+            fused_rotary_position_embedding(
+                q, position_ids=paddle.to_tensor(np.array([[8, 9, 10, 11]])))
+        assert not np.allclose(base.numpy(), shifted.numpy())
+
+    def test_varlen_attention_masks_padding(self):
+        import paddle_tpu.incubate as incubate
+        q = t(RNG.standard_normal((1, 2, 8, 16)))  # B,H,S,D
+        k = t(RNG.standard_normal((1, 2, 8, 16)))
+        v = t(RNG.standard_normal((1, 2, 8, 16)))
+        full = incubate.nn.functional.\
+            variable_length_memory_efficient_attention(q, k, v)
+        masked = incubate.nn.functional.\
+            variable_length_memory_efficient_attention(
+                q, k, v, seq_lens=paddle.to_tensor(np.array([4])))
+        assert not np.allclose(full.numpy(), masked.numpy())
+        # masked result must equal attention over the first 4 keys only
+        ref = incubate.nn.functional.\
+            variable_length_memory_efficient_attention(
+                q, k[:, :, :4], v[:, :, :4])
+        np.testing.assert_allclose(masked.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_fused_layer_norm_delegates(self):
+        import paddle_tpu.incubate as incubate
+        x = t(RNG.standard_normal((2, 8)))
+        w, b = t(np.ones(8)), t(np.zeros(8))
+        out = incubate.nn.functional.fused_layer_norm(x, w, b)
+        ref = paddle.nn.functional.layer_norm(x, 8, weight=w, bias=b)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+        with pytest.raises(NotImplementedError):
+            incubate.nn.functional.fused_layer_norm(x, w, b,
+                                                    begin_norm_axis=0)
+
+    def test_istft_rejects_onesided_complex(self):
+        spec = paddle.to_tensor(np.zeros((1, 33, 4), np.complex64))
+        with pytest.raises(ValueError):
+            paddle.signal.istft(spec, 64, return_complex=True)
