@@ -67,6 +67,7 @@ from . import utils  # noqa: F401
 from .utils import metrics as metric  # noqa: F401
 from .utils.checkpoint import save, load  # noqa: F401
 from .hapi import Model, callbacks  # noqa: F401
+from .hapi.summary import summary, flops  # noqa: F401
 
 # regularizer namespace (paddle.regularizer.L1Decay/L2Decay)
 from .optimizer.optimizers import L1Decay as _L1, L2Decay as _L2

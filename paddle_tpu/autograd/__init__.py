@@ -7,6 +7,8 @@ from .tape import (  # noqa: F401
     GradNode,
 )
 from .pylayer import PyLayer, PyLayerContext  # noqa: F401
+from .functional import (jacobian, hessian, vjp, jvp,  # noqa: F401
+                         Jacobian, Hessian)
 
 
 def is_grad_enabled() -> bool:

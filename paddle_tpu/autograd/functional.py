@@ -1,0 +1,153 @@
+"""paddle.autograd functional transforms — jacobian/hessian/vjp/jvp.
+
+Reference parity: python/paddle/autograd/autograd.py (Jacobian/Hessian with
+lazy evaluation) + paddle.incubate.autograd vjp/jvp (upstream-canonical,
+unverified — SURVEY.md §0). TPU-native: these ARE jax transforms — the
+wrapper only moves Tensors across the boundary; everything composes with
+jit/vmap underneath, which the reference's dynamic-graph double-grad cannot.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["jacobian", "hessian", "vjp", "jvp", "Jacobian", "Hessian"]
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return jnp.asarray(x)
+
+
+def _wrap(x):
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(v) for v in x)
+    return Tensor(x)
+
+
+def _fnify(func):
+    def fn(*arrs):
+        out = func(*[Tensor(a) for a in arrs])
+        if isinstance(out, (tuple, list)):
+            return type(out)(_unwrap(o) for o in out)
+        return _unwrap(out)
+    return fn
+
+
+def jacobian(func: Callable, xs, batch_axis=None) -> Union[Tensor, tuple]:
+    """∂func/∂xs. xs: Tensor or sequence; returns Tensor (or tuple per x).
+    batch_axis=0 computes per-sample jacobians (reference semantics) via
+    vmap."""
+    single = not isinstance(xs, (list, tuple))
+    arrs = [_unwrap(x) for x in ([xs] if single else xs)]
+    fn = _fnify(func if not single else (lambda a: func(a)))
+
+    if batch_axis is None:
+        jac = jax.jacobian(fn, argnums=tuple(range(len(arrs))))(*arrs)
+    else:
+        if batch_axis != 0:
+            raise ValueError("batch_axis must be None or 0")
+        inner = jax.jacobian(fn, argnums=tuple(range(len(arrs))))
+        jac = jax.vmap(inner)(*arrs)
+    out = tuple(_wrap(j) for j in jac)
+    return out[0] if single else out
+
+
+def hessian(func: Callable, xs, batch_axis=None) -> Union[Tensor, tuple]:
+    """∂²func/∂xs² for scalar-output func."""
+    single = not isinstance(xs, (list, tuple))
+    arrs = [_unwrap(x) for x in ([xs] if single else xs)]
+    fn = _fnify(func if not single else (lambda a: func(a)))
+
+    def scalar_fn(*a):
+        out = fn(*a)
+        return jnp.squeeze(out)
+
+    if batch_axis is None:
+        hes = jax.hessian(scalar_fn, argnums=tuple(range(len(arrs))))(*arrs)
+    else:
+        if batch_axis != 0:
+            raise ValueError("batch_axis must be None or 0")
+        hes = jax.vmap(jax.hessian(scalar_fn,
+                                   argnums=tuple(range(len(arrs)))))(*arrs)
+    if single:
+        return _wrap(hes[0][0])
+    return tuple(tuple(_wrap(h) for h in row) for row in hes)
+
+
+def vjp(func: Callable, xs, v=None):
+    """→ (func(xs), vjp_result) like paddle.incubate.autograd.vjp."""
+    single = not isinstance(xs, (list, tuple))
+    arrs = [_unwrap(x) for x in ([xs] if single else xs)]
+    fn = _fnify(func if not single else (lambda a: func(a)))
+    out, pullback = jax.vjp(fn, *arrs)
+    if v is None:
+        cot = jax.tree.map(jnp.ones_like, out)
+    else:
+        # re-shape v's leaves onto the output structure (a list of
+        # cotangents for a tuple-returning func is the documented form)
+        cot = jax.tree.unflatten(jax.tree.structure(out),
+                                 jax.tree.leaves(_unwrap(v)))
+    grads = pullback(cot)
+    g = _wrap(grads[0]) if single else tuple(_wrap(x) for x in grads)
+    return _wrap(out), g
+
+
+def jvp(func: Callable, xs, v=None):
+    """→ (func(xs), jvp_result)."""
+    single = not isinstance(xs, (list, tuple))
+    arrs = [_unwrap(x) for x in ([xs] if single else xs)]
+    fn = _fnify(func if not single else (lambda a: func(a)))
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrs)
+    else:
+        tv = _unwrap(v)
+        tangents = tuple(tv) if isinstance(tv, (list, tuple)) else (tv,)
+    out, tangent_out = jax.jvp(fn, tuple(arrs), tangents)
+    return _wrap(out), _wrap(tangent_out)
+
+
+class _MatrixView:
+    """Indexable view over a Tensor result or a (nested) tuple of them —
+    multi-input Jacobians index per input first: J[i][r, c]."""
+
+    def __init__(self, value):
+        self._v = value
+
+    def __getitem__(self, idx):
+        if isinstance(self._v, tuple):
+            if not isinstance(idx, int):
+                raise TypeError(
+                    "multi-input Jacobian/Hessian: index the input block "
+                    "first (J[i][r, c])")
+            return _MatrixView(self._v[idx]) if \
+                isinstance(self._v[idx], tuple) else self._v[idx]
+        return self._v[idx]
+
+    @property
+    def shape(self):
+        if isinstance(self._v, tuple):
+            return [v.shape for v in self._v]
+        return self._v.shape
+
+
+class Jacobian(_MatrixView):
+    """Lazy Jacobian accessor (reference paddle.autograd.Jacobian).
+    Materializes fully on first use (XLA computes it in one pass)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        super().__init__(jacobian(func, xs,
+                                  batch_axis=0 if is_batched else None))
+
+
+class Hessian(_MatrixView):
+    def __init__(self, func, xs, is_batched=False):
+        super().__init__(hessian(func, xs,
+                                 batch_axis=0 if is_batched else None))

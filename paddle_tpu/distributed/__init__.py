@@ -29,7 +29,7 @@ from . import auto_parallel  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     Placement, Replicate, Shard, Partial, ProcessMesh,
     shard_tensor, dtensor_from_fn, reshard, unshard_dtensor,
-    shard_layer, shard_optimizer)
+    shard_layer, shard_optimizer, shard_dataloader)
 
 
 def get_rank(group=None) -> int:

@@ -10,4 +10,4 @@ from .placement import (Placement, Replicate, Shard, Partial,  # noqa: F401
 from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
 from .api import (shard_tensor, dtensor_from_fn, reshard,  # noqa: F401
                   unshard_dtensor, shard_layer, shard_optimizer,
-                  get_placements, get_placement_mesh)
+                  shard_dataloader, get_placements, get_placement_mesh)

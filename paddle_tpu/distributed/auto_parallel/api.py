@@ -153,6 +153,55 @@ def shard_layer(layer, process_mesh: ProcessMesh,
     return layer
 
 
+class _ShardedDataLoader:
+    def __init__(self, loader, mesh: ProcessMesh, shard_dims, input_keys):
+        self._loader = loader
+        self._mesh = mesh
+        dims = shard_dims if isinstance(shard_dims, (list, tuple)) \
+            else [shard_dims]
+        self._placements = [Shard(0) if d in dims else Replicate()
+                            for d in mesh.dim_names]
+        self._input_keys = set(input_keys) if input_keys else None
+
+    def _place(self, item, key=None):
+        if isinstance(item, (list, tuple)):
+            return type(item)(self._place(v) for v in item)
+        if isinstance(item, dict):
+            return {k: self._place(v, key=k) for k, v in item.items()}
+        if isinstance(item, Tensor):
+            if self._input_keys is not None and key is not None and \
+                    key not in self._input_keys:
+                return item  # reference: only the named inputs shard
+            return shard_tensor(item, self._mesh, self._placements)
+        return item
+
+    def __iter__(self):
+        for batch in self._loader:
+            yield self._place(batch)
+
+    def __len__(self):
+        return len(self._loader)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """Wrap a DataLoader so every yielded Tensor lands batch-sharded on the
+    mesh (reference: dist.shard_dataloader). shard_dims: mesh dim name(s)
+    the batch axis shards over (defaults to the first mesh dim);
+    input_keys restricts sharding to those dict keys."""
+    if isinstance(meshes, (list, tuple)):
+        if len(meshes) > 1:
+            raise NotImplementedError(
+                "shard_dataloader: one mesh per loader — per-stage "
+                "multi-mesh placement (pipeline parallel) is handled by the "
+                "compiled pp schedule, not the input pipeline "
+                "(paddle_tpu/distributed/auto_parallel/api.py)")
+        meshes = meshes[0]
+    mesh = meshes
+    if shard_dims is None:
+        shard_dims = mesh.dim_names[0]
+    return _ShardedDataLoader(dataloader, mesh, shard_dims, input_keys)
+
+
 def shard_optimizer(optimizer, shard_fn: Optional[Callable] = None):
     """Align optimizer state sharding with (possibly resharded) parameters
     (reference: dist.shard_optimizer; its ShardOptimizer re-places moments).

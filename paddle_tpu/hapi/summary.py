@@ -1,0 +1,115 @@
+"""paddle.summary / paddle.flops — model inspection.
+
+Reference parity: python/paddle/hapi/model_summary.py + hapi/dynamic_flops.py
+(upstream-canonical, unverified — SURVEY.md §0). Output shapes come from one
+real forward pass with per-layer hooks (same mechanism as the reference).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["summary", "flops"]
+
+
+def _num_params(layer: Layer, include_sublayers=False):
+    total = trainable = 0
+    for _, p in layer.named_parameters(
+            include_sublayers=include_sublayers):
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+    return total, trainable
+
+
+def _make_inputs(input_size, dtypes):
+    if input_size is None:
+        raise ValueError(
+            "summary/flops: pass input_size (e.g. (1, 3, 224, 224)) or a "
+            "concrete `input`")
+    if isinstance(input_size, tuple) and all(
+            isinstance(s, int) for s in input_size):
+        input_size = [input_size]
+    dtypes = dtypes or ["float32"] * len(input_size)
+    outs = []
+    for shape, dt in zip(input_size, dtypes):
+        shape = tuple(1 if (s is None or s < 0) else int(s) for s in shape)
+        outs.append(Tensor(np.zeros(shape, np.dtype(str(dt)))))
+    return outs
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}
+    (reference return contract)."""
+    rows = []
+    hooks = []
+
+    def make_hook(name, cls_name):
+        def hook(layer, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) \
+                else outputs
+            shape = list(out.shape) if hasattr(out, "shape") else "?"
+            total, _ = _num_params(layer, include_sublayers=False)
+            rows.append((f"{cls_name}-{len(rows) + 1}", name, shape, total))
+        return hook
+
+    for name, sub in net.named_sublayers(include_self=False):
+        if sub._sub_layers:  # only leaves get rows (reference behavior)
+            continue
+        hooks.append(sub.register_forward_post_hook(
+            make_hook(name, type(sub).__name__)))
+    try:
+        if input is not None:
+            net(*input) if isinstance(input, (list, tuple)) else net(input)
+        else:
+            net(*_make_inputs(input_size, dtypes))
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total, trainable = _num_params(net, include_sublayers=True)
+    w_layer = max([len(r[0]) for r in rows] + [12]) + 2
+    w_shape = max([len(str(r[2])) for r in rows] + [14]) + 2
+    line = "-" * (w_layer + w_shape + 14)
+    print(line)
+    print(f"{'Layer (type)':<{w_layer}}{'Output Shape':<{w_shape}}"
+          f"{'Param #':>12}")
+    print("=" * (w_layer + w_shape + 14))
+    for lname, _, shape, n in rows:
+        print(f"{lname:<{w_layer}}{str(shape):<{w_shape}}{n:>12,}")
+    print("=" * (w_layer + w_shape + 14))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail=False):
+    """Approximate forward FLOPs via jax cost analysis of the traced
+    forward — exact for the XLA program that actually runs (stronger than
+    the reference's per-layer formula table)."""
+    import jax
+
+    from ..jit import functional_call, state_of
+
+    inputs = _make_inputs(input_size, None)
+    state = state_of(net)
+
+    def fwd(state_arrays, *xs):
+        out, _ = functional_call(net, state_arrays,
+                                 *[Tensor(x) for x in xs])
+        first = out[0] if isinstance(out, (tuple, list)) else out
+        return first._data if isinstance(first, Tensor) else first
+
+    lowered = jax.jit(fwd).lower(state, *[t._data for t in inputs])
+    cost = lowered.compile().cost_analysis()
+    fl = cost.get("flops", 0.0) if isinstance(cost, dict) else 0.0
+    if print_detail:
+        print(f"FLOPs: {fl:,.0f}")
+    return int(fl)

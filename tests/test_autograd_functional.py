@@ -1,0 +1,139 @@
+"""paddle.autograd.{jacobian,hessian,vjp,jvp}, paddle.summary/flops, and
+dist.shard_dataloader tests (SURVEY.md §2.4 autograd + hapi rows)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import autograd
+
+RNG = np.random.default_rng(31)
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestFunctionalTransforms:
+    def test_jacobian(self):
+        x = t([1.0, 2.0, 3.0])
+        J = autograd.jacobian(lambda a: a * a, x)
+        np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0, 6.0]),
+                                   rtol=1e-6)
+
+    def test_jacobian_multi_input(self):
+        x, y = t([1.0, 2.0]), t([3.0, 4.0])
+        Jx, Jy = autograd.jacobian(lambda a, b: a * b, [x, y])
+        np.testing.assert_allclose(Jx.numpy(), np.diag([3.0, 4.0]))
+        np.testing.assert_allclose(Jy.numpy(), np.diag([1.0, 2.0]))
+
+    def test_jacobian_batched(self):
+        xb = t(RNG.standard_normal((4, 3)))
+        Jb = autograd.jacobian(lambda a: (a ** 2).sum(), xb, batch_axis=0)
+        np.testing.assert_allclose(Jb.numpy(), 2 * xb.numpy(), rtol=1e-5)
+
+    def test_hessian(self):
+        x = t([1.0, 2.0])
+        H = autograd.hessian(lambda a: (a ** 3).sum(), x)
+        np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]),
+                                   rtol=1e-6)
+
+    def test_vjp_jvp(self):
+        x = t([1.0, 2.0])
+        out, g = autograd.vjp(lambda a: a * a, x, v=t([1.0, 1.0]))
+        np.testing.assert_allclose(out.numpy(), [1.0, 4.0])
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+        out2, tg = autograd.jvp(lambda a: a * a, x, v=t([1.0, 0.0]))
+        np.testing.assert_allclose(tg.numpy(), [2.0, 0.0])
+
+    def test_lazy_wrappers(self):
+        x = t([1.0, 2.0])
+        J = autograd.Jacobian(lambda a: a * 3.0, x)
+        np.testing.assert_allclose(np.asarray(J[0, 0]._data), 3.0)
+        assert J.shape == [2, 2]
+
+
+class TestSummaryFlops:
+    def _model(self):
+        return paddle.nn.Sequential(
+            paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+            paddle.nn.Linear(32, 10))
+
+    def test_summary_counts(self, capsys):
+        info = paddle.summary(self._model(), (1, 16))
+        out = capsys.readouterr().out
+        assert "Linear" in out and "Total params" in out
+        assert info["total_params"] == 16 * 32 + 32 + 32 * 10 + 10
+        assert info["trainable_params"] == info["total_params"]
+
+    def test_flops_positive(self):
+        n = paddle.flops(self._model(), (1, 16))
+        # ≥ 2 * params-in-matmuls MACs
+        assert n >= 2 * (16 * 32 + 32 * 10)
+
+
+class TestShardDataloader:
+    def test_batches_sharded(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return np.full((4,), i, np.float32), np.int64(i % 2)
+
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        loader = dist.shard_dataloader(
+            DataLoader(DS(), batch_size=8), mesh, shard_dims="dp")
+        assert len(loader) == 2
+        for x, y in loader:
+            assert x._data.sharding.spec[0] == "dp"
+            assert np.asarray(x._data).shape == (8, 4)
+
+
+class TestReviewRegressions:
+    def test_multi_input_lazy_jacobian(self):
+        x, y = t([1.0, 2.0]), t([3.0, 4.0])
+        J = autograd.Jacobian(lambda a, b: a * b, [x, y])
+        assert len(J.shape) == 2  # per-input block shapes
+        np.testing.assert_allclose(np.asarray(J[0]._data),
+                                   np.diag([3.0, 4.0]))
+        with pytest.raises(TypeError):
+            J[0, 0]
+
+    def test_vjp_list_cotangent_for_tuple_output(self):
+        x = t([1.0, 2.0])
+        out, g = autograd.vjp(lambda a: (a * a, a + 1.0), x,
+                              v=[t([1.0, 1.0]), t([1.0, 1.0])])
+        np.testing.assert_allclose(g.numpy(), [3.0, 5.0])  # 2x+1
+
+    def test_shard_dataloader_multi_mesh_rejected(self):
+        import paddle_tpu.distributed as dist
+        m = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        with pytest.raises(NotImplementedError):
+            dist.shard_dataloader([], [m, m])
+
+    def test_shard_dataloader_input_keys(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return {"images": np.zeros((4,), np.float32),
+                        "meta": np.float32(i)}
+
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        loader = dist.shard_dataloader(DataLoader(DS(), batch_size=8), mesh,
+                                       shard_dims="dp",
+                                       input_keys=["images"])
+        batch = next(iter(loader))
+        assert batch["images"]._data.sharding.spec[0] == "dp"
+        assert getattr(batch["meta"], "placements", None) is None
+
+    def test_summary_without_inputs_raises(self):
+        with pytest.raises(ValueError, match="input_size"):
+            paddle.summary(paddle.nn.Linear(2, 2))
