@@ -62,6 +62,7 @@ from . import signal  # noqa: F401
 from . import geometric  # noqa: F401
 from . import inference  # noqa: F401
 from . import onnx  # noqa: F401
+from . import audio  # noqa: F401
 from . import jit  # noqa: F401
 from . import utils  # noqa: F401
 from .utils import metrics as metric  # noqa: F401

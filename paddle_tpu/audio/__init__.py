@@ -1,0 +1,12 @@
+"""paddle.audio — audio feature extraction (spectrograms, mel, MFCC).
+
+Reference parity: python/paddle/audio/ (features/layers.py Spectrogram/
+MelSpectrogram/LogMelSpectrogram/MFCC over paddle.signal.stft;
+functional/functional.py hz_to_mel/mel_to_hz/compute_fbank_matrix/
+create_dct; functional/window.py get_window — upstream-canonical,
+unverified, SURVEY.md §0). TPU-native: everything composes from the
+framework stft (batched FFT) + one fbank matmul — XLA fuses the chain.
+"""
+from . import functional  # noqa: F401
+from .features import (Spectrogram, MelSpectrogram,  # noqa: F401
+                       LogMelSpectrogram, MFCC)

@@ -1,0 +1,128 @@
+"""paddle.audio.functional — mel scales, filter banks, DCT, windows."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "compute_fbank_matrix", "create_dct", "get_window",
+           "power_to_db"]
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not hasattr(freq, "__len__") and not isinstance(freq, Tensor)
+    f = np.asarray(freq._data if isinstance(freq, Tensor) else freq,
+                   np.float32)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:  # slaney
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, out)
+    return float(out) if scalar else Tensor(jnp.asarray(out))
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not hasattr(mel, "__len__") and not isinstance(mel, Tensor)
+    m = np.asarray(mel._data if isinstance(mel, Tensor) else mel, np.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)), out)
+    return float(out) if scalar else Tensor(jnp.asarray(out))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return Tensor(jnp.asarray(
+        np.asarray(mel_to_hz(mels, htk)._data), jnp.float32))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, n_fft//2 + 1] triangular mel filter bank."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fft_freqs = np.linspace(0, float(sr) / 2, n_fft // 2 + 1)
+    mel_f = np.asarray(mel_frequencies(n_mels + 2, f_min, f_max, htk)._data)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_freqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights, np.dtype(dtype)))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II matrix (torchaudio/paddle layout)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct, np.dtype(dtype)))
+
+
+_WINDOWS = {
+    "hann": np.hanning, "hamming": np.hamming, "blackman": np.blackman,
+    "bartlett": np.bartlett,
+}
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    if isinstance(window, (tuple, list)):
+        name, *args = window
+    else:
+        name, args = window, []
+    n = win_length + 1 if fftbins else win_length
+    if name in _WINDOWS:
+        w = _WINDOWS[name](n)
+    elif name in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    elif name == "gaussian":
+        std = args[0] if args else win_length / 6.0
+        m = np.arange(n) - (n - 1) / 2.0
+        w = np.exp(-0.5 * (m / std) ** 2)
+    elif name == "kaiser":
+        beta = args[0] if args else 12.0
+        w = np.kaiser(n, beta)
+    else:
+        raise ValueError(f"unknown window {window!r}")
+    if fftbins:
+        w = w[:-1]
+    return Tensor(jnp.asarray(w, np.dtype(dtype)))
+
+
+def power_to_db(magnitude, ref_value=1.0, amin=1e-10, top_db=80.0):
+    from ..ops._registry import eager
+
+    def raw(x):
+        db = 10.0 * jnp.log10(jnp.maximum(amin, x))
+        db -= 10.0 * math.log10(max(amin, ref_value))
+        if top_db is not None:
+            db = jnp.maximum(db, jnp.max(db) - top_db)
+        return db
+    return eager(raw, (magnitude,), {}, name="power_to_db")

@@ -68,6 +68,10 @@ def hessian(func: Callable, xs, batch_axis=None) -> Union[Tensor, tuple]:
 
     def scalar_fn(*a):
         out = fn(*a)
+        if jnp.size(out) != 1:
+            raise ValueError(
+                "hessian requires a scalar-output func, got output shape "
+                f"{jnp.shape(out)}")
         return jnp.squeeze(out)
 
     if batch_axis is None:

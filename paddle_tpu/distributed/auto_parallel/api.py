@@ -159,13 +159,19 @@ class _ShardedDataLoader:
         self._mesh = mesh
         dims = shard_dims if isinstance(shard_dims, (list, tuple)) \
             else [shard_dims]
+        unknown = [d for d in dims if d not in mesh.dim_names]
+        if unknown:
+            raise ValueError(
+                f"shard_dims {unknown} not in mesh dims {mesh.dim_names}")
         self._placements = [Shard(0) if d in dims else Replicate()
                             for d in mesh.dim_names]
         self._input_keys = set(input_keys) if input_keys else None
 
     def _place(self, item, key=None):
         if isinstance(item, (list, tuple)):
-            return type(item)(self._place(v) for v in item)
+            # containers inherit the parent dict key (input_keys filtering
+            # must hold for nested tensors)
+            return type(item)(self._place(v, key=key) for v in item)
         if isinstance(item, dict):
             return {k: self._place(v, key=k) for k, v in item.items()}
         if isinstance(item, Tensor):

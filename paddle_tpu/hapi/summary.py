@@ -104,8 +104,9 @@ def flops(net: Layer, input_size, custom_ops=None, print_detail=False):
     def fwd(state_arrays, *xs):
         out, _ = functional_call(net, state_arrays,
                                  *[Tensor(x) for x in xs])
-        first = out[0] if isinstance(out, (tuple, list)) else out
-        return first._data if isinstance(first, Tensor) else first
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        # keep EVERY output live so XLA cannot DCE auxiliary branches
+        return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
 
     lowered = jax.jit(fwd).lower(state, *[t._data for t in inputs])
     cost = lowered.compile().cost_analysis()

@@ -8,4 +8,5 @@ from .sampler import (  # noqa: F401
     Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
     SubsetRandomSampler, BatchSampler, DistributedBatchSampler,
 )
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import (DataLoader, default_collate_fn,  # noqa: F401
+                         get_worker_info, WorkerInfo)

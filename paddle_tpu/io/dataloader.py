@@ -74,8 +74,30 @@ def _picklable(obj) -> bool:
         return False
 
 
+class WorkerInfo:
+    """paddle.io.get_worker_info payload (id/num_workers/dataset/seed)."""
+
+    def __init__(self, id, num_workers, seed, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker: that worker's WorkerInfo; None in the
+    main process (reference contract)."""
+    return _worker_info
+
+
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn,
-                 worker_id, seed, ring_name=None):
+                 worker_id, seed, ring_name=None, num_workers=1):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, seed + worker_id,
+                              dataset)
     np.random.seed((seed + worker_id) % (2 ** 31))
     ring = None
     if ring_name is not None:
@@ -204,7 +226,8 @@ class _MultiProcessIter:
                 target=_worker_loop,
                 args=(loader.dataset, iq, self.data_queue, worker_collate,
                       loader.worker_init_fn, wid, seed,
-                      self.ring.name if self.ring is not None else None),
+                      self.ring.name if self.ring is not None else None,
+                      loader.num_workers),
                 daemon=True)
             w.start()
             self.index_queues.append(iq)

@@ -1,0 +1,97 @@
+"""paddle.audio tests — mel scale/fbank/DCT vs known values; feature layers
+shape + consistency with paddle.signal.stft (SURVEY.md §2.4 domain rows)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import functional as AF
+
+RNG = np.random.default_rng(41)
+
+
+class TestFunctional:
+    def test_mel_round_trip(self):
+        for htk in (False, True):
+            f = np.array([100.0, 440.0, 4000.0], np.float32)
+            m = AF.hz_to_mel(paddle.to_tensor(f), htk=htk)
+            back = AF.mel_to_hz(m, htk=htk)
+            np.testing.assert_allclose(back.numpy(), f, rtol=1e-4)
+
+    def test_hz_to_mel_htk_scalar(self):
+        # classic anchor: 1000 Hz ~ 1000 mel (HTK)
+        assert abs(AF.hz_to_mel(1000.0, htk=True) - 999.99) < 0.1
+
+    def test_fbank_matrix(self):
+        fb = AF.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(axis=1) > 0).all()  # every filter non-empty
+
+    def test_dct_orthonormal(self):
+        d = AF.create_dct(13, 40).numpy()
+        assert d.shape == (40, 13)
+        gram = d.T @ d
+        np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+    def test_get_window(self):
+        w = AF.get_window("hann", 64).numpy()
+        assert w.shape == (64,)
+        np.testing.assert_allclose(w, np.hanning(65)[:-1], rtol=1e-6)
+        with pytest.raises(ValueError):
+            AF.get_window("nope", 8)
+
+    def test_power_to_db(self):
+        x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
+        db = AF.power_to_db(x, top_db=None).numpy()
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-5)
+
+
+class TestFeatures:
+    def test_spectrogram_matches_stft(self):
+        x = paddle.to_tensor(RNG.standard_normal((2, 2048))
+                             .astype(np.float32))
+        layer = paddle.audio.Spectrogram(n_fft=256, hop_length=128)
+        out = layer(x)
+        spec = paddle.signal.stft(x, 256, 128, window=layer.window)
+        np.testing.assert_allclose(out.numpy(),
+                                   np.abs(spec.numpy()) ** 2, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_mel_and_mfcc_shapes(self):
+        x = paddle.to_tensor(RNG.standard_normal((1, 16000))
+                             .astype(np.float32))
+        mel = paddle.audio.MelSpectrogram(sr=16000, n_fft=512,
+                                          hop_length=256, n_mels=40)(x)
+        assert mel.shape[1] == 40
+        logmel = paddle.audio.LogMelSpectrogram(
+            sr=16000, n_fft=512, hop_length=256, n_mels=40)(x)
+        assert logmel.shape == mel.shape
+        assert float(logmel.max().numpy()) <= 10 * np.log10(
+            float(mel.max().numpy())) + 1e-3
+        mfcc = paddle.audio.MFCC(sr=16000, n_mfcc=13, n_fft=512,
+                                 hop_length=256, n_mels=40)(x)
+        assert mfcc.shape[1] == 13
+
+
+class TestWorkerInfo:
+    def test_main_process_none(self):
+        from paddle_tpu.io import get_worker_info
+        assert get_worker_info() is None
+
+    def test_worker_sees_info(self):
+        from paddle_tpu.io import DataLoader, Dataset, get_worker_info
+
+        class DS(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                info = get_worker_info()
+                assert info is not None and info.num_workers == 2
+                return np.float32(info.id)
+
+        loader = DataLoader(DS(), batch_size=2, num_workers=2)
+        ids = set()
+        for batch in loader:
+            ids.update(batch.numpy().tolist())
+        assert ids <= {0.0, 1.0}

@@ -137,3 +137,47 @@ class TestReviewRegressions:
     def test_summary_without_inputs_raises(self):
         with pytest.raises(ValueError, match="input_size"):
             paddle.summary(paddle.nn.Linear(2, 2))
+
+    def test_hessian_rejects_vector_output(self):
+        with pytest.raises(ValueError, match="scalar"):
+            autograd.hessian(lambda a: a * a, t([1.0, 2.0]))
+
+    def test_shard_dataloader_bad_dim_and_nested_keys(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.io import DataLoader, Dataset
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        with pytest.raises(ValueError, match="shard_dims"):
+            dist.shard_dataloader([], mesh, shard_dims="dpp")
+
+        class DS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return {"images": np.zeros((4,), np.float32),
+                        "meta": [np.float32(i), np.float32(i * 2)]}
+
+        loader = dist.shard_dataloader(DataLoader(DS(), batch_size=8), mesh,
+                                       shard_dims="dp",
+                                       input_keys=["images"])
+        batch = next(iter(loader))
+        for m in batch["meta"]:  # nested under an excluded key: unsharded
+            assert getattr(m, "placements", None) is None
+
+    def test_flops_counts_aux_outputs(self):
+        class TwoHead(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = paddle.nn.Linear(16, 64)
+                self.b = paddle.nn.Linear(16, 64)
+
+            def forward(self, x):
+                return self.a(x), self.b(x)
+
+        class OneHead(TwoHead):
+            def forward(self, x):
+                return self.a(x)
+
+        two = paddle.flops(TwoHead(), (1, 16))
+        one = paddle.flops(OneHead(), (1, 16))
+        assert two > one  # aux head not DCE'd
