@@ -70,6 +70,67 @@ from .utils.checkpoint import save, load  # noqa: F401
 from .hapi import Model, callbacks  # noqa: F401
 from .hapi.summary import summary, flops  # noqa: F401
 
+# top-level shims (paddle parity): version/dtype/framework aliases,
+# printoptions, batch reader decorator, LazyGuard no-op
+import types as _sh_types
+version = _sh_types.SimpleNamespace(
+    full_version=__version__,
+    major="0", minor="1", patch="0", rc="0",
+    cuda=lambda: "False", cudnn=lambda: "False",
+    show=lambda: print("paddle_tpu (TPU-native)"))
+dtype = _dtype_mod.convert_dtype
+framework = _sh_types.SimpleNamespace(
+    in_dygraph_mode=lambda: in_dynamic_mode(),
+    core=_sh_types.SimpleNamespace())
+del _sh_types
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch reader decorator (legacy reader protocol parity)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+class LazyGuard:
+    """paddle.LazyGuard parity: lazy param init is a no-op here — params
+    materialize at construction (XLA init is cheap and jit-compiled)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def disable_signal_handler():
+    pass  # the reference installs C++ crash handlers; nothing to disable
+
+
 # regularizer namespace (paddle.regularizer.L1Decay/L2Decay)
 from .optimizer.optimizers import L1Decay as _L1, L2Decay as _L2
 import types as _t

@@ -10,8 +10,8 @@ import jax.numpy as jnp
 
 
 class Spectrogram(nn.Layer):
-    def __init__(self, n_fft=512, hop_length=None, win_length=None,
-                 window="hann", power=2.0, center=True, pad_mode="reflect",
+    def __init__(self, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=1.0, center=True, pad_mode="reflect",
                  dtype="float32"):
         super().__init__()
         self.n_fft = n_fft
@@ -31,7 +31,7 @@ class Spectrogram(nn.Layer):
 
 
 class MelSpectrogram(nn.Layer):
-    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+    def __init__(self, sr=22050, n_fft=512, hop_length=512, win_length=None,
                  window="hann", power=2.0, center=True, pad_mode="reflect",
                  n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
                  dtype="float32"):
@@ -49,7 +49,7 @@ class MelSpectrogram(nn.Layer):
 
 
 class LogMelSpectrogram(nn.Layer):
-    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+    def __init__(self, sr=22050, n_fft=512, hop_length=512, win_length=None,
                  window="hann", power=2.0, center=True, pad_mode="reflect",
                  n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
                  ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
@@ -67,7 +67,7 @@ class LogMelSpectrogram(nn.Layer):
 
 
 class MFCC(nn.Layer):
-    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=512,
                  win_length=None, window="hann", power=2.0, center=True,
                  pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
                  htk=False, norm="slaney", ref_value=1.0, amin=1e-10,

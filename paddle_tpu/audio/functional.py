@@ -110,7 +110,13 @@ def get_window(window, win_length, fftbins=True, dtype="float32"):
         beta = args[0] if args else 12.0
         w = np.kaiser(n, beta)
     else:
-        raise ValueError(f"unknown window {window!r}")
+        try:  # full reference window zoo via scipy (taylor/tukey/bohman/...)
+            from scipy.signal import get_window as _sp_get_window
+            return Tensor(jnp.asarray(
+                _sp_get_window(window if args else name, win_length,
+                               fftbins=fftbins), np.dtype(dtype)))
+        except (ImportError, ValueError) as e:
+            raise ValueError(f"unknown window {window!r}") from e
     if fftbins:
         w = w[:-1]
     return Tensor(jnp.asarray(w, np.dtype(dtype)))

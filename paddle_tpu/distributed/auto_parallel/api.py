@@ -159,6 +159,8 @@ class _ShardedDataLoader:
         self._mesh = mesh
         dims = shard_dims if isinstance(shard_dims, (list, tuple)) \
             else [shard_dims]
+        # reference accepts mesh-dim indices as well as names
+        dims = [mesh.dim_names[d] if isinstance(d, int) else d for d in dims]
         unknown = [d for d in dims if d not in mesh.dim_names]
         if unknown:
             raise ValueError(
@@ -167,16 +169,20 @@ class _ShardedDataLoader:
                             for d in mesh.dim_names]
         self._input_keys = set(input_keys) if input_keys else None
 
-    def _place(self, item, key=None):
+    def _place(self, item, matched=None):
+        """matched: None = no dict ancestor (plain tuple batches shard
+        everything); True = under an included key; False = under an
+        excluded key — once a top-level key matches, nested values stop
+        re-filtering."""
         if isinstance(item, (list, tuple)):
-            # containers inherit the parent dict key (input_keys filtering
-            # must hold for nested tensors)
-            return type(item)(self._place(v, key=key) for v in item)
+            return type(item)(self._place(v, matched) for v in item)
         if isinstance(item, dict):
-            return {k: self._place(v, key=k) for k, v in item.items()}
+            return {k: self._place(
+                v, True if (matched is True or self._input_keys is None
+                            or k in self._input_keys) else False)
+                for k, v in item.items()}
         if isinstance(item, Tensor):
-            if self._input_keys is not None and key is not None and \
-                    key not in self._input_keys:
+            if matched is False:
                 return item  # reference: only the named inputs shard
             return shard_tensor(item, self._mesh, self._placements)
         return item

@@ -39,6 +39,8 @@ class TestFunctional:
         np.testing.assert_allclose(w, np.hanning(65)[:-1], rtol=1e-6)
         with pytest.raises(ValueError):
             AF.get_window("nope", 8)
+        tk = AF.get_window(("tukey", 0.5), 64)  # scipy zoo fallback
+        assert tk.shape == [64]
 
     def test_power_to_db(self):
         x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
@@ -50,12 +52,17 @@ class TestFeatures:
     def test_spectrogram_matches_stft(self):
         x = paddle.to_tensor(RNG.standard_normal((2, 2048))
                              .astype(np.float32))
-        layer = paddle.audio.Spectrogram(n_fft=256, hop_length=128)
+        layer = paddle.audio.Spectrogram(n_fft=256, hop_length=128,
+                                         power=2.0)
         out = layer(x)
         spec = paddle.signal.stft(x, 256, 128, window=layer.window)
         np.testing.assert_allclose(out.numpy(),
                                    np.abs(spec.numpy()) ** 2, rtol=1e-4,
                                    atol=1e-5)
+        # reference default: magnitude (power=1) spectrum
+        mag = paddle.audio.Spectrogram(n_fft=256, hop_length=128)(x)
+        np.testing.assert_allclose(mag.numpy(), np.abs(spec.numpy()),
+                                   rtol=1e-4, atol=1e-5)
 
     def test_mel_and_mfcc_shapes(self):
         x = paddle.to_tensor(RNG.standard_normal((1, 16000))

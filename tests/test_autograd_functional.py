@@ -181,3 +181,24 @@ class TestReviewRegressions:
         two = paddle.flops(TwoHead(), (1, 16))
         one = paddle.flops(OneHead(), (1, 16))
         assert two > one  # aux head not DCE'd
+
+    def test_shard_dims_int_and_nested_included_dict(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.io import DataLoader, Dataset
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+
+        class DS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return {"images": {"rgb": np.zeros((4,), np.float32)},
+                        "meta": np.float32(i)}
+
+        loader = dist.shard_dataloader(DataLoader(DS(), batch_size=8), mesh,
+                                       shard_dims=0,  # int index form
+                                       input_keys=["images"])
+        batch = next(iter(loader))
+        # nested under an INCLUDED key: sharded
+        assert batch["images"]["rgb"]._data.sharding.spec[0] == "dp"
+        assert getattr(batch["meta"], "placements", None) is None
