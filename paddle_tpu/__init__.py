@@ -73,12 +73,16 @@ from .hapi.summary import summary, flops  # noqa: F401
 # top-level shims (paddle parity): version/dtype/framework aliases,
 # printoptions, batch reader decorator, LazyGuard no-op
 import types as _sh_types
+_v_parts = (__version__.split(".") + ["0", "0", "0"])[:3]
 version = _sh_types.SimpleNamespace(
     full_version=__version__,
-    major="0", minor="1", patch="0", rc="0",
+    major=_v_parts[0], minor=_v_parts[1], patch=_v_parts[2], rc="0",
     cuda=lambda: "False", cudnn=lambda: "False",
     show=lambda: print("paddle_tpu (TPU-native)"))
-dtype = _dtype_mod.convert_dtype
+del _v_parts
+import numpy as _sh_np
+dtype = _sh_np.dtype  # a TYPE: isinstance(x.dtype, paddle.dtype) works and
+del _sh_np           # paddle.dtype("float32") still converts
 framework = _sh_types.SimpleNamespace(
     in_dygraph_mode=lambda: in_dynamic_mode(),
     core=_sh_types.SimpleNamespace())

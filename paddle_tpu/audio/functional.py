@@ -113,7 +113,7 @@ def get_window(window, win_length, fftbins=True, dtype="float32"):
         try:  # full reference window zoo via scipy (taylor/tukey/bohman/...)
             from scipy.signal import get_window as _sp_get_window
             return Tensor(jnp.asarray(
-                _sp_get_window(window if args else name, win_length,
+                _sp_get_window(tuple(window) if args else name, win_length,
                                fftbins=fftbins), np.dtype(dtype)))
         except (ImportError, ValueError) as e:
             raise ValueError(f"unknown window {window!r}") from e

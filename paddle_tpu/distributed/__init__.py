@@ -26,6 +26,8 @@ from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .fleet import DistributedStrategy  # noqa: F401
 from . import auto_parallel  # noqa: F401
+from . import rpc  # noqa: F401
+from . import ps  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     Placement, Replicate, Shard, Partial, ProcessMesh,
     shard_tensor, dtensor_from_fn, reshard, unshard_dtensor,

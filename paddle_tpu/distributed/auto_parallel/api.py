@@ -160,6 +160,11 @@ class _ShardedDataLoader:
         dims = shard_dims if isinstance(shard_dims, (list, tuple)) \
             else [shard_dims]
         # reference accepts mesh-dim indices as well as names
+        for d in dims:
+            if isinstance(d, int) and not 0 <= d < mesh.ndim:
+                raise ValueError(
+                    f"shard_dims index {d} out of range for a "
+                    f"{mesh.ndim}-d mesh")
         dims = [mesh.dim_names[d] if isinstance(d, int) else d for d in dims]
         unknown = [d for d in dims if d not in mesh.dim_names]
         if unknown:
@@ -177,9 +182,11 @@ class _ShardedDataLoader:
         if isinstance(item, (list, tuple)):
             return type(item)(self._place(v, matched) for v in item)
         if isinstance(item, dict):
+            # only the first (outermost) dict level filters; nested dicts
+            # inherit their ancestor's include/exclude decision
             return {k: self._place(
-                v, True if (matched is True or self._input_keys is None
-                            or k in self._input_keys) else False)
+                v, matched if matched is not None else
+                (self._input_keys is None or k in self._input_keys))
                 for k, v in item.items()}
         if isinstance(item, Tensor):
             if matched is False:

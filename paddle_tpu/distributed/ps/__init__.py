@@ -1,0 +1,14 @@
+"""paddle.distributed.ps — out-of-scope stub (SURVEY.md §2.3 Parameter
+Server row: 'out of scope for v1'; §7 build plan)."""
+
+
+def _unsupported(*a, **k):
+    raise NotImplementedError(
+        "paddle.distributed.ps: the bRPC parameter-server stack "
+        "(recommendation sparse tables, GEO-SGD) is explicitly out of v1 "
+        "scope (paddle_tpu/distributed/ps/__init__.py; SURVEY.md §2.3/§7).")
+
+
+class TheOnePs:
+    def __init__(self, *a, **k):
+        _unsupported()

@@ -1,0 +1,15 @@
+"""paddle.distributed.rpc — out-of-scope stub (SURVEY.md §7 'What we
+deliberately do NOT rebuild'; the reference's bRPC-based RPC layer serves
+parameter-server workloads)."""
+
+
+def _unsupported(*a, **k):
+    raise NotImplementedError(
+        "paddle.distributed.rpc: RPC/parameter-server workloads are out of "
+        "scope for the TPU-native framework "
+        "(paddle_tpu/distributed/rpc/__init__.py; SURVEY.md §7). Use GSPMD "
+        "sharding (paddle_tpu.distributed.auto_parallel) for model "
+        "parallelism.")
+
+
+init_rpc = rpc_sync = rpc_async = shutdown = get_worker_info = _unsupported
