@@ -80,9 +80,21 @@ version = _sh_types.SimpleNamespace(
     cuda=lambda: "False", cudnn=lambda: "False",
     show=lambda: print("paddle_tpu (TPU-native)"))
 del _v_parts
-import numpy as _sh_np
-dtype = _sh_np.dtype  # a TYPE: isinstance(x.dtype, paddle.dtype) works and
-del _sh_np           # paddle.dtype("float32") still converts
+class _DTypeMeta(type):
+    # np.dtype cannot be subclassed; delegate isinstance and construction
+    def __instancecheck__(cls, obj):
+        import numpy as _np
+        return isinstance(obj, _np.dtype)
+
+    def __call__(cls, obj=None):
+        return _dtype_mod.convert_dtype(obj)
+
+
+class dtype(metaclass=_DTypeMeta):
+    """paddle.dtype parity: a TYPE (isinstance(x.dtype, paddle.dtype)
+    works — Tensor.dtype returns np.dtype instances) whose constructor
+    resolves Paddle spellings (bfloat16/half/FP32/None-default) through
+    core.dtype.convert_dtype."""
 framework = _sh_types.SimpleNamespace(
     in_dygraph_mode=lambda: in_dynamic_mode(),
     core=_sh_types.SimpleNamespace())

@@ -167,3 +167,19 @@ def calculate_gain(nonlinearity, param=None):
     if nonlinearity == "selu":
         return 3.0 / 4
     raise ValueError(f"unknown nonlinearity {nonlinearity}")
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """paddle.nn.initializer.set_global_initializer parity: default
+    initializers used by create_parameter when no attr/default is given."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def _global_default(is_bias):
+    return _global_bias_init if is_bias else _global_weight_init

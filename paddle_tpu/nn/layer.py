@@ -126,7 +126,11 @@ class Layer:
         if attr is False:
             return None
         dtype = dtypes.convert_dtype(dtype or self._dtype)
-        init = attr.initializer or default_initializer
+        # precedence: explicit ParamAttr initializer > global initializer
+        # (set_global_initializer) > the layer's built-in default
+        init = attr.initializer
+        if init is None:
+            init = I._global_default(is_bias) or default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         data = init(tuple(int(s) for s in shape), dtype)

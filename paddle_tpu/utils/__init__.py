@@ -1,3 +1,33 @@
 from . import checkpoint  # noqa: F401
 from . import metrics  # noqa: F401
 from .checkpoint import save, load  # noqa: F401
+from . import unique_name  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    """paddle.utils.try_import parity."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or
+                          f"{module_name} is required but not installed")
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the framework computes on the
+    available device and report it."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    import jax
+    dev = jax.devices()[0]
+    x = Tensor(np.ones((2, 2), np.float32))
+    out = (x @ x).numpy()
+    if not np.allclose(out, 2.0):  # assert would vanish under python -O
+        raise RuntimeError("paddle_tpu run_check: matmul sanity check "
+                           f"failed (got {out})")
+    n = jax.device_count()
+    print(f"paddle_tpu is installed and working on {dev.platform} "
+          f"({dev.device_kind}), {n} device(s) visible.")
+    if n > 1:
+        print("paddle_tpu works on multiple devices via jax.sharding.Mesh.")

@@ -1,0 +1,30 @@
+"""paddle.utils.unique_name — name uniquifier (reference parity)."""
+from __future__ import annotations
+
+import contextlib
+
+_counters = {}
+
+
+def generate(key: str) -> str:
+    n = _counters.get(key, 0)
+    _counters[key] = n + 1
+    return f"{key}_{n}"
+
+
+def switch(new_generator=None):
+    """Swap the active counter state for `new_generator` (a dict previously
+    returned by switch(), or None for a fresh scope); returns the old one."""
+    global _counters
+    old = _counters
+    _counters = new_generator if new_generator is not None else {}
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -202,3 +202,33 @@ class TestReviewRegressions:
         # nested under an INCLUDED key: sharded
         assert batch["images"]["rgb"]._data.sharding.spec[0] == "dp"
         assert getattr(batch["meta"], "placements", None) is None
+
+
+class TestUtilsInitializer:
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+        with unique_name.guard():
+            a = unique_name.generate("fc")
+            b = unique_name.generate("fc")
+            assert a == "fc_0" and b == "fc_1"
+        with unique_name.guard():
+            assert unique_name.generate("fc") == "fc_0"  # fresh scope
+
+    def test_run_check_and_try_import(self, capsys):
+        paddle.utils.run_check()
+        assert "working" in capsys.readouterr().out
+        assert paddle.utils.try_import("math") is not None
+        with pytest.raises(ImportError):
+            paddle.utils.try_import("definitely_not_a_module_xyz")
+
+    def test_set_global_initializer(self):
+        I = paddle.nn.initializer
+        I.set_global_initializer(I.Constant(0.5), I.Constant(-0.5))
+        try:
+            lin = paddle.nn.Linear(3, 3)
+            np.testing.assert_allclose(lin.weight.numpy(), 0.5)
+            np.testing.assert_allclose(lin.bias.numpy(), -0.5)
+        finally:
+            I.set_global_initializer(None, None)
+        lin2 = paddle.nn.Linear(3, 3)
+        assert not np.allclose(lin2.weight.numpy(), 0.5)
