@@ -134,3 +134,76 @@ class TestGraftEntry:
     def test_dryrun_multichip(self):
         import __graft_entry__ as g
         g.dryrun_multichip(8)
+
+
+class TestGradAccumulation:
+    def test_accum_matches_full_batch(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.nlp import llama, train
+        cfg = llama.LlamaConfig.tiny()
+        tx = train.make_optimizer(1e-3)
+        state1 = train.init_state(jax.random.key(0), cfg, tx, mesh=None)
+        state2 = train.init_state(jax.random.key(0), cfg, tx, mesh=None)
+        step1 = train.make_train_step(cfg, tx, mesh=None, donate=False)
+        step4 = train.make_train_step(cfg, tx, mesh=None, donate=False,
+                                      grad_accum_steps=4)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 64)),
+            jnp.int32)
+        s1, m1 = step1(state1, tokens)
+        s2, m2 = step4(state2, tokens)
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(m1["grad_norm"]),
+                                   float(m2["grad_norm"]), rtol=1e-3)
+        # bf16 forward rounding differs between chunked and full batches;
+        # Adam turns near-zero grad sign flips into ~lr-sized param deltas,
+        # so params match to ~2*lr, not machine precision
+        flat1 = jax.tree.leaves(s1.params)
+        flat2 = jax.tree.leaves(s2.params)
+        for a, b in zip(flat1, flat2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=2.5e-3)
+
+    def test_bad_divisor_and_pp_combination(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import pytest
+        from paddle_tpu.nlp import llama, train
+        cfg = llama.LlamaConfig.tiny()
+        tx = train.make_optimizer(1e-3)
+        state = train.init_state(jax.random.key(0), cfg, tx, mesh=None)
+        step3 = train.make_train_step(cfg, tx, mesh=None, donate=False,
+                                      grad_accum_steps=3)
+        tokens = jnp.asarray(np.zeros((8, 64)), jnp.int32)
+        with pytest.raises(ValueError, match="divisible"):
+            step3(state, tokens)
+        with pytest.raises(ValueError, match=">= 1"):
+            train.make_train_step(cfg, tx, mesh=None, grad_accum_steps=0)
+        from paddle_tpu.parallel import topology
+        pp_mesh = topology.build_mesh(dp=4, pp=2)
+        with pytest.raises(ValueError, match="num_microbatches"):
+            train.make_train_step(cfg, tx, mesh=pp_mesh, grad_accum_steps=2)
+
+    def test_accum_on_sharded_mesh(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.nlp import llama, train
+        from paddle_tpu.parallel import topology
+        mesh = topology.build_mesh(dp=2, sharding=2, mp=2)
+        cfg = llama.LlamaConfig.tiny()
+        tx = train.make_optimizer(3e-4)
+        state = train.init_state(jax.random.key(0), cfg, tx, mesh=mesh)
+        step = train.make_train_step(cfg, tx, mesh=mesh, grad_accum_steps=2)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 128)),
+            jnp.int32)
+        l0 = None
+        for _ in range(4):
+            state, m = step(state, tokens)
+            l0 = l0 or float(m["loss"])
+        assert float(m["loss"]) < l0
