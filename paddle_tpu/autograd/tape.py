@@ -87,10 +87,11 @@ class GradNode:
 
     __slots__ = (
         "vjp_fn", "inputs", "n_outputs", "out_avals", "multi_out", "seq",
-        "name", "__weakref__",
+        "name", "fn", "__weakref__",
     )
 
-    def __init__(self, vjp_fn, inputs: Sequence["Any"], out_avals, multi_out: bool, name: str):
+    def __init__(self, vjp_fn, inputs: Sequence["Any"], out_avals, multi_out: bool, name: str,
+                 fn=None):
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)  # Tensor refs (differentiable inputs)
         self.out_avals = out_avals  # [(shape, dtype)] per output
@@ -98,9 +99,27 @@ class GradNode:
         self.multi_out = multi_out
         self.seq = _next_seq()
         self.name = name
+        # The primal function over the differentiable inputs (pure jnp), kept
+        # so create_graph=True can RE-record the vjp as eager ops (double
+        # grad). None for opaque nodes (custom PyLayer backward).
+        self.fn = fn
 
     def __repr__(self):
         return f"<GradNode {self.name} seq={self.seq}>"
+
+
+def _discover_nodes(nodes: Dict[int, "GradNode"]) -> None:
+    """Expand `nodes` in place with every GradNode reachable through inputs."""
+    stack = list(nodes.values())
+    seen = set(nodes.keys())
+    while stack:
+        n = stack.pop()
+        for t in n.inputs:
+            pn = getattr(t, "_grad_node", None)
+            if pn is not None and id(pn) not in seen:
+                seen.add(id(pn))
+                nodes[id(pn)] = pn
+                stack.append(pn)
 
 
 def _zero_cotangent(shape, dtype):
@@ -188,17 +207,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
 
     # Discover reachable nodes (for correct ordering we rely on seq numbers:
     # a node's inputs were produced by lower-seq nodes).
-    stack = list(nodes.values())
-    seen = set(nodes.keys())
-    while stack:
-        n = stack.pop()
-        for t in n.inputs:
-            pn = getattr(t, "_grad_node", None)
-            if pn is not None and id(pn) not in seen:
-                seen.add(id(pn))
-                nodes[id(pn)] = pn
-                stack.append(pn)
-
+    _discover_nodes(nodes)
     order = sorted(nodes.values(), key=lambda n: n.seq, reverse=True)
 
     for node in order:
@@ -242,22 +251,158 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
         if not retain_graph:
             node.vjp_fn = None
             node.inputs = []
+            node.fn = None  # the primal closure pins input arrays — free them
+
+
+def _replay_vjp(node: GradNode, slots):
+    """Re-record node's vjp as an eager op so the grads carry a tape graph.
+
+    Calls jax.vjp(node.fn, primals) INSIDE a raw function dispatched through
+    the normal eager path; the resulting grad Tensors get a GradNode whose own
+    vjp is the second-order derivative — this is how create_graph=True double
+    grad works (reference: paddle/fluid/eager double-grad nodes from
+    backward.yaml; here the re-trace IS the higher-order node)."""
+    from ..core.tensor import Tensor
+    from ..ops._registry import eager
+
+    prim_ts = list(node.inputs)
+    k = len(prim_ts)
+    out_avals = node.out_avals
+    float_slots = [
+        j for j, (_, d) in enumerate(out_avals) if np.dtype(d).kind not in "iub"
+    ]
+    fs_set = set(float_slots)
+    ct_ts = []
+    for j in float_slots:
+        s = slots[j]
+        if s is None:
+            shape, d = out_avals[j]
+            s = Tensor(jnp.zeros(shape, dtype=d), stop_gradient=True)
+        ct_ts.append(s)
+    fn, multi = node.fn, node.multi_out
+
+    def vjp_raw(*arrays):
+        prim = arrays[:k]
+        it = iter(arrays[k:])
+        cts = []
+        for j, (shape, d) in enumerate(out_avals):
+            if j in fs_set:
+                c = next(it)
+                if np.dtype(c.dtype) != np.dtype(d):
+                    c = c.astype(d)
+                cts.append(c)
+            else:
+                cts.append(np.zeros(shape, dtype=jax.dtypes.float0))
+        _, vf = jax.vjp(fn, *prim)
+        return tuple(vf(tuple(cts) if multi else cts[0]))
+
+    out = eager(vjp_raw, tuple(prim_ts) + tuple(ct_ts), {},
+                name=node.name + "_grad")
+    return out if isinstance(out, tuple) else (out,)
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
+    """paddle.grad(create_graph=True): tape walk where every cotangent is a
+    tracked Tensor and every vjp application is itself an eager op."""
+    from ..core.tensor import Tensor
+
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+
+    acc: Dict[Any, Any] = {}   # (node_id, out_idx) or ("leaf", tensor_id) -> Tensor
+    nodes: Dict[int, GradNode] = {}
+
+    def _key(t):
+        if t._grad_node is not None:
+            return (id(t._grad_node), t._out_index)
+        return ("leaf", id(t))
+
+    def _add(key, g):
+        cur = acc.get(key)
+        acc[key] = g if cur is None else cur + g  # Tensor add → tape-recorded
+
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    "pass grad_outputs for non-scalar grad()")
+            g = Tensor(jnp.ones_like(t._data), stop_gradient=True)
+        elif not isinstance(g, Tensor):
+            g = Tensor(jnp.asarray(g), stop_gradient=True)
+        _add(_key(t), g)
+        if t._grad_node is not None:
+            nodes[id(t._grad_node)] = t._grad_node
+
+    _discover_nodes(nodes)
+
+    for node in sorted(nodes.values(), key=lambda n: n.seq, reverse=True):
+        slots = [acc.get((id(node), j)) for j in range(node.n_outputs)]
+        if all(s is None for s in slots):
+            continue
+        if node.vjp_fn is None and not node.inputs:
+            raise RuntimeError(
+                f"trying to backward through {node.name} a second time; "
+                "set retain_graph=True if you need to")
+        if node.fn is None:
+            raise RuntimeError(
+                f"create_graph=True through '{node.name}' is not supported: "
+                "the node has an opaque Python backward (custom PyLayer); "
+                "write its backward with differentiable ops or use the "
+                "functional jax.grad composition")
+        in_grads = _replay_vjp(node, slots)
+        for t, g in zip(node.inputs, in_grads):
+            if t is None or g is None:
+                continue
+            for hook in t._hooks:
+                out = hook(g)
+                if out is not None:
+                    if not isinstance(out, Tensor):
+                        import warnings
+                        warnings.warn(
+                            f"tensor hook on '{node.name}' input returned a "
+                            "non-Tensor under create_graph=True; the "
+                            "second-order graph is severed through this edge",
+                            RuntimeWarning, stacklevel=2)
+                        out = Tensor(jnp.asarray(out), stop_gradient=True)
+                    g = out
+            if t.stop_gradient:
+                continue
+            _add(_key(t), g)
+
+    res = []
+    for t in inputs:
+        g = acc.get(_key(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                f"one of the input tensors was not used in the graph "
+                f"(shape={t.shape}); pass allow_unused=True to get None")
+        if g is not None:
+            # AMP contract parity with backward(): grads come back in the
+            # param's dtype. astype dispatches through eager → graph intact.
+            td = np.dtype(t._data.dtype)
+            if td.kind in "fc" and np.dtype(g._data.dtype) != td:
+                g = g.astype(td)
+        res.append(g)
+    return res
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False):
     """paddle.grad — functional gradient w.r.t. given inputs.
 
-    create_graph=True (double grad) is served by the functional API
-    (paddle_tpu.incubate.autograd / jax.grad composition), not the eager tape.
+    create_graph=True re-records each node's vjp through the eager dispatch
+    path, so returned grads carry a tape graph and can be differentiated again
+    (gradient-penalty patterns); see _grad_create_graph.
     """
     from ..core.tensor import Tensor
 
     if create_graph:
-        raise NotImplementedError(
-            "create_graph=True on the eager tape is not supported; use "
-            "paddle_tpu.jit.grad (jax.grad composition) for higher-order "
-            "derivatives (see paddle_tpu/autograd/tape.py)")
+        inputs_l = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+        return _grad_create_graph(outputs, inputs_l, grad_outputs, allow_unused)
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
     # Stash and restore .grad so paddle.grad doesn't clobber accumulated grads;
     # _grad_filter keeps backward() from writing .grad on any other leaf.

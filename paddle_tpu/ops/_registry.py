@@ -150,6 +150,7 @@ def eager(raw: Callable, args, kwargs, name: str = "op"):
         [(o.shape, np.dtype(o.dtype)) for o in outs],
         multi_out=multi,
         name=name,
+        fn=fn,  # re-traceable primal — enables create_graph double grad
     )
     wrapped = []
     for j, o in enumerate(outs):

@@ -178,3 +178,70 @@ class TestInplace:
         np.testing.assert_allclose(x.numpy(), [0.0, 0.0])
         x.fill_(7.0)
         np.testing.assert_allclose(x.numpy(), [7.0, 7.0])
+
+
+class TestDoubleGrad:
+    """create_graph=True: the vjp is re-recorded through eager dispatch so
+    grads carry a tape graph (reference: double-grad nodes from backward.yaml,
+    paddle/fluid/eager — SURVEY.md §2.4 autograd row)."""
+
+    def test_cubic_second_derivative(self):
+        x = t([2.0, -1.5, 0.5])
+        y = (x * x * x).sum()
+        (g1,) = paddle.grad(y, [x], create_graph=True)
+        assert g1.stop_gradient is False
+        (g2,) = paddle.grad(g1.sum(), [x])
+        np.testing.assert_allclose(
+            g2.numpy(), 6 * np.array([2.0, -1.5, 0.5]), rtol=1e-6)
+
+    def test_matches_jax_double_grad(self):
+        import jax
+        import jax.numpy as jnp
+
+        xv = np.array([0.3, -0.7, 1.2], np.float32)
+
+        def f(v):
+            return jnp.tanh(v * v + jnp.sin(v)).sum()
+
+        ref = jax.grad(lambda v: jax.grad(f)(v).sum())(jnp.asarray(xv))
+        xt = t(xv)
+        yt = (xt * xt + xt.sin()).tanh().sum()
+        (g1,) = paddle.grad(yt, [xt], create_graph=True)
+        (g2,) = paddle.grad(g1.sum(), [xt])
+        np.testing.assert_allclose(g2.numpy(), np.asarray(ref), rtol=1e-5)
+
+    def test_gradient_penalty_backward(self):
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        wv = rng.standard_normal((4, 4), dtype=np.float32)
+        xv = rng.standard_normal((2, 4), dtype=np.float32)
+        w, x = t(wv), t(xv)
+        out = (x @ w).tanh().sum()
+        (gx,) = paddle.grad(out, [x], create_graph=True)
+        (gx * gx).sum().backward()
+
+        def penalty(wa, xa):
+            g = jax.grad(lambda xx: jnp.tanh(xx @ wa).sum())(xa)
+            return (g * g).sum()
+
+        ref = jax.grad(penalty)(jnp.asarray(wv), jnp.asarray(xv))
+        np.testing.assert_allclose(
+            w.grad.numpy(), np.asarray(ref), rtol=2e-4, atol=1e-6)
+
+    def test_third_order(self):
+        x = t([1.5])
+        y = (x ** 4).sum()
+        (a,) = paddle.grad(y, [x], create_graph=True)
+        (b,) = paddle.grad(a.sum(), [x], create_graph=True)
+        (c,) = paddle.grad(b.sum(), [x])
+        np.testing.assert_allclose(c.numpy(), [24 * 1.5], rtol=1e-6)
+
+    def test_unused_input_raises_and_allow_unused(self):
+        x, z = t([1.0]), t([2.0])
+        y = (x * x).sum()
+        with pytest.raises(RuntimeError):
+            paddle.grad(y, [z], create_graph=True)
+        g = paddle.grad(y, [z], create_graph=True, allow_unused=True)
+        assert g[0] is None
