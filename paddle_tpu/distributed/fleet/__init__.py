@@ -30,7 +30,11 @@ from .pipeline_layer import (  # noqa: F401
 @dataclasses.dataclass
 class PpConfigs:
     accumulate_steps: int = 1
-    schedule_mode: str = "1F1B"   # metadata; compiled schedule is GPipe-scan
+    # Honest default: the eager PipelineParallel facade runs sequential
+    # microbatching (single-controller — no schedule to speak of). The real
+    # 1F1B/GPipe schedules are the COMPILED ones in parallel.pipeline
+    # (one_f_one_b / gpipe_apply), selected via nlp.train's pp_schedule.
+    schedule_mode: str = "sequential"
 
 
 class DistributedStrategy:

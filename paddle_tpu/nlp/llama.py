@@ -254,21 +254,35 @@ def forward_pp(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
     hops are ppermute, all inside one XLA program."""
     from ..parallel.pipeline import pipelined
 
-    n = mesh.shape["pp"]
+    n, stage_params, stage_fn = _pp_stage_setup(
+        params, tokens.shape, cfg, mesh, num_microbatches)
     B, S = tokens.shape
     M = num_microbatches
-    if B % M:
-        raise ValueError(f"batch {B} not divisible by {M} microbatches")
-    cd = cfg.dtype
-    x = jnp.take(params["embed_tokens"], tokens, axis=0).astype(cd)
-    cos, sin = rope_freqs(cfg.head_dim, S, cfg.rope_theta, jnp.float32)
+    x = jnp.take(params["embed_tokens"], tokens, axis=0).astype(cfg.dtype)
+    mb = x.reshape((M, B // M) + x.shape[1:])
+    outs = pipelined(stage_fn, mesh, remat=cfg.remat)(stage_params, mb)
+    x = outs.reshape(B, S, -1)
+    return _final_head(params, x, cfg)
 
-    # [L,...] → [n, L/n, ...]: a LOCAL no-op when layers are sharded
-    # P('pp') (contiguous blocks), i.e. param_specs(cfg, pp=True)
+
+def _pp_stage_setup(params, tokens_shape, cfg: LlamaConfig, mesh,
+                    num_microbatches: int):
+    """Shared pipeline-partition plumbing for the GPipe and 1F1B paths:
+    validates divisibility, reshapes [L, ...] layer params into
+    [n, L/n, ...] stage slices (a LOCAL no-op when layers are sharded
+    P('pp') — contiguous blocks, i.e. param_specs(cfg, pp=True), the
+    reference's LayerDesc partition-by-layer), and builds the stage body.
+    Returns (n_stages, stage_params, stage_fn)."""
+    n = mesh.shape["pp"]
+    B, S = tokens_shape
+    if B % num_microbatches:
+        raise ValueError(
+            f"batch {B} not divisible by {num_microbatches} microbatches")
     L = cfg.num_hidden_layers
     if L % n:
         raise ValueError(
             f"{L} decoder layers not divisible by pp={n} stages")
+    cos, sin = rope_freqs(cfg.head_dim, S, cfg.rope_theta, jnp.float32)
     stage_params = jax.tree.map(
         lambda p: p.reshape((n, L // n) + p.shape[1:]), params["layers"])
 
@@ -278,10 +292,76 @@ def forward_pp(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
         h, _ = jax.lax.scan(body, h, local_layers)
         return h
 
-    mb = x.reshape((M, B // M) + x.shape[1:])
-    outs = pipelined(stage_fn, mesh, remat=cfg.remat)(stage_params, mb)
-    x = outs.reshape(B, S, -1)
-    return _final_head(params, x, cfg)
+    return n, stage_params, stage_fn
+
+
+def _mb_loss(logits, tokens):
+    """Per-microbatch next-token loss — same normalization as loss_fn, so
+    the mean over microbatches equals the global loss."""
+    targets = jnp.roll(tokens, -1, axis=1)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    seq = tokens.shape[1]
+    valid = (jnp.arange(seq) < seq - 1).astype(logits.dtype)
+    return jnp.sum((logz - gold) * valid[None]) / (
+        tokens.shape[0] * (seq - 1))
+
+
+def loss_and_grad_pp(params: Dict[str, Any], tokens: jax.Array,
+                     cfg: LlamaConfig, mesh, num_microbatches: int):
+    """Fused loss + grads through the compiled 1F1B pipeline schedule.
+
+    Reference analog: PipelineParallel.train_batch with its default 1F1B
+    scheduler (fleet/meta_parallel/pipeline_parallel.py, SURVEY.md §3.3).
+    Unlike the GPipe path (loss_fn + jax.grad, which transposes the forward
+    scan and therefore keeps O(M) microbatch activations live), this runs
+    parallel.pipeline.one_f_one_b: embedding at stage 0, decoder slices per
+    stage, final norm + head + loss at the last stage, O(pp) activation
+    residency. Returns (loss, grads) with grads matching the params tree.
+    """
+    from ..parallel.pipeline import one_f_one_b
+
+    n, stage_params, stage_fn = _pp_stage_setup(
+        params, tokens.shape, cfg, mesh, num_microbatches)
+    B, S = tokens.shape
+    M = num_microbatches
+    L = cfg.num_hidden_layers
+    cd = cfg.dtype
+    first_params = params["embed_tokens"]
+    last_params = {"norm": params["norm"]}
+    if cfg.tie_word_embeddings:
+        last_params["embed_tokens"] = params["embed_tokens"]
+    else:
+        last_params["lm_head"] = params["lm_head"]
+
+    def first_fn(embed, tok_mb):
+        return jnp.take(embed, tok_mb, axis=0).astype(cd)
+
+    def last_fn(lp, y, tok_mb):
+        x = rms_norm_ref(y, lp["norm"], cfg.rms_norm_eps)
+        head = (lp["embed_tokens"].T if cfg.tie_word_embeddings
+                else lp["lm_head"])
+        logits = (x.astype(cd) @ head.astype(cd)).astype(jnp.float32)
+        return _mb_loss(logits, tok_mb)
+
+    toks_mb = tokens.reshape((M, B // M) + tokens.shape[1:])
+    loss, g_s, g_f, g_l = one_f_one_b(
+        stage_fn, first_fn, last_fn, mesh, n_stages=n)(
+            stage_params, first_params, last_params, toks_mb)
+
+    d_embed = g_f
+    if cfg.tie_word_embeddings:
+        d_embed = d_embed + g_l["embed_tokens"]
+    grads = {
+        "embed_tokens": d_embed,
+        "layers": jax.tree.map(
+            lambda g: g.reshape((L,) + g.shape[2:]), g_s),
+        "norm": g_l["norm"],
+    }
+    if not cfg.tie_word_embeddings:
+        grads["lm_head"] = g_l["lm_head"]
+    grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
+    return loss, grads
 
 
 def loss_fn(params, tokens, cfg: LlamaConfig, mesh=None,
@@ -300,12 +380,7 @@ def loss_fn(params, tokens, cfg: LlamaConfig, mesh=None,
         logits = forward_pp(params, tokens, cfg, mesh, pp_microbatches)
     else:
         logits = forward(params, tokens, cfg, mesh)
-    targets = jnp.roll(tokens, -1, axis=1)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    seq = tokens.shape[1]
-    valid = (jnp.arange(seq) < seq - 1).astype(logits.dtype)
-    return jnp.sum((logz - gold) * valid[None]) / (tokens.shape[0] * (seq - 1))
+    return _mb_loss(logits, tokens)
 
 
 def num_params(cfg: LlamaConfig) -> int:

@@ -106,12 +106,19 @@ def make_train_step(cfg, tx, mesh: Optional[Mesh] = None,
                     donate: bool = True,
                     num_microbatches: Optional[int] = None,
                     grad_accum_steps: int = 1,
+                    pp_schedule: str = "1f1b",
                     model=llama) -> Callable:
     """Build the jitted train step. With a mesh: full GSPMD shardings on
     state and batch; without: plain jit (single device). A mesh with pp > 1
-    runs the decoder through the compiled GPipe schedule —
+    runs the decoder through a compiled pipeline schedule —
     `num_microbatches` (default 2·pp) microbatches per step (models without
-    a forward_pp, e.g. moe, ignore it).
+    a forward_pp, e.g. moe, ignore it). pp_schedule picks the compiled
+    schedule (reference: PipelineParallel's 1F1B / interleaved modes,
+    SURVEY.md §3.3): "1f1b" (default) runs the fused one_f_one_b
+    forward+backward with O(pp) activation residency; "gpipe" runs
+    forward_pp under jax.grad (scan transpose, O(num_microbatches)
+    residency) and is the automatic fallback for models without a
+    loss_and_grad_pp.
 
     grad_accum_steps > 1 splits the batch axis into that many chunks and
     accumulates grads through one lax.scan before the optimizer update —
@@ -123,6 +130,10 @@ def make_train_step(cfg, tx, mesh: Optional[Mesh] = None,
     dp/sharding batch shards."""
     pp = _use_pp(mesh) and hasattr(model, "forward_pp")
     mb = (num_microbatches or 2 * mesh.shape["pp"]) if pp else None
+    if pp_schedule not in ("1f1b", "gpipe"):
+        raise ValueError(f"unknown pp_schedule {pp_schedule!r}")
+    use_1f1b = (pp and pp_schedule == "1f1b"
+                and hasattr(model, "loss_and_grad_pp"))
     if grad_accum_steps < 1:
         raise ValueError(
             f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
@@ -159,6 +170,9 @@ def make_train_step(cfg, tx, mesh: Optional[Mesh] = None,
             (gsum, lsum), _ = jax.lax.scan(micro, init, chunks)
             grads = jax.tree.map(lambda g: g / grad_accum_steps, gsum)
             loss = lsum / grad_accum_steps
+        elif use_1f1b:
+            loss, grads = model.loss_and_grad_pp(
+                state.params, tokens, cfg, mesh, mb)
         else:
             loss, grads = jax.value_and_grad(lfn)(state.params, tokens)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)

@@ -124,6 +124,55 @@ class TestLlamaPipeline:
             p, t, cfg, mesh, pp_microbatches=4))(params, toks)
         assert abs(float(ref) - float(pp)) < 1e-3
 
+    def test_1f1b_loss_and_grad_parity(self, pp_mesh):
+        """The fused 1F1B schedule (one_f_one_b) matches the unpipelined
+        reference — loss and every grad leaf."""
+        cfg = llama.LlamaConfig.tiny(remat=False, use_flash=False,
+                                     num_hidden_layers=4)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (8, 32)), jnp.int32)
+        ref_l, ref_g = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, toks, cfg, None))(params)
+        l, g = jax.jit(lambda p, t: llama.loss_and_grad_pp(
+            p, t, cfg, pp_mesh, 8))(params, toks)
+        assert abs(float(ref_l) - float(l)) < 1e-3
+        errs = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                            ref_g, g)
+        assert max(jax.tree.leaves(errs)) < 1e-3
+
+    def test_1f1b_memory_beats_gpipe(self, pp_mesh):
+        """The 1F1B claim (VERDICT r1 item 2): stage activation residency is
+        O(pp), not O(M). At M=32 microbatches / pp=4 stages the compiled
+        temp memory of the fused schedule must be several times below the
+        GPipe-under-jax.grad path (whose scan transpose keeps all M
+        microbatch activations live)."""
+        cfg = llama.LlamaConfig.tiny(remat=True, use_flash=False,
+                                     num_hidden_layers=4)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.zeros((32, 32), jnp.int32)
+        M = 32
+        gpipe = jax.jit(jax.grad(
+            lambda p: llama.loss_fn(p, toks, cfg, pp_mesh, M)))
+        f1b = jax.jit(lambda p, t: llama.loss_and_grad_pp(
+            p, t, cfg, pp_mesh, M))
+        m_gpipe = gpipe.lower(params).compile().memory_analysis()
+        m_1f1b = f1b.lower(params, toks).compile().memory_analysis()
+        assert m_1f1b.temp_size_in_bytes * 3 < m_gpipe.temp_size_in_bytes
+
+    def test_1f1b_train_step_loss_decreases(self, pp_mesh):
+        cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=4)
+        tx = train.make_optimizer(1e-3)
+        state = train.init_state(jax.random.key(0), cfg, tx, mesh=pp_mesh)
+        step = train.make_train_step(cfg, tx, mesh=pp_mesh,
+                                     pp_schedule="1f1b")
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (8, 32)), jnp.int32)
+        state, m0 = step(state, toks)
+        for _ in range(4):
+            state, m = step(state, toks)
+        assert float(m["loss"]) < float(m0["loss"])
+
     def test_layers_not_divisible_by_stages_raises(self, pp_mesh):
         cfg = llama.LlamaConfig.tiny(num_hidden_layers=2, use_flash=False)
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
