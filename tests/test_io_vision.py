@@ -109,6 +109,7 @@ class TestTransforms:
 
 
 class TestVisionModels:
+    @pytest.mark.slow
     def test_resnet18_forward_backward(self):
         net = models.resnet18(num_classes=4)
         out = net(paddle.randn([2, 3, 32, 32]))
@@ -128,10 +129,12 @@ class TestVisionModels:
         net = models.LeNet()
         assert net(paddle.randn([2, 1, 28, 28])).shape == [2, 10]
 
+    @pytest.mark.slow
     def test_mobilenet_v2(self):
         net = models.mobilenet_v2(num_classes=5)
         assert net(paddle.randn([1, 3, 32, 32])).shape == [1, 5]
 
+    @pytest.mark.slow
     def test_vgg11_tiny(self):
         net = models.vgg11(num_classes=3)
         assert net(paddle.randn([1, 3, 224, 224])).shape == [1, 3]

@@ -78,6 +78,7 @@ class TestGpipePrimitive:
 
 
 class TestLlamaPipeline:
+    @pytest.mark.slow
     def test_pp_loss_and_grad_parity(self, pp_mesh):
         cfg = llama.LlamaConfig.tiny(remat=False, use_flash=False,
                                      num_hidden_layers=4)

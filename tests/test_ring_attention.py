@@ -45,6 +45,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_grad_matches_exact(self, sep_mesh):
         q, k, v = _qkv(s=16)
 
