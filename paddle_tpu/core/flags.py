@@ -56,5 +56,8 @@ def flag(name: str) -> Any:
 define_flag("FLAGS_check_nan_inf", False, "raise on nan/inf in op outputs (debug)")
 define_flag("FLAGS_use_pallas", True, "use Pallas TPU kernels for hot ops when available")
 define_flag("FLAGS_eager_jit_ops", False, "jit-compile each eager op (dispatch caching)")
+define_flag("FLAGS_pallas_interpret", False,
+            "run Pallas kernels in interpret mode on any backend (testing: "
+            "exercises the kernel path on CPU)")
 define_flag("FLAGS_allocator_strategy", "xla", "allocator is owned by XLA/PJRT on TPU")
 define_flag("FLAGS_cudnn_deterministic", False, "determinism toggle (XLA flag passthrough)")

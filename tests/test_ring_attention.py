@@ -195,3 +195,109 @@ class TestFlashBackwardPallas:
         for a, r in ((dq, rdq), (dk, rdk), (dv, rdv)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                        rtol=2e-3, atol=2e-3)
+
+
+class TestRingFlashBlocks:
+    """Ring attention's Pallas inner-block path (VERDICT r1 item 5 / weak
+    item 2): flash_block with runtime diagonal offsets inside the ring fold,
+    asserted ACTIVE via the trace counter, vs the exact reference."""
+
+    def _qkv(self, s=512, hkv=2, seed=0):
+        import numpy as np
+        import jax.numpy as jnp
+        rng = np.random.RandomState(seed)
+        q = jnp.asarray(rng.randn(2, s, 4, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(2, s, hkv, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(2, s, hkv, 8), jnp.float32)
+        return q, k, v
+
+    @pytest.fixture(autouse=True)
+    def _interp(self):
+        from paddle_tpu.core import flags as F
+        F.set_flags({"FLAGS_pallas_interpret": True})
+        yield
+        F.set_flags({"FLAGS_pallas_interpret": False})
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_ring_flash_matches_exact(self, causal):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.parallel.topology import build_mesh
+        from paddle_tpu.kernels import ring_attention as ra
+        from paddle_tpu.kernels.flash_attention import mha_ref
+        mesh = build_mesh(sep=4, dp=2)
+        q, k, v = self._qkv()
+        ref = mha_ref(q, k, v, causal=causal)
+        n0 = ra.FLASH_RING_TRACES
+        out = jax.jit(lambda q, k, v: ra.sep_attention(
+            q, k, v, mesh, impl="ring", causal=causal))(q, k, v)
+        assert ra.FLASH_RING_TRACES > n0, "Pallas ring path not selected"
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=5e-4, atol=5e-4)
+
+    @pytest.mark.slow
+    def test_ring_flash_grads(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.parallel.topology import build_mesh
+        from paddle_tpu.kernels import ring_attention as ra
+        from paddle_tpu.kernels.flash_attention import mha_ref
+        mesh = build_mesh(sep=4, dp=2)
+        q, k, v = self._qkv()
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ra.sep_attention(
+                q, k, v, mesh, impl="ring", causal=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                mha_ref(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+        gf = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, r in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=5e-3, atol=5e-3)
+
+    def test_misaligned_seq_falls_back_exact(self):
+        """seq % 128 == 0 but not block-divisible (384): the gate must
+        reject the kernel (whose grid would floor-drop trailing rows) and
+        the sq > sk causal case (kernel zeros vs softmax-uniform rows),
+        falling back to the exact path."""
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import flash_attention as fa
+        rng = np.random.RandomState(2)
+        q, k, v = (jnp.asarray(rng.randn(1, 384, 2, 8), jnp.float32)
+                   for _ in range(3))
+        assert not fa.block_aligned(384)
+        out = fa.flash_attention_fwd(q, k, v, True, None)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(fa.mha_ref(q, k, v, causal=True)),
+            rtol=1e-6, atol=1e-6)
+        q2 = jnp.asarray(rng.randn(1, 256, 2, 8), jnp.float32)
+        k2, v2 = (jnp.asarray(rng.randn(1, 128, 2, 8), jnp.float32)
+                  for _ in range(2))
+        out2 = fa.flash_attention_fwd(q2, k2, v2, True, None)
+        np.testing.assert_allclose(
+            np.asarray(out2),
+            np.asarray(fa.mha_ref(q2, k2, v2, causal=True)),
+            rtol=1e-6, atol=1e-6)
+
+    def test_rectangular_causal_offset(self):
+        """Default offset sk-sq == mha_ref's bottom-right diagonal (chunked
+        prefill against a longer KV cache)."""
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import flash_attention as fa
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(2, 128, 2, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 256, 2, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 256, 2, 8), jnp.float32)
+        out = fa.flash_attention_pallas(q, k, v, causal=True,
+                                        interpret=True)
+        ref = fa.mha_ref(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
