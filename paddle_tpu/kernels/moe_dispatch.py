@@ -15,8 +15,10 @@ indices) is the CPU/GSPMD fallback — XLA can partition that gather under a
 mesh, whereas a pallas_call is opaque to the SPMD partitioner.
 
 Backward: gather transposes to scatter-add; the custom VJP runs it as a
-jnp scatter (unique indices — capacity slots collide nowhere), which XLA
-lowers well; the forward is the hot, memory-bound direction.
+jnp scatter-ADD — indices are NOT unique in general (the dispatch-direction
+gather receives each token id up to k times, once per expert choice, so
+duplicate contributions must accumulate); the forward is the hot,
+memory-bound direction.
 """
 from __future__ import annotations
 

@@ -193,14 +193,20 @@ class TestIndexDispatch:
         eidx, slot, probs, valid, inv, _ = moe.top_k_routing(logits, 2, 6)
         # one-hot dispatch total == number of valid index assignments
         assert int(jnp.sum(d)) == int(jnp.sum(valid))
-        # inverse map round-trips: inv[e, c] = t implies dispatch[t, e, c]
+        # inverse map round-trips: inv[e, c] = t implies dispatch[t, e, c],
+        # and combine there carries that token's gate prob for that choice
         invn = np.asarray(inv)
-        dn = np.asarray(d)
+        dn, cn = np.asarray(d), np.asarray(c)
+        en, sn = np.asarray(eidx), np.asarray(slot)
+        pn, vn = np.asarray(probs), np.asarray(valid)
         for e in range(8):
             for s in range(6):
                 t = invn[e, s]
                 if t >= 0:
                     assert dn[t, e, s] == 1.0
+                    (j,) = np.where((en[t] == e) & (sn[t] == s) & vn[t])
+                    np.testing.assert_allclose(cn[t, e, s], pn[t, j[0]],
+                                               rtol=1e-6)
 
     def test_dispatch_memory_linear_not_quadratic(self):
         """The round-1 one-hot dispatch materialized [B,S,E,C] with
