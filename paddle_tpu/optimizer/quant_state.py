@@ -1,0 +1,172 @@
+"""Blockwise-quantized Adam state: 8-bit moments, optax-compatible.
+
+Reference analog: the memory-saving optimizer variants in
+paddle.incubate.optimizer / PaddleNLP's quantization-aware AdamW recipes
+(upstream-canonical, unverified — SURVEY.md §0); technique per the public
+8-bit-optimizer literature (blockwise dynamic scaling).
+
+TPU-native rationale: a single v5e chip holds 16GB. AdamW's f32 moments
+cost 8 bytes/param — the round-1 bench capped at ~0.5B params because
+state, not compute, filled HBM (VERDICT item 6). Storing m (and v in
+sqrt-space) as float8_e4m3 codes with one f32 scale per 256-value block
+(overhead 1/64) cuts state to ~2 bytes/param and puts a 2B-param Llama
+on one chip. Quantize/dequantize is elementwise and fuses into the update
+— invisible next to the matmuls.
+
+Numerics: float8_e4m3 codes with one f32 scale per block — the float
+exponent gives ~5 orders of dynamic range inside a block (linear int8
+codes underflow small v entries to zero there, and m/(sqrt(v)+eps)
+explodes); the loss trajectory tracks f32 AdamW closely (tests assert it).
+The multi-chip path needs none of this: ZeRO ('sharding' axis) divides
+f32 state across chips instead.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+BLOCK = 256
+
+
+class _QTensor(NamedTuple):
+    """Blockwise-quantized tensor: float8_e4m3 codes [nb, BLOCK] + f32
+    scale [nb, 1] (x ≈ codes * scale). The second moment is stored in
+    sqrt-space (codes of sqrt(v)/scale), doubling its effective exponent
+    range."""
+    codes: jax.Array
+    scale: jax.Array
+
+
+F8 = jnp.float8_e4m3fn
+# e4m3 max finite value — normalize block maxima to this so the codes use
+# the full exponent range
+F8_MAX = 448.0
+
+
+def _pad_len(n: int) -> int:
+    return (-n) % BLOCK
+
+
+def _q_blocks(blocks: jax.Array, sqrt_space: bool) -> _QTensor:
+    """blocks [c, BLOCK] f32 → f8 codes + per-block scale."""
+    if sqrt_space:
+        blocks = jnp.sqrt(blocks)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-30) / F8_MAX
+    return _QTensor((blocks / scale).astype(F8), scale)
+
+
+def _dq_blocks(q: _QTensor, sqrt_space: bool) -> jax.Array:
+    blocks = q.codes.astype(jnp.float32) * q.scale
+    return blocks * blocks if sqrt_space else blocks
+
+
+def _quantize(x: jax.Array, sqrt_space: bool) -> _QTensor:
+    flat = x.astype(jnp.float32).reshape(-1)
+    flat = jnp.pad(flat, (0, _pad_len(flat.size)))
+    return _q_blocks(flat.reshape(-1, BLOCK), sqrt_space)
+
+
+def _dequantize(q: _QTensor, shape, sqrt_space: bool) -> jax.Array:
+    blocks = _dq_blocks(q, sqrt_space)
+    n = 1
+    for s in shape:
+        n *= s
+    return blocks.reshape(-1)[:n].reshape(shape)
+
+
+class ScaleByAdamQState(NamedTuple):
+    count: jax.Array
+    m: Any   # pytree of _QTensor
+    v: Any   # pytree of _QTensor
+
+
+def scale_by_adam_q(b1: float = 0.9, b2: float = 0.999,
+                    eps: float = 1e-8) -> optax.GradientTransformation:
+    """optax scale_by_adam with 8-bit blockwise state (f8 codes + block
+    scales; v stored in sqrt-space)."""
+
+    def init(params):
+        # zero state needs no data-dependent quantization — build the code
+        # blocks directly (quantizing a materialized f32 zero tree would
+        # cost ~2 full-leaf f32 transients per moment, the very peak the
+        # chunked update path exists to avoid)
+        def zero_q(p):
+            nb = (p.size + BLOCK - 1) // BLOCK
+            return _QTensor(jnp.zeros((nb, BLOCK), F8),
+                            jnp.full((nb, 1), 1e-30 / F8_MAX, jnp.float32))
+
+        return ScaleByAdamQState(jnp.zeros((), jnp.int32),
+                                 jax.tree.map(zero_q, params),
+                                 jax.tree.map(zero_q, params))
+
+    # blocks per lax.map chunk: 8192 * 256 = 2M params * 4B ≈ 8MB of f32
+    # transients per chunk — the dequant/update/requant stream never
+    # materializes a full-leaf f32 moment (which for a 2B model's stacked
+    # [L, F, D] leaf would be ~2GB and blow the single-chip HBM budget)
+    chunk_blocks = 8192
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        bc1 = 1.0 - b1 ** count.astype(jnp.float32)
+        bc2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        def blockwise(gb, mq, vq):
+            """One chunk: gb [c, BLOCK] f32; mq/vq _QTensor over [c] blocks."""
+            m = b1 * _dq_blocks(mq, False) + (1 - b1) * gb
+            v = b2 * _dq_blocks(vq, True) + (1 - b2) * gb * gb
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            return upd, _q_blocks(m, False), _q_blocks(v, True)
+
+        def leaf(g, mq, vq):
+            nb = mq.codes.shape[0]
+            gf = jnp.pad(g.astype(jnp.float32).reshape(-1),
+                         (0, _pad_len(g.size))).reshape(nb, BLOCK)
+            if nb <= chunk_blocks:
+                upd, new_m, new_v = blockwise(gf, mq, vq)
+            else:
+                # pad the block axis to whole chunks, stream with lax.map
+                k = -(-nb // chunk_blocks)
+                pad = k * chunk_blocks - nb
+
+                def padb(x):
+                    return jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1)
+                                   ).reshape((k, chunk_blocks) + x.shape[1:])
+
+                upd, new_m, new_v = jax.lax.map(
+                    lambda c: blockwise(*c),
+                    (padb(gf), _QTensor(padb(mq.codes), padb(mq.scale)),
+                     _QTensor(padb(vq.codes), padb(vq.scale))))
+                upd = upd.reshape(-1, BLOCK)[:nb]
+                new_m = _QTensor(new_m.codes.reshape(-1, BLOCK)[:nb],
+                                 new_m.scale.reshape(-1, 1)[:nb])
+                new_v = _QTensor(new_v.codes.reshape(-1, BLOCK)[:nb],
+                                 new_v.scale.reshape(-1, 1)[:nb])
+            upd = upd.reshape(-1)[:g.size].reshape(g.shape).astype(g.dtype)
+            return upd, new_m, new_v
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_m = treedef.flatten_up_to(state.m)
+        flat_v = treedef.flatten_up_to(state.v)
+        out = [leaf(g, m, v) for g, m, v in zip(flat_g, flat_m, flat_v)]
+        updates = treedef.unflatten([o[0] for o in out])
+        new_m = treedef.unflatten([o[1] for o in out])
+        new_v = treedef.unflatten([o[2] for o in out])
+        return updates, ScaleByAdamQState(count, new_m, new_v)
+
+    return optax.GradientTransformation(init, update)
+
+
+def adamw_q(learning_rate, b1: float = 0.9, b2: float = 0.999,
+            eps: float = 1e-8, weight_decay: float = 0.0
+            ) -> optax.GradientTransformation:
+    """AdamW with 8-bit moments — drop-in for optax.adamw where optimizer
+    state must fit alongside the params (single-chip flagship bench)."""
+    return optax.chain(
+        scale_by_adam_q(b1, b2, eps),
+        optax.add_decayed_weights(weight_decay),
+        optax.scale_by_learning_rate(learning_rate),
+    )

@@ -1,0 +1,100 @@
+"""8-bit blockwise Adam state (optimizer.quant_state) — the single-chip
+flagship-bench optimizer (VERDICT r1 item 6)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+
+from paddle_tpu.optimizer.quant_state import (
+    BLOCK, adamw_q, scale_by_adam_q, _quantize, _dequantize)
+from paddle_tpu.nlp import llama, train
+
+
+class TestQuantization:
+    def test_roundtrip_precision(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(1000) * np.exp(rng.randn(1000)),
+                        jnp.float32)
+        q = _quantize(x, False)
+        assert q.codes.dtype == jnp.float8_e4m3fn
+        back = _dequantize(q, x.shape, False)
+        rel = np.abs(np.asarray(back - x)) / (np.abs(np.asarray(x)) + 1e-30)
+        assert float(np.median(rel)) < 0.05
+
+    def test_sqrt_space_preserves_small_values(self):
+        """A block mixing 1e-9 and 1.0 must keep the small entry nonzero
+        after the v (sqrt-space) round trip: f8 codes in sqrt-space span
+        ~1e10 of v dynamic range per block, where linear int8 codes
+        flushed anything below max/500 to zero — and a zeroed v makes
+        m/(sqrt(v)+eps) explode."""
+        x = jnp.full((BLOCK,), 1e-9, jnp.float32).at[0].set(1.0)
+        back = _dequantize(_quantize(x, True), x.shape, True)
+        assert float(back[1]) > 1e-11
+
+    def test_state_bytes_per_param(self):
+        p = {"w": jnp.zeros((4096, 256), jnp.float32)}
+        st = scale_by_adam_q().init(p)
+        n = p["w"].size
+
+        def nbytes(t):
+            return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(t))
+
+        per_param = (nbytes(st.m) + nbytes(st.v)) / n
+        assert per_param < 2.1, per_param  # ~2 bytes vs f32 Adam's 8
+
+
+class TestAdamQ:
+    def test_update_matches_optax_adam(self):
+        """Per-step update direction within a few percent RMS of f32
+        scale_by_adam, through the chunked (lax.map) path."""
+        from paddle_tpu.optimizer.quant_state import scale_by_adam_q
+        rng = np.random.RandomState(0)
+        n = 8192 * BLOCK + 77  # > one chunk: exercises padding + lax.map
+        p = {"w": jnp.asarray(rng.randn(n), jnp.float32)}
+        tx, ref = scale_by_adam_q(), optax.scale_by_adam(0.9, 0.999, 1e-8)
+        st, rst = tx.init(p), ref.init(p)
+        for i in range(3):
+            g = {"w": jnp.asarray(rng.randn(n) * 0.1, jnp.float32)}
+            u, st = tx.update(g, st)
+            ru, rst = ref.update(g, rst)
+            rms = float(jnp.sqrt(jnp.mean((u["w"] - ru["w"]) ** 2))
+                        / jnp.sqrt(jnp.mean(ru["w"] ** 2)))
+            assert rms < 0.1, (i, rms)
+
+    def test_llama_loss_trajectory_tracks_f32(self):
+        cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=2)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (8, 32)), jnp.int32)
+
+        def run(state_quant):
+            tx = train.make_optimizer(3e-3, state_quant=state_quant)
+            state = train.init_state(jax.random.key(0), cfg, tx, mesh=None)
+            step = train.make_train_step(cfg, tx, mesh=None)
+            losses = []
+            for _ in range(10):
+                state, m = step(state, toks)
+                losses.append(float(m["loss"]))
+            return losses
+
+        f32, q8 = run(None), run("8bit")
+        assert q8[-1] < q8[0] * 0.8
+        assert abs(q8[-1] - f32[-1]) / f32[-1] < 0.05, (q8[-1], f32[-1])
+
+    def test_bf16_params_8bit_state_trains(self):
+        """The exact headline-bench combination — bf16 params +
+        state_quant='8bit' + grad_clip=0 — must train, not just the f32
+        default (a bf16-specific numerics regression would otherwise only
+        surface as a wrong 'loss' field in the TPU bench JSON)."""
+        cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=2,
+                                     param_dtype=jnp.bfloat16)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (8, 32)), jnp.int32)
+        tx = train.make_optimizer(3e-3, state_quant="8bit", grad_clip=0.0)
+        state = train.init_state(jax.random.key(0), cfg, tx, mesh=None)
+        step = train.make_train_step(cfg, tx, mesh=None)
+        losses = []
+        for _ in range(10):
+            state, m = step(state, toks)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0] * 0.8, losses
