@@ -4,7 +4,8 @@ Reference analog: the PaddleNLP model zoo the reference's training recipes use
 (out-of-repo domain suite, SURVEY.md §1 Lx; upstream-canonical, unverified
 §0). Here the flagship is a functional, scan-based Llama family designed for
 GSPMD sharding (see llama.py), plus the sharded train step (train.py)."""
-from . import llama, moe, train, ernie  # noqa: F401
+from . import llama, moe, train, ernie, generation  # noqa: F401
+from .generation import KVCache, init_cache, forward_cached, generate  # noqa: F401
 from .moe import MoeConfig  # noqa: F401
 from .llama import LlamaConfig, init_params, forward, loss_fn, param_specs  # noqa: F401
 from .train import TrainState, make_optimizer, make_train_step, init_state, state_specs  # noqa: F401

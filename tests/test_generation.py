@@ -1,0 +1,82 @@
+"""KV-cache generation (nlp.generation) — VERDICT r1 missing item 10.
+
+Reference analog: PaddleNLP llm/ predict recipes' model.generate
+(greedy_search/sampling over a KV cache); SURVEY.md §3.5's serving story.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nlp import llama, generation
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 256, (2, 8)), jnp.int32)
+    return cfg, params, prompt
+
+
+class TestKVCache:
+    def test_prefill_matches_full_forward(self, setup):
+        cfg, params, prompt = setup
+        cache = generation.init_cache(cfg, 2, 16)
+        lc, cache = generation.forward_cached(params, prompt, cache, 0, cfg)
+        lf = llama.forward(params, prompt, cfg)
+        np.testing.assert_allclose(np.asarray(lc), np.asarray(lf),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_decode_matches_full_forward(self, setup):
+        """Single-token cached decode logits == full-forward last-position
+        logits at every step."""
+        cfg, params, prompt = setup
+        T = prompt.shape[1] + 4
+        cache = generation.init_cache(cfg, 2, T)
+        _, cache = generation.forward_cached(params, prompt, cache, 0, cfg)
+        seq = prompt
+        for i in range(3):
+            nxt = jnp.argmax(llama.forward(params, seq, cfg)[:, -1],
+                             axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+            lc, cache = generation.forward_cached(
+                params, nxt[:, None], cache, seq.shape[1] - 1, cfg)
+            lf = llama.forward(params, seq, cfg)[:, -1:]
+            np.testing.assert_allclose(np.asarray(lc), np.asarray(lf),
+                                       rtol=1e-4, atol=1e-4)
+
+
+class TestGenerate:
+    def test_greedy_matches_rolling_forward(self, setup):
+        cfg, params, prompt = setup
+        out = jax.jit(lambda p, t: generation.generate(
+            p, t, cfg, max_new_tokens=6))(params, prompt)
+        seq, ref = prompt, []
+        for _ in range(6):
+            nxt = jnp.argmax(llama.forward(params, seq, cfg)[:, -1],
+                             axis=-1).astype(jnp.int32)
+            ref.append(nxt)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        assert bool(jnp.all(out == jnp.stack(ref, axis=1)))
+
+    def test_sampling_shapes_and_determinism(self, setup):
+        cfg, params, prompt = setup
+        kw = dict(max_new_tokens=5, greedy=False, temperature=0.8,
+                  top_k=16, top_p=0.9, key=jax.random.PRNGKey(1))
+        a = generation.generate(params, prompt, cfg, **kw)
+        b = generation.generate(params, prompt, cfg, **kw)
+        assert a.shape == (2, 5) and bool(jnp.all(a == b))
+        assert int(jnp.min(a)) >= 0 and int(jnp.max(a)) < cfg.vocab_size
+
+    def test_eos_pads_tail(self, setup):
+        cfg, params, prompt = setup
+        greedy = generation.generate(params, prompt, cfg, max_new_tokens=6)
+        eos = int(greedy[0, 1])  # force an eos hit at step 2 for row 0
+        out = generation.generate(params, prompt, cfg, max_new_tokens=6,
+                                  eos_token_id=eos, pad_token_id=-1)
+        row = out[0].tolist()
+        assert eos in row
+        after = row[row.index(eos) + 1:]
+        assert all(t == -1 for t in after), row
