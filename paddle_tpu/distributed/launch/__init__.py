@@ -13,4 +13,4 @@ membership-resize protocol the watchdog restarts the training script (which
 resumes from its latest checkpoint) up to --max_restarts times, classifying
 exit codes like the reference's controller does.
 """
-from .main import launch, main  # noqa: F401
+from .main import launch, main, heartbeat, classify_exit  # noqa: F401

@@ -37,21 +37,96 @@ def _parse(argv):
                                               "0")),
                    help="elastic: restart the script on failure this many "
                         "times (training resumes from its checkpoint)")
+    p.add_argument("--heartbeat_timeout", type=float,
+                   default=float(os.environ.get(
+                       "PADDLE_ELASTIC_HEARTBEAT_TIMEOUT", "0")),
+                   help="seconds without a trainer heartbeat before the "
+                        "worker is declared hung and restarted (0 = off). "
+                        "The trainer calls "
+                        "paddle_tpu.distributed.launch.heartbeat() each "
+                        "step; a stalled collective or lost coordination "
+                        "service stops the beat")
+    p.add_argument("--heartbeat_grace", type=float,
+                   default=float(os.environ.get(
+                       "PADDLE_ELASTIC_HEARTBEAT_GRACE", "300")),
+                   help="seconds allowed before the FIRST heartbeat "
+                        "(startup: imports + XLA compile routinely take "
+                        "minutes); the steady-state timeout applies only "
+                        "after the worker's first beat")
     p.add_argument("--job_id", type=str, default="default")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
-# exit-code classification (reference: launch controllers' watch loop)
+# ---------------------------------------------------------------------------
+# Failure classification (reference: launch controllers' watch loop +
+# fleet/elastic's ElasticManager exit-code handling — SURVEY.md §5
+# failure-detection row). Classes decide restart-vs-abort and label the
+# failure for the operator.
+# ---------------------------------------------------------------------------
+
 _FATAL_CODES = {2}  # usage errors don't deserve a restart
 
+# log-tail signatures of a lost coordination service / stuck collective —
+# the single-controller analog of the reference's etcd-heartbeat loss
+_COORD_SIGNATURES = (
+    "coordination service", "DEADLINE_EXCEEDED",
+    "heartbeat to coordination", "Barrier timed out",
+    "DataLoss: connection",
+)
 
-def _child_env(args) -> dict:
+
+def classify_exit(code: int, log_tail: str = "") -> tuple:
+    """(kind, restartable). kinds: ok | usage | oom | signal | coord | error."""
+    if code == 0:
+        return "ok", False
+    if code in _FATAL_CODES:
+        return "usage", False
+    if code < 0:
+        sig = -code
+        try:
+            name = signal.Signals(sig).name
+        except ValueError:
+            name = f"SIG{sig}"
+        if sig == signal.SIGKILL:
+            # SIGKILL is the host OOM-killer's signature kill
+            return f"oom-or-killed ({name})", True
+        return f"signal ({name})", True
+    low = log_tail.lower()
+    if any(s.lower() in low for s in _COORD_SIGNATURES):
+        return "coord (coordination-service/heartbeat loss)", True
+    return "error", True
+
+
+def heartbeat(path: str = None):
+    """Trainer-side beat: touch the heartbeat file the launcher watches.
+    Call once per training step; path defaults to $PADDLE_HEARTBEAT_FILE
+    (set by the launcher when --heartbeat_timeout is on). No-op when
+    unset, so train loops can call it unconditionally."""
+    path = path or os.environ.get("PADDLE_HEARTBEAT_FILE")
+    if path:
+        with open(path, "w") as f:
+            f.write(str(time.time()))
+
+
+def _tail(path: str, n: int = 4096) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            f.seek(max(f.tell() - n, 0))
+            return f.read().decode("utf-8", "replace")
+    except OSError:
+        return ""
+
+
+def _child_env(args, hb_file=None) -> dict:
     env = dict(os.environ)
     nnodes = int(str(args.nnodes).split(":")[0])
     env["PADDLE_TRAINERS_NUM"] = str(nnodes)
     env["PADDLE_TRAINER_ID"] = str(args.rank)
+    if hb_file:
+        env["PADDLE_HEARTBEAT_FILE"] = hb_file
     if args.master:
         env["PADDLE_MASTER"] = args.master
         env["JAX_COORDINATOR_ADDRESS"] = args.master
@@ -68,7 +143,9 @@ def launch(argv=None):
               "runs one process per host; device parallelism comes from "
               "the mesh", file=sys.stderr)
     os.makedirs(args.log_dir, exist_ok=True)
-    env = _child_env(args)
+    hb_file = (os.path.join(args.log_dir, f"heartbeat.{args.rank}")
+               if args.heartbeat_timeout > 0 else None)
+    env = _child_env(args, hb_file)
     cmd = [sys.executable, args.training_script, *args.training_script_args]
 
     attempts = 0
@@ -76,29 +153,78 @@ def launch(argv=None):
         log_path = os.path.join(
             args.log_dir, f"workerlog.{args.rank}"
             + (f".restart{attempts}" if attempts else ""))
+        hung = False
+        if hb_file:
+            heartbeat(hb_file)  # arm the watchdog at process start
+            armed_at = os.path.getmtime(hb_file)
         with open(log_path, "ab") as log:
             print(f"[launch] starting (attempt {attempts}): "
                   f"{' '.join(cmd)} → {log_path}")
+            # new session: the watchdog/interrupt kills must reach the whole
+            # process GROUP — dataloader workers or wrapper-script children
+            # would otherwise survive and hold the TPU claim across restarts
             proc = subprocess.Popen(cmd, env=env, stdout=log,
-                                    stderr=subprocess.STDOUT)
+                                    stderr=subprocess.STDOUT,
+                                    start_new_session=True)
+
+            def kill_group(sig):
+                try:
+                    os.killpg(proc.pid, sig)
+                except ProcessLookupError:
+                    pass
+
             try:
-                code = proc.wait()
+                if hb_file:
+                    # watchdog poll: child alive AND beating?
+                    while True:
+                        try:
+                            code = proc.wait(
+                                timeout=min(args.heartbeat_timeout / 4, 5))
+                            break
+                        except subprocess.TimeoutExpired:
+                            try:
+                                mtime = os.path.getmtime(hb_file)
+                            except OSError:
+                                # file removed (cleanup job): re-arm rather
+                                # than crash and orphan the worker
+                                heartbeat(hb_file)
+                                armed_at = mtime = os.path.getmtime(hb_file)
+                            stale = time.time() - mtime
+                            # before the first worker beat only the startup
+                            # grace applies (imports + XLA compile take
+                            # minutes); after it, the steady-state timeout
+                            limit = (args.heartbeat_timeout
+                                     if mtime > armed_at
+                                     else max(args.heartbeat_grace,
+                                              args.heartbeat_timeout))
+                            if stale > limit:
+                                print(f"[launch] no heartbeat for "
+                                      f"{stale:.0f}s — killing hung worker",
+                                      file=sys.stderr)
+                                kill_group(signal.SIGKILL)
+                                code = proc.wait()
+                                hung = True
+                                break
+                else:
+                    code = proc.wait()
             except KeyboardInterrupt:
-                proc.send_signal(signal.SIGTERM)
+                kill_group(signal.SIGTERM)
                 try:
                     proc.wait(timeout=10)
                 except subprocess.TimeoutExpired:
-                    proc.kill()
+                    kill_group(signal.SIGKILL)
                 raise
         if code == 0:
             print("[launch] training finished")
             return 0
-        if code in _FATAL_CODES or attempts >= args.max_restarts:
-            print(f"[launch] training failed (exit {code}); "
+        kind, restartable = (("hung (heartbeat lost)", True) if hung
+                             else classify_exit(code, _tail(log_path)))
+        if not restartable or attempts >= args.max_restarts:
+            print(f"[launch] training failed (exit {code}, {kind}); "
                   f"{attempts} restarts used", file=sys.stderr)
             return code
         attempts += 1
-        print(f"[launch] exit {code} — elastic restart "
+        print(f"[launch] exit {code} ({kind}) — elastic restart "
               f"{attempts}/{args.max_restarts} (resume from checkpoint)",
               file=sys.stderr)
         time.sleep(min(2 ** attempts, 30))
