@@ -45,6 +45,7 @@ from . import ops  # noqa: F401
 from .ops.linalg import fft  # noqa: F401
 
 from . import nn  # noqa: F401
+ops.register_surface(nn.functional)  # yaml-parity: functionals are ops
 from . import optimizer  # noqa: F401
 from . import amp  # noqa: F401
 from .nn.layer import ParamAttr  # noqa: F401
@@ -184,6 +185,11 @@ for _n in ("cholesky", "cholesky_solve", "inverse", "pinv", "solve",
            "slogdet", "cond", "lstsq", "householder_product", "corrcoef",
            "cov", "matrix_exp", "multi_dot"):
     setattr(linalg, _n, getattr(_linalg_mod, _n))
+from .ops import optable as _optable_mod  # noqa: E402
+for _n in ("lu_unpack", "matrix_norm", "matrix_transpose", "ormqr",
+           "vector_norm", "cdist", "cholesky_inverse", "svd_lowrank",
+           "pca_lowrank"):
+    setattr(linalg, _n, getattr(_optable_mod, _n))
 from .ops.reduction import norm as _norm  # noqa: E402
 from .ops.math import matmul as _matmul  # noqa: E402
 linalg.norm = _norm

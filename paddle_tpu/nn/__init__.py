@@ -11,8 +11,8 @@ from .layers_common import (  # noqa: F401
     Identity, Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
     Flatten, Unflatten, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
     PixelShuffle, PixelUnshuffle, ChannelShuffle, Pad1D, Pad2D, Pad3D,
-    ZeroPad2D, CosineSimilarity, PairwiseDistance, Sequential, LayerList,
-    ParameterList, LayerDict, Bilinear, Fold, Unfold,
+    ZeroPad2D, ZeroPad1D, ZeroPad3D, CosineSimilarity, PairwiseDistance,
+    Sequential, LayerList, ParameterList, LayerDict, Bilinear, Fold, Unfold,
 )
 from .layers_conv import (  # noqa: F401
     Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
@@ -21,7 +21,7 @@ from .layers_conv import (  # noqa: F401
     AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
     LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
     SyncBatchNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
-    LocalResponseNorm, SpectralNorm,
+    LocalResponseNorm, SpectralNorm, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
 )
 from .layers_act_loss import (  # noqa: F401
     ReLU, ReLU6, GELU, SiLU, Silu, Swish, ELU, SELU, CELU, LeakyReLU,
@@ -33,7 +33,9 @@ from .layers_act_loss import (  # noqa: F401
     TripletMarginWithDistanceLoss, CosineEmbeddingLoss, HingeEmbeddingLoss,
     HuberLoss, SoftMarginLoss, MultiLabelSoftMarginLoss, MultiMarginLoss,
     PoissonNLLLoss, GaussianNLLLoss, CTCLoss, AdaptiveLogSoftmaxWithLoss,
+    HSigmoidLoss,
 )
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .layers_transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,

@@ -376,3 +376,61 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_max_pool(x, output_size, return_mask, 3,
                               "adaptive_max_pool3d")
+
+
+# ---------------------------------------------------------------------------
+# Max un-pooling (reference: phi unpool kernels behind F.max_unpool{1,2,3}d)
+# ---------------------------------------------------------------------------
+
+def _max_unpool_raw(x, indices, nd, kernel_size, stride, padding,
+                    output_size, data_format="NCL"):
+    if not data_format.startswith("NC"):
+        raise NotImplementedError(
+            "max_unpool with channels-last layout is not supported "
+            "(mirrors max_pool's return_mask restriction)")
+    ksize = _ntuple(kernel_size, nd)
+    strides = _ntuple(stride if stride is not None else kernel_size, nd)
+    pads = _ntuple(padding, nd)
+    sp_in = x.shape[2:]
+    if output_size is None:
+        output_size = tuple(
+            (s - 1) * st - 2 * p + k
+            for s, st, p, k in zip(sp_in, strides, pads, ksize))
+    else:
+        output_size = tuple(output_size)[-nd:]
+    N, C = x.shape[:2]
+    flat = 1
+    for s in output_size:
+        flat *= s
+    xi = x.reshape(N, C, -1)
+    ii = indices.reshape(N, C, -1).astype(jnp.int32)
+    out = jnp.zeros((N, C, flat), x.dtype)
+    out = out.at[jnp.arange(N)[:, None, None],
+                 jnp.arange(C)[None, :, None], ii].set(xi)
+    return out.reshape((N, C) + output_size)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Inverse of max_pool1d(return_mask=True): scatter each pooled value
+    back to the argmax position its mask recorded; everything else zero."""
+    return eager(lambda a, i: _max_unpool_raw(a, i, 1, kernel_size, stride,
+                                              padding, output_size,
+                                              data_format),
+                 (x, indices), {}, name="max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return eager(lambda a, i: _max_unpool_raw(a, i, 2, kernel_size, stride,
+                                              padding, output_size,
+                                              data_format),
+                 (x, indices), {}, name="max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return eager(lambda a, i: _max_unpool_raw(a, i, 3, kernel_size, stride,
+                                              padding, output_size,
+                                              data_format),
+                 (x, indices), {}, name="max_unpool3d")

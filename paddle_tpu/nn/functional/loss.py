@@ -464,3 +464,50 @@ def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
                          delta * (ad - 0.5 * delta))
         return _reduce(loss, reduction)
     return eager(raw, (input, label), {}, name="huber_loss")
+
+
+def _hsigmoid_raw(x, label, weight, bias, num_classes):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: phi hsigmoid_loss kernel / F.hsigmoid_loss). Heap-style
+    node ids: leaves are label + num_classes; ancestors down to the root
+    (id 1) are internal nodes whose row in `weight` is id - 1."""
+    import numpy as _np
+    label = label.reshape(-1)  # documented label shape is [N, 1]
+    leaf = label.astype(jnp.int32) + num_classes
+    depth = int(_np.ceil(_np.log2(2 * num_classes)))
+    loss = jnp.zeros(x.shape[:1], jnp.float32)
+    cur = leaf
+    for _ in range(depth):
+        parent = cur // 2
+        bit = (cur % 2).astype(jnp.float32)      # which child was taken
+        active = parent >= 1
+        row = jnp.clip(parent - 1, 0, num_classes - 2)
+        score = jnp.sum(x.astype(jnp.float32) * weight[row], axis=-1)
+        if bias is not None:
+            score = score + bias[row].astype(jnp.float32).reshape(-1)
+        # BCE-with-logits against the path bit
+        step = jnp.maximum(score, 0) - score * bit + jnp.log1p(
+            jnp.exp(-jnp.abs(score)))
+        loss = loss + jnp.where(active, step, 0.0)
+        cur = parent
+    return loss[:, None]
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """F.hsigmoid_loss parity (default tree only; custom path_table is the
+    deliberately-deferred tier — SURVEY.md §7)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom path_table/path_code hsigmoid is deferred "
+            "(paddle_tpu/nn/functional/loss.py — default complete binary "
+            "tree only)")
+    from ...ops._registry import eager
+    args = (input, label, weight) if bias is None else (input, label,
+                                                        weight, bias)
+
+    def raw(x, lab, w, b=None):
+        return _hsigmoid_raw(x, lab, w, b, num_classes)
+
+    return eager(raw, args, {}, name="hsigmoid_loss")

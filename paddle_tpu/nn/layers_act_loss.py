@@ -397,3 +397,27 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
     def predict(self, input):
         from .. import ops
         return ops.argmax(self._full_log_prob(input), axis=-1)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss (reference nn.HSigmoidLoss): a learned
+    binary tree over classes; cost O(log C) per sample instead of a full
+    softmax. Default complete-binary-tree paths (custom path tables are
+    the deferred tier — see F.hsigmoid_loss)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "custom-tree HSigmoidLoss is deferred (see F.hsigmoid_loss)")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (num_classes - 1,), attr=bias_attr, is_bias=True))
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)

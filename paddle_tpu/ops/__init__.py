@@ -16,8 +16,10 @@ from .reduction import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .comparison import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
+from .optable import *  # noqa: F401,F403
 
-from . import creation, math, reduction, manipulation, comparison, linalg  # noqa: F401
+from . import (creation, math, reduction, manipulation, comparison,  # noqa: F401
+               linalg, optable)
 
 # names that are python builtins shadowed above (keep references)
 import builtins as _bt
@@ -26,7 +28,8 @@ import builtins as _bt
 # Tensor method attachment ("codegen" step)
 # ---------------------------------------------------------------------------
 
-_METHOD_SOURCES = [creation, math, reduction, manipulation, comparison, linalg]
+_METHOD_SOURCES = [creation, math, reduction, manipulation, comparison,
+                   linalg, optable]
 
 # ops that should NOT become Tensor methods (first arg isn't a tensor / special)
 _NON_METHODS = {
@@ -45,7 +48,9 @@ _ALIASES = {
     "rsub": None,
 }
 
-# ops with in-place variants in paddle
+# ops with in-place variants in paddle (ops.yaml lists each `name_` as its
+# own op entry; the table's INPLACE_FROM_TABLE extends this, and every
+# generated variant is REGISTERED below to mirror that accounting)
 _INPLACE = [
     "add", "subtract", "multiply", "divide", "clip", "scale", "exp", "sqrt",
     "rsqrt", "floor", "ceil", "round", "reciprocal", "abs", "sin", "cos",
@@ -53,6 +58,21 @@ _INPLACE = [
     "pow", "mod", "floor_divide", "neg", "log", "lerp", "erfinv",
     "masked_fill", "index_put", "index_add", "put_along_axis",
     "cast", "transpose",
+    # the 2.x inplace wave: trig/hyperbolic/exp-log family
+    "tan", "asin", "acos", "atan", "sinh", "cosh", "asinh", "acosh",
+    "atanh", "expm1", "log2", "log10", "log1p", "square",
+    # masking / clamping / rounding
+    "trunc", "frac", "nan_to_num", "logit", "renorm", "copysign", "hypot",
+    "i0", "ldexp", "digamma", "lgamma", "polygamma", "gamma",
+    # comparison / logical / bitwise inplace (2.6)
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_xor",
+    "logical_not", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift",
+    # structure
+    "tril", "triu", "scatter", "masked_scatter", "where", "cumsum",
+    "cumprod", "fmax", "fmin", "maximum", "minimum", "remainder",
+    "gcd", "lcm", "heaviside", "atan2", "nextafter",
 ]
 
 
@@ -63,6 +83,12 @@ def _attach():
     import types
 
     for mod in _METHOD_SOURCES:
+        if mod is optable:
+            # table-driven module: the spec decides method attachment
+            for name, spec in optable.SPECS.items():
+                if spec.method and not hasattr(Tensor, name):
+                    setattr(Tensor, name, getattr(mod, name))
+            continue
         for name in dir(mod):
             fn = getattr(mod, name)
             if name.startswith("_") or not callable(fn):
@@ -79,9 +105,9 @@ def _attach():
         if target and hasattr(Tensor, target):
             setattr(Tensor, alias, getattr(Tensor, target))
 
-    # in-place variants
+    # in-place variants — registered like the yaml's separate `name_` ops
     g = globals()
-    for name in _INPLACE:
+    for name in _INPLACE + optable.INPLACE_FROM_TABLE:
         fn = g.get(name) or REGISTRY.get(name)
         if fn is None:
             continue
@@ -92,9 +118,11 @@ def _attach():
             return inplace
 
         ip = make_inplace(fn)
-        ip.__name__ = name + "_"
-        g[name + "_"] = ip
-        setattr(Tensor, name + "_", ip)
+        ip_name = optable.INPLACE_NAME_OVERRIDES.get(name, name + "_")
+        ip.__name__ = ip_name
+        g[ip_name] = ip
+        setattr(Tensor, ip_name, ip)
+        REGISTRY.setdefault(ip_name, ip)
 
     # zero_/fill_ already defined on Tensor (core/tensor.py)
 
@@ -138,6 +166,14 @@ def _attach():
     Tensor.__hash__ = lambda s: id(s)
 
     # method-only names
+    Tensor.tolist = lambda s: s.numpy().tolist()
+    Tensor.nelement = lambda s: int(s.size)
+    Tensor.element_size = lambda s: int(
+        __import__("numpy").dtype(s._data.dtype).itemsize)
+    Tensor.apply_ = lambda s, fn: _adopt(
+        s, Tensor(optable.jnp.asarray(fn(s.numpy()))))
+    Tensor.cuda = lambda s, *a, **k: s  # device move is a no-op (one TPU VM)
+    g["unfold"] = g["tensor_unfold"]  # paddle.unfold == Tensor sliding window
     Tensor.dim = lambda s: s.ndim
     Tensor.mod = lambda s, o, name=None: mod(s, o)
     Tensor.pow = lambda s, o, name=None: globals()["pow"](s, o)
@@ -160,3 +196,26 @@ def _attach():
 _attach()
 
 del _bt
+
+def register_surface(module, prefix: str = "") -> int:
+    """Count a module's public op callables into REGISTRY (yaml-parity
+    accounting: the reference's ops.yaml has entries for creation ops and
+    the nn.functional surface too — conv2d, batch_norm, dropout ... are
+    ops there, not just python sugar). Called from paddle_tpu/__init__
+    once nn.functional exists (importing it here would be circular).
+    setdefault: ops already registered by defop keep their entry."""
+    n = 0
+    for name in dir(module):
+        if name.startswith("_"):
+            continue
+        fn = getattr(module, name)
+        if not callable(fn) or isinstance(fn, type):
+            continue
+        if not getattr(fn, "__module__", "").startswith("paddle_tpu"):
+            continue
+        if REGISTRY.setdefault(prefix + name, fn) is fn:
+            n += 1
+    return n
+
+
+register_surface(creation)

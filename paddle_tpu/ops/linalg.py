@@ -99,6 +99,37 @@ def multi_dot(x, name=None):
 
 
 # ---- fft namespace --------------------------------------------------------
+def _fftn_axes(x, s, axes):
+    """Paddle semantics: axes=None means the last len(s) axes (all axes
+    when s is None too)."""
+    if axes is None:
+        n = x.ndim if s is None else len(s)
+        axes = tuple(range(x.ndim - n, x.ndim))
+    else:
+        axes = tuple(axes)
+    s = (None,) * len(axes) if s is None else tuple(s)
+    return s, axes
+
+
+def _hfftn(x, s, axes, norm):
+    s, axes = _fftn_axes(x, s, axes)
+    lead_s = None if all(v is None for v in s[:-1]) else s[:-1]
+    y = x
+    if len(axes) > 1:
+        y = jnp.fft.fftn(y, s=lead_s, axes=axes[:-1], norm=norm)
+    return jnp.fft.hfft(y, n=s[-1], axis=axes[-1], norm=norm)
+
+
+def _ihfftn(x, s, axes, norm):
+    s, axes = _fftn_axes(x, s, axes)
+    lead_s = None if all(v is None for v in s[:-1]) else s[:-1]
+    y = jnp.fft.ihfft(x, n=s[-1], axis=axes[-1], norm=norm)
+    if len(axes) > 1:
+        y = jnp.fft.ifftn(y, s=lead_s, axes=axes[:-1], norm=norm)
+    return y
+
+
+
 class _FFT:
     fft = staticmethod(defop("fft.fft", lambda x, n=None, axis=-1, norm="backward", name=None:
                              jnp.fft.fft(x, n=n, axis=axis, norm=norm)))
@@ -124,6 +155,16 @@ class _FFT:
                               jnp.fft.hfft(x, n=n, axis=axis, norm=norm)))
     ihfft = staticmethod(defop("fft.ihfft", lambda x, n=None, axis=-1, norm="backward", name=None:
                                jnp.fft.ihfft(x, n=n, axis=axis, norm=norm)))
+    # hermitian 2d/nd: complex fft over the leading axes + hfft/ihfft on
+    # the last (numpy has no hfft2/hfftn; paddle defines them this way)
+    hfft2 = staticmethod(defop("fft.hfft2", lambda x, s=None, axes=(-2, -1), norm="backward", name=None:
+                               _hfftn(x, s, axes, norm)))
+    hfftn = staticmethod(defop("fft.hfftn", lambda x, s=None, axes=None, norm="backward", name=None:
+                               _hfftn(x, s, axes, norm)))
+    ihfft2 = staticmethod(defop("fft.ihfft2", lambda x, s=None, axes=(-2, -1), norm="backward", name=None:
+                                _ihfftn(x, s, axes, norm)))
+    ihfftn = staticmethod(defop("fft.ihfftn", lambda x, s=None, axes=None, norm="backward", name=None:
+                                _ihfftn(x, s, axes, norm)))
     fftshift = staticmethod(defop("fft.fftshift", lambda x, axes=None, name=None:
                                   jnp.fft.fftshift(x, axes=axes)))
     ifftshift = staticmethod(defop("fft.ifftshift", lambda x, axes=None, name=None:

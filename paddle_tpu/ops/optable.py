@@ -1,0 +1,659 @@
+"""Table-driven op generation — the reference's ops.yaml codegen, TPU-native.
+
+Reference analog: paddle/phi/ops/yaml/ (ops.yaml + backward.yaml are the
+single source of truth from which the C++ API, python bindings, grad nodes
+and PIR defs are generated — SURVEY.md §2.1 'Op definition YAML + codegen',
+§7 hard-part 5; upstream-canonical, unverified §0).
+
+TPU-native design: the table IS python (a yaml file would just deserialize
+into this), and "codegen" is registration at import time — there is no C++
+to emit. One OpSpec row yields, mechanically:
+  * the registered eager op (defop -> REGISTRY -> tape/AMP/static hooks),
+  * the paddle.* export and Tensor method (ops/__init__._attach),
+  * the `name_` in-place variant where paddle has one (INPLACE extension),
+  * aliases,
+  * an OpTest-style auto-test: numpy-reference forward + finite-difference
+    grad sweep (tests/test_optable.py iterates TABLE — the reference's
+    per-op test_*_op.py files become table rows).
+
+Tiering (what is deliberately NOT here — SURVEY.md §7 'do NOT rebuild'):
+  tier 1 (this table + the hand-written ops/ modules): everything
+    PaddleNLP/vision recipes and the Tensor API docs commonly touch;
+  tier 2 (documented stubs elsewhere): sparse/quant long tail;
+  tier 3 (excluded): mobile/lite ops, ONNX-only ops, fluid legacy ops with
+    no 2.x public API, and CUDA-semantics ops with no XLA meaning
+    (e.g. memcpy_d2h, cudnn_lstm variants).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math as _math
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._registry import REGISTRY, defop
+
+# numpy counterparts used by references
+import numpy.linalg as npl
+
+
+@dataclasses.dataclass
+class OpSpec:
+    name: str
+    raw: Callable                       # jnp impl; tensor args first
+    ref: Optional[Callable] = None      # numpy reference (None: no autotest)
+    n_in: int = 1                       # tensor inputs fed by the autotest
+    kind: str = "elementwise"           # elementwise | custom
+    domain: Tuple[float, float] = (-0.9, 0.9)  # test sampling range
+    shapes: Optional[Sequence] = None   # test input shapes (default (3, 4))
+    grad: bool = True                   # finite-difference grad check
+    int_op: bool = False                # integer inputs, no grad
+    method: bool = True                 # attach as Tensor method
+    inplace: bool = False               # generate & register `name_`
+    aliases: Tuple[str, ...] = ()
+    kwargs: Optional[dict] = None       # extra kwargs for the autotest call
+    rtol: Optional[float] = None
+
+
+TABLE: list = []
+
+
+def U(name, raw, ref=None, **kw):
+    """Unary elementwise op."""
+    TABLE.append(OpSpec(name, raw, ref, n_in=1, **kw))
+
+
+def B(name, raw, ref=None, **kw):
+    """Binary broadcasting op."""
+    TABLE.append(OpSpec(name, raw, ref, n_in=2, **kw))
+
+
+def C(name, raw, ref=None, n_in=1, **kw):
+    """Custom/shape op."""
+    TABLE.append(OpSpec(name, raw, ref, n_in=n_in, kind="custom", **kw))
+
+
+def _seq(x):
+    return x if isinstance(x, (list, tuple)) else (x,)
+
+
+# ---------------------------------------------------------------------------
+# Math — elementwise
+# ---------------------------------------------------------------------------
+
+U("erfc", lambda x: 1.0 - jax.scipy.special.erf(x),
+  ref=lambda x: 1.0 - np.vectorize(_math.erf)(x).astype(x.dtype))
+U("i0e", lambda x: jax.scipy.special.i0e(x),
+  ref=None)  # scipy-free env: identity checked via i0 relation test below
+U("i1e", lambda x: jax.scipy.special.i1e(x), ref=None)
+U("sgn", lambda x: jnp.where(x == 0, 0, x / jnp.abs(x))
+  if jnp.iscomplexobj(x) else jnp.sign(x),
+  ref=np.sign)
+U("positive", lambda x: x, ref=lambda x: +x, grad=False)
+U("negative", jnp.negative, ref=lambda x: -x, aliases=())
+C("increment", lambda x, value=1.0: x + value,
+  ref=lambda x: x + 1.0, inplace=True)
+B("reduce_as", lambda x, y: _reduce_as(x, y), ref=None, grad=False)
+
+
+def _reduce_as(x, target):
+    """Sum x down to target's shape (paddle.reduce_as)."""
+    tshape = target.shape
+    extra = x.ndim - len(tshape)
+    axes = tuple(range(extra)) + tuple(
+        extra + i for i, (a, b) in enumerate(
+            zip(x.shape[extra:], tshape)) if a != b and b == 1)
+    out = jnp.sum(x, axis=axes, keepdims=False)
+    return out.reshape(tshape)
+
+
+C("frexp", lambda x: _frexp(x), ref=lambda x: np.frexp(x), grad=False)
+
+
+def _frexp(x):
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+C("multigammaln", lambda x, p: _multigammaln(x, p),
+  ref=None, grad=False, kwargs={"p": 2}, domain=(2.0, 5.0))
+
+
+def _multigammaln(x, p):
+    i = jnp.arange(p, dtype=x.dtype)
+    return (p * (p - 1) / 4.0 * jnp.log(jnp.pi).astype(x.dtype)
+            + jnp.sum(jax.scipy.special.gammaln(
+                x[..., None] - i / 2.0), axis=-1))
+
+
+B("isin", lambda x, t: jnp.isin(x, t), ref=np.isin, grad=False,
+  int_op=True)
+B("vecdot", lambda x, y, axis=-1: jnp.sum(x * y, axis=axis),
+  ref=lambda x, y: np.sum(x * y, axis=-1))
+B("complex", lambda re, im: jax.lax.complex(re, im),
+  ref=lambda re, im: re + 1j * im, grad=False)  # complex out: holomorphic
+B("polar", lambda ab, ang: jax.lax.complex(ab * jnp.cos(ang),
+                                           ab * jnp.sin(ang)),
+  ref=lambda ab, ang: ab * np.cos(ang) + 1j * ab * np.sin(ang),
+  domain=(0.1, 1.0), grad=False)
+C("clip_by_norm", lambda x, max_norm: _clip_by_norm(x, max_norm),
+  ref=lambda x: x * min(1.0, 5.0 / (npl.norm(x) + 1e-12)),
+  kwargs={"max_norm": 5.0})
+
+
+def _clip_by_norm(x, max_norm):
+    n = jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2))
+    return (x * jnp.minimum(1.0, max_norm / jnp.maximum(n, 1e-12))
+            ).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Reductions
+# ---------------------------------------------------------------------------
+
+C("nanargmax", lambda x, axis=None, keepdim=False:
+  jnp.nanargmax(x, axis=axis, keepdims=keepdim),
+  ref=np.nanargmax, grad=False)
+C("nanargmin", lambda x, axis=None, keepdim=False:
+  jnp.nanargmin(x, axis=axis, keepdims=keepdim),
+  ref=np.nanargmin, grad=False)
+C("nanstd", lambda x, axis=None, unbiased=True, keepdim=False:
+  _nanstd(x, axis, unbiased, keepdim), ref=None, grad=False)
+
+
+def _nanstd(x, axis, unbiased, keepdim):
+    return jnp.sqrt(jnp.nanvar(x, axis=axis, ddof=1 if unbiased else 0,
+                               keepdims=keepdim))
+
+
+C("histogram_bin_edges",
+  lambda x, bins=100, min=0.0, max=0.0: _hist_edges(x, bins, min, max),
+  ref=lambda x: np.histogram_bin_edges(x, bins=10), grad=False,
+  kwargs={"bins": 10}, method=False)
+
+
+def _hist_edges(x, bins, min, max):
+    lo, hi = (min, max) if (min != 0.0 or max != 0.0) else \
+        (jnp.min(x), jnp.max(x))
+    return jnp.linspace(lo, hi, bins + 1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Manipulation
+# ---------------------------------------------------------------------------
+
+C("atleast_1d", jnp.atleast_1d, ref=np.atleast_1d, grad=False)
+C("atleast_2d", jnp.atleast_2d, ref=np.atleast_2d, grad=False)
+C("atleast_3d", jnp.atleast_3d, ref=np.atleast_3d, grad=False)
+C("tensor_split",
+  lambda x, num_or_indices, axis=0:
+  tuple(jnp.array_split(x, num_or_indices, axis=axis)),
+  ref=lambda x: tuple(np.array_split(x, 2, axis=0)),
+  kwargs={"num_or_indices": 2}, grad=False)
+C("hsplit", lambda x, num_or_indices:
+  tuple(jnp.split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)),
+  ref=lambda x: tuple(np.hsplit(x, 2)), kwargs={"num_or_indices": 2},
+  shapes=((4, 4),), grad=False)
+C("vsplit", lambda x, num_or_indices:
+  tuple(jnp.split(x, num_or_indices, axis=0)),
+  ref=lambda x: tuple(np.vsplit(x, 2)), kwargs={"num_or_indices": 2},
+  shapes=((4, 4),), grad=False)
+C("dsplit", lambda x, num_or_indices:
+  tuple(jnp.split(x, num_or_indices, axis=2)),
+  ref=lambda x: tuple(np.dsplit(x, 2)), kwargs={"num_or_indices": 2},
+  shapes=((2, 3, 4),), grad=False)
+C("unstack", lambda x, axis=0, num=None:
+  tuple(jnp.moveaxis(x, axis, 0)),
+  ref=lambda x: tuple(np.moveaxis(x, 0, 0)), grad=False)
+C("unflatten", lambda x, axis, shape: _unflatten(x, axis, shape),
+  ref=lambda x: x.reshape(2, 2, 4), kwargs={"axis": 0, "shape": (2, 2)},
+  shapes=((4, 4),))
+
+
+def _unflatten(x, axis, shape):
+    axis = axis % x.ndim
+    return x.reshape(x.shape[:axis] + tuple(shape) + x.shape[axis + 1:])
+
+
+C("view_as", lambda x, other: x.reshape(other.shape), ref=None, n_in=2,
+  grad=False)
+C("matrix_transpose", lambda x: jnp.swapaxes(x, -1, -2),
+  ref=lambda x: np.swapaxes(x, -1, -2), shapes=((3, 4),))
+C("crop", lambda x, shape=None, offsets=None: _crop(x, shape, offsets),
+  ref=lambda x: x[:2, :3], kwargs={"shape": (2, 3), "offsets": (0, 0)},
+  shapes=((4, 4),))
+
+
+def _crop(x, shape, offsets):
+    shape = tuple(x.shape[i] if s in (-1, None) else s
+                  for i, s in enumerate(shape))
+    offsets = (0,) * x.ndim if offsets is None else tuple(offsets)
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+C("take", lambda x, index, mode="raise": _take(x, index, mode),
+  ref=None, grad=False)
+
+
+def _take(x, index, mode):
+    flat = x.reshape(-1)
+    idx = index
+    if mode == "wrap":
+        idx = idx % flat.shape[0]
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+    return jnp.take(flat, idx)
+
+
+C("index_fill", lambda x, index, axis, value: _index_fill(x, index, axis,
+                                                          value),
+  ref=None, inplace=True, grad=False)
+
+
+def _index_fill(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[index].set(value)
+    return jnp.moveaxis(moved, 0, axis)
+
+
+C("diagonal_scatter", lambda x, y, offset=0, axis1=0, axis2=1:
+  _diagonal_scatter(x, y, offset, axis1, axis2), ref=None, n_in=2,
+  grad=False)
+
+
+def _diagonal_scatter(x, y, offset, axis1, axis2):
+    # build index grid along the diagonal and scatter y onto it
+    n = min(x.shape[axis1], x.shape[axis2] - offset) if offset >= 0 else \
+        min(x.shape[axis1] + offset, x.shape[axis2])
+    i = jnp.arange(n)
+    r = i - min(offset, 0)
+    c = i + max(offset, 0)
+    moved = jnp.moveaxis(x, (axis1, axis2), (0, 1))
+    moved = moved.at[r, c].set(jnp.moveaxis(
+        y, -1, 0) if y.ndim > 1 else y)
+    return jnp.moveaxis(moved, (0, 1), (axis1, axis2))
+
+
+C("select_scatter", lambda x, values, axis, index:
+  _select_scatter(x, values, axis, index), ref=None, n_in=2, grad=False)
+
+
+def _select_scatter(x, values, axis, index):
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[index].set(values)
+    return jnp.moveaxis(moved, 0, axis)
+
+
+C("slice_scatter", lambda x, value, axes, starts, ends, strides:
+  _slice_scatter(x, value, axes, starts, ends, strides), ref=None,
+  n_in=2, grad=False)
+
+
+def _slice_scatter(x, value, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(_seq(axes), _seq(starts), _seq(ends),
+                           _seq(strides)):
+        idx[a] = slice(s, e, st)
+    return x.at[tuple(idx)].set(value)
+
+
+def _cartesian_prod(xs):
+    grids = jnp.meshgrid(*xs, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+C("combinations", lambda x, r=2, with_replacement=False:
+  _combinations(x, r, with_replacement), ref=None, grad=False,
+  shapes=((5,),))
+
+
+def _combinations(x, r, with_replacement):
+    import itertools
+    n = x.shape[0]
+    comb = (itertools.combinations_with_replacement if with_replacement
+            else itertools.combinations)
+    idx = np.asarray(list(comb(range(n), r)), dtype=np.int32)
+    if idx.size == 0:
+        return jnp.zeros((0, r), x.dtype)
+    return x[idx]
+
+
+def _multiplex(ins, index):
+    stacked = jnp.stack(ins, axis=0)                    # [n, B, ...]
+    rows = index.reshape(-1).astype(jnp.int32)          # [B]
+    return stacked[rows, jnp.arange(stacked.shape[1])]
+
+
+# ---------------------------------------------------------------------------
+# Linalg
+# ---------------------------------------------------------------------------
+
+C("vector_norm", lambda x, p=2.0, axis=None, keepdim=False:
+  _vector_norm(x, p, axis, keepdim),
+  ref=lambda x: npl.norm(x.reshape(-1)), shapes=((3, 4),))
+
+
+def _vector_norm(x, p, axis, keepdim):
+    xf = x.astype(jnp.float32) if x.dtype != jnp.float64 else x
+    if p == jnp.inf:
+        r = jnp.max(jnp.abs(xf), axis=axis, keepdims=keepdim)
+    elif p == -jnp.inf:
+        r = jnp.min(jnp.abs(xf), axis=axis, keepdims=keepdim)
+    elif p == 0:
+        r = jnp.sum((xf != 0).astype(xf.dtype), axis=axis, keepdims=keepdim)
+    else:
+        r = jnp.sum(jnp.abs(xf) ** p, axis=axis, keepdims=keepdim) ** (1 / p)
+    return r.astype(x.dtype)
+
+
+C("matrix_norm", lambda x, p="fro", axis=(-2, -1), keepdim=False:
+  _matrix_norm(x, p, axis, keepdim),
+  ref=lambda x: npl.norm(x, "fro"), shapes=((3, 4),))
+
+
+def _matrix_norm(x, p, axis, keepdim):
+    a1, a2 = axis
+    xf = x.astype(jnp.float32) if x.dtype != jnp.float64 else x
+    if p == "fro":
+        r = jnp.sqrt(jnp.sum(xf * xf, axis=axis, keepdims=keepdim))
+    elif p == "nuc":
+        moved = jnp.moveaxis(xf, axis, (-2, -1))
+        s = jnp.linalg.svd(moved, compute_uv=False)
+        r = jnp.sum(s, axis=-1, keepdims=False)
+        if keepdim:
+            r = jnp.expand_dims(r, axis)
+    elif p in (1, -1):
+        col = jnp.sum(jnp.abs(xf), axis=a1, keepdims=True)
+        r = (jnp.max if p == 1 else jnp.min)(col, axis=a2, keepdims=True)
+        if not keepdim:
+            r = jnp.squeeze(r, axis)
+    elif p in (2, -2):
+        moved = jnp.moveaxis(xf, axis, (-2, -1))
+        s = jnp.linalg.svd(moved, compute_uv=False)
+        r = (jnp.max if p == 2 else jnp.min)(s, axis=-1)
+        if keepdim:
+            r = jnp.expand_dims(r, axis)
+    elif p in (jnp.inf, -jnp.inf):
+        row = jnp.sum(jnp.abs(xf), axis=a2, keepdims=True)
+        r = (jnp.max if p == jnp.inf else jnp.min)(row, axis=a1,
+                                                   keepdims=True)
+        if not keepdim:
+            r = jnp.squeeze(r, axis)
+    else:
+        raise ValueError(f"unsupported matrix norm order {p!r}")
+    return r.astype(x.dtype)
+
+
+C("cdist", lambda x, y, p=2.0: _cdist(x, y, p),
+  ref=lambda x, y: npl.norm(x[:, None] - y[None], axis=-1),
+  n_in=2, shapes=((4, 3), (5, 3)), rtol=1e-4)
+
+
+def _cdist(x, y, p):
+    d = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == jnp.inf:
+        return jnp.max(d, axis=-1)
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype), axis=-1)
+    s = jnp.sum(d ** p, axis=-1)
+    # zero distances (cdist(x, x) diagonal) are non-differentiable points
+    # of the p-root; the where-in-where keeps their grad 0, not NaN
+    pos = s > 0
+    return jnp.where(pos, jnp.where(pos, s, 1.0) ** (1.0 / p), 0.0)
+
+
+C("lu_unpack", lambda lu, pivots, unpack_ludata=True, unpack_pivots=True:
+  _lu_unpack(lu, pivots), ref=None, n_in=1, grad=False, method=False)
+# (unpack_ludata/unpack_pivots accepted for API parity; both always
+# computed — the P/L/U triple is cheap relative to the LU itself)
+
+
+def _lu_unpack(lu, pivots):
+    m, n = lu.shape[-2:]
+    k = min(m, n)
+    L = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
+    U = jnp.triu(lu[..., :k, :])
+    # pivots (1-based sequential transpositions) -> permutation matrix
+    P = jnp.eye(m, dtype=lu.dtype)
+
+    def body(i, args):
+        P, = args
+        j = pivots[i] - 1
+        row_i, row_j = P[i], P[j]
+        P = P.at[i].set(row_j).at[j].set(row_i)
+        return (P,)
+
+    (P,) = jax.lax.fori_loop(0, pivots.shape[-1], body, (P,))
+    return P.T, L, U
+
+
+C("cholesky_inverse", lambda x, upper=False: _cholesky_inverse(x, upper),
+  ref=None, grad=False)
+
+
+def _cholesky_inverse(L, upper):
+    A = (L.T @ L) if upper else (L @ L.T)
+    return jnp.linalg.inv(A)
+
+
+C("ormqr", lambda x, tau, y, left=True, transpose=False:
+  _ormqr(x, tau, y, left, transpose), ref=None, n_in=3, grad=False,
+  method=False)
+
+
+def _ormqr(x, tau, y, left, transpose):
+    Q = jax.lax.linalg.householder_product(x, tau)
+    Qm = Q.T if transpose else Q
+    return (Qm @ y) if left else (y @ Qm)
+
+
+C("cumulative_trapezoid", lambda y, x=None, dx=1.0, axis=-1:
+  _cumtrapz(y, x, dx, axis),
+  ref=lambda y: np.cumsum((y[..., 1:] + y[..., :-1]) / 2.0, axis=-1))
+
+
+def _cumtrapz(y, x, dx, axis):
+    y0 = jnp.moveaxis(y, axis, -1)
+    if x is not None:
+        d = jnp.diff(jnp.moveaxis(x, axis, -1) if x.ndim > 1 else x)
+    else:
+        d = dx
+    out = jnp.cumsum(d * (y0[..., 1:] + y0[..., :-1]) / 2.0, axis=-1)
+    return jnp.moveaxis(out, -1, axis)
+
+
+C("pdist", lambda x, p=2.0: _pdist(x, p), ref=None, shapes=((5, 3),))
+
+
+def _pdist(x, p):
+    n = x.shape[0]
+    full = _cdist(x, x, p)
+    r, c = jnp.triu_indices(n, k=1)
+    return full[r, c]
+
+
+C("is_complex", lambda x: jnp.iscomplexobj(x), ref=None, grad=False)
+C("is_floating_point", lambda x: jnp.issubdtype(x.dtype, jnp.floating),
+  ref=None, grad=False)
+C("is_integer", lambda x: jnp.issubdtype(x.dtype, jnp.integer), ref=None,
+  grad=False)
+C("rank", lambda x: jnp.asarray(x.ndim, jnp.int32),
+  ref=lambda x: np.int32(x.ndim), grad=False)
+C("shape", lambda x: jnp.asarray(x.shape, jnp.int32),
+  ref=lambda x: np.asarray(x.shape, np.int32), grad=False, method=False)
+C("fill_diagonal", lambda x, value, offset=0, wrap=False:
+  _fill_diagonal(x, value, offset), ref=None, inplace=True, grad=False,
+  shapes=((4, 4),), kwargs={"value": 0.0})
+
+
+def _fill_diagonal(x, value, offset):
+    n = min(x.shape[-2], x.shape[-1] - offset) if offset >= 0 else \
+        min(x.shape[-2] + offset, x.shape[-1])
+    i = jnp.arange(n)
+    return x.at[..., i - min(offset, 0), i + max(offset, 0)].set(value)
+
+
+C("fill_diagonal_tensor", lambda x, y, offset=0, dim1=0, dim2=1:
+  _diagonal_scatter(x, y, offset, dim1, dim2), ref=None, n_in=2,
+  inplace=True, grad=False)
+C("svd_lowrank", lambda x, q=6, niter=2: _svd_lowrank(x, q, niter),
+  ref=None, grad=False, method=False, shapes=((8, 6),))
+
+
+def _svd_lowrank(x, q, niter):
+    """Randomized low-rank SVD (Halko et al. — the reference's
+    linalg.svd_lowrank)."""
+    m, n = x.shape[-2:]
+    q = min(q, m, n)
+    G = jax.random.normal(_next_key(), x.shape[:-2] + (n, q), x.dtype)
+    Y = x @ G
+    for _ in range(niter):
+        Y = x @ (x.swapaxes(-1, -2) @ Y)
+    Q, _ = jnp.linalg.qr(Y)
+    B = Q.swapaxes(-1, -2) @ x
+    U, s, Vh = jnp.linalg.svd(B, full_matrices=False)
+    return Q @ U, s, Vh.swapaxes(-1, -2)
+
+
+C("pca_lowrank", lambda x, q=None, center=True, niter=2:
+  _pca_lowrank(x, q, center, niter), ref=None, grad=False, method=False,
+  shapes=((8, 6),))
+
+
+def _pca_lowrank(x, q, center, niter):
+    q = min(6 if q is None else q, *x.shape[-2:])
+    if center:
+        x = x - jnp.mean(x, axis=-2, keepdims=True)
+    return _svd_lowrank(x, q, niter)
+
+
+# ---------------------------------------------------------------------------
+# Random sampling (tensor-parameterized; keyed off core.random's stream)
+# ---------------------------------------------------------------------------
+
+C("log_normal", lambda mean=1.0, std=2.0, shape=(1,):
+  jnp.exp(mean + std * jax.random.normal(_next_key(), tuple(shape))),
+  ref=None, grad=False, method=False, n_in=0)
+C("standard_normal", lambda shape, dtype=None:
+  jax.random.normal(_next_key(), tuple(shape),
+                    dtype or jnp.float32),
+  ref=None, grad=False, method=False, n_in=0)
+C("tril_indices", lambda row, col=None, offset=0:
+  jnp.stack(jnp.tril_indices(row, offset, col or row)).astype(jnp.int64),
+  ref=None, grad=False, method=False, n_in=0)
+C("triu_indices", lambda row, col=None, offset=0:
+  jnp.stack(jnp.triu_indices(row, offset, col or row)).astype(jnp.int64),
+  ref=None, grad=False, method=False, n_in=0)
+
+# in-place-only random initializers (paddle defines ONLY Tensor.cauchy_ /
+# geometric_ / exponential_ — no out-of-place spelling, and `geometric`
+# must stay free for the paddle.geometric graph package). The raw op
+# returns a fresh sample shaped like x; ops/__init__ adopts it in place
+# under the paddle `name_` from INPLACE_NAME_OVERRIDES.
+C("cauchy_sample", lambda x, loc=0.0, scale=1.0:
+  loc + scale * jax.random.cauchy(_next_key(), x.shape).astype(x.dtype),
+  ref=None, grad=False, inplace=True, method=False)
+C("geometric_sample", lambda x, probs=0.5:
+  jax.random.geometric(_next_key(), probs, x.shape).astype(x.dtype),
+  ref=None, grad=False, inplace=True, method=False)
+C("exponential_sample", lambda x, lam=1.0:
+  (jax.random.exponential(_next_key(), x.shape) / lam).astype(x.dtype),
+  ref=None, grad=False, inplace=True, method=False)
+
+# table op name -> the paddle `name_` its in-place variant binds as
+INPLACE_NAME_OVERRIDES = {
+    "cauchy_sample": "cauchy_",
+    "geometric_sample": "geometric_",
+    "exponential_sample": "exponential_",
+}
+
+def _next_key():
+    from ..core import random as _r
+    return _r.next_key()
+
+
+C("poisson", lambda x: jax.random.poisson(_next_key(), x).astype(x.dtype),
+  ref=None, grad=False, domain=(0.5, 5.0))
+C("binomial", lambda count, prob: jax.random.binomial(
+    _next_key(), count, prob).astype(count.dtype),
+  ref=None, n_in=2, grad=False, method=False, domain=(0.1, 0.9))
+C("standard_gamma", lambda x: jax.random.gamma(_next_key(), x
+                                               ).astype(x.dtype),
+  ref=None, grad=False, domain=(0.5, 5.0))
+
+
+# ---------------------------------------------------------------------------
+# List-input ops: paddle's API takes a LIST of tensors; eager dispatch
+# unwraps positionals, so the public fn splats the list
+# ---------------------------------------------------------------------------
+
+import functools as _ft
+
+from ._registry import eager as _eager
+
+
+def _deflistop(name, raw_on_arrays, trailing=0):
+    """Register op(list_of_tensors, *trailing_tensors). raw_on_arrays
+    receives (arrays_tuple, *trailing_arrays)."""
+    def raw(*arrs):
+        if trailing:
+            return raw_on_arrays(arrs[:-trailing], *arrs[-trailing:])
+        return raw_on_arrays(arrs)
+
+    def public(xs, *rest, **kw):
+        return _eager(raw, tuple(xs) + tuple(rest), kw, name=name)
+
+    public.__name__ = name
+    public.raw = raw
+    REGISTRY[name] = public
+    return public
+
+
+add_n = _deflistop("add_n", lambda xs: _ft.reduce(jnp.add, xs))
+column_stack = _deflistop("column_stack", lambda xs: jnp.column_stack(xs))
+block_diag = _deflistop(
+    "block_diag", lambda xs: jax.scipy.linalg.block_diag(*xs))
+cartesian_prod = _deflistop("cartesian_prod", _cartesian_prod)
+multiplex = _deflistop("multiplex", _multiplex, trailing=1)
+
+
+# ---------------------------------------------------------------------------
+# Generation ("codegen" at import): registry + module globals + aliases
+# ---------------------------------------------------------------------------
+
+# name -> OpSpec, for the auto-test harness
+SPECS = {}
+
+# ops whose `name_` in-place variant paddle defines and we generate
+# (ops/__init__ extends its _INPLACE list with these; they are REGISTERED
+# so the op count reflects the yaml's separate inplace entries)
+INPLACE_FROM_TABLE = []
+
+
+# star-import surface: ONLY generated ops (the table builders U/B/C,
+# TABLE/SPECS and helpers stay module-internal — they must not leak into
+# paddle.* or become Tensor methods)
+__all__ = []
+
+
+def _generate():
+    g = globals()
+    for spec in TABLE:
+        fn = defop(spec.name, spec.raw)
+        g[spec.name] = fn
+        SPECS[spec.name] = spec
+        __all__.append(spec.name)
+        for alias in spec.aliases:
+            g[alias] = fn
+            REGISTRY.setdefault(alias, fn)
+            __all__.append(alias)
+        if spec.inplace:
+            INPLACE_FROM_TABLE.append(spec.name)
+    __all__.extend(["add_n", "column_stack", "block_diag",
+                    "cartesian_prod", "multiplex"])
+
+
+_generate()

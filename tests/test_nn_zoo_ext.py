@@ -302,3 +302,100 @@ class TestReviewRegressions:
             paddle.to_tensor(np.array([200.0], np.float32)),
             paddle.to_tensor(np.array([-1.0], np.float32)))
         assert np.isfinite(out.numpy()) and out.numpy() == 200.0
+
+
+class TestMaxUnPool:
+    def test_unpool2d_inverts_pool(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(
+            np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        y, mask = F.max_pool2d(x, 2, return_mask=True)
+        up = nn.MaxUnPool2D(2)(y, mask)
+        assert up.shape == [1, 1, 4, 4]
+        # pooled maxima land back at their argmax positions; rest zero
+        assert float(up.sum().numpy()) == float(y.sum().numpy())
+        assert float(up.numpy()[0, 0, 3, 3]) == 15.0
+
+    def test_unpool1d_shapes(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(np.random.randn(2, 3, 8).astype(np.float32))
+        y, mask = F.max_pool1d(x, 2, return_mask=True)
+        assert nn.MaxUnPool1D(2)(y, mask).shape == [2, 3, 8]
+
+
+class TestHSigmoidLoss:
+    def test_loss_positive_and_trains(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        hs = nn.HSigmoidLoss(8, 6)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                             .astype(np.float32), stop_gradient=False)
+        lab = paddle.to_tensor(np.array([0, 1, 2, 5]))
+        loss = hs(x, lab).mean()
+        assert float(loss.numpy()) > 0
+        loss.backward()
+        assert x.grad is not None
+        assert hs.weight.grad is not None
+
+
+class TestBeamSearchDecode:
+    def _cell(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        class Cell(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x, states):
+                h = paddle.tanh(self.fc(x) + states)
+                return h, h
+
+        return Cell()
+
+    def test_beam_shapes_and_greedy_consistency(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        paddle.seed(7)
+        cell = self._cell()
+        emb = nn.Embedding(10, 4)
+        proj = nn.Linear(4, 10)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=9,
+                                   beam_size=3, embedding_fn=emb,
+                                   output_fn=proj)
+        init = paddle.zeros([2, 4])
+        out, _ = nn.dynamic_decode(dec, inits=init, max_step_num=5)
+        assert out.shape == [2, 5, 3]
+        # beam 0 of beam_size=1 == greedy argmax rollout of the same cell
+        dec1 = nn.BeamSearchDecoder(cell, 0, 9, 1, embedding_fn=emb,
+                                    output_fn=proj)
+        o1, _ = nn.dynamic_decode(dec1, inits=init, max_step_num=4)
+        state = init
+        tok = paddle.to_tensor(np.zeros(2, np.int64))
+        want = []
+        for _ in range(4):
+            h, state = cell(emb(tok), state)
+            tok = paddle.argmax(proj(h), axis=-1)
+            want.append(tok.numpy())
+        np.testing.assert_array_equal(
+            o1.numpy()[:, :, 0], np.stack(want, axis=1))
+
+
+class TestHSigmoidLabelShape:
+    def test_n_by_1_label(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        hs = nn.HSigmoidLoss(8, 6)
+        out = hs(paddle.to_tensor(np.random.randn(4, 8).astype(np.float32)),
+                 paddle.to_tensor(np.array([[0], [1], [2], [5]])))
+        assert out.shape == [4, 1]

@@ -1,0 +1,111 @@
+"""Auto-generated op tests: every optable.OpSpec row with a numpy
+reference gets an OpTest-style forward check, and every grad-eligible row
+a finite-difference grad check — the table IS the test list, exactly the
+reference's ops.yaml -> per-op test generation loop (SURVEY.md §2.1
+codegen row, §4 OpTest; VERDICT r1 item 3)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.optable import SPECS, INPLACE_FROM_TABLE
+from paddle_tpu.ops._registry import REGISTRY
+
+import optest
+
+_FWD = sorted(n for n, s in SPECS.items() if s.ref is not None)
+_GRAD = sorted(n for n, s in SPECS.items()
+               if s.grad and not s.int_op and s.ref is not None)
+
+
+def _inputs(spec, seed=7):
+    rng = np.random.RandomState(seed)
+    shapes = spec.shapes or ((3, 4),) * max(spec.n_in, 1)
+    if len(shapes) < spec.n_in:
+        shapes = tuple(shapes) * spec.n_in
+    lo, hi = spec.domain
+    if spec.int_op:
+        return [rng.randint(0, 5, sh).astype(np.int64) for sh in shapes]
+    return [(rng.uniform(lo, hi, sh)).astype(np.float32) for sh in shapes]
+
+
+@pytest.mark.parametrize("name", _FWD)
+def test_forward_matches_numpy(name):
+    spec = SPECS[name]
+    inputs = _inputs(spec)
+    optest.check_output(REGISTRY[name], spec.ref, inputs,
+                        kwargs=spec.kwargs, rtol=spec.rtol)
+
+
+@pytest.mark.parametrize("name", _GRAD)
+def test_grad_matches_finite_difference(name):
+    spec = SPECS[name]
+    inputs = _inputs(spec)
+    optest.check_grad(REGISTRY[name], inputs, kwargs=spec.kwargs)
+
+
+def test_table_ops_are_registered_and_attached():
+    """Every table row is in REGISTRY; method rows are Tensor methods;
+    inplace rows registered their `name_` twin."""
+    from paddle_tpu import Tensor
+    for name, spec in SPECS.items():
+        assert name in REGISTRY, name
+        if spec.method:
+            assert hasattr(Tensor, name), name
+    from paddle_tpu.ops.optable import INPLACE_NAME_OVERRIDES
+    for name in INPLACE_FROM_TABLE:
+        ip = INPLACE_NAME_OVERRIDES.get(name, name + "_")
+        assert ip in REGISTRY, ip
+
+
+def test_surface_breadth():
+    """The registry op count must hold the round-2 breadth line (VERDICT
+    r1 item 3: >= ~600 with inplace/functional accounting)."""
+    assert len(REGISTRY) >= 550, len(REGISTRY)
+
+
+def test_inplace_variants_adopt():
+    x = paddle.to_tensor(np.array([1.0, 4.0], np.float32))
+    x.sqrt_()
+    np.testing.assert_allclose(x.numpy(), [1.0, 2.0])
+    y = paddle.to_tensor(np.array([True, False]))
+    y.logical_not_()
+    np.testing.assert_array_equal(y.numpy(), [False, True])
+
+
+def test_special_value_ops():
+    # i0e/i1e: exponentially-scaled Bessel identities vs i0/i1
+    x = paddle.to_tensor(np.array([0.5, 1.0, 2.0], np.float32))
+    np.testing.assert_allclose(
+        (paddle.i0e(x) * paddle.exp(x)).numpy(), paddle.i0(x).numpy(),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        (paddle.i1e(x) * paddle.exp(x)).numpy(), paddle.i1(x).numpy(),
+        rtol=1e-5)
+    # multigammaln(x, 1) == gammaln(x)
+    np.testing.assert_allclose(
+        paddle.multigammaln(x + 2, 1).numpy(),
+        paddle.lgamma(x + 2).numpy(), rtol=1e-5)
+
+
+def test_no_machinery_leaks():
+    """Table builders/TABLE must not leak into paddle.* or Tensor (the
+    star-import chain is __all__-gated; method=False rows stay functions)."""
+    from paddle_tpu import Tensor
+    assert not hasattr(paddle, "U") and not hasattr(paddle, "TABLE")
+    assert not hasattr(Tensor, "lu_unpack")
+    assert not hasattr(Tensor, "standard_normal")
+    assert hasattr(Tensor, "cdist") and hasattr(paddle, "add_n")
+
+
+def test_cdist_zero_distance_grads_finite():
+    """cdist(x, x)'s zero diagonal is a non-differentiable point of the
+    p-root; grads there must be 0, not NaN."""
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 4)
+                         .astype(np.float32), stop_gradient=False)
+    paddle.cdist(x, x).sum().backward()
+    assert bool(paddle.isfinite(x.grad).all())
+
+
+def test_hfftn_s_without_axes_uses_trailing_axes():
+    x = paddle.to_tensor((np.random.randn(3, 4) + 0j).astype(np.complex64))
+    assert paddle.fft.hfftn(x, s=[6]).shape == [3, 6]
