@@ -244,15 +244,24 @@ def _final_head(params, x, cfg: LlamaConfig):
 
 
 def forward_pp(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
-               mesh, num_microbatches: int) -> jax.Array:
+               mesh, num_microbatches: int,
+               virtual_pp: int = 1) -> jax.Array:
     """Pipeline-parallel forward: the decoder stack runs as a compiled GPipe
     schedule over the mesh's `pp` axis (parallel.pipeline), embed/head stay
     GSPMD (replicated compute over pp, sharded over mp/sharding).
 
+    virtual_pp > 1 selects the interleaved (virtual-pp) circular schedule:
+    each device holds virtual_pp non-contiguous layer chunks, shrinking the
+    fill/drain bubble by that factor (reference: PipelineParallel's
+    interleaved mode). Note the [v, p, L/(v*p)] chunk layout differs from
+    param_specs' contiguous-P('pp') blocks, so GSPMD reshards the layer
+    stack at entry — init with a matching sharding for production runs.
+
     Reference analog: PipelineParallel.train_batch's forward half
     (SURVEY.md §3.3) — here the microbatch loop is a lax.scan and the stage
     hops are ppermute, all inside one XLA program."""
-    from ..parallel.pipeline import pipelined
+    from ..parallel.pipeline import (interleaved, pipelined,
+                                     stack_virtual_chunks)
 
     n, stage_params, stage_fn = _pp_stage_setup(
         params, tokens.shape, cfg, mesh, num_microbatches)
@@ -260,7 +269,14 @@ def forward_pp(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
     M = num_microbatches
     x = jnp.take(params["embed_tokens"], tokens, axis=0).astype(cfg.dtype)
     mb = x.reshape((M, B // M) + x.shape[1:])
-    outs = pipelined(stage_fn, mesh, remat=cfg.remat)(stage_params, mb)
+    if virtual_pp > 1:
+        chunks = stack_virtual_chunks(
+            params["layers"], n, virtual_pp)
+        chunk_fn = interleaved(stage_fn, mesh, v=virtual_pp,
+                               remat=cfg.remat)
+        outs = chunk_fn(chunks, mb)
+    else:
+        outs = pipelined(stage_fn, mesh, remat=cfg.remat)(stage_params, mb)
     x = outs.reshape(B, S, -1)
     return _final_head(params, x, cfg)
 
@@ -365,7 +381,7 @@ def loss_and_grad_pp(params: Dict[str, Any], tokens: jax.Array,
 
 
 def loss_fn(params, tokens, cfg: LlamaConfig, mesh=None,
-            pp_microbatches: Optional[int] = None):
+            pp_microbatches: Optional[int] = None, pp_virtual: int = 1):
     """Next-token cross entropy, masked at the final position. f32 softmax.
 
     Shapes stay [B, S] throughout (targets via roll + mask, not slicing):
@@ -377,7 +393,8 @@ def loss_fn(params, tokens, cfg: LlamaConfig, mesh=None,
     the compiled GPipe schedule with this many microbatches."""
     if (pp_microbatches and mesh is not None
             and "pp" in mesh.axis_names and mesh.shape["pp"] > 1):
-        logits = forward_pp(params, tokens, cfg, mesh, pp_microbatches)
+        logits = forward_pp(params, tokens, cfg, mesh, pp_microbatches,
+                            pp_virtual)
     else:
         logits = forward(params, tokens, cfg, mesh)
     return _mb_loss(logits, tokens)

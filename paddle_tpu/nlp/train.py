@@ -122,6 +122,7 @@ def make_train_step(cfg, tx, mesh: Optional[Mesh] = None,
                     num_microbatches: Optional[int] = None,
                     grad_accum_steps: int = 1,
                     pp_schedule: str = "1f1b",
+                    virtual_pp_degree: int = 2,
                     model=llama) -> Callable:
     """Build the jitted train step. With a mesh: full GSPMD shardings on
     state and batch; without: plain jit (single device). A mesh with pp > 1
@@ -133,7 +134,9 @@ def make_train_step(cfg, tx, mesh: Optional[Mesh] = None,
     forward+backward with O(pp) activation residency; "gpipe" runs
     forward_pp under jax.grad (scan transpose, O(num_microbatches)
     residency) and is the automatic fallback for models without a
-    loss_and_grad_pp.
+    loss_and_grad_pp; "interleaved" runs the circular virtual-pp schedule
+    (virtual_pp_degree chunks per device — bubble shrinks by that factor)
+    under jax.grad.
 
     grad_accum_steps > 1 splits the batch axis into that many chunks and
     accumulates grads through one lax.scan before the optimizer update —
@@ -145,10 +148,12 @@ def make_train_step(cfg, tx, mesh: Optional[Mesh] = None,
     dp/sharding batch shards."""
     pp = _use_pp(mesh) and hasattr(model, "forward_pp")
     mb = (num_microbatches or 2 * mesh.shape["pp"]) if pp else None
-    if pp_schedule not in ("1f1b", "gpipe"):
+    if pp_schedule not in ("1f1b", "gpipe", "interleaved"):
         raise ValueError(f"unknown pp_schedule {pp_schedule!r}")
     use_1f1b = (pp and pp_schedule == "1f1b"
                 and hasattr(model, "loss_and_grad_pp"))
+    pp_virtual = virtual_pp_degree if (
+        pp and pp_schedule == "interleaved") else 1
     if grad_accum_steps < 1:
         raise ValueError(
             f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
@@ -159,7 +164,11 @@ def make_train_step(cfg, tx, mesh: Optional[Mesh] = None,
 
     def step_fn(state: TrainState, tokens):
         if pp:
-            lfn = lambda p, t: model.loss_fn(p, t, cfg, mesh, mb)  # noqa: E731
+            if pp_virtual > 1:
+                lfn = lambda p, t: model.loss_fn(  # noqa: E731
+                    p, t, cfg, mesh, mb, pp_virtual)
+            else:
+                lfn = lambda p, t: model.loss_fn(p, t, cfg, mesh, mb)  # noqa: E731
         else:
             lfn = lambda p, t: model.loss_fn(p, t, cfg, mesh)  # noqa: E731
         if grad_accum_steps > 1:

@@ -70,7 +70,7 @@ _INPLACE = [
     "logical_not", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
     "bitwise_left_shift", "bitwise_right_shift",
     # structure
-    "tril", "triu", "scatter", "masked_scatter", "where", "cumsum",
+    "tril", "triu", "scatter", "masked_scatter", "cumsum",
     "cumprod", "fmax", "fmin", "maximum", "minimum", "remainder",
     "gcd", "lcm", "heaviside", "atan2", "nextafter",
 ]
@@ -123,6 +123,15 @@ def _attach():
         g[ip_name] = ip
         setattr(Tensor, ip_name, ip)
         REGISTRY.setdefault(ip_name, ip)
+
+    # where_ writes into X (paddle.where_(cond, x, y) -> x), not the
+    # condition — the generic first-arg adopt would destroy the bool mask
+    def where_(cond, x, y, name=None):
+        return _adopt(x, g["where"](cond, x, y))
+
+    g["where_"] = where_
+    Tensor.where_ = lambda s, x, y, name=None: where_(s, x, y)
+    REGISTRY.setdefault("where_", where_)
 
     # zero_/fill_ already defined on Tensor (core/tensor.py)
 
@@ -205,17 +214,23 @@ def register_surface(module, prefix: str = "") -> int:
     once nn.functional exists (importing it here would be circular).
     setdefault: ops already registered by defop keep their entry."""
     n = 0
+    _machinery = ("paddle_tpu.ops._registry", "paddle_tpu.core.tensor")
     for name in dir(module):
-        if name.startswith("_"):
+        if name.startswith("_") or name in _NON_OPS:
             continue
         fn = getattr(module, name)
         if not callable(fn) or isinstance(fn, type):
             continue
-        if not getattr(fn, "__module__", "").startswith("paddle_tpu"):
+        mod = getattr(fn, "__module__", "")
+        if not mod.startswith("paddle_tpu") or mod in _machinery:
             continue
         if REGISTRY.setdefault(prefix + name, fn) is fn:
             n += 1
     return n
+
+
+# dispatch machinery that star-imports re-export — never ops
+_NON_OPS = {"eager", "defop", "op", "as_array", "to_tensor", "adopt_inplace"}
 
 
 register_surface(creation)

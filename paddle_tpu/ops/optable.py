@@ -243,7 +243,10 @@ def _take(x, index, mode):
     idx = index
     if mode == "wrap":
         idx = idx % flat.shape[0]
-    elif mode == "clip":
+    else:
+        # mode "raise" clamps like "clip": XLA cannot raise data-dependently
+        # inside a compiled program (same accepted divergence as gather's
+        # out-of-bounds clamp); jnp.take's default would FILL with NaN
         idx = jnp.clip(idx, 0, flat.shape[0] - 1)
     return jnp.take(flat, idx)
 
@@ -412,6 +415,8 @@ C("lu_unpack", lambda lu, pivots, unpack_ludata=True, unpack_pivots=True:
 
 
 def _lu_unpack(lu, pivots):
+    if lu.ndim > 2:  # batched factors: paddle's lu/lu_unpack batch
+        return jax.vmap(_lu_unpack)(lu, pivots)
     m, n = lu.shape[-2:]
     k = min(m, n)
     L = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)

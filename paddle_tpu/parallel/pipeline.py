@@ -120,6 +120,130 @@ def pipelined(stage_fn: Callable, mesh: Mesh, n_stages: Optional[int] = None,
 
 
 # ---------------------------------------------------------------------------
+# Interleaved / virtual pipeline (circular schedule), compiled
+# ---------------------------------------------------------------------------
+
+def circular_gpipe_apply(stage_fn: Callable, chunk_params: Any,
+                         microbatches: jax.Array, n_stages: int, v: int,
+                         axis_name: str = "pp",
+                         remat: bool = True) -> jax.Array:
+    """Interleaved virtual-pp forward INSIDE a shard_map manual over `pp`.
+
+    Reference analog: PipelineParallel's interleaved (virtual pipeline)
+    schedule — each device holds v NON-contiguous model chunks, so the
+    fill/drain bubble shrinks by v (SURVEY.md §2.3 PP row). Compiled here
+    as a CIRCULAR pipeline: virtual stage c = j*p + i lives on device
+    i = c mod p as its chunk j, and the microbatch stream flows around the
+    device ring v times — the stage hop c -> c+1 is the SAME neighbor
+    ppermute every tick, chunk j's boundary crossing included (device p-1
+    chunk j feeds device 0 chunk j+1 on the wraparound hop). At tick t,
+    device i sees stream position k = t - i: microbatch k % M under chunk
+    k // M, selected from the stacked chunk params by dynamic index.
+    M microbatches drain in v*M + p - 1 ticks of 1/(v*p)-of-the-model work
+    each — bubble (p-1)/(v*M + p - 1), v times smaller than GPipe's.
+
+    chunk_params: this device's chunk stack, leading dims [v, 1, ...]
+    (from the global [v, p, ...] layout sharded P(None, 'pp')).
+    microbatches: [M, mb...] replicated over pp, with p | M (microbatches
+    stream in GROUPS of p — a group cycles all v chunks before the next
+    enters, which is what keeps every device uniquely busy: the device
+    stream position u = t - i decomposes as u = g*(v*p) + j*p + r with
+    group g, chunk j, in-group microbatch r, each decomposition unique).
+    Returns [M, mb...] outputs of the LAST virtual stage, replicated
+    over pp.
+    """
+    i = lax.axis_index(axis_name)
+    p = n_stages
+    M = microbatches.shape[0]
+    if M % p:
+        raise ValueError(
+            f"interleaved pp streams microbatches in groups of p: "
+            f"{M} microbatches not divisible by {p} stages")
+    local = jax.tree.map(lambda w: w[:, 0], chunk_params)   # [v, ...]
+
+    def apply_chunk(j, x):
+        cp = jax.tree.map(
+            lambda w: lax.dynamic_index_in_dim(w, j, 0, keepdims=False),
+            local)
+        return stage_fn(cp, x)
+
+    body = (jax.checkpoint(apply_chunk) if remat else apply_chunk)
+
+    def step(carry, t):
+        buf, outs = carry
+        u = jnp.clip(t - i, 0, v * M - 1)   # device stream position
+        g = u // (v * p)                    # microbatch group
+        w = u % (v * p)
+        j = w // p                          # chunk (virtual-stage row)
+        m = g * p + w % p                   # microbatch
+        # device 0 ingests microbatch m on chunk-0 slots; wraparound hops
+        # (device p-1 chunk j -> device 0 chunk j+1) ride the ring buffer
+        inp0 = lax.dynamic_index_in_dim(microbatches, m, 0, keepdims=False)
+        x = jnp.where((i == 0) & (j == 0), inp0, buf)
+        y = body(j, x)
+        nxt = lax.ppermute(y, axis_name,
+                           [(s, (s + 1) % p) for s in range(p)])
+        # device p-1, last chunk: microbatch m done
+        cur = lax.dynamic_index_in_dim(outs, m, 0, keepdims=False)
+        write = (i == p - 1) & (j == v - 1) & (t - i >= 0)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(write, y, cur), m, 0)
+        return (nxt, outs), None
+
+    buf0 = jnp.zeros(microbatches.shape[1:], microbatches.dtype)
+    outs0 = jnp.zeros_like(microbatches)
+    T = v * M + p - 1
+    (_, outs), _ = lax.scan(step, (buf0, outs0), jnp.arange(T))
+    dt = outs.dtype
+    outs = lax.psum(jnp.where(i == p - 1, outs, jnp.zeros_like(outs))
+                    .astype(jnp.float32), axis_name)
+    return outs.astype(dt)
+
+
+def interleaved(stage_fn: Callable, mesh: Mesh, v: int,
+                axis_name: str = "pp", remat: bool = True) -> Callable:
+    """Wrap circular_gpipe_apply in the partial-manual shard_map.
+
+    Returns fn(chunk_params, microbatches): chunk_params leading dims
+    [v, p, ...] with the p axis sharded over pp (build with
+    stack_virtual_chunks); microbatches [M, mb...] replicated over pp.
+    """
+    p = mesh.shape[axis_name]
+
+    def call(chunk_params, microbatches):
+        dt = microbatches.dtype  # f32 boundary: see pipelined()
+
+        def bodyfn(cp, mb):
+            out = circular_gpipe_apply(stage_fn, cp, mb.astype(dt),
+                                       n_stages=p, v=v,
+                                       axis_name=axis_name, remat=remat)
+            return out.astype(jnp.float32)
+
+        fn = shard_map(bodyfn, mesh=mesh,
+                       in_specs=(P(None, axis_name), P()), out_specs=P(),
+                       axis_names={axis_name}, check_vma=False)
+        return fn(chunk_params,
+                  microbatches.astype(jnp.float32)).astype(dt)
+
+    return call
+
+
+def stack_virtual_chunks(layer_params: Any, n_stages: int, v: int) -> Any:
+    """[L, ...] layer stack → [v, p, L/(v*p), ...] chunk layout: virtual
+    stage c = j*p + i (chunk j of device i) holds layers
+    [c*L/(v*p), (c+1)*L/(v*p)) — contiguous layer blocks in virtual-stage
+    order, laid out device-minor so P(None, 'pp') shards dimension 1."""
+    def reshape(w):
+        L = w.shape[0]
+        if L % (n_stages * v):
+            raise ValueError(
+                f"{L} layers not divisible by {v} chunks x {n_stages} stages")
+        per = L // (n_stages * v)
+        return w.reshape((v, n_stages, per) + w.shape[1:])
+    return jax.tree.map(reshape, layer_params)
+
+
+# ---------------------------------------------------------------------------
 # 1F1B — fused forward+backward schedule, compiled
 # ---------------------------------------------------------------------------
 

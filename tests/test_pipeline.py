@@ -242,3 +242,95 @@ class TestFleetPipelineFacade:
         s0 = pl.stage_layers(0)
         s1 = pl.stage_layers(1)
         assert len(s0) + len(s1) == 8
+
+
+class TestInterleavedVirtualPP:
+    """Circular virtual-pp schedule (reference: PipelineParallel's
+    interleaved mode — SURVEY.md §2.3 PP row, the round-1 gap's second
+    half after 1F1B)."""
+
+    def test_circular_matches_sequential(self, pp_mesh):
+        from paddle_tpu.parallel.pipeline import (
+            interleaved, stack_virtual_chunks)
+        rng = np.random.RandomState(0)
+        L, d = 8, 8
+        ws = jnp.asarray(rng.randn(L, d, d) * 0.3, jnp.float32)
+        mb = jnp.asarray(rng.randn(8, 2, d), jnp.float32)
+
+        def stage_fn(w, x):
+            def body(x, wl):
+                return jnp.tanh(x @ wl), None
+            x, _ = jax.lax.scan(body, x, w)
+            return x
+
+        chunks = stack_virtual_chunks(ws, 4, 2)
+        out = jax.jit(interleaved(stage_fn, pp_mesh, v=2,
+                                  remat=False))(chunks, mb)
+        ref = mb
+        for l in range(L):
+            ref = jnp.tanh(ref @ ws[l])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads_flow_through_circular_schedule(self, pp_mesh):
+        from paddle_tpu.parallel.pipeline import (
+            interleaved, stack_virtual_chunks)
+        rng = np.random.RandomState(1)
+        ws = jnp.asarray(rng.randn(8, 4, 4) * 0.3, jnp.float32)
+        mb = jnp.asarray(rng.randn(4, 2, 4), jnp.float32)
+
+        def stage_fn(w, x):
+            def body(x, wl):
+                return jnp.tanh(x @ wl), None
+            x, _ = jax.lax.scan(body, x, w)
+            return x
+
+        def loss_i(ws):
+            return jnp.sum(interleaved(stage_fn, pp_mesh, v=2, remat=False)(
+                stack_virtual_chunks(ws, 4, 2), mb) ** 2)
+
+        def loss_r(ws):
+            x = mb
+            for l in range(8):
+                x = jnp.tanh(x @ ws[l])
+            return jnp.sum(x ** 2)
+
+        gi = jax.jit(jax.grad(loss_i))(ws)
+        gr = jax.grad(loss_r)(ws)
+        np.testing.assert_allclose(np.asarray(gi), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_llama_interleaved_loss_parity(self, pp_mesh):
+        cfg = llama.LlamaConfig.tiny(remat=False, use_flash=False,
+                                     num_hidden_layers=8)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (8, 32)), jnp.int32)
+        ref = llama.loss_fn(params, toks, cfg, mesh=None)
+        got = jax.jit(lambda p, t: llama.loss_fn(
+            p, t, cfg, pp_mesh, pp_microbatches=4, pp_virtual=2))(
+            params, toks)
+        assert abs(float(ref) - float(got)) < 1e-3
+
+    def test_interleaved_train_step(self, pp_mesh):
+        cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=8)
+        tx = train.make_optimizer(1e-3)
+        state = train.init_state(jax.random.key(0), cfg, tx, mesh=pp_mesh)
+        step = train.make_train_step(cfg, tx, mesh=pp_mesh,
+                                     pp_schedule="interleaved",
+                                     virtual_pp_degree=2)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (8, 32)), jnp.int32)
+        state, m0 = step(state, toks)
+        for _ in range(3):
+            state, m = step(state, toks)
+        assert float(m["loss"]) < float(m0["loss"])
+
+    def test_microbatches_not_divisible_by_stages_raises(self, pp_mesh):
+        from paddle_tpu.parallel.pipeline import (
+            interleaved, stack_virtual_chunks)
+        ws = jnp.zeros((8, 4, 4), jnp.float32)
+        mb = jnp.zeros((6, 2, 4), jnp.float32)  # 6 % 4 != 0
+        with pytest.raises(ValueError, match="groups of p"):
+            jax.jit(interleaved(lambda w, x: x, pp_mesh, v=2))(
+                stack_virtual_chunks(ws, 4, 2), mb)
