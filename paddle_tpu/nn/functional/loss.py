@@ -511,3 +511,118 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         return _hsigmoid_raw(x, lab, w, b, num_classes)
 
     return eager(raw, args, {}, name="hsigmoid_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """F.triplet_margin_with_distance_loss parity (custom metric form of
+    triplet margin; default metric is euclidean)."""
+    from ... import ops
+    # default distance keeps an epsilon inside the sqrt: d sqrt(0) is
+    # infinite and identical anchor/positive rows would NaN the grads
+    # (same guard as triplet_margin_loss's |u - v| + eps)
+    dist = distance_function or (
+        lambda a, b: (((a - b) ** 2).sum(-1) + 1e-12).sqrt())
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = ops.minimum(dn, dist(positive, negative))
+    from .activation import relu
+    loss = relu(dp - dn + margin)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace-class margin softmax (reference F.margin_cross_entropy over
+    the margin_cross_entropy kernel): the label logit cos(theta) becomes
+    cos(margin1*theta + margin2) - margin3, everything scaled by `scale`.
+    Single-program form — the reference's model-parallel `group` argument
+    is subsumed by GSPMD sharding of the class dim (SURVEY.md §2.3 TP row),
+    so it is accepted and ignored."""
+    from ... import ops
+
+    def raw(lg, lab):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        # clip strictly inside [-1, 1]: arccos' derivative is infinite at
+        # the endpoints and a saturated label cosine would NaN the grads
+        cos_t = jnp.clip(jnp.take_along_axis(
+            lg, lab[:, None], axis=1)[:, 0], -1.0 + 1e-6, 1.0 - 1e-6)
+        theta = jnp.arccos(cos_t)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        modified = lg.at[jnp.arange(lg.shape[0]), lab].set(target) * scale
+        logp = jax.nn.log_softmax(modified, axis=-1)
+        nll = -jnp.take_along_axis(logp, lab[:, None], axis=1)[:, 0]
+        return nll, jnp.exp(logp)
+
+    from ...ops._registry import eager
+    loss, sm = eager(raw, (logits, label), {}, name="margin_cross_entropy")
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    return (loss, sm) if return_softmax else loss
+
+
+def ctc_greedy_decoder(input, blank=0, name=None):
+    """Greedy CTC decode (reference F.ctc_greedy_decoder): per-frame
+    argmax, collapse repeats, drop blanks. input: [B, T, C] probs/logits.
+    Returns (decoded [B, T] int64 padded with -1, lengths [B] int64)."""
+    from ...ops._registry import eager
+
+    def raw(x):
+        ids = jnp.argmax(x, axis=-1)                        # [B, T]
+        prev = jnp.concatenate(
+            [jnp.full_like(ids[:, :1], -1), ids[:, :-1]], axis=1)
+        keep = (ids != blank) & (ids != prev)               # collapse+drop
+        # stable-compact kept tokens to the left via sort over masked keys
+        B, T = ids.shape
+        pos = jnp.where(keep, jnp.arange(T)[None, :], T + jnp.arange(T))
+        order = jnp.argsort(pos, axis=1)
+        compacted = jnp.take_along_axis(
+            jnp.where(keep, ids, -1), order, axis=1)
+        lengths = jnp.sum(keep, axis=1).astype(jnp.int64)
+        return compacted.astype(jnp.int64), lengths
+
+    return eager(raw, (input,), {}, name="ctc_greedy_decoder")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Functional adaptive softmax (reference
+    F.adaptive_log_softmax_with_loss): head_weight [in, cut0+n_clusters];
+    tail_weights: per-cluster [down_proj [in, h], out_proj [h, size]]
+    pairs; cutoffs excludes n_classes. Returns (target log-probs [N],
+    mean NLL) like nn.AdaptiveLogSoftmaxWithLoss.forward."""
+    from ... import ops
+    cutlist = list(cutoffs)
+    n_clusters = len(tail_weights)
+    cut0 = cutlist[0]
+    label = ops.reshape(label, [-1]).astype("int64")
+    head_out = input.matmul(head_weight)
+    if head_bias is not None:
+        head_out = head_out + head_bias
+    from .activation import log_softmax
+    head_logp = log_softmax(head_out, axis=-1)
+    clipped = ops.clip(label, 0, cut0 - 1)
+    output = ops.take_along_axis(
+        head_logp, ops.reshape(clipped, [-1, 1]), 1).reshape([-1])
+    for i in range(n_clusters):
+        lo = cutlist[i]
+        size = int(tail_weights[i][1].shape[-1])
+        hi = lo + size
+        in_cluster = (label >= lo).logical_and(label < hi)
+        rel = ops.clip(label - lo, 0, size - 1)
+        proj = input.matmul(tail_weights[i][0]).matmul(tail_weights[i][1])
+        c_logp = log_softmax(proj, axis=-1)
+        val = head_logp[:, cut0 + i] + ops.take_along_axis(
+            c_logp, ops.reshape(rel, [-1, 1]), 1).reshape([-1])
+        output = ops.where(in_cluster, val, output)
+    return output, -output.mean()

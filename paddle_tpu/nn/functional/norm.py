@@ -193,3 +193,36 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
         return a / jnp.power(k + alpha * win / size, beta)
 
     return eager(raw, (x,), {}, name="local_response_norm")
+
+
+def spectral_norm(weight, axis=0, power_iters=1, epsilon=1e-12, u=None,
+                  name=None):
+    """Spectral normalization: weight / sigma_max, sigma estimated by
+    power iteration (reference F.spectral_norm over the spectral_norm
+    kernel). `u` optionally seeds the left singular vector estimate (the
+    SpectralNorm layer passes its persistent buffer); without it the
+    iteration starts from a fixed normalized vector — more power_iters
+    compensate."""
+    import jax
+    import jax.numpy as jnp
+    from ...ops._registry import eager
+
+    def raw(w, u0=None):
+        h = w.shape[axis]
+        mat = jnp.moveaxis(w, axis, 0).reshape(h, -1).astype(jnp.float32)
+        if u0 is None:
+            uv = jnp.ones((h,), jnp.float32) / jnp.sqrt(h * 1.0)
+        else:
+            uv = u0.reshape(h).astype(jnp.float32)
+        for _ in range(max(power_iters, 1)):
+            v = mat.T @ uv
+            v = v / (jnp.linalg.norm(v) + epsilon)
+            uv = mat @ v
+            uv = uv / (jnp.linalg.norm(uv) + epsilon)
+        sigma = uv @ mat @ v
+        return (w / jnp.maximum(sigma, epsilon)).astype(w.dtype), \
+            uv.astype(w.dtype)
+
+    args = (weight,) if u is None else (weight, u)
+    out, u_new = eager(raw, args, {}, name="spectral_norm")
+    return (out, u_new) if u is not None else out

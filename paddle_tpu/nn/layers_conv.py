@@ -392,12 +392,29 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """nn.SpectralNorm parity: normalizes an incoming weight by its
+    largest singular value, estimated by power iteration whose left
+    singular vector persists across forwards (a non-trainable buffer —
+    the reference keeps U/V as persistable vars)."""
+
     def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12,
-                 dtype="float32"):
+                 dtype="float32", name=None):
         super().__init__()
-        raise NotImplementedError(
-            "SpectralNorm: deferred (paddle_tpu/nn/layers_conv.py) — needs "
-            "power-iteration state; planned with the GAN model family")
+        self.axis, self.power_iters, self.eps = axis, power_iters, epsilon
+        h = weight_shape[axis]
+        import numpy as _np
+        from ..core.tensor import to_tensor
+        # a registered buffer, like the reference's persistable U var —
+        # state_dict round-trips the converged singular-vector estimate
+        self.register_buffer("weight_u", to_tensor(
+            (_np.ones(h, _np.float32) / _np.sqrt(h)).astype(dtype)))
+
+    def forward(self, weight):
+        out, u_new = F.spectral_norm(
+            weight, axis=self.axis, power_iters=self.power_iters,
+            epsilon=self.eps, u=self.weight_u)
+        self.weight_u.set_value(u_new)  # persistent power-iteration state
+        return out
 
 
 class MaxPool3D(Layer):

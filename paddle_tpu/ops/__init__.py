@@ -216,7 +216,7 @@ def register_surface(module, prefix: str = "") -> int:
     n = 0
     _machinery = ("paddle_tpu.ops._registry", "paddle_tpu.core.tensor")
     for name in dir(module):
-        if name.startswith("_") or name in _NON_OPS:
+        if name.startswith("_"):
             continue
         fn = getattr(module, name)
         if not callable(fn) or isinstance(fn, type):
@@ -228,9 +228,6 @@ def register_surface(module, prefix: str = "") -> int:
             n += 1
     return n
 
-
-# dispatch machinery that star-imports re-export — never ops
-_NON_OPS = {"eager", "defop", "op", "as_array", "to_tensor", "adopt_inplace"}
 
 
 register_surface(creation)

@@ -300,6 +300,7 @@ class TestInterleavedVirtualPP:
         np.testing.assert_allclose(np.asarray(gi), np.asarray(gr),
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_llama_interleaved_loss_parity(self, pp_mesh):
         cfg = llama.LlamaConfig.tiny(remat=False, use_flash=False,
                                      num_hidden_layers=8)
