@@ -90,5 +90,89 @@ class _Utils:
             p.set_value(vec[offset:offset + n].reshape(p.shape))
             offset += n
 
+    @staticmethod
+    def weight_norm(layer, name="weight", dim=0):
+        """nn.utils.weight_norm parity: reparameterize `name` as
+        magnitude (name_g) x direction (name_v / ||name_v||), recomputed
+        by a forward pre-hook every call so optimizers train g and v."""
+        from ..core.tensor import Parameter
+        w = getattr(layer, name)
+        if dim is None:
+            axes = None
+        else:
+            d = dim % w.ndim
+            axes = tuple(a for a in range(w.ndim) if a != d)
+
+        def norm_v(v):
+            if axes is not None:
+                return (v * v).sum(axis=axes, keepdim=True).sqrt()
+            return (v * v).sum().sqrt()
+
+        g = Parameter(norm_v(w)._data)
+        v = Parameter(w._data)
+        del layer._parameters[name]
+        layer.add_parameter(name + "_g", g)
+        layer.add_parameter(name + "_v", v)
+
+        def compute(lyr, *unused):
+            vv = getattr(lyr, name + "_v")
+            gg = getattr(lyr, name + "_g")
+            setattr(lyr, name, vv * (gg / norm_v(vv)))
+
+        compute(layer)
+        handle = layer.register_forward_pre_hook(
+            lambda lyr, inputs: compute(lyr))
+        layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+        layer._weight_norm_hooks[name] = (handle, compute)
+        return layer
+
+    @staticmethod
+    def remove_weight_norm(layer, name="weight"):
+        """Fold name_g/name_v back into a plain `name` parameter."""
+        from ..core.tensor import Parameter
+        handle, compute = layer._weight_norm_hooks.pop(name)
+        handle.remove()
+        # recompute from the LIVE g/v — the cached attr predates any
+        # optimizer steps taken since the last forward
+        compute(layer)
+        w = getattr(layer, name)
+        # drop the cached instance attr: it would shadow the re-added
+        # Parameter in __dict__ and freeze forward at today's value
+        layer.__dict__.pop(name, None)
+        del layer._parameters[name + "_g"]
+        del layer._parameters[name + "_v"]
+        layer.add_parameter(name, Parameter(w._data))
+        return layer
+
+    @staticmethod
+    def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                      dim=0):
+        """nn.utils.spectral_norm parity: divide `name` by its largest
+        singular value each forward (power iteration with a persistent u
+        buffer on the layer)."""
+        from . import functional as F
+        from ..core.tensor import to_tensor
+        import numpy as np
+        w = getattr(layer, name)
+        h = w.shape[dim % w.ndim]
+        layer.register_buffer(
+            name + "_u",
+            to_tensor((np.ones(h, np.float32) / np.sqrt(h))
+                      .astype(str(np.dtype(w._data.dtype)))))
+        orig = layer._parameters.pop(name)
+        layer.add_parameter(name + "_orig", orig)
+
+        def compute(lyr, *unused):
+            wn, u_new = F.spectral_norm(
+                getattr(lyr, name + "_orig"), axis=dim,
+                power_iters=n_power_iterations, epsilon=eps,
+                u=getattr(lyr, name + "_u"))
+            getattr(lyr, name + "_u").set_value(u_new)
+            setattr(lyr, name, wn)
+
+        compute(layer)
+        layer.register_forward_pre_hook(lambda lyr, inputs: compute(lyr))
+        return layer
+
 
 utils = _Utils()

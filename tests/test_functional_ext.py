@@ -92,3 +92,29 @@ class TestTripletWithDistance:
         # shrinking dn and thus never DECREASING the hinge loss
         ls = F.triplet_margin_with_distance_loss(a, p, n_, swap=True)
         assert float(ls.numpy()) >= float(l2.numpy()) - 1e-6
+
+
+class TestWeightNormUtils:
+    def test_weight_norm_roundtrip_and_grads(self):
+        lin = nn.Linear(4, 6)
+        nn.utils.weight_norm(lin, "weight")
+        named = dict(lin.named_parameters())
+        assert "weight_g" in named and "weight_v" in named
+        x = paddle.to_tensor(RNG.randn(2, 4).astype(np.float32))
+        y1 = lin(x)
+        (y1 ** 2).sum().backward()
+        assert lin.weight_g.grad is not None
+        assert lin.weight_v.grad is not None
+        nn.utils.remove_weight_norm(lin, "weight")
+        assert "weight" in dict(lin.named_parameters())
+        np.testing.assert_allclose(y1.numpy(), lin(x).numpy(), rtol=1e-5)
+
+    def test_spectral_norm_util_constrains_sigma(self):
+        lin = nn.Linear(4, 6)
+        nn.utils.spectral_norm(lin, "weight", n_power_iterations=3)
+        x = paddle.to_tensor(RNG.randn(2, 4).astype(np.float32))
+        for _ in range(4):
+            lin(x)
+        s = np.linalg.svd(lin.weight.numpy(), compute_uv=False)
+        assert abs(s[0] - 1.0) < 0.05
+        assert "weight_u" in lin.state_dict()  # persistent buffer
