@@ -148,3 +148,112 @@ def is_compiled_with_tpu() -> bool:
 def device_count() -> int:
     plat = _accelerator_platform()
     return len(_platforms()[plat]) if plat else len(jax.devices())
+
+
+# ---------------------------------------------------------------------------
+# Memory introspection (reference: paddle.device.cuda.memory_allocated /
+# max_memory_allocated / memory_reserved and friends — SURVEY.md §5
+# metrics row: 'memory via jax.local_devices()[0].memory_stats()').
+# On TPU the PJRT allocator owns HBM; these read its live statistics.
+# Backends that expose no memory_stats (CPU; remote-tunneled devices)
+# degrade to 0 rather than raising — recipes keep running.
+# ---------------------------------------------------------------------------
+
+def _memory_stats(device_id: int = 0) -> dict:
+    devs = jax.local_devices()
+    if not 0 <= device_id < len(devs):
+        return {}
+    return devs[device_id].memory_stats() or {}
+
+
+def _dev_idx(device) -> int:
+    """Resolve a device argument to a local_devices() position. None means
+    the CURRENT device (set_device), not device 0."""
+    if device is None:
+        place = _default_place()
+        device = place.index if place.index is not None else 0
+    if isinstance(device, Place):
+        device = device.index or 0
+    if not isinstance(device, int):
+        sdev = str(device)
+        device = int(sdev.rsplit(":", 1)[-1]) if ":" in sdev else 0
+    # Place.index / ids are global device ids; map to a local position
+    for pos, d in enumerate(jax.local_devices()):
+        if d.id == device:
+            return pos
+    return device
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently held by live buffers on the device."""
+    return int(_memory_stats(_dev_idx(device)).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """High-water mark of live buffer bytes."""
+    s = _memory_stats(_dev_idx(device))
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes the allocator arena holds. PJRT reports bytes_reserved when
+    it runs a pool; otherwise bytes_limit (the whole managed HBM arena)
+    is the closest analog; bytes_in_use is the last resort."""
+    s = _memory_stats(_dev_idx(device))
+    return int(s.get("bytes_reserved",
+                     s.get("bytes_limit", s.get("bytes_in_use", 0))))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = _memory_stats(_dev_idx(device))
+    return int(s.get("peak_bytes_reserved",
+                     s.get("bytes_limit",
+                           s.get("peak_bytes_in_use",
+                                 s.get("bytes_in_use", 0)))))
+
+
+def empty_cache() -> None:
+    """XLA/PJRT owns the allocator: there is no user-facing cache to
+    drop; provided for recipe parity (reference empties the CUDA caching
+    allocator)."""
+
+
+def synchronize(device=None) -> None:
+    """Block until queued work on THE GIVEN device finishes (reference
+    cuda.synchronize): an empty computation placed there as a barrier."""
+    import jax.numpy as jnp
+    devs = jax.local_devices()
+    idx = _dev_idx(device)
+    target = devs[idx] if 0 <= idx < len(devs) else devs[0]
+    jax.device_put(jnp.zeros(()), target).block_until_ready()
+
+
+def get_device_properties(device=None):
+    import types
+    devs = jax.local_devices()
+    idx = _dev_idx(device)
+    if not 0 <= idx < len(devs):  # degrade like the memory_* getters
+        return types.SimpleNamespace(name="unknown", total_memory=0,
+                                     multi_processor_count=0,
+                                     major=0, minor=0)
+    d = devs[idx]
+    stats = _memory_stats(idx)
+    return types.SimpleNamespace(
+        name=getattr(d, "device_kind", str(d)),
+        total_memory=int(stats.get("bytes_limit", 0)),
+        multi_processor_count=getattr(d, "core_count", 1),
+        major=0, minor=0)
+
+
+import types as _t
+# paddle.device.cuda namespace: recipes call cuda.* regardless of backend
+cuda = _t.SimpleNamespace(
+    memory_allocated=memory_allocated,
+    max_memory_allocated=max_memory_allocated,
+    memory_reserved=memory_reserved,
+    max_memory_reserved=max_memory_reserved,
+    empty_cache=empty_cache,
+    synchronize=synchronize,
+    device_count=device_count,
+    get_device_properties=get_device_properties,
+)

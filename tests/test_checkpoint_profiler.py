@@ -100,3 +100,23 @@ class TestProfiler:
     def test_timer_only_mode(self):
         with prof.Profiler(timer_only=True) as p:
             p.step()
+
+
+class TestDeviceMemoryStats:
+    """paddle.device.cuda.* memory introspection (SURVEY.md §5 metrics
+    row — reference: paddle.device.cuda.memory_allocated family)."""
+
+    def test_api_surface_and_types(self):
+        import paddle_tpu as paddle
+        d = paddle.device
+        for fn in (d.memory_allocated, d.max_memory_allocated,
+                   d.memory_reserved, d.max_memory_reserved):
+            v = fn()
+            assert isinstance(v, int) and v >= 0
+        d.empty_cache()
+        d.synchronize()
+        props = d.get_device_properties()
+        assert props.name
+        # cuda namespace aliases (recipes call cuda.* regardless of backend)
+        assert d.cuda.memory_allocated() == d.memory_allocated()
+        assert d.cuda.device_count() >= 1
