@@ -30,6 +30,15 @@ import optax
 
 BLOCK = 256
 
+# blocks per lax.map chunk: 65536 * 256 = 16M params; each chunk holds
+# ~4 f32 transients of that size before XLA fusion (g, dequant m, dequant
+# v, upd) ≈ 256MB peak — the dequant/update/requant stream never
+# materializes a full-leaf f32 moment (a 2B model's stacked [L, F, D]
+# leaf would be ~2GB and blow the single-chip HBM budget), while chunks
+# stay large enough that the serial lax.map adds negligible launches
+# (the old 2M-param chunks cost ~195 launches on the big leaf)
+CHUNK_BLOCKS = 65536
+
 
 class _QTensor(NamedTuple):
     """Blockwise-quantized tensor: float8_e4m3 codes [nb, BLOCK] + f32
@@ -103,13 +112,8 @@ def scale_by_adam_q(b1: float = 0.9, b2: float = 0.999,
                                  jax.tree.map(zero_q, params),
                                  jax.tree.map(zero_q, params))
 
-    # blocks per lax.map chunk: 8192 * 256 = 2M params * 4B ≈ 8MB of f32
-    # transients per chunk — the dequant/update/requant stream never
-    # materializes a full-leaf f32 moment (which for a 2B model's stacked
-    # [L, F, D] leaf would be ~2GB and blow the single-chip HBM budget)
-    chunk_blocks = 8192
-
     def update(grads, state, params=None):
+        chunk_blocks = CHUNK_BLOCKS
         count = state.count + 1
         bc1 = 1.0 - b1 ** count.astype(jnp.float32)
         bc2 = 1.0 - b2 ** count.astype(jnp.float32)

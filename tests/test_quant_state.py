@@ -45,12 +45,13 @@ class TestQuantization:
 
 
 class TestAdamQ:
-    def test_update_matches_optax_adam(self):
+    def test_update_matches_optax_adam(self, monkeypatch):
         """Per-step update direction within a few percent RMS of f32
         scale_by_adam, through the chunked (lax.map) path."""
-        from paddle_tpu.optimizer.quant_state import scale_by_adam_q
+        from paddle_tpu.optimizer import quant_state
+        monkeypatch.setattr(quant_state, "CHUNK_BLOCKS", 1024)
         rng = np.random.RandomState(0)
-        n = 8192 * BLOCK + 77  # > one chunk: exercises padding + lax.map
+        n = 1024 * BLOCK * 8 + 77  # > one chunk: exercises padding + lax.map
         p = {"w": jnp.asarray(rng.randn(n), jnp.float32)}
         tx, ref = scale_by_adam_q(), optax.scale_by_adam(0.9, 0.999, 1e-8)
         st, rst = tx.init(p), ref.init(p)
