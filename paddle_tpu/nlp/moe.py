@@ -335,6 +335,22 @@ def moe_block(x: jax.Array, lp: Dict[str, jax.Array], cfg: MoeConfig,
     return y, aux
 
 
+def _decoder_body(carry, lp, cfg: MoeConfig, lcfg, cos, sin, mesh,
+                  constrain=None):
+    """One MoE decoder layer on the (x, lb, zl) carry — the SINGLE source
+    for both the plain scan (forward) and the pipeline stage (forward_pp);
+    `constrain` optionally re-annotates activation sharding."""
+    h, lb, zl = carry
+    a = rms_norm_ref(h, lp["input_layernorm"], cfg.rms_norm_eps)
+    h = h + _llama._attention(a, lp, lcfg, cos, sin, mesh)
+    a = rms_norm_ref(h, lp["post_attention_layernorm"], cfg.rms_norm_eps)
+    y, aux = moe_block(a, lp, cfg, mesh)
+    h = h + y
+    if constrain is not None:
+        h = constrain(h)
+    return (h, lb + aux["load_balance_loss"], zl + aux["router_z_loss"])
+
+
 def forward(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig,
             mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """tokens [B,S] → (logits [B,S,V] f32, aux losses)."""
@@ -354,14 +370,8 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig,
     x = maybe_constrain(x)
 
     def body(carry, lp):
-        h, lb, zl = carry
-        a = rms_norm_ref(h, lp["input_layernorm"], cfg.rms_norm_eps)
-        h = h + _llama._attention(a, lp, lcfg, cos, sin, mesh)
-        a = rms_norm_ref(h, lp["post_attention_layernorm"], cfg.rms_norm_eps)
-        y, aux = moe_block(a, lp, cfg, mesh)
-        h = maybe_constrain(h + y)
-        return (h, lb + aux["load_balance_loss"],
-                zl + aux["router_z_loss"]), None
+        return _decoder_body(carry, lp, cfg, lcfg, cos, sin, mesh,
+                             constrain=maybe_constrain), None
 
     if cfg.remat:
         body = jax.checkpoint(
@@ -375,10 +385,66 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig,
     return logits, {"load_balance_loss": lb / L, "router_z_loss": zl / L}
 
 
-def loss_fn(params, tokens, cfg: MoeConfig, mesh=None):
+def forward_pp(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig,
+               mesh, num_microbatches: int) -> Tuple[jax.Array,
+                                                     Dict[str, jax.Array]]:
+    """Pipeline-parallel MoE forward: decoder stages run the compiled GPipe
+    schedule over the mesh's `pp` axis, composing with ep/sharding/mp
+    (reference: DeepSeek-class recipes run pp x ep). The router aux losses
+    ride the pipe as extra pytree-buffer channels — each stage adds its
+    layers' load-balance and z losses to the per-microbatch accumulators
+    (parallel.pipeline.gpipe_apply carries arbitrary pytrees)."""
+    from ..parallel.pipeline import pipelined, stack_stages
+
+    n = mesh.shape["pp"]
+    B, S = tokens.shape
+    M = num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    L = cfg.num_hidden_layers
+    lcfg = _llama_cfg(cfg)
+    cd = cfg.dtype
+    cos, sin = rope_freqs(cfg.head_dim, S, cfg.rope_theta, jnp.float32)
+    stage_params = stack_stages(params["layers"], n)
+
+    def stage_fn(local_layers, buf):
+        def body(carry, lp):
+            return _decoder_body(carry, lp, cfg, lcfg, cos, sin, mesh), None
+        (x, lb, zl), _ = jax.lax.scan(
+            body, (buf["x"], buf["lb"], buf["zl"]), local_layers)
+        return {"x": x, "lb": lb, "zl": zl}
+
+    x = jnp.take(params["embed_tokens"], tokens, axis=0).astype(cd)
+    mb = {
+        "x": x.reshape((M, B // M) + x.shape[1:]),
+        "lb": jnp.zeros((M,), jnp.float32),
+        "zl": jnp.zeros((M,), jnp.float32),
+    }
+    outs = pipelined(stage_fn, mesh, remat=cfg.remat)(stage_params, mb)
+    x = outs["x"].reshape(B, S, -1)
+    x = rms_norm_ref(x, params["norm"], cfg.rms_norm_eps)
+    logits = (x.astype(cd) @ params["lm_head"].astype(cd)).astype(jnp.float32)
+    aux = {"load_balance_loss": jnp.mean(outs["lb"]) / L,
+           "router_z_loss": jnp.mean(outs["zl"]) / L}
+    return logits, aux
+
+
+def loss_fn(params, tokens, cfg: MoeConfig, mesh=None,
+            pp_microbatches=None, pp_virtual: int = 1):
     """Next-token CE + router aux losses (full-shape roll+mask, same
-    rationale as llama.loss_fn)."""
-    logits, aux = forward(params, tokens, cfg, mesh)
+    rationale as llama.loss_fn). pp_microbatches: with a mesh whose pp
+    axis > 1, run the decoder through the compiled GPipe schedule.
+    pp_virtual > 1 (the interleaved schedule) is not implemented for MoE
+    — the aux-loss pipe channels need the chunked circular layout too."""
+    if pp_virtual > 1:
+        raise NotImplementedError(
+            "interleaved virtual-pp for the MoE family is not implemented "
+            "(paddle_tpu/nlp/moe.py) — use pp_schedule='gpipe'")
+    if (pp_microbatches and mesh is not None
+            and "pp" in mesh.axis_names and mesh.shape["pp"] > 1):
+        logits, aux = forward_pp(params, tokens, cfg, mesh, pp_microbatches)
+    else:
+        logits, aux = forward(params, tokens, cfg, mesh)
     targets = jnp.roll(tokens, -1, axis=1)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]

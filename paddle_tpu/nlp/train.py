@@ -127,8 +127,8 @@ def make_train_step(cfg, tx, mesh: Optional[Mesh] = None,
     """Build the jitted train step. With a mesh: full GSPMD shardings on
     state and batch; without: plain jit (single device). A mesh with pp > 1
     runs the decoder through a compiled pipeline schedule —
-    `num_microbatches` (default 2·pp) microbatches per step (models without
-    a forward_pp, e.g. moe, ignore it). pp_schedule picks the compiled
+    `num_microbatches` (default 2·pp) microbatches per step (llama AND moe
+    both pipeline via their forward_pp). pp_schedule picks the compiled
     schedule (reference: PipelineParallel's 1F1B / interleaved modes,
     SURVEY.md §3.3): "1f1b" (default) runs the fused one_f_one_b
     forward+backward with O(pp) activation residency; "gpipe" runs

@@ -35,19 +35,23 @@ def _select_tree(pred, a, b):
     return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
 
 
-def gpipe_apply(stage_fn: Callable, stage_params: Any, microbatches: jax.Array,
+def gpipe_apply(stage_fn: Callable, stage_params: Any, microbatches: Any,
                 n_stages: int, axis_name: str = "pp",
-                remat: bool = True) -> jax.Array:
+                remat: bool = True) -> Any:
     """Run the pipeline INSIDE a shard_map manual over `axis_name`.
 
-    stage_fn(local_params, x) -> y, with y.shape == x.shape (a transformer
-    stage). stage_params: this device's slice, leading dim 1 (from the
-    [n_stages, ...] stack). microbatches: [M, mb...] identical on every pp
-    rank. Returns [M, mb...] outputs of the LAST stage, replicated over pp.
+    stage_fn(local_params, x) -> y, with y the same pytree-of-arrays
+    structure and shapes as x (a transformer stage; pytree buffers let a
+    stage carry side accumulators — e.g. MoE router aux losses — through
+    the pipe alongside the activation). stage_params: this device's slice,
+    leading dim 1 (from the [n_stages, ...] stack). microbatches: pytree
+    of [M, mb...] identical on every pp rank. Returns [M, mb...] outputs
+    of the LAST stage, replicated over pp.
     """
     i = lax.axis_index(axis_name)
     n = n_stages
-    M = microbatches.shape[0]
+    leaves = jax.tree.leaves(microbatches)
+    M = leaves[0].shape[0]
     local = jax.tree.map(lambda p: p[0], stage_params)
     body = (jax.checkpoint(lambda x: stage_fn(local, x)) if remat
             else (lambda x: stage_fn(local, x)))
@@ -55,31 +59,40 @@ def gpipe_apply(stage_fn: Callable, stage_params: Any, microbatches: jax.Array,
     def step(carry, t):
         buf, outs = carry
         # stage 0 ingests microbatch t (clipped past the end; masked anyway)
-        inp0 = lax.dynamic_index_in_dim(
-            microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-        x = jnp.where(i == 0, inp0, buf)
+        tc = jnp.clip(t, 0, M - 1)
+        inp0 = jax.tree.map(
+            lambda mb: lax.dynamic_index_in_dim(mb, tc, 0, keepdims=False),
+            microbatches)
+        x = _select_tree(i == 0, inp0, buf)
         y = body(x)
         # one hop down the pipeline (last stage's hop is dropped by the mask
         # next step; ring wrap keeps the perm legal)
-        nxt = lax.ppermute(y, axis_name, [(s, (s + 1) % n) for s in range(n)])
+        perm = [(s, (s + 1) % n) for s in range(n)]
+        nxt = jax.tree.map(lambda a: lax.ppermute(a, axis_name, perm), y)
         # the last stage finished microbatch t-(n-1) this step
         m_idx = t - (n - 1)
         safe = jnp.clip(m_idx, 0, M - 1)
-        cur = lax.dynamic_index_in_dim(outs, safe, 0, keepdims=False)
-        outs = lax.dynamic_update_index_in_dim(
-            outs, jnp.where(m_idx >= 0, y, cur), safe, 0)
+
+        def write(o, yy):
+            cur = lax.dynamic_index_in_dim(o, safe, 0, keepdims=False)
+            return lax.dynamic_update_index_in_dim(
+                o, jnp.where(m_idx >= 0, yy, cur), safe, 0)
+
+        outs = jax.tree.map(write, outs, y)
         return (nxt, outs), None
 
-    buf0 = jnp.zeros(microbatches.shape[1:], microbatches.dtype)
-    outs0 = jnp.zeros_like(microbatches)
+    buf0 = jax.tree.map(lambda mb: jnp.zeros(mb.shape[1:], mb.dtype),
+                        microbatches)
+    outs0 = jax.tree.map(jnp.zeros_like, microbatches)
     (_, outs), _ = lax.scan(step, (buf0, outs0), jnp.arange(M + n - 1))
     # every rank wrote its own stage outputs; keep only the last stage's.
     # psum in f32: a bf16 all-reduce aborts XLA-CPU's AllReducePromotion
     # pass ("Invalid binary instruction opcode copy" CHECK) as of jax 0.9.
-    dt = outs.dtype
-    outs = lax.psum(jnp.where(i == n - 1, outs, jnp.zeros_like(outs))
-                    .astype(jnp.float32), axis_name)
-    return outs.astype(dt)
+    def collect(o):
+        return lax.psum(jnp.where(i == n - 1, o, jnp.zeros_like(o))
+                        .astype(jnp.float32), axis_name).astype(o.dtype)
+
+    return jax.tree.map(collect, outs)
 
 
 def pipelined(stage_fn: Callable, mesh: Mesh, n_stages: Optional[int] = None,
@@ -104,17 +117,19 @@ def pipelined(stage_fn: Callable, mesh: Mesh, n_stages: Optional[int] = None,
         # input is a psum of its cotangent, and a bf16 all-reduce aborts
         # XLA-CPU's AllReducePromotion pass (jax 0.9). Inside the pipeline the
         # original dtype is restored, so stage compute / ppermute stay bf16.
-        dt = microbatches.dtype
+        dts = jax.tree.map(lambda mb: mb.dtype, microbatches)
 
         def body(sp, mb):
-            out = gpipe_apply(stage_fn, sp, mb.astype(dt), n_stages=n,
+            mb = jax.tree.map(lambda a, d: a.astype(d), mb, dts)
+            out = gpipe_apply(stage_fn, sp, mb, n_stages=n,
                               axis_name=axis_name, remat=remat)
-            return out.astype(jnp.float32)
+            return jax.tree.map(lambda a: a.astype(jnp.float32), out)
 
         fn = shard_map(body, mesh=mesh, in_specs=(param_specs, P()),
                        out_specs=P(), axis_names={axis_name}, check_vma=False)
-        return fn(stage_params,
-                  microbatches.astype(jnp.float32)).astype(dt)
+        out = fn(stage_params,
+                 jax.tree.map(lambda a: a.astype(jnp.float32), microbatches))
+        return jax.tree.map(lambda a, d: a.astype(d), out, dts)
 
     return call
 
