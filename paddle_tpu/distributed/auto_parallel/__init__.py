@@ -11,3 +11,5 @@ from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
 from .api import (shard_tensor, dtensor_from_fn, reshard,  # noqa: F401
                   unshard_dtensor, shard_layer, shard_optimizer,
                   shard_dataloader, get_placements, get_placement_mesh)
+
+from .engine import Engine, Strategy  # noqa: F401

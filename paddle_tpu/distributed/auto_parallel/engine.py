@@ -1,0 +1,182 @@
+"""auto_parallel Engine — the semi-auto training entry point.
+
+Reference analog: python/paddle/distributed/auto_parallel/engine (the
+`auto.Engine(model, loss, optimizer, strategy)` + engine.fit/evaluate/
+predict path of SURVEY.md §3.4 — there it drives dy2static tracing,
+completion, partitioner, reshard and the per-rank InterpreterCore).
+
+TPU-native design: that whole static pipeline IS GSPMD (SURVEY.md §3.4
+'this is the subsystem our framework replaces'), so the Engine here is a
+thin trainer loop: the model's tensors carry their placements (from
+shard_tensor / shard_layer), XLA propagates shardings and inserts
+collectives, and fit/evaluate/predict just drive batches through the
+eager layer — every step compiled by the surrounding jit machinery where
+the user opts in (paddle.jit.to_static on the layer works unchanged).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+
+@dataclasses.dataclass
+class _AmpConfig:
+    enable: bool = False
+    level: str = "O1"
+    dtype: str = "bfloat16"
+
+
+@dataclasses.dataclass
+class _ShardingConfig:
+    enable: bool = False
+    stage: int = 1
+    degree: int = 1
+
+
+@dataclasses.dataclass
+class _RecomputeConfig:
+    enable: bool = False
+
+
+@dataclasses.dataclass
+class Strategy:
+    """auto_parallel.Strategy parity: a config tree whose knobs map onto
+    the mechanisms this framework already has (amp -> paddle.amp,
+    sharding -> mesh 'sharding' axis specs, recompute -> jax.checkpoint
+    in the model); unknown sub-configs are carried verbatim."""
+    amp: _AmpConfig = dataclasses.field(default_factory=_AmpConfig)
+    sharding: _ShardingConfig = dataclasses.field(
+        default_factory=_ShardingConfig)
+    recompute: _RecomputeConfig = dataclasses.field(
+        default_factory=_RecomputeConfig)
+
+
+class Engine:
+    """auto.Engine(model, loss, optimizer, strategy) -> fit/evaluate/
+    predict/save/load. Data: a paddle_tpu.io.Dataset/DataLoader or any
+    iterable of (input, label) pairs."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy: Optional[Strategy] = None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics is not None else [])
+        self.strategy = strategy or Strategy()
+        self.history: dict = {}
+
+    # -- data plumbing ------------------------------------------------------
+    def _loader(self, data, batch_size):
+        from ...io import DataLoader, Dataset
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=False)
+        return data  # any iterable of batches
+
+    @staticmethod
+    def _split(batch):
+        if isinstance(batch, (list, tuple)) and len(batch) == 2:
+            return batch[0], batch[1]
+        return batch, None
+
+    def _amp_ctx(self):
+        import paddle_tpu as paddle
+        if self.strategy.amp.enable:
+            return paddle.amp.auto_cast(level=self.strategy.amp.level,
+                                        dtype=self.strategy.amp.dtype)
+        import contextlib
+        return contextlib.nullcontext()
+
+    # -- the three drives ---------------------------------------------------
+    def fit(self, train_data=None, epochs: int = 1, batch_size: int = 1,
+            steps_per_epoch: Optional[int] = None, log_freq: int = 10,
+            verbose: int = 1, valid_data=None, **kwargs):
+        loader = self._loader(train_data, batch_size)
+        self.history = {"loss": []}
+        for epoch in range(epochs):
+            for step, batch in enumerate(loader):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                x, y = self._split(batch)
+                with self._amp_ctx():
+                    out = self.model(x)
+                    loss = self.loss(out, y) if y is not None else \
+                        self.loss(out)
+                loss.backward()
+                self.optimizer.step()
+                self.optimizer.clear_grad()
+                self.history["loss"].append(float(loss.numpy()))
+                if verbose and step % max(log_freq, 1) == 0:
+                    print(f"[auto.Engine] epoch {epoch} step {step}: "
+                          f"loss {float(loss.numpy()):.4f}")
+            if valid_data is not None:
+                self.evaluate(valid_data, batch_size=batch_size,
+                              verbose=verbose)
+        return self.history
+
+    def evaluate(self, valid_data=None, batch_size: int = 1, verbose: int = 1,
+                 **kwargs):
+        import numpy as np
+        loader = self._loader(valid_data, batch_size)
+        losses = []
+        for m in self.metrics:
+            m.reset()
+        import paddle_tpu as paddle
+        with paddle.no_grad():
+            for batch in loader:
+                x, y = self._split(batch)
+                out = self.model(x)
+                if self.loss is not None and y is not None:
+                    losses.append(float(self.loss(out, y).numpy()))
+                for m in self.metrics:
+                    # reference semantics: compute's outputs unpack into
+                    # update (base Metric.compute returns the args tuple)
+                    computed = m.compute(out, y)
+                    if isinstance(computed, (list, tuple)):
+                        m.update(*computed)
+                    else:
+                        m.update(computed)
+        result = {"loss": float(np.mean(losses)) if losses else None}
+        for m in self.metrics:
+            names = m.name() if callable(getattr(m, "name", None)) \
+                else type(m).__name__
+            acc = m.accumulate()
+            if isinstance(names, (list, tuple)):  # e.g. Accuracy(topk=(1,5))
+                for nm, a in zip(names, acc if isinstance(
+                        acc, (list, tuple)) else [acc] * len(names)):
+                    result[nm] = a
+            else:
+                result[names] = acc
+        if verbose:
+            print(f"[auto.Engine] eval: {result}")
+        return result
+
+    def predict(self, test_data=None, batch_size: int = 1, **kwargs):
+        import paddle_tpu as paddle
+        loader = self._loader(test_data, batch_size)
+        outs = []
+        with paddle.no_grad():
+            for batch in loader:
+                x, _ = self._split(batch)
+                outs.append(self.model(x))
+        return outs
+
+    # -- checkpoint ---------------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        import paddle_tpu as paddle
+        paddle.save(self.model.state_dict(), path + ".pdparams")
+        if training and self.optimizer is not None and \
+                hasattr(self.optimizer, "state_dict"):
+            paddle.save(self.optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str):
+        import paddle_tpu as paddle
+        self.model.set_state_dict(paddle.load(path + ".pdparams"))
+        import os
+        if self.optimizer is not None and os.path.exists(path + ".pdopt") \
+                and hasattr(self.optimizer, "set_state_dict"):
+            self.optimizer.set_state_dict(paddle.load(path + ".pdopt"))

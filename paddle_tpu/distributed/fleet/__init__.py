@@ -182,3 +182,6 @@ class meta_parallel:
     VocabParallelEmbedding = VocabParallelEmbedding
     ParallelCrossEntropy = ParallelCrossEntropy
     get_rng_state_tracker = staticmethod(get_rng_state_tracker)
+
+# reference import path: `from paddle.distributed.fleet import auto`
+from .. import auto_parallel as auto  # noqa: F401,E402
