@@ -51,6 +51,7 @@ from . import amp  # noqa: F401
 from .nn.layer import ParamAttr  # noqa: F401
 
 from . import distributed  # noqa: F401
+from .parallel.env import DataParallel  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from . import io  # noqa: F401

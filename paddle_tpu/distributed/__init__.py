@@ -18,7 +18,9 @@ from .collective import (  # noqa: F401
     ReduceOp, all_reduce, all_gather, all_gather_object, reduce_scatter,
     alltoall, alltoall_single, broadcast, reduce, scatter, send, recv,
     isend, irecv, barrier, new_group, get_group, destroy_process_group,
-    wait, stream_synchronize)
+    wait, stream_synchronize, gather, get_backend, P2POp,
+    batch_isend_irecv, stream)
+from . import launch  # noqa: F401
 from ..parallel.topology import (  # noqa: F401
     build_mesh, get_mesh, set_mesh, HybridCommunicateGroup,
     get_hybrid_communicate_group, CommGroup)
@@ -92,3 +94,15 @@ def spawn(func, args=(), nprocs=-1, **options):
     per-device processes — run func once; device parallelism comes from
     sharding (SURVEY.md §3.2 'TPU translation')."""
     return func(*args)
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """dist.parallelize parity (the 2.6 intermediate auto-parallel API):
+    apply the mesh placements to the layer tree (shard_layer) and return
+    (model, optimizer) — the reference rewrites the program per dp/mp/pp
+    sub-configs; under GSPMD the placements carried by the params are the
+    whole strategy (SURVEY.md §3.4)."""
+    if mesh is not None:
+        from .auto_parallel import shard_layer
+        model = shard_layer(model, mesh)
+    return model, optimizer  # two-value contract even when optimizer=None

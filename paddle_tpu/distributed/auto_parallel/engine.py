@@ -67,14 +67,14 @@ class Engine:
         self.history: dict = {}
 
     # -- data plumbing ------------------------------------------------------
-    def _loader(self, data, batch_size):
+    def _loader(self, data, batch_size, shuffle=False, what="data"):
         from ...io import DataLoader, Dataset
         if data is None:
-            return None
+            raise ValueError(f"auto.Engine: {what} is required")
         if isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
-            return DataLoader(data, batch_size=batch_size, shuffle=False)
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
         return data  # any iterable of batches
 
     @staticmethod
@@ -94,8 +94,10 @@ class Engine:
     # -- the three drives ---------------------------------------------------
     def fit(self, train_data=None, epochs: int = 1, batch_size: int = 1,
             steps_per_epoch: Optional[int] = None, log_freq: int = 10,
-            verbose: int = 1, valid_data=None, **kwargs):
-        loader = self._loader(train_data, batch_size)
+            verbose: int = 1, valid_data=None, shuffle: bool = True,
+            **kwargs):
+        loader = self._loader(train_data, batch_size, shuffle=shuffle,
+                              what="train_data")
         self.history = {"loss": []}
         for epoch in range(epochs):
             for step, batch in enumerate(loader):
@@ -109,10 +111,11 @@ class Engine:
                 loss.backward()
                 self.optimizer.step()
                 self.optimizer.clear_grad()
-                self.history["loss"].append(float(loss.numpy()))
+                lv = float(loss.numpy())  # one host sync per step
+                self.history["loss"].append(lv)
                 if verbose and step % max(log_freq, 1) == 0:
                     print(f"[auto.Engine] epoch {epoch} step {step}: "
-                          f"loss {float(loss.numpy()):.4f}")
+                          f"loss {lv:.4f}")
             if valid_data is not None:
                 self.evaluate(valid_data, batch_size=batch_size,
                               verbose=verbose)
@@ -121,7 +124,7 @@ class Engine:
     def evaluate(self, valid_data=None, batch_size: int = 1, verbose: int = 1,
                  **kwargs):
         import numpy as np
-        loader = self._loader(valid_data, batch_size)
+        loader = self._loader(valid_data, batch_size, what="valid_data")
         losses = []
         for m in self.metrics:
             m.reset()
@@ -157,7 +160,7 @@ class Engine:
 
     def predict(self, test_data=None, batch_size: int = 1, **kwargs):
         import paddle_tpu as paddle
-        loader = self._loader(test_data, batch_size)
+        loader = self._loader(test_data, batch_size, what="test_data")
         outs = []
         with paddle.no_grad():
             for batch in loader:

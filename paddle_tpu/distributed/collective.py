@@ -368,3 +368,59 @@ def wait(tensor, group=None, use_calc_stream=True):
 
 def stream_synchronize():
     pass
+
+
+def gather(tensor, gather_list=None, dst: int = 0, group=None, sync_op=True):
+    """Collective gather to `dst` (reference dist.gather over NCCL gather).
+    Single-controller: every rank's shard is visible, so this is all_gather
+    with the paddle list convention; `dst` only matters multi-process
+    (non-dst ranks leave gather_list untouched there)."""
+    lst: List = []
+    task = all_gather(lst, tensor, group=group, sync_op=sync_op)
+    if gather_list is not None and (jax.process_count() == 1
+                                    or jax.process_index() == dst):
+        gather_list.extend(lst)
+    return task
+
+
+def get_backend(group=None) -> str:
+    """Reference returns 'nccl'/'gloo'; the comm backend here is XLA's
+    compiled collectives (SURVEY.md §2.3)."""
+    return "xla"
+
+
+class P2POp:
+    """dist.P2POp parity: a deferred point-to-point op for
+    batch_isend_irecv."""
+
+    def __init__(self, op, tensor, peer: int = 0, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Issue a batch of P2POps (reference: coalesced NCCL group calls).
+    Sends are issued before recvs regardless of list order — inside a
+    coalesced batch ordering is free in the reference, and our recv()
+    pairs with the pending send queue."""
+    sends = [op for op in p2p_op_list if op.op is isend or op.op is send]
+    others = [op for op in p2p_op_list if op not in sends]
+    return [op.op(op.tensor, op.peer, group=op.group)
+            for op in sends + others]
+
+
+def _make_stream_ns():
+    """dist.stream namespace parity: the reference's stream.* variants take
+    explicit comm streams; XLA owns scheduling, so they alias the plain
+    collectives."""
+    import types
+    return types.SimpleNamespace(
+        all_reduce=all_reduce, all_gather=all_gather, reduce=reduce,
+        broadcast=broadcast, scatter=scatter, alltoall=alltoall,
+        alltoall_single=alltoall_single, reduce_scatter=reduce_scatter,
+        send=send, recv=recv, gather=gather)
+
+
+stream = _make_stream_ns()

@@ -176,3 +176,5 @@ class _Utils:
 
 
 utils = _Utils()
+
+from ..parallel.env import DataParallel  # noqa: F401,E402

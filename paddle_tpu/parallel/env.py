@@ -79,3 +79,53 @@ class ParallelEnv:
     @property
     def trainer_endpoints(self):
         return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+
+def _data_parallel_cls():
+    from ..nn.layer import Layer
+
+    class DataParallel(Layer):
+        """paddle.DataParallel parity (reference: python/paddle/parallel.py
+        — dygraph DP with EagerReducer bucketed grad allreduce, SURVEY.md
+        §2.3 DP row). TPU-native: grad sync is XLA-inserted psum over the
+        mesh's data axes, so this wrapper is transparent — a real Layer
+        (isinstance checks, parameter walks, nesting all work) that exists
+        so reference scripts (`model = paddle.DataParallel(model)`) run
+        unchanged."""
+
+        def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                     last_comm_buffer_size=1, find_unused_parameters=False,
+                     group=None):
+            super().__init__()
+            self._layers = layers
+            self.add_sublayer("_layers", layers)
+
+        def forward(self, *args, **kwargs):
+            return self._layers(*args, **kwargs)
+
+        def __getattr__(self, name):
+            try:  # params/sublayers first (Layer machinery)
+                return super().__getattr__(name)
+            except AttributeError:
+                return getattr(self._layers, name)
+
+        def no_sync(self):
+            """Grad-sync-free context (reference skips allreduce inside):
+            GSPMD has no per-step allreduce to skip — a no-op context."""
+            import contextlib
+            return contextlib.nullcontext()
+
+        @staticmethod
+        def scale_loss(loss):
+            return loss  # reference scales by world_size in some modes
+
+        def state_dict(self, *a, **k):
+            return self._layers.state_dict(*a, **k)
+
+        def set_state_dict(self, *a, **k):
+            return self._layers.set_state_dict(*a, **k)
+
+    return DataParallel
+
+
+DataParallel = _data_parallel_cls()
