@@ -25,6 +25,28 @@ class Config:
             model_path = model_path[:-len(".pdmodel")]
         self._prefix = model_path
         self._device = "tpu"
+        self._llm_gen = None
+        self._llm_mp = 1
+        self._llm_dp = 1
+
+    def enable_llm_generation(self, max_new_tokens: int = 32,
+                              decode_strategy: str = "greedy_search",
+                              temperature: float = 1.0, top_k: int = 0,
+                              top_p: float = 1.0, eos_token_id=None,
+                              pad_token_id: int = 0, seed: int = 0):
+        """Serve a .pdllm generation checkpoint (prefill + compiled decode
+        scan) instead of a static .pdmodel artifact. Mirrors the PaddleNLP
+        llm/ predict decode knobs (SURVEY.md §3.5)."""
+        self._llm_gen = dict(
+            max_new_tokens=max_new_tokens, decode_strategy=decode_strategy,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_token_id=eos_token_id, pad_token_id=pad_token_id, seed=seed)
+
+    def set_llm_parallel(self, mp: int = 1, dp: int = 1):
+        """Tensor-/data-parallel serving degrees (reference: predictor
+        --tensor_parallel_degree). Weights placed per infer_param_specs;
+        the KV cache stays mp-sharded across the decode loop."""
+        self._llm_mp, self._llm_dp = int(mp), int(dp)
 
     def set_prog_file(self, path: str):
         self._prefix = path[:-len(".pdmodel")] if path.endswith(".pdmodel") \
@@ -97,5 +119,13 @@ class Predictor:
         return [self._fetch[n] for n in self._fetch_names]
 
 
-def create_predictor(config: Config) -> Predictor:
+def create_predictor(config: Config):
+    """Dispatch: a Config pointing at a .pdllm generation checkpoint (or
+    with enable_llm_generation set) gets the LLM serving predictor; plain
+    .pdmodel artifacts get the jax.export Predictor."""
+    import os
+    from .llm import LLM_SUFFIX, LLMPredictor
+    if config._llm_gen is not None or (
+            config._prefix and os.path.exists(config._prefix + LLM_SUFFIX)):
+        return LLMPredictor(config)
     return Predictor(config)

@@ -1,0 +1,158 @@
+"""LLM serving path: save/load a generation-ready checkpoint and run
+TP/DP-sharded prefill+decode behind the inference Config/Predictor API.
+
+Reference analog: PaddleNLP `llm/` predict — `predictor.py` loading a
+Llama checkpoint and serving model.generate() with mp>1 tensor
+parallelism (upstream-canonical, unverified — SURVEY.md §0, §3.5, §1 Lx
+row; VERDICT r2 missing item 1: training was multi-chip-complete,
+inference was not).
+
+TPU-native design: the artifact is the param pytree + config (no program
+— generate() is re-traced and jit-compiled per shape signature, XLA is
+the pass pipeline). Parallel serving is a mesh + infer_param_specs
+placement: TP weights stay resident, the KV cache lives sharded over mp
+heads for the whole compiled decode scan (nlp.generation.cache_spec).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["save_llm", "load_llm", "LLMPredictor"]
+
+LLM_SUFFIX = ".pdllm"
+
+
+def _cfg_to_dict(cfg) -> Dict[str, Any]:
+    d = dataclasses.asdict(cfg)
+    for k in ("dtype", "param_dtype"):
+        d[k] = jnp.dtype(d[k]).name
+    return d
+
+
+def _cfg_from_dict(d: Dict[str, Any]):
+    from ..nlp import llama
+    d = dict(d)
+    for k in ("dtype", "param_dtype"):
+        d[k] = jnp.dtype(d[k]).type
+    return llama.LlamaConfig(**d)
+
+
+def save_llm(path_prefix: str, params: Dict[str, Any], cfg) -> None:
+    """Write `{prefix}.pdllm`: config + param pytree (numpy). The analog of
+    the reference's .pdparams checkpoint plus its generation config."""
+    payload = {
+        "config": _cfg_to_dict(cfg),
+        "params": jax.tree.map(np.asarray, params),
+    }
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + LLM_SUFFIX, "wb") as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_llm(path_prefix: str):
+    with open(path_prefix + LLM_SUFFIX, "rb") as f:
+        payload = pickle.load(f)
+    return payload["params"], _cfg_from_dict(payload["config"])
+
+
+class LLMPredictor:
+    """Generation predictor with the paddle_infer handle API.
+
+    Input handle: "input_ids" [B, P] int32. Output handle:
+    "generated_ids" [B, max_new_tokens] int32. Decode knobs come from the
+    Config (Config.enable_llm_generation / set_llm_parallel)."""
+
+    def __init__(self, config):
+        from ..nlp import llama
+        if config._prefix is None:
+            raise ValueError("Config has no model path")
+        params, cfg = load_llm(config._prefix)
+        self._cfg = cfg
+        self._gen = dict(config._llm_gen or {})
+        mp = int(getattr(config, "_llm_mp", 1))
+        dp = int(getattr(config, "_llm_dp", 1))
+        self._mesh = None
+        if mp * dp > 1:
+            from ..parallel.topology import build_mesh
+            ndev = len(jax.devices())
+            if mp * dp > ndev:
+                raise ValueError(
+                    f"set_llm_parallel(mp={mp}, dp={dp}) needs {mp * dp} "
+                    f"devices, have {ndev}")
+            self._mesh = build_mesh(dp=dp, mp=mp,
+                                    devices=jax.devices()[:mp * dp])
+            from jax.sharding import NamedSharding
+            specs = llama.infer_param_specs(cfg)
+            self._params = jax.tree.map(
+                lambda p, s: jax.device_put(
+                    jnp.asarray(p), NamedSharding(self._mesh, s)),
+                params, specs)
+        else:
+            self._params = jax.tree.map(jnp.asarray, params)
+        self._feed: Dict[str, np.ndarray] = {}
+        self._fetch: Dict[str, np.ndarray] = {}
+        self._key = jax.random.PRNGKey(int(self._gen.get("seed", 0)))
+        self._run_fn = None
+
+    # -- handle API (paddle_infer::Predictor parity) -----------------------
+    def get_input_names(self) -> List[str]:
+        return ["input_ids"]
+
+    def get_output_names(self) -> List[str]:
+        return ["generated_ids"]
+
+    def get_input_handle(self, name: str):
+        from . import Tensor
+        return Tensor(name, self, True)
+
+    def get_output_handle(self, name: str):
+        from . import Tensor
+        return Tensor(name, self, False)
+
+    def _fn(self):
+        from ..nlp import generation
+        g = self._gen
+        greedy = g.get("decode_strategy", "greedy_search") == "greedy_search"
+        kw = dict(max_new_tokens=int(g.get("max_new_tokens", 32)),
+                  temperature=float(g.get("temperature", 1.0)),
+                  top_k=int(g.get("top_k", 0)),
+                  top_p=float(g.get("top_p", 1.0)), greedy=greedy,
+                  eos_token_id=g.get("eos_token_id"),
+                  pad_token_id=int(g.get("pad_token_id", 0)),
+                  mesh=self._mesh)
+
+        def run(params, ids, key):
+            return generation.generate(params, ids, self._cfg, key=key, **kw)
+
+        return jax.jit(run)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None
+            ) -> List[np.ndarray]:
+        if inputs is not None:
+            self._feed["input_ids"] = np.asarray(inputs[0])
+        ids = jnp.asarray(self._feed["input_ids"], jnp.int32)
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            dp_total = self._mesh.shape["dp"] * self._mesh.shape["sharding"]
+            if ids.shape[0] % dp_total:
+                raise ValueError(
+                    f"input_ids batch {ids.shape[0]} not divisible by the "
+                    f"dp degree {dp_total} (set_llm_parallel); pad the "
+                    f"request batch to a multiple of dp")
+            ids = jax.device_put(
+                ids, NamedSharding(self._mesh, P(("dp", "sharding"), None)))
+        if self._run_fn is None:
+            self._run_fn = self._fn()
+        # fresh randomness per request, reproducible as a SEQUENCE from the
+        # configured seed (greedy ignores the key entirely)
+        self._key, sub = jax.random.split(self._key)
+        out = np.asarray(self._run_fn(self._params, ids, sub))
+        self._fetch = {"generated_ids": out}
+        return [out]

@@ -19,6 +19,7 @@ from typing import Any, Dict, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..kernels.rms_norm import rms_norm_ref
 from ..kernels.rope import rope_freqs, apply_rope_half
@@ -31,11 +32,30 @@ class KVCache(NamedTuple):
     v: jax.Array
 
 
-def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int) -> KVCache:
+def cache_spec() -> P:
+    """PartitionSpec for each KV-cache leaf [L, B, T, KV, hd]: batch over
+    the data axes, KV heads over mp (tensor parallel) — the serving-side
+    counterpart of llama.param_specs' head-dim column split. The cache
+    never leaves its shard: decode writes ride dynamic_update_slice on the
+    local [KV/mp] head block (reference: PaddleNLP llm/ predict's
+    mp-sharded fused-attention cache; SURVEY.md §3.5)."""
+    return P(None, ("dp", "sharding"), None, "mp", None)
+
+
+def _constrain(x, mesh, spec):
+    if mesh is None:
+        return x
+    return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
+               mesh=None) -> KVCache:
     L, KV, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
                  cfg.head_dim)
     shape = (L, batch, max_len, KV, hd)
-    return KVCache(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+    z = jnp.zeros(shape, cfg.dtype)
+    return KVCache(_constrain(z, mesh, cache_spec()),
+                   _constrain(z, mesh, cache_spec()))
 
 
 def _attention_cached(x, lp, cfg, cos, sin, ck, cv, pos):
@@ -65,12 +85,18 @@ def _attention_cached(x, lp, cfg, cos, sin, ck, cv, pos):
 
 
 def forward_cached(params: Dict[str, Any], tokens: jax.Array,
-                   cache: KVCache, pos, cfg: llama.LlamaConfig):
+                   cache: KVCache, pos, cfg: llama.LlamaConfig, mesh=None):
     """tokens [B, P] at absolute positions pos..pos+P-1 → (logits [B,P,V]
-    f32, cache'). P>1 = prefill; P=1 = decode step. pos may be traced."""
+    f32, cache'). P>1 = prefill; P=1 = decode step. pos may be traced.
+
+    With a mesh, activations are constrained [B over (dp, sharding), heads
+    over mp implicitly via the weight shards] and the cache keeps
+    cache_spec() — TP decode stays local per shard except the row-parallel
+    o_proj/down_proj all-reduces GSPMD inserts (SURVEY.md §2.3 TP row)."""
     cd = cfg.dtype
     T = cache.k.shape[2]
     x = jnp.take(params["embed_tokens"], tokens, axis=0).astype(cd)
+    x = _constrain(x, mesh, P(("dp", "sharding"), None, None))
     cos, sin = rope_freqs(cfg.head_dim, T, cfg.rope_theta, jnp.float32)
 
     def body(x, layer):
@@ -80,35 +106,45 @@ def forward_cached(params: Dict[str, Any], tokens: jax.Array,
         x = x + a
         h = rms_norm_ref(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
         x = x + llama._mlp(h, lp, cfg)
-        return x, (ck, cv)
+        return _constrain(x, mesh, P(("dp", "sharding"), None, None)), (ck, cv)
 
     x, (ck, cv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     logits = llama._final_head(params, x, cfg)
-    return logits, KVCache(ck, cv)
+    return logits, KVCache(_constrain(ck, mesh, cache_spec()),
+                           _constrain(cv, mesh, cache_spec()))
 
 
 def _sample(logits, key, temperature: float, top_k: int, top_p: float,
             greedy: bool):
-    """logits [B, V] → token ids [B]. Branch-free top-k/top-p masking."""
+    """logits [B, V] → token ids [B]. Branch-free top-k/top-p masking.
+
+    Filters apply sequentially like the reference's TopKProcess →
+    TopPProcess: top-p renormalizes over the top-k SURVIVORS, and top_k is
+    clamped to vocab_size. lax.top_k keeps the decode-loop cost at
+    O(V·log k); the full-vocab sort only runs for a pure top-p request."""
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / jnp.maximum(temperature, 1e-6)
-    if top_k or top_p < 1.0:
-        # one descending sort serves both filters
-        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
-        if top_k:
-            logits = jnp.where(
-                logits < sorted_l[:, top_k - 1][:, None], -1e30, logits)
-        if top_p < 1.0:
-            probs = jax.nn.softmax(sorted_l, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            # smallest set whose cumulative prob >= top_p; clamp keeps at
-            # least the top token even at top_p == 0
-            cutoff_idx = jnp.maximum(
-                jnp.sum((cum - probs) < top_p, axis=-1) - 1, 0)
-            cutoff = jnp.take_along_axis(
-                sorted_l, cutoff_idx[:, None], axis=-1)
-            logits = jnp.where(logits < cutoff, -1e30, logits)
+    V = logits.shape[-1]
+    sorted_l = None
+    if top_k:
+        k = min(int(top_k), V)
+        sorted_l = lax.top_k(logits, k)[0]          # descending, [B, k]
+        logits = jnp.where(logits < sorted_l[:, -1][:, None], -1e30, logits)
+    if top_p < 1.0:
+        if sorted_l is None:
+            sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        # masked-out entries are -1e30 → softmax weight 0, so softmax over
+        # the k survivors equals the renormalized truncated distribution
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set whose cumulative prob >= top_p; clamp keeps at
+        # least the top token even at top_p == 0
+        cutoff_idx = jnp.maximum(
+            jnp.sum((cum - probs) < top_p, axis=-1) - 1, 0)
+        cutoff = jnp.take_along_axis(
+            sorted_l, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
@@ -116,14 +152,19 @@ def generate(params: Dict[str, Any], input_ids: jax.Array,
              cfg: llama.LlamaConfig, max_new_tokens: int = 32,
              temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
              greedy: bool = True, eos_token_id: Optional[int] = None,
-             pad_token_id: int = 0, key: Optional[jax.Array] = None
-             ) -> jax.Array:
+             pad_token_id: int = 0, key: Optional[jax.Array] = None,
+             mesh=None) -> jax.Array:
     """Autoregressive generation: prefill + compiled decode scan.
 
     input_ids [B, P] int32 → [B, max_new_tokens] int32 (positions after an
     eos are pad_token_id). The decode loop is ONE lax.scan — paddle-shaped
     model.generate(decode_strategy='greedy_search'/'sampling') semantics
-    without the reference's per-token host loop."""
+    without the reference's per-token host loop.
+
+    With a mesh (and params placed per llama.infer_param_specs), the whole
+    prefill + decode scan is TP/DP-sharded: the KV cache stays sharded
+    over mp heads (cache_spec) for the full loop — the PaddleNLP llm/
+    predict mp>1 serving path, compiled (SURVEY.md §3.5)."""
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     B, P = input_ids.shape
@@ -131,8 +172,8 @@ def generate(params: Dict[str, Any], input_ids: jax.Array,
     if key is None:
         key = jax.random.PRNGKey(0)
 
-    cache = init_cache(cfg, B, T)
-    logits, cache = forward_cached(params, input_ids, cache, 0, cfg)
+    cache = init_cache(cfg, B, T, mesh)
+    logits, cache = forward_cached(params, input_ids, cache, 0, cfg, mesh)
     key, sub = jax.random.split(key)
     first = _sample(logits[:, -1], sub, temperature, top_k, top_p, greedy)
     done0 = (first == eos_token_id) if eos_token_id is not None else \
@@ -140,7 +181,8 @@ def generate(params: Dict[str, Any], input_ids: jax.Array,
 
     def step(carry, _):
         tok, cache, pos, key, done = carry
-        logits, cache = forward_cached(params, tok[:, None], cache, pos, cfg)
+        logits, cache = forward_cached(params, tok[:, None], cache, pos,
+                                       cfg, mesh)
         key, sub = jax.random.split(key)
         nxt = _sample(logits[:, 0], sub, temperature, top_k, top_p, greedy)
         nxt = jnp.where(done, pad_token_id, nxt)

@@ -148,6 +148,33 @@ def param_specs(cfg: LlamaConfig, pp: bool = False) -> Dict[str, Any]:
     }
 
 
+def infer_param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Serving-side PartitionSpec tree: Megatron TP ONLY (mp on the
+    head/ffn dims; qkv/gate/up column-split, o/down row-split, lm_head
+    vocab-split), everything else replicated. Unlike param_specs there is
+    no ZeRO 'sharding' axis — weights must stay resident so decode steps
+    insert no per-step param all-gathers (the reference's PaddleNLP llm/
+    predict mp>1 layout; SURVEY.md §3.5, VERDICT r2 missing item 1)."""
+    specs = {
+        "embed_tokens": P(None, None),
+        "layers": {
+            "input_layernorm": P(None, None),
+            "q_proj": P(None, None, "mp"),
+            "k_proj": P(None, None, "mp"),
+            "v_proj": P(None, None, "mp"),
+            "o_proj": P(None, "mp", None),
+            "post_attention_layernorm": P(None, None),
+            "gate_proj": P(None, None, "mp"),
+            "up_proj": P(None, None, "mp"),
+            "down_proj": P(None, "mp", None),
+        },
+        "norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "mp")
+    return specs
+
+
 def act_spec() -> P:
     """Activation sharding [B, S, D]: batch over (dp, sharding) — ZeRO data
     axes — and sequence over sep (context parallel). Megatron-SP falls out of
