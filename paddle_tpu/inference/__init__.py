@@ -37,6 +37,11 @@ class Config:
         """Serve a .pdllm generation checkpoint (prefill + compiled decode
         scan) instead of a static .pdmodel artifact. Mirrors the PaddleNLP
         llm/ predict decode knobs (SURVEY.md §3.5)."""
+        if decode_strategy not in ("greedy_search", "sampling"):
+            raise ValueError(
+                f"decode_strategy {decode_strategy!r} not supported: use "
+                f"'greedy_search' or 'sampling' (beam_search is not "
+                f"implemented in paddle_tpu.inference.llm)")
         self._llm_gen = dict(
             max_new_tokens=max_new_tokens, decode_strategy=decode_strategy,
             temperature=temperature, top_k=top_k, top_p=top_p,

@@ -46,7 +46,13 @@ def _cfg_from_dict(d: Dict[str, Any]):
 
 def save_llm(path_prefix: str, params: Dict[str, Any], cfg) -> None:
     """Write `{prefix}.pdllm`: config + param pytree (numpy). The analog of
-    the reference's .pdparams checkpoint plus its generation config."""
+    the reference's .pdparams checkpoint plus its generation config.
+
+    Format is pickle for .pdparams parity (paddle.save/load are
+    pickle-based — SURVEY.md §5 checkpoint row), with the same caveat:
+    NEVER load a .pdllm from an untrusted source (pickle executes code at
+    load time). For exchange, convert to orbax via paddle_tpu.distributed
+    .checkpoint."""
     payload = {
         "config": _cfg_to_dict(cfg),
         "params": jax.tree.map(np.asarray, params),
@@ -90,9 +96,12 @@ class LLMPredictor:
                                     devices=jax.devices()[:mp * dp])
             from jax.sharding import NamedSharding
             specs = llama.infer_param_specs(cfg)
+            # device_put the HOST (numpy) arrays straight into their shards
+            # — staging jnp.asarray first would materialize every full
+            # weight on device 0 and OOM models that only fit sharded
             self._params = jax.tree.map(
                 lambda p, s: jax.device_put(
-                    jnp.asarray(p), NamedSharding(self._mesh, s)),
+                    p, NamedSharding(self._mesh, s)),
                 params, specs)
         else:
             self._params = jax.tree.map(jnp.asarray, params)

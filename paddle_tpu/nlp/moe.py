@@ -429,17 +429,76 @@ def forward_pp(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig,
     return logits, aux
 
 
+def loss_and_grad_pp(params: Dict[str, Any], tokens: jax.Array,
+                     cfg: MoeConfig, mesh, num_microbatches: int,
+                     virtual_pp: int = 1):
+    """Fused loss + grads for MoE through the compiled 1F1B schedule.
+
+    Reference analog: DeepSeek-class MoE under fleet's 1F1B scheduler
+    (SURVEY.md §2.3 EP row; VERDICT r2 missing 5 — MoE+pp previously fell
+    back to GPipe because 1F1B's activation contract was a single array).
+    The router aux-loss accumulators ride the pipe as extra PYTREE buffer
+    channels — pipeline.one_f_one_b carries arbitrary pytrees now — and
+    their cotangents flow back up the same ring, so load-balance/z-loss
+    gradients reach every stage's routers. virtual_pp > 1 uses the
+    interleaved 1F1B (O(v·pp) residency) with the same pytree buffers.
+    Returns (loss, grads) with grads matching the params tree."""
+    from ..parallel.pipeline import run_1f1b
+
+    n = mesh.shape["pp"]
+    B, S = tokens.shape
+    M = num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    L = cfg.num_hidden_layers
+    lcfg = _llama_cfg(cfg)
+    cd = cfg.dtype
+    cos, sin = rope_freqs(cfg.head_dim, S, cfg.rope_theta, jnp.float32)
+    f32 = jnp.float32
+
+    def stage_fn(local_layers, buf):
+        def body(carry, lp):
+            return _decoder_body(carry, lp, cfg, lcfg, cos, sin, mesh), None
+        (x, lb, zl), _ = jax.lax.scan(
+            body, (buf["x"], buf["lb"], buf["zl"]), local_layers)
+        return {"x": x, "lb": lb, "zl": zl}
+
+    def first_fn(embed, tok_mb):
+        return {"x": jnp.take(embed, tok_mb, axis=0).astype(cd),
+                "lb": jnp.zeros((), f32), "zl": jnp.zeros((), f32)}
+
+    def last_fn(lp, buf, tok_mb):
+        x = rms_norm_ref(buf["x"], lp["norm"], cfg.rms_norm_eps)
+        logits = (x.astype(cd) @ lp["lm_head"].astype(cd)).astype(f32)
+        ce = _llama._mb_loss(logits, tok_mb)
+        return (ce + cfg.router_aux_loss_coef * buf["lb"] / L
+                + cfg.router_z_loss_coef * buf["zl"] / L)
+
+    first_params = params["embed_tokens"]
+    last_params = {"norm": params["norm"], "lm_head": params["lm_head"]}
+    toks_mb = tokens.reshape((M, B // M) + tokens.shape[1:])
+    loss, g_layers, g_f, g_l = run_1f1b(
+        stage_fn, first_fn, last_fn, mesh, params["layers"], first_params,
+        last_params, toks_mb, n_stages=n, virtual_pp=virtual_pp)
+    grads = {"embed_tokens": g_f, "layers": g_layers,
+             "norm": g_l["norm"], "lm_head": g_l["lm_head"]}
+    grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
+    return loss, grads
+
+
 def loss_fn(params, tokens, cfg: MoeConfig, mesh=None,
             pp_microbatches=None, pp_virtual: int = 1):
     """Next-token CE + router aux losses (full-shape roll+mask, same
     rationale as llama.loss_fn). pp_microbatches: with a mesh whose pp
     axis > 1, run the decoder through the compiled GPipe schedule.
-    pp_virtual > 1 (the interleaved schedule) is not implemented for MoE
-    — the aux-loss pipe channels need the chunked circular layout too."""
+    pp_virtual > 1 under the GPipe forward is not implemented for MoE —
+    use schedule='1f1b' (loss_and_grad_pp handles virtual_pp with the
+    pytree aux channels)."""
     if pp_virtual > 1:
         raise NotImplementedError(
-            "interleaved virtual-pp for the MoE family is not implemented "
-            "(paddle_tpu/nlp/moe.py) — use pp_schedule='gpipe'")
+            "interleaved virtual-pp under the MoE GPipe forward is not "
+            "implemented (paddle_tpu/nlp/moe.py) — use pp_schedule='1f1b', "
+            "whose interleaved_one_f_one_b carries the aux-loss pytree")
     if (pp_microbatches and mesh is not None
             and "pp" in mesh.axis_names and mesh.shape["pp"] > 1):
         logits, aux = forward_pp(params, tokens, cfg, mesh, pp_microbatches)

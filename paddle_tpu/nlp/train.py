@@ -134,9 +134,10 @@ def make_train_step(cfg, tx, mesh: Optional[Mesh] = None,
     forward+backward with O(pp) activation residency; "gpipe" runs
     forward_pp under jax.grad (scan transpose, O(num_microbatches)
     residency) and is the automatic fallback for models without a
-    loss_and_grad_pp; "interleaved" runs the circular virtual-pp schedule
-    (virtual_pp_degree chunks per device — bubble shrinks by that factor)
-    under jax.grad.
+    loss_and_grad_pp; "interleaved" runs the interleaved/virtual-pp 1F1B
+    (virtual_pp_degree chunks per device — bubble shrinks by that factor,
+    O(v·pp) residency) when the model has loss_and_grad_pp, else the
+    circular virtual-pp GPipe under jax.grad.
 
     grad_accum_steps > 1 splits the batch axis into that many chunks and
     accumulates grads through one lax.scan before the optimizer update —
@@ -150,7 +151,7 @@ def make_train_step(cfg, tx, mesh: Optional[Mesh] = None,
     mb = (num_microbatches or 2 * mesh.shape["pp"]) if pp else None
     if pp_schedule not in ("1f1b", "gpipe", "interleaved"):
         raise ValueError(f"unknown pp_schedule {pp_schedule!r}")
-    use_1f1b = (pp and pp_schedule == "1f1b"
+    use_1f1b = (pp and pp_schedule in ("1f1b", "interleaved")
                 and hasattr(model, "loss_and_grad_pp"))
     pp_virtual = virtual_pp_degree if (
         pp and pp_schedule == "interleaved") else 1
@@ -196,7 +197,7 @@ def make_train_step(cfg, tx, mesh: Optional[Mesh] = None,
             loss = lsum / grad_accum_steps
         elif use_1f1b:
             loss, grads = model.loss_and_grad_pp(
-                state.params, tokens, cfg, mesh, mb)
+                state.params, tokens, cfg, mesh, mb, pp_virtual)
         else:
             loss, grads = jax.value_and_grad(lfn)(state.params, tokens)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
