@@ -405,8 +405,9 @@ def batch_isend_irecv(p2p_op_list):
     Sends are issued before recvs regardless of list order — inside a
     coalesced batch ordering is free in the reference, and our recv()
     pairs with the pending send queue."""
-    sends = [op for op in p2p_op_list if op.op is isend or op.op is send]
-    others = [op for op in p2p_op_list if op not in sends]
+    sends, others = [], []
+    for op in p2p_op_list:
+        (sends if op.op in (isend, send) else others).append(op)
     return [op.op(op.tensor, op.peer, group=op.group)
             for op in sends + others]
 

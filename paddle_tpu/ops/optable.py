@@ -239,15 +239,30 @@ C("take", lambda x, index, mode="raise": _take(x, index, mode),
 
 
 def _take(x, index, mode):
+    """mode "raise" CLAMPS like "clip" under jit (XLA cannot raise
+    data-dependently — the documented divergence, same as gather's OOB
+    clamp). Eagerly, with FLAGS_check_nan_inf set (the debug-checks flag),
+    out-of-bounds indices DO raise like the reference (ADVICE r2)."""
     flat = x.reshape(-1)
     idx = index
     if mode == "wrap":
         idx = idx % flat.shape[0]
     else:
-        # mode "raise" clamps like "clip": XLA cannot raise data-dependently
-        # inside a compiled program (same accepted divergence as gather's
-        # out-of-bounds clamp); jnp.take's default would FILL with NaN
-        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+        if mode == "raise":
+            from ..core.flags import flag
+            if flag("FLAGS_check_nan_inf") and not isinstance(
+                    idx, jax.core.Tracer):
+                import numpy as _np
+                ia = _np.asarray(idx)
+                if ia.size and (ia.min() < -flat.shape[0]
+                                or ia.max() >= flat.shape[0]):
+                    raise IndexError(
+                        f"paddle.take(mode='raise'): index out of range "
+                        f"for tensor with {flat.shape[0]} elements")
+        # raise-mode negatives are valid [-n, -1] wraps (paddle's index
+        # range is [-prod(shape), prod(shape))); only true OOB clamps
+        idx = jnp.clip(jnp.where(idx < 0, idx + flat.shape[0], idx),
+                       0, flat.shape[0] - 1)
     return jnp.take(flat, idx)
 
 

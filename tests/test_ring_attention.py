@@ -261,30 +261,85 @@ class TestRingFlashBlocks:
             np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                        rtol=5e-3, atol=5e-3)
 
-    def test_misaligned_seq_falls_back_exact(self):
-        """seq % 128 == 0 but not block-divisible (384): the gate must
-        reject the kernel (whose grid would floor-drop trailing rows) and
-        the sq > sk causal case (kernel zeros vs softmax-uniform rows),
-        falling back to the exact path."""
+    def test_sq_gt_sk_causal_falls_back_exact(self):
+        """The sq > sk causal case stays on the exact path (kernel zeros vs
+        softmax-uniform fully-masked rows — the two would diverge)."""
         import numpy as np
         import jax.numpy as jnp
         from paddle_tpu.kernels import flash_attention as fa
         rng = np.random.RandomState(2)
-        q, k, v = (jnp.asarray(rng.randn(1, 384, 2, 8), jnp.float32)
-                   for _ in range(3))
-        assert not fa.block_aligned(384)
-        out = fa.flash_attention_fwd(q, k, v, True, None)
-        np.testing.assert_allclose(
-            np.asarray(out), np.asarray(fa.mha_ref(q, k, v, causal=True)),
-            rtol=1e-6, atol=1e-6)
         q2 = jnp.asarray(rng.randn(1, 256, 2, 8), jnp.float32)
         k2, v2 = (jnp.asarray(rng.randn(1, 128, 2, 8), jnp.float32)
                   for _ in range(2))
+        assert not fa._pallas_ok(q2, k2, causal=True)
         out2 = fa.flash_attention_fwd(q2, k2, v2, True, None)
         np.testing.assert_allclose(
             np.asarray(out2),
             np.asarray(fa.mha_ref(q2, k2, v2, causal=True)),
             rtol=1e-6, atol=1e-6)
+
+    @pytest.mark.parametrize("s", [200, 333, 384])
+    def test_padded_kernel_arbitrary_lengths(self, s):
+        """VERDICT r2 missing 8: misaligned seq lengths (384 = the classic
+        grid floor-drop case; 200/333 = not even lane-aligned) go through
+        the PAD-to-block kernel path, not the O(S^2) fallback, and match
+        the exact reference."""
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import flash_attention as fa
+        rng = np.random.RandomState(s)
+        q = jnp.asarray(rng.randn(1, s, 2, 8), jnp.float32)
+        k, v = (jnp.asarray(rng.randn(1, s, 2, 8), jnp.float32)
+                for _ in range(2))
+        assert fa._pallas_ok(q, k, causal=True)
+        out = fa.flash_attention_padded(q, k, v, causal=True,
+                                        interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(fa.mha_ref(q, k, v, causal=True)),
+            rtol=2e-4, atol=2e-4)
+
+    def test_padded_kernel_grads_match_exact(self):
+        """Backward through the padded path: padded rows carry zero dO, so
+        dq/dk/dv match the exact-attention vjp at an odd length."""
+        import jax
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import flash_attention as fa
+        s = 200
+        rng = np.random.RandomState(7)
+        q = jnp.asarray(rng.randn(1, s, 2, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(1, s, 1, 8), jnp.float32)  # GQA too
+        v = jnp.asarray(rng.randn(1, s, 1, 8), jnp.float32)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(fa.flash_attention_fwd(q, k, v, True, None)
+                           .astype(jnp.float32) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(fa.mha_ref(q, k, v, causal=True)
+                           .astype(jnp.float32) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, r in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=5e-4, atol=5e-4)
+
+    def test_padded_rectangular_prefill(self):
+        """Odd-length chunked prefill against a longer odd-length cache:
+        the unpadded offset sk-sq keeps padded keys invisible."""
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import flash_attention as fa
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(1, 100, 2, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 390, 2, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(1, 390, 2, 8), jnp.float32)
+        out = fa.flash_attention_padded(q, k, v, causal=True,
+                                        interpret=True)
+        ref = fa.mha_ref(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
 
     def test_rectangular_causal_offset(self):
         """Default offset sk-sq == mha_ref's bottom-right diagonal (chunked
