@@ -47,8 +47,11 @@ def make_optimizer(learning_rate=3e-4, weight_decay=0.1, b1=0.9, b2=0.95,
     if state_quant is None:
         adam = optax.adamw(sched, b1=b1, b2=b2, weight_decay=weight_decay)
     elif state_quant in ("8bit", "int8"):
+        # the clip streams through the chunked 8-bit update (no second
+        # grad tree — the single-chip 2B config OOMs with the optax clip)
         from ..optimizer.quant_state import adamw_q
-        adam = adamw_q(sched, b1=b1, b2=b2, weight_decay=weight_decay)
+        return adamw_q(sched, b1=b1, b2=b2, weight_decay=weight_decay,
+                       clip_norm=grad_clip or None)
     else:
         raise ValueError(f"unknown state_quant {state_quant!r}")
     tx = optax.chain(

@@ -259,10 +259,11 @@ def _take(x, index, mode):
                     raise IndexError(
                         f"paddle.take(mode='raise'): index out of range "
                         f"for tensor with {flat.shape[0]} elements")
-        # raise-mode negatives are valid [-n, -1] wraps (paddle's index
-        # range is [-prod(shape), prod(shape))); only true OOB clamps
-        idx = jnp.clip(jnp.where(idx < 0, idx + flat.shape[0], idx),
-                       0, flat.shape[0] - 1)
+            # raise-mode negatives are valid [-n, -1] wraps (paddle's
+            # index range is [-prod(shape), prod(shape))); clip mode keeps
+            # numpy's semantics — negatives clamp to 0, no wrapping
+            idx = jnp.where(idx < 0, idx + flat.shape[0], idx)
+        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
     return jnp.take(flat, idx)
 
 

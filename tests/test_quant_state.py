@@ -99,3 +99,62 @@ class TestAdamQ:
             state, m = step(state, toks)
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0] * 0.8, losses
+
+
+class TestStreamedClip:
+    """clip_norm fused into the chunked 8-bit update (VERDICT r2 weak 5):
+    semantics match ClipGradByGlobalNorm without a second grad tree."""
+
+    def test_clip_matches_prescaled_grads(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.optimizer.quant_state import adamw_q
+        params = {"a": jnp.ones((1000,), jnp.float32),
+                  "b": jnp.ones((300,), jnp.float32)}
+        g = {"a": jnp.full((1000,), 3.0), "b": jnp.full((300,), -4.0)}
+        gnorm = float(jnp.sqrt(sum(jnp.sum(x * x)
+                                   for x in jax.tree.leaves(g))))
+        clip = 1.0
+        scale = min(1.0, clip / (gnorm + 1e-6))
+        tx_c = adamw_q(1e-2, clip_norm=clip)
+        tx_p = adamw_q(1e-2)
+        u_c, _ = tx_c.update(g, tx_c.init(params), params)
+        u_p, _ = tx_p.update(jax.tree.map(lambda x: x * scale, g),
+                             tx_p.init(params), params)
+        for k in g:
+            np.testing.assert_allclose(np.asarray(u_c[k]),
+                                       np.asarray(u_p[k]),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_no_clip_below_threshold(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.optimizer.quant_state import adamw_q
+        params = {"a": jnp.ones((256,), jnp.float32)}
+        g = {"a": jnp.full((256,), 1e-4)}  # tiny norm, clip must be no-op
+        tx_c = adamw_q(1e-2, clip_norm=1.0)
+        tx_p = adamw_q(1e-2)
+        u_c, _ = tx_c.update(g, tx_c.init(params), params)
+        u_p, _ = tx_p.update(g, tx_p.init(params), params)
+        np.testing.assert_allclose(np.asarray(u_c["a"]),
+                                   np.asarray(u_p["a"]), rtol=1e-6)
+
+    def test_make_optimizer_8bit_uses_streamed_clip(self):
+        """make_optimizer(state_quant='8bit', grad_clip=1.0) must NOT chain
+        optax.clip_by_global_norm (the second-tree version) — train step
+        still runs and decreases loss with clip on."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.nlp import llama, train
+        cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=2)
+        tx = train.make_optimizer(3e-3, state_quant="8bit", grad_clip=1.0)
+        state = train.init_state(jax.random.key(0), cfg, tx, mesh=None)
+        step = train.make_train_step(cfg, tx, mesh=None)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (8, 32)), jnp.int32)
+        state, m0 = step(state, toks)
+        for _ in range(6):
+            state, m = step(state, toks)
+        assert float(m["loss"]) < float(m0["loss"])
