@@ -181,13 +181,26 @@ def _fwd_call(off, qt, kt, vt, grid, block_q, block_k, causal, scale, sk, b,
 
 # ---------------------------------------------------------------------------
 # Pallas TPU kernels (backward). Standard flash backward: softmax re-derived
-# per block from the LSE residual; D = rowsum(dO*O). Two kernels — one
-# produces dq (grid over q blocks, loop over kv), one produces dk/dv (grid
-# over kv blocks, loop over q) — so neither needs atomics.
+# per block from the LSE residual; D = rowsum(dO*O). Two formulations, both
+# atomics-free:
+#   RESIDENT (seq <= _RESIDENT_MAX_SEQ): the counterpart tensor stays in a
+#   full-seq VMEM window and an in-kernel fori_loop streams blocks with a
+#   DYNAMIC trip count — causal blocks past the diagonal cost zero
+#   iterations. Fastest at training lengths (2-4k), but the windows hit
+#   Mosaic's 16MB scoped-vmem stack limit at seq 8192.
+#   STREAMED (longer): 3D grid — dq over (bh, qb, kb) with an f32 scratch
+#   accumulator, dk/dv over (bh, kb, qb) — every ref is ONE block, nothing
+#   full-sequence in VMEM, so seq scales to the 8B north-star 8k+ shapes;
+#   causal invisibility is a pl.when compute skip (the block DMA still
+#   runs, ~1pt MFU at 2k — why the resident path is kept).
 # ---------------------------------------------------------------------------
 
-def _flash_bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                         dcap_ref, dq_ref, *, block_k, causal, scale, seq_k):
+_RESIDENT_MAX_SEQ = 4096
+
+
+def _flash_bwd_dq_kernel_res(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                             dcap_ref, dq_ref, *, block_k, causal, scale,
+                             seq_k):
     from jax.experimental import pallas as pl
 
     block_q, d = int(q_ref.shape[1]), int(q_ref.shape[2])
@@ -225,9 +238,9 @@ def _flash_bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                          dcap_ref, dk_ref, dv_ref, *, block_q, causal,
-                          scale, seq_q):
+def _flash_bwd_dkv_kernel_res(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                              dcap_ref, dk_ref, dv_ref, *, block_q, causal,
+                              scale, seq_q):
     from jax.experimental import pallas as pl
 
     block_k, d = int(k_ref.shape[1]), int(k_ref.shape[2])
@@ -269,12 +282,111 @@ def _flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
+def _flash_bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         dcap_ref, dq_ref, acc_ref, *, causal, scale,
+                         n_kb):
+    from jax.experimental import pallas as pl
+
+    block_q, d = int(q_ref.shape[1]), int(q_ref.shape[2])
+    block_k = int(k_ref.shape[1])
+    kb = pl.program_id(2)
+    q_offset = pl.program_id(1) * block_q
+    k_offset = kb * block_k
+    off = off_ref[0, 0] if causal else 0
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros((block_q, d), jnp.float32)
+
+    visible = True
+    if causal:
+        visible = (q_offset + block_q - 1 + off) >= k_offset
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        dcap = dcap_ref[0, :, 0]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            q_idx = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + q_offset
+            k_idx = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1) + k_offset
+            # mask p, not s: fully-masked rows have lse == -inf and
+            # exp(NEG_INF - lse) would be exp(0) == 1 there
+            p = jnp.where((q_idx + off) >= k_idx, p, 0.0)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dcap[:, None]) * scale
+        acc_ref[...] += jnp.dot(ds, k_blk,
+                                preferred_element_type=jnp.float32)
+
+    @pl.when(kb == n_kb - 1)
+    def _flush():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          dcap_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          causal, scale, n_qb):
+    from jax.experimental import pallas as pl
+
+    block_k, d = int(k_ref.shape[1]), int(k_ref.shape[2])
+    block_q = int(q_ref.shape[1])
+    qb = pl.program_id(2)
+    k_offset = pl.program_id(1) * block_k
+    q_offset = qb * block_q
+    off = off_ref[0, 0] if causal else 0
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros((block_k, d), jnp.float32)
+        dv_acc[...] = jnp.zeros((block_k, d), jnp.float32)
+
+    visible = True
+    if causal:
+        # block contributes iff its LAST q row reaches this kv block:
+        # row iq sees ik <= iq + off
+        visible = (q_offset + block_q - 1 + off) >= k_offset
+
+    @pl.when(visible)
+    def _compute():
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        dcap = dcap_ref[0, :, 0]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            q_idx = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + q_offset
+            k_idx = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1) + k_offset
+            p = jnp.where((q_idx + off) >= k_idx, p, 0.0)
+        dv_acc[...] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dcap[:, None]) * scale
+        dk_acc[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(qb == n_qb - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k", "interpret"))
+                                             "block_k", "interpret",
+                                             "streamed"))
 def flash_attention_pallas_bwd(q, k, v, out, lse, g, causal=False,
                                scale=None, offset=None, dlse=None,
-                               block_q=512, block_k=512, interpret=False):
+                               block_q=512, block_k=512, interpret=False,
+                               streamed=None):
     """Blocked flash backward. q,k,v,out,g: [B,S,H,D]; lse: [B,H,S].
     Returns (dq, dk, dv) with O(S) memory per block row.
 
@@ -302,19 +414,85 @@ def flash_attention_pallas_bwd(q, k, v, out, lse, g, causal=False,
                    axis=-1, keepdims=True)
     if dlse is not None:
         dcap = dcap - dlse.astype(jnp.float32).reshape(b * h, sq, 1)
+    if streamed is None:  # auto: resident kernels up to the VMEM-safe seq
+        streamed = max(sq, sk) > _RESIDENT_MAX_SEQ
     with jax.enable_x64(False):  # see flash_attention_pallas docstring
         off = jnp.asarray(offset, jnp.int32).reshape(1, 1)
         return _bwd_call(off, qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d,
                          block_q, block_k, causal, scale, q.dtype, k.dtype,
-                         v.dtype, interpret)
+                         v.dtype, interpret, streamed)
 
 
 def _bwd_call(off, qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d, block_q,
-              block_k, causal, scale, q_dtype, k_dtype, v_dtype, interpret):
+              block_k, causal, scale, q_dtype, k_dtype, v_dtype, interpret,
+              streamed):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not streamed:
+        return _bwd_call_resident(
+            off, qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d, block_q,
+            block_k, causal, scale, q_dtype, k_dtype, v_dtype, interpret)
+
+    n_kb = sk // block_k
+    n_qb = sq // block_q
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale,
+                          n_kb=n_kb),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q_dtype),
+        grid=(b * h, n_qb, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda bh, qb, kb: (0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qb, kb: (bh, qb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qb, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qb, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qb, kb: (bh, qb, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qb, kb: (bh, qb, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qb, kb: (bh, qb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qb, kb: (bh, qb, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(off, qt, kt, vt, dot, lse_t, dcap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale,
+                          n_qb=n_qb),
+        out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k_dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), v_dtype)],
+        grid=(b * h, n_kb, n_qb),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda bh, kb, qb: (0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, kb, qb: (bh, qb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, kb, qb: (bh, qb, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, kb, qb: (bh, qb, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, kb, qb: (bh, qb, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (bh, kb, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(off, qt, kt, vt, dot, lse_t, dcap)
+
+    def back(x):
+        return x.reshape(b, h, -1, d).transpose(0, 2, 1, 3)
+
+    return back(dq), back(dk), back(dv)
+
+
+def _bwd_call_resident(off, qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d,
+                       block_q, block_k, causal, scale, q_dtype, k_dtype,
+                       v_dtype, interpret):
     from jax.experimental import pallas as pl
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+        functools.partial(_flash_bwd_dq_kernel_res, block_k=block_k,
                           causal=causal, scale=scale, seq_k=sk),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q_dtype),
         grid=(b * h, sq // block_q),
@@ -332,7 +510,7 @@ def _bwd_call(off, qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d, block_q,
     )(off, qt, kt, vt, dot, lse_t, dcap)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+        functools.partial(_flash_bwd_dkv_kernel_res, block_q=block_q,
                           causal=causal, scale=scale, seq_q=sq),
         out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k_dtype),
                    jax.ShapeDtypeStruct((b * h, sk, d), v_dtype)],
@@ -499,6 +677,13 @@ def _pallas_ok(q, k, causal=True):
     return _pad_len(k.shape[1]) == k.shape[1]
 
 
+def _intentional_exact(q, k, causal):
+    """Shapes where the exact path is the DESIGNED fast path, not a
+    fallback worth warning about: decode-shaped causal sq < 128 (a matvec
+    beats padding 1 -> 128 rows + a K/V pad copy)."""
+    return causal and q.shape[1] < 128 and q.shape[1] <= k.shape[1]
+
+
 def _flash_impl(q, k, v, causal, scale):
     if _pallas_ok(q, k, causal):
         ke, ve = _expand_gqa(q, k, v)
@@ -508,7 +693,7 @@ def _flash_impl(q, k, v, causal, scale):
                                           interpret=_interpret())
         except Exception as e:
             _warn_fallback("flash_fwd", e)
-    elif _use_pallas(q):
+    elif _use_pallas(q) and not _intentional_exact(q, k, causal):
         _warn_fallback("flash_gate", ValueError(
             f"unsupported shape q={q.shape} k={k.shape} causal={causal}"))
     return mha_ref(q, k, v, causal=causal, scale=scale)
@@ -533,7 +718,7 @@ def _flash_fwd_rule(q, k, v, causal, scale):
             return out, (q, k, v, out, lse)
         except Exception as e:
             _warn_fallback("flash_fwd_vjp", e)
-    elif _use_pallas(q):
+    elif _use_pallas(q) and not _intentional_exact(q, k, causal):
         _warn_fallback("flash_gate_vjp", ValueError(
             f"unsupported shape q={q.shape} k={k.shape} causal={causal}"))
     return mha_ref(q, k, v, causal=causal, scale=scale), (q, k, v, None,

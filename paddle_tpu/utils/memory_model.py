@@ -1,0 +1,98 @@
+"""Per-chip HBM projection for sharded train states.
+
+Reference analog: the capacity planning the reference ecosystem does by
+hand around fleet hybrid-parallel configs (SURVEY.md §2.3; BASELINE.md
+north star — Llama-3-8B on v5p-64). The projection walks the model's
+ACTUAL PartitionSpec tables (llama.param_specs — the same trees the train
+step's in_shardings use), so it reflects what GSPMD will materialize, not
+a back-of-envelope: each leaf's bytes divide by the product of the mesh
+axes its spec shards over.
+
+Accounting (matches nlp/train's TrainState under the default remat
+policy):
+  params        param_dtype x per-leaf sharding
+  grads         one params-shaped tree (live at the optimizer update)
+  optimizer     adam m+v, f32 (8 B/param) sharded like params, or 8-bit
+                blockwise (~2.06 B/param) when state_quant='8bit'
+  activations   jax.checkpoint(nothing_saveable) saves each scanned
+                layer's input carry: L x [B_local, S_local, D] in the
+                compute dtype, plus the f32 logits working set (sharded
+                over mp via the lm_head spec)
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _axis_product(spec, axes: Dict[str, int]) -> int:
+    n = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for a in names:
+            n *= int(axes.get(a, 1))
+    return n
+
+
+def hbm_plan(cfg, axes: Dict[str, int], batch: int, seq: int,
+             model=None, state_quant: str | None = None) -> Dict[str, Any]:
+    """Project per-chip HBM bytes for training `cfg` on a mesh with the
+    given axis sizes (e.g. dict(dp=2, sharding=8, mp=4) = 64 chips).
+
+    Returns a dict of byte counts per chip plus `total` and `n_chips`.
+    `batch` is the GLOBAL batch; activations shard over (dp, sharding)
+    and seq over sep, exactly like llama.act_spec."""
+    if model is None:
+        from ..nlp import llama as model
+
+    params_shape = jax.eval_shape(
+        functools.partial(model.init_params, cfg=cfg),
+        jax.random.key(0))
+    specs = model.param_specs(cfg, pp=axes.get("pp", 1) > 1)
+
+    pbytes = np.dtype(cfg.param_dtype).itemsize
+    opt_bytes = 2.0625 if state_quant in ("8bit", "int8") else 8.0
+
+    params = grads = opt = 0.0
+    for leaf, spec in zip(jax.tree.leaves(params_shape),
+                          jax.tree.leaves(
+                              specs, is_leaf=lambda x: isinstance(
+                                  x, jax.sharding.PartitionSpec))):
+        shard_elems = leaf.size / _axis_product(spec, axes)
+        params += shard_elems * pbytes
+        grads += shard_elems * pbytes
+        opt += shard_elems * opt_bytes
+
+    dp_total = axes.get("dp", 1) * axes.get("sharding", 1)
+    sep = axes.get("sep", 1)
+    pp = axes.get("pp", 1)
+    b_loc = max(batch / dp_total, 1)
+    s_loc = seq / sep
+    cd_bytes = np.dtype(cfg.dtype).itemsize
+    L_loc = cfg.num_hidden_layers / pp
+    # remat(nothing_saveable) residual: one carry per scanned layer
+    acts = L_loc * b_loc * s_loc * cfg.hidden_size * cd_bytes
+    # f32 logits + one bf16 working copy, vocab sharded over mp
+    logits = b_loc * s_loc * cfg.vocab_size / axes.get("mp", 1) * (4 + 2)
+
+    total = params + grads + opt + acts + logits
+    return {
+        "n_chips": int(np.prod([int(v) for v in axes.values()])),
+        "params": params, "grads": grads, "opt_state": opt,
+        "activations": acts, "logits_workspace": logits, "total": total,
+        "total_gib": total / 2**30,
+    }
+
+
+def format_plan(name: str, plan: Dict[str, Any]) -> str:
+    rows = [f"{name} ({plan['n_chips']} chips):"]
+    for k in ("params", "grads", "opt_state", "activations",
+              "logits_workspace", "total"):
+        rows.append(f"  {k:18s} {plan[k] / 2**30:8.2f} GiB/chip")
+    return "\n".join(rows)

@@ -120,3 +120,29 @@ class TestDeviceMemoryStats:
         # cuda namespace aliases (recipes call cuda.* regardless of backend)
         assert d.cuda.memory_allocated() == d.memory_allocated()
         assert d.cuda.device_count() >= 1
+
+
+class TestMemoryModel:
+    """utils.memory_model.hbm_plan — the v5p-64 north-star projection
+    (VERDICT r2 missing 7) walks the real param_specs tables."""
+
+    def test_sharded_total_shrinks_with_mesh(self):
+        from paddle_tpu.nlp import llama
+        from paddle_tpu.utils.memory_model import hbm_plan
+        cfg = llama.LlamaConfig.tiny()
+        one = hbm_plan(cfg, dict(), batch=8, seq=64)
+        many = hbm_plan(cfg, dict(sharding=4, mp=2), batch=8, seq=64)
+        assert many["params"] < one["params"] / 4
+        assert many["total"] < one["total"]
+        assert many["n_chips"] == 8
+
+    def test_8b_fits_v5p(self):
+        from paddle_tpu.nlp import llama
+        from paddle_tpu.utils.memory_model import hbm_plan
+        cfg = llama.LlamaConfig.llama3_8b()
+        plan = hbm_plan(cfg, dict(dp=2, sharding=8, mp=4),
+                        batch=32, seq=8192)
+        # the README table: ~10.7 GiB/chip, far under v5p's 95 GiB
+        assert 8 < plan["total_gib"] < 20, plan["total_gib"]
+        # params 8B f32 over the 32-way (sharding x mp) 2D shard
+        assert 0.5 < plan["params"] / 2**30 < 1.5
