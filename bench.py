@@ -64,8 +64,13 @@ def run_config(cfg, batch, seq, timed_steps, state_quant=None,
     tok_s = batch * seq * timed_steps / dt
     flops_tok = llama.flops_per_token(cfg, seq)
     mfu = tok_s * flops_tok / peak_for(dev)
-    del state
-    return {"tok_s": tok_s, "mfu": mfu, "loss": float(m["loss"]),
+    loss_val = float(m["loss"])
+    # free this config's HBM before the next one — lingering buffers
+    # measurably slow the following config (fragmentation)
+    del state, m, step, tx, tokens
+    import gc
+    gc.collect()
+    return {"tok_s": tok_s, "mfu": mfu, "loss": loss_val,
             "params": llama.num_params(cfg)}
 
 
@@ -107,9 +112,13 @@ def run_8b_layer(seq, batch=1, timed_steps=8):
     dt = (time.perf_counter() - t0) / timed_steps
 
     matmul = D * (H + 2 * KV) * hd + H * hd * D + 3 * D * F
-    attn = 2 * H * hd * seq          # causal QK^T + PV per token
+    attn = H * hd * seq    # causal: QK^T + PV at ~seq/2 visible keys each
     flops = 6.0 * (matmul + attn) * batch * seq
-    return flops / dt / peak_for(dev)
+    mfu = flops / dt / peak_for(dev)
+    del lp, x, g, step
+    import gc
+    gc.collect()
+    return mfu
 
 
 def main():

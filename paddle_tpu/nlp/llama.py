@@ -457,5 +457,8 @@ def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
     # embedding is a gather — ~zero MXU FLOPs, tied or not)
     matmul = L * (D * (H + 2 * KV) * hd + H * hd * D + 3 * D * F) \
         + cfg.vocab_size * D
-    attn = L * 2 * H * hd * seq_len  # QK^T + PV per token (causal ≈ /2 *2)
+    # causal attention MACs/token: QK^T + PV visit ~seq/2 keys each →
+    # 2 * H*hd*seq/2 = H*hd*seq (the flash kernels really skip the masked
+    # half, so crediting full attention would overstate MFU)
+    attn = L * H * hd * seq_len
     return 6.0 * (matmul + attn)
