@@ -13,11 +13,38 @@ Usage mirrors the reference:
 """
 from __future__ import annotations
 
+import os as _os
+
 import jax as _jax
 
 # int64/float64 are first-class in Paddle (default int dtype is int64);
 # enable x64 before anything traces. TPU work uses bf16/f32 regardless.
 _jax.config.update("jax_enable_x64", True)
+
+# Multi-process bootstrap MUST precede any backend use, and importing this
+# package creates arrays (dtype tables, flags) — so when the launch CLI's
+# env names a coordination service, connect HERE, before any submodule
+# import (reference: init_parallel_env's TCPStore rendezvous runs before
+# any CUDA context; SURVEY.md §3.2). init_parallel_env() stays the
+# user-facing entry and is a no-op once this ran.
+_coord = _os.environ.get("JAX_COORDINATOR_ADDRESS") or \
+    _os.environ.get("PADDLE_MASTER")
+_nproc = int(_os.environ.get("JAX_NUM_PROCESSES")
+             or _os.environ.get("PADDLE_TRAINERS_NUM") or "1")
+if _coord and _nproc > 1:
+    if ":" not in _coord:  # portless PADDLE_MASTER, same default as env.py
+        _coord = f"{_coord}:{_os.environ.get('MASTER_PORT', '8476')}"
+    try:
+        _jax.distributed.initialize(
+            coordinator_address=_coord, num_processes=_nproc,
+            process_id=int(_os.environ.get("JAX_PROCESS_ID")
+                           or _os.environ.get("PADDLE_TRAINER_ID") or "0"))
+    except RuntimeError as _e:
+        # tolerate ONLY an explicit earlier user init; real failures
+        # (unreachable coordinator) must not degrade to single-process
+        if "already" not in str(_e).lower() and "once" not in str(_e).lower():
+            raise
+del _coord, _nproc
 
 __version__ = "0.1.0"
 

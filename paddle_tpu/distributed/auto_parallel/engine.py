@@ -93,6 +93,11 @@ class Engine:
                         f"sharding.degree {degree} does not divide "
                         f"{ndev} devices")
                 mesh = build_mesh(dp=ndev // degree, sharding=degree)
+                if topology._global_mesh is None:
+                    # register it, or any later get_mesh() consumer (e.g.
+                    # with_sharding_constraint inside the model) would
+                    # lazily build a CONFLICTING dp-only default mesh
+                    topology.set_mesh(mesh)
             self._mesh = mesh
         if s.recompute.enable and not self._recompute_applied and \
                 self.model is not None:
@@ -178,38 +183,15 @@ class Engine:
                    if hasattr(entries[n], "optimize_attr") else 1.0
                    for n in pnames}
         clip = opt._grad_clip
-        clip_kind = type(clip).__name__ if clip is not None else None
-        if clip_kind not in (None, "ClipGradByGlobalNorm", "ClipGradByNorm",
-                             "ClipGradByValue"):
-            raise NotImplementedError(
-                f"auto.Engine compiled fit: unsupported grad clip "
-                f"{clip_kind} (paddle_tpu/distributed/auto_parallel/"
-                f"engine.py)")
 
         def apply_clip(g):
-            f32 = jnp.float32
-            if clip_kind == "ClipGradByGlobalNorm":
-                cn = jnp.asarray(float(clip.clip_norm), f32)
-                gn = jnp.sqrt(sum(jnp.sum(jnp.square(v.astype(f32)))
-                                  for v in g.values()))
-                # the eager ClipGradByGlobalNorm formula exactly:
-                # scale = clip_norm / max(gn, clip_norm)
-                scale = cn / jnp.maximum(gn, cn)
-                return {k: (v.astype(f32) * scale).astype(v.dtype)
-                        for k, v in g.items()}
-            if clip_kind == "ClipGradByNorm":     # per-parameter norm
-                cn = jnp.asarray(float(clip.clip_norm), f32)
-
-                def one(v):
-                    n_ = jnp.sqrt(jnp.sum(jnp.square(v.astype(f32))))
-                    return (v.astype(f32) * (cn / jnp.maximum(n_, cn))
-                            ).astype(v.dtype)
-
-                return {k: one(v) for k, v in g.items()}
-            if clip_kind == "ClipGradByValue":
-                lo, hi = float(clip.min), float(clip.max)
-                return {k: jnp.clip(v, lo, hi) for k, v in g.items()}
-            return g
+            if clip is None:
+                return g
+            # the eager clip classes (optimizers.py ClipGradBy*) are pure
+            # jnp over (p, g) pairs — reuse them verbatim in the traced
+            # step so compiled and eager fit clip identically (p is only
+            # carried through, so the name stands in for it)
+            return dict(clip([(n, g[n]) for n in pnames]))
 
         def step(pv, buf, os_, x, y, lr):
             def loss_val(pv):
@@ -303,7 +285,7 @@ class Engine:
             return a
 
         step_fn = None
-        logged_last = False
+        raw_losses = []   # un-synced device scalars: one per step
         for epoch in range(epochs):
             for step, batch in enumerate(loader):
                 if steps_per_epoch is not None and step >= steps_per_epoch:
@@ -317,34 +299,35 @@ class Engine:
                 lr = jnp.asarray(opt.get_lr(), jnp.float32)
                 l, pv, buf, os_ = step_fn(pv, buf, os_, xa, ya, lr)
                 opt._step_count += 1
-                logged_last = step % max(log_freq, 1) == 0
-                if logged_last:
-                    lv = float(l)          # host sync only at log points
-                    self.history["loss"].append(lv)
-                    if verbose:
-                        print(f"[auto.Engine] epoch {epoch} step {step}: "
-                              f"loss {lv:.4f}")
-            if valid_data is not None:
+                raw_losses.append(l)
+                if verbose and step % max(log_freq, 1) == 0:
+                    # the ONLY per-step host sync, and only when printing
+                    print(f"[auto.Engine] epoch {epoch} step {step}: "
+                          f"loss {float(l):.4f}")
+            if valid_data is not None and step_fn is not None:
                 self._writeback(pv, buf, os_)
                 self.evaluate(valid_data, batch_size=batch_size,
                               verbose=verbose)
         if step_fn is not None:
             self._writeback(pv, buf, os_)
-            if not logged_last:
-                self.history["loss"].append(float(l))
+        self.history["loss"] = [float(v) for v in raw_losses]
         return self.history
 
     def _writeback(self, pv, buf, os_):
-        """Land the jitted carries back on the layer/optimizer state
-        (the 'master' entry rides the jitted opt state, so it lands back
-        verbatim — no down-up cast)."""
+        """Land the jitted carries back on the layer/optimizer state as
+        COPIES — a mid-training writeback (the valid_data path) must not
+        alias the carries, or the next epoch's donation would invalidate
+        the live model. The 'master' entry rides the jitted opt state, so
+        it lands back verbatim (no down-up cast)."""
+        import jax.numpy as jnp
         entries = self.model.state_dict()
         opt = self.optimizer
         for n, v in pv.items():
-            entries[n]._rebind(v)
-            opt._state[id(entries[n])] = dict(os_[n])
+            entries[n]._rebind(jnp.array(v, copy=True))
+            opt._state[id(entries[n])] = {
+                k: jnp.array(s, copy=True) for k, s in os_[n].items()}
         for n, v in buf.items():
-            entries[n]._data = v
+            entries[n]._data = jnp.array(v, copy=True)
 
     def evaluate(self, valid_data=None, batch_size: int = 1, verbose: int = 1,
                  **kwargs):

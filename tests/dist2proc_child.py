@@ -1,0 +1,48 @@
+"""Child worker for the two-process jax.distributed tests (NOT a test
+module — spawned by tests/test_dist_multiprocess.py).
+
+Reference analog: the collective_*_api.py child scripts of
+test/collective/ that TestDistBase launches as real processes on
+127.0.0.1 (SURVEY.md §4 — 'multi-node is simulated as multi-process on
+one node'). Argv: coordinator_address process_id result_path.
+"""
+import json
+import sys
+
+import jax
+
+coordinator, pid, result_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+jax.distributed.initialize(coordinator_address=coordinator,
+                           num_processes=2, process_id=pid)
+
+import jax.numpy as jnp  # noqa: E402
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+rank = jax.process_index()
+out = {"rank": rank, "process_count": jax.process_count()}
+
+# all_reduce (SUM then AVG) — the eager multi-process branch
+t = paddle.to_tensor(jnp.asarray([float(rank + 1), 10.0 * (rank + 1)]))
+dist.all_reduce(t)
+out["sum"] = [float(v) for v in t.numpy()]          # [3, 30]
+t2 = paddle.to_tensor(jnp.asarray([float(rank)]))
+dist.all_reduce(t2, op=dist.ReduceOp.AVG)
+out["avg"] = float(t2.numpy()[0])                   # 0.5
+
+# all_gather
+lst = []
+dist.all_gather(lst, paddle.to_tensor(jnp.asarray([float(rank), -1.0])))
+out["gather"] = [[float(v) for v in x.numpy()] for x in lst]
+
+# broadcast from rank 0
+b = paddle.to_tensor(jnp.asarray([float(rank * 7 + 3)]))
+dist.broadcast(b, src=0)
+out["bcast"] = float(b.numpy()[0])                  # rank0's 3.0
+
+# barrier — both processes must pass
+dist.barrier()
+out["barrier"] = True
+
+with open(result_path, "w") as f:
+    json.dump(out, f)

@@ -1,0 +1,29 @@
+"""Child trainer for the launch-CLI end-to-end test (NOT a test module).
+
+Bootstraps via paddle_tpu.distributed.init_parallel_env from the env the
+launch CLI sets (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+JAX_PROCESS_ID — the TCPStore-rendezvous analog, SURVEY.md §3.2), beats
+the heartbeat, and all_reduces one value so the run proves real
+cross-process communication.
+"""
+import json
+import os
+import sys
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.launch.main import heartbeat
+
+dist.init_parallel_env()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import paddle_tpu as paddle  # noqa: E402
+
+rank = jax.process_index()
+for _ in range(3):  # fake train steps with heartbeats
+    heartbeat()
+t = paddle.to_tensor(jnp.asarray([float(rank + 1)]))
+dist.all_reduce(t)
+with open(sys.argv[1] + f".{rank}", "w") as f:
+    json.dump({"rank": rank, "world": jax.process_count(),
+               "sum": float(t.numpy()[0])}, f)
