@@ -99,6 +99,26 @@ from .utils.checkpoint import save, load  # noqa: F401
 from .hapi import Model, callbacks  # noqa: F401
 from .hapi.summary import summary, flops  # noqa: F401
 
+from . import text  # noqa: F401
+
+# yaml-parity accounting for the remaining op surfaces (SURVEY.md §2.1:
+# signal/audio/vision/sparse/geometric kernels are all ops.yaml entries in
+# the reference; sparse ops prefix like the reference's sparse_ kernels,
+# image-transform functionals like its vision ops)
+ops.register_surface(signal)
+ops.register_surface(geometric)
+ops.register_surface(audio.functional)
+ops.register_surface(vision.ops)
+ops.register_surface(vision.transforms, prefix="vision.")
+ops.register_surface(sparse, prefix="sparse.")
+ops.register_surface(sparse.nn.functional, prefix="sparse.nn.")
+ops.register_surface(incubate.nn.functional)
+ops.register_surface(incubate)
+ops.register_surface(distributed.collective, prefix="comm.")
+from .distributed.fleet import mpu as _mpu  # noqa: F401,E402  (c_* ops)
+from .distribution import kl_divergence as _kl  # noqa: F401,E402
+ops.REGISTRY.setdefault("kl_divergence", _kl)
+
 # top-level shims (paddle parity): version/dtype/framework aliases,
 # printoptions, batch reader decorator, LazyGuard no-op
 import types as _sh_types

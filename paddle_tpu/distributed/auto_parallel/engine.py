@@ -183,6 +183,15 @@ class Engine:
                    if hasattr(entries[n], "optimize_attr") else 1.0
                    for n in pnames}
         clip = opt._grad_clip
+        if clip is not None and type(clip).__name__ not in (
+                "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"):
+            # custom clip protocols may touch the param object (need_clip
+            # filtering etc.) — the compiled step passes names, so refuse
+            # loudly instead of tracing garbage
+            raise NotImplementedError(
+                f"auto.Engine compiled fit: unsupported grad clip "
+                f"{type(clip).__name__} (paddle_tpu/distributed/"
+                f"auto_parallel/engine.py)")
 
         def apply_clip(g):
             if clip is None:

@@ -181,3 +181,48 @@ def model_parallel_random_seed(seed=None):
     tracker = get_rng_state_tracker()
     tracker.reset()
     tracker.add("model_parallel_rng", seed)
+
+
+# ---------------------------------------------------------------------------
+# The mp comm ops as REGISTERED ops (reference: the c_* op family —
+# c_identity/c_concat/c_split/c_allreduce_sum/c_softmax_with_cross_entropy
+# are PHI kernels that appear in programs; SURVEY.md §2.3 comm-kernels row)
+# ---------------------------------------------------------------------------
+
+from ...ops._registry import REGISTRY as _REG
+
+_REG.setdefault("c_identity", _c_identity)
+_REG.setdefault("c_concat", _c_concat)
+_REG.setdefault("c_split", _c_split)
+_REG.setdefault("c_allreduce_sum", _mp_allreduce)
+
+
+def c_embedding(weight, x, start_index=0, name=None):
+    """Vocab-parallel embedding op: rows outside this shard's
+    [start_index, start_index + n) produce zeros (summed over mp by the
+    caller's allreduce — VocabParallelEmbedding's kernel)."""
+    from ...ops._registry import eager
+    import jax.numpy as jnp
+
+    def raw(w, ids):
+        local = ids - start_index
+        ok = (local >= 0) & (local < w.shape[0])
+        safe = jnp.clip(local, 0, w.shape[0] - 1)
+        out = w[safe]
+        return jnp.where(ok[..., None], out, 0)
+
+    return eager(raw, (weight, x), {}, name="c_embedding")
+
+
+_REG.setdefault("c_embedding", c_embedding)
+
+
+def c_softmax_with_cross_entropy(logits, label, group=None,
+                                 ignore_index=-100, name=None):
+    """The vocab-parallel CE op (ParallelCrossEntropy's kernel)."""
+    return ParallelCrossEntropy(mp_group=group,
+                                ignore_index=ignore_index)(logits, label)
+
+
+_REG.setdefault("c_softmax_with_cross_entropy",
+                c_softmax_with_cross_entropy)

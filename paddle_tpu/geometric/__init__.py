@@ -106,3 +106,66 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
                 "div": xs / yd}[message_op]
 
     return eager(raw, (x, y), {}, name="send_uv")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """paddle.geometric.sample_neighbors over a CSC graph — static-shape:
+    returns [len(input_nodes), sample_size] neighbor ids padded with -1
+    plus per-node counts (the reference's ragged out_count)."""
+    from ..ops._registry import eager
+    from ..core import random as _r
+    if sample_size < 0:
+        raise ValueError("static-shape sample_neighbors needs an explicit "
+                         "sample_size")
+    key = _r.next_key()
+
+    def raw(rw, cp, nodes):
+        def one(k, n):
+            start = cp[n]
+            deg = cp[n + 1] - start
+            # WITHOUT replacement: a random-offset contiguous window of the
+            # neighbor list (distinct indices whenever deg >= sample_size;
+            # uniform per-neighbor marginal, not uniform over subsets — the
+            # static-shape tradeoff vs the reference's full shuffle)
+            off = jax.random.randint(k, (), 0, jnp.maximum(deg, 1))
+            idx = (off + jnp.arange(sample_size)) % jnp.maximum(deg, 1)
+            neigh = rw[jnp.clip(start + idx, 0, rw.shape[0] - 1)]
+            valid = jnp.arange(sample_size) < deg
+            return jnp.where(valid, neigh, -1), jnp.minimum(deg, sample_size)
+
+        keys = jax.random.split(key, nodes.shape[0])
+        return jax.vmap(one)(keys, nodes)
+
+    return eager(raw, (row, colptr, input_nodes), {},
+                 name="sample_neighbors")
+
+
+def reindex_graph(x, neighbors, count=None, value_buffer=None,
+                  index_buffer=None, name=None):
+    """paddle.geometric.reindex_graph: renumber x ∪ neighbors to a dense
+    0..n-1 id space (static shapes; -1 padding passes through)."""
+    from ..ops._registry import eager
+
+    def raw(xa, na):
+        allv = jnp.concatenate([xa, na.reshape(-1)])
+        n_all = allv.shape[0]
+        big = jnp.asarray(jnp.iinfo(allv.dtype).max, allv.dtype)
+        uni, inv = jnp.unique(jnp.where(allv < 0, big, allv),
+                              return_inverse=True, size=n_all,
+                              fill_value=big)
+        # dense ids in FIRST-APPEARANCE order (paddle contract: x maps to
+        # 0..len(x)-1 in order, new neighbor ids follow)
+        first = jnp.full((n_all,), 1 << 30, jnp.int32).at[inv].min(
+            jnp.arange(n_all, dtype=jnp.int32))
+        order = jnp.argsort(first)
+        rank = jnp.zeros((n_all,), jnp.int32).at[order].set(
+            jnp.arange(n_all, dtype=jnp.int32))
+        dense = rank[inv]
+        out_x = dense[:xa.shape[0]]
+        out_n = jnp.where(na.reshape(-1) < 0, -1,
+                          dense[xa.shape[0]:]).reshape(na.shape)
+        return out_n, out_x, uni[order]
+
+    return eager(raw, (x, neighbors), {}, name="reindex_graph")

@@ -1,3 +1,77 @@
 """paddle.incubate namespace — experimental API parity surface."""
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """paddle.incubate.softmax_mask_fuse: softmax(x + mask) fused
+    (the reference's fused CUDA kernel; XLA fuses this chain natively)."""
+    import jax
+    from ..ops._registry import eager
+    return eager(lambda a, m: jax.nn.softmax(
+        a.astype("float32") + m.astype("float32"), axis=-1).astype(a.dtype),
+        (x, mask), {}, name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax over causally-masked logits [B, H, S, S] (fused kernel)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops._registry import eager
+
+    def raw(a):
+        s = a.shape[-1]
+        m = jnp.tril(jnp.ones((s, s), bool))
+        z = jnp.where(m, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(z, axis=-1).astype(a.dtype)
+
+    return eager(raw, (x,), {}, name="softmax_mask_fuse_upper_triangle")
+
+
+def identity_loss(x, reduction="none", name=None):
+    """paddle.incubate.identity_loss."""
+    from ..ops._registry import eager
+    import jax.numpy as jnp
+    red = {"none": lambda a: a, "mean": jnp.mean, "sum": jnp.sum,
+           0: jnp.sum, 1: jnp.mean, 2: lambda a: a}[reduction]
+    return eager(lambda a: red(a), (x,), {}, name="identity_loss")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy alias of geometric.send_u_recv."""
+    from .. import geometric
+    return geometric.send_u_recv(x, src_index, dst_index,
+                                 reduce_op=pool_type, out_size=out_size)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           **kw):
+    from .. import geometric
+    return geometric.sample_neighbors(row, colptr, input_nodes,
+                                      sample_size, **kw)
+
+
+def graph_reindex(x, neighbors, count=None, **kw):
+    from .. import geometric
+    return geometric.reindex_graph(x, neighbors, count, **kw)
+
+
+def segment_sum(data, segment_ids, name=None):
+    from .. import geometric
+    return geometric.segment_sum(data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    from .. import geometric
+    return geometric.segment_mean(data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    from .. import geometric
+    return geometric.segment_max(data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    from .. import geometric
+    return geometric.segment_min(data, segment_ids)

@@ -6,3 +6,159 @@ unverified, SURVEY.md §0, §2.1 fused-kernels row). TPU-native: the
 "fused" ops ARE our Pallas kernels / XLA-fused jnp formulas.
 """
 from . import functional  # noqa: F401
+
+# ---------------------------------------------------------------------------
+# Round-3: the fused Layer zoo (python/paddle/incubate/nn/layer/ — each
+# wraps the functional fused op; upstream-canonical, unverified §0)
+# ---------------------------------------------------------------------------
+from ...nn.layer import Layer
+from ...nn import initializer as I
+
+
+class FusedRMSNorm(Layer):
+    def __init__(self, hidden_size, epsilon=1e-6, name=None):
+        super().__init__()
+        import paddle_tpu as paddle
+        self.weight = self.create_parameter(
+            [hidden_size], default_initializer=I.Constant(1.0))
+        self._eps = epsilon
+
+    def forward(self, x):
+        return functional.fused_rms_norm(x, self.weight, epsilon=self._eps)
+
+
+class FusedLayerNorm(Layer):
+    def __init__(self, hidden_size, epsilon=1e-5, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [hidden_size], default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            [hidden_size], default_initializer=I.Constant(0.0))
+        self._eps = epsilon
+
+    def forward(self, x):
+        return functional.fused_layer_norm(x, self.weight, self.bias,
+                                           epsilon=self._eps)
+
+
+class FusedLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.weight = self.create_parameter([in_features, out_features])
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_features], default_initializer=I.Constant(0.0))
+        self._tw = transpose_weight
+
+    def forward(self, x):
+        return functional.fused_linear(x, self.weight, self.bias, self._tw)
+
+
+class FusedDropoutAdd(Layer):
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self._p = p
+        self._mode = mode
+
+    def forward(self, x, y):
+        return functional.fused_dropout_add(
+            x, y, p=self._p, training=self.training, mode=self._mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    def __init__(self, embed_dim, dropout_rate=0.5, epsilon=1e-5,
+                 name=None, **kw):
+        super().__init__()
+        self.linear_bias = self.create_parameter(
+            [embed_dim], default_initializer=I.Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], default_initializer=I.Constant(0.0))
+        self._p = dropout_rate
+        self._eps = epsilon
+
+    def forward(self, x, residual):
+        y = functional.fused_dropout_add(
+            x + self.linear_bias, residual, p=self._p,
+            training=self.training)
+        return functional.fused_layer_norm(
+            y, self.ln_scale, self.ln_bias, epsilon=self._eps)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN fused attention block (functional fused path + the
+    framework's flash attention — SURVEY.md §2.1 fused-kernels row)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 weight_attr=None, bias_attr=None, epsilon=1e-5,
+                 name=None, **kw):
+        super().__init__()
+        from ...nn.layers_transformer import MultiHeadAttention
+        from ...nn.layers_conv import LayerNorm
+        self._pre = normalize_before
+        self.attn = MultiHeadAttention(embed_dim, num_heads,
+                                       dropout=attn_dropout_rate)
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon)
+        self._p = dropout_rate
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        residual = query
+        x = self.norm(query) if self._pre else query
+        out = self.attn(x, key if key is not None else x,
+                        value if value is not None else x, attn_mask)
+        out = functional.fused_dropout_add(out, residual, p=self._p,
+                                           training=self.training)
+        return out if self._pre else self.norm(out)
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, name=None, **kw):
+        super().__init__()
+        from ...nn.layers_common import Linear
+        from ...nn.layers_conv import LayerNorm
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm = LayerNorm(d_model, epsilon=epsilon)
+        self._act = activation
+        self._p = dropout_rate
+        self._pre = normalize_before
+
+    def forward(self, src):
+        residual = src
+        x = self.norm(src) if self._pre else src
+        import paddle_tpu.nn.functional as F
+        act = getattr(F, self._act)
+        x = self.linear2(act(self.linear1(x)))
+        x = functional.fused_dropout_add(x, residual, p=self._p,
+                                         training=self.training)
+        return x if self._pre else self.norm(x)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, name=None,
+                 **kw):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate or dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+__all__ = ["functional", "FusedRMSNorm", "FusedLayerNorm", "FusedLinear",
+           "FusedDropoutAdd", "FusedBiasDropoutResidualLayerNorm",
+           "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]

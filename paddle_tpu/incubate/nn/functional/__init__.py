@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from ....core.tensor import Tensor
@@ -139,3 +140,265 @@ def fused_multi_head_attention(*args, **kwargs):
     raise NotImplementedError(
         "fused_multi_head_attention: use nn.MultiHeadAttention or "
         "F.flash_attention (paddle_tpu/incubate/nn/functional/__init__.py)")
+
+
+def swiglu(x, y=None, name=None):
+    """paddle.incubate.nn.functional.swiglu: silu(x) * y; when y is None,
+    x splits in half on the last axis (the fused SwiGLU MLP gate)."""
+    from ....ops._registry import eager
+    if y is None:
+        def raw(xa):
+            a, b = jnp.split(xa, 2, axis=-1)
+            return jax.nn.silu(a) * b
+        return eager(raw, (x,), {}, name="swiglu")
+    return eager(lambda a, b: jax.nn.silu(a) * b, (x, y), {},
+                 name="swiglu")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """x @ y + bias in one fused op (cublasLt epilogue in the reference;
+    XLA fuses the epilogue natively)."""
+    from ....ops._registry import eager
+
+    def raw(xa, ya, ba=None):
+        if transpose_x:
+            xa = jnp.swapaxes(xa, -1, -2)
+        if transpose_y:
+            ya = jnp.swapaxes(ya, -1, -2)
+        out = xa @ ya
+        return out if ba is None else out + ba
+
+    args = (x, y) if bias is None else (x, y, bias)
+    return eager(raw, args, {}, name="fused_matmul_bias")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, False, transpose_weight)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    from ....ops._registry import eager
+    act = {"gelu": jax.nn.gelu, "relu": lambda a: jnp.maximum(a, 0),
+           "none": lambda a: a}[activation]
+    return eager(lambda a: act(a), (out,), {},
+                 name="fused_linear_activation")
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y fused (phi fused_dropout_add)."""
+    from ....ops._registry import eager
+    from ....core import random as _r
+    if not training or p == 0.0:
+        return eager(lambda a, b: a + b, (x, y), {},
+                     name="fused_dropout_add")
+    key = _r.next_key()
+
+    def raw(a, b):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        if mode == "upscale_in_train":
+            a = jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        else:
+            a = jnp.where(keep, a, 0.0).astype(a.dtype)
+        return a + b
+
+    return eager(raw, (x, y), {}, name="fused_dropout_add")
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", name=None, **kw):
+    from ....ops._registry import eager
+    act = {"gelu": jax.nn.gelu, "relu": lambda a: jnp.maximum(a, 0),
+           "silu": jax.nn.silu, "swiglu": None}[act_method]
+    if act_method == "swiglu":
+        def raw(a, b=None):
+            if b is not None:
+                a = a + b
+            lo, hi = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(lo) * hi
+    else:
+        def raw(a, b=None):
+            if b is not None:
+                a = a + b
+            return act(a)
+    args = (x,) if bias is None else (x, bias)
+    return eager(raw, args, {}, name="fused_bias_act")
+
+
+__all__ += ["swiglu", "fused_matmul_bias", "fused_linear",
+            "fused_linear_activation", "fused_dropout_add",
+            "fused_bias_act"]
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False, name=None,
+                               **kw):
+    """Decode-phase fused attention: one new token's qkv [B, 3*H*D]
+    against a [2, B, H, T, D] cache (the reference's generation kernel).
+    Returns (out, new_cache_kv)."""
+    from ....ops._registry import eager
+
+    seq_lens = None if sequence_lengths is None else \
+        (sequence_lengths._data if hasattr(sequence_lengths, "_data")
+         else jnp.asarray(sequence_lengths))
+
+    def raw(xa, cache):
+        B = xa.shape[0]
+        H, T, D = cache.shape[2], cache.shape[3], cache.shape[4]
+        qkv = xa.reshape(B, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        if seq_lens is not None:
+            # the reference contract: sequence_lengths[b] = tokens already
+            # cached — the next slot index
+            pos = seq_lens.reshape(-1).astype(jnp.int32)
+        else:
+            # fallback: first all-zero slot (caveat: an exactly-zero stored
+            # key miscounts — pass sequence_lengths to be exact)
+            filled = jnp.any(cache[0] != 0, axis=(1, 3))      # [B, T]
+            pos = jnp.sum(filled.astype(jnp.int32), axis=1)   # [B]
+        bidx = jnp.arange(B)
+        ck = cache[0].at[bidx, :, pos].set(k)
+        cv = cache[1].at[bidx, :, pos].set(v)
+        live = jnp.arange(T)[None, :] <= pos[:, None]     # [B, T]
+        s = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32),
+                       ck.astype(jnp.float32)) / jnp.sqrt(float(D))
+        s = jnp.where(live[:, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bht,bhtd->bhd", p, cv.astype(jnp.float32))
+        return o.reshape(B, H * D).astype(xa.dtype), jnp.stack([ck, cv])
+
+    return eager(raw, (x, cache_kv), {},
+                 name="masked_multihead_attention")
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            out_weights, out_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, **kw):
+    """Multi-layer fused transformer (inference): sequential pre-LN blocks
+    over the packed per-layer weight lists."""
+    from ....nn import functional as F
+    h = x
+    for i in range(len(qkv_weights)):
+        a = fused_layer_norm(h, ln_scales[i], ln_biases[i])
+        import paddle_tpu as paddle
+        qw = qkv_weights[i]
+        if len(qw.shape) == 4:
+            # reference layout [3, num_head, dim_head, dim_embed]
+            nh, hd = int(qw.shape[1]), int(qw.shape[2])
+            qw = paddle.reshape(paddle.transpose(qw, [3, 0, 1, 2]),
+                                [int(qw.shape[3]), 3 * nh * hd])
+            qb = paddle.reshape(qkv_biases[i], [3 * nh * hd]) \
+                if qkv_biases[i] is not None else None
+        else:
+            nh = kw.get("num_heads")
+            if not nh:
+                raise ValueError(
+                    "fused_multi_transformer with 2D qkv weights needs "
+                    "num_heads= (the reference's 4D [3, nh, hd, D] layout "
+                    "is inferred automatically)")
+            hd = int(qw.shape[-1]) // (3 * int(nh))
+            qb = qkv_biases[i]
+        qkv = fused_matmul_bias(a, qw, qb)
+        B, S = qkv.shape[0], qkv.shape[1]
+        qkv_r = paddle.reshape(qkv, [B, S, 3, nh, hd])
+        q, k, v = (paddle.squeeze(t, 2) for t in
+                   paddle.split(qkv_r, 3, axis=2))
+        o = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        o = paddle.reshape(o, [B, S, nh * hd])
+        h = h + fused_matmul_bias(o, out_weights[i], out_biases[i])
+        a = fused_layer_norm(h, ffn_ln_scales[i], ffn_ln_biases[i])
+        a = fused_linear_activation(a, ffn1_weights[i], ffn1_biases[i],
+                                    activation="gelu")
+        h = h + fused_matmul_bias(a, ffn2_weights[i], ffn2_biases[i])
+    return h
+
+
+def fused_gate_attention(query, key=None, query_weight=None,
+                         key_weight=None, value_weight=None,
+                         qkv_weight=None, gate_linear_weight=None,
+                         gate_linear_bias=None, out_linear_weight=None,
+                         out_linear_bias=None, nonbatched_bias=None,
+                         attn_mask=None, has_gating=True, **kw):
+    """AlphaFold-style gated attention (fused_gate_attention kernel),
+    composed from the framework's fused primitives."""
+    import paddle_tpu as paddle
+    from ....nn import functional as F
+    q = paddle.matmul(query, query_weight) if query_weight is not None \
+        else query
+    k = paddle.matmul(key if key is not None else query, key_weight) \
+        if key_weight is not None else (key if key is not None else query)
+    v = paddle.matmul(key if key is not None else query, value_weight) \
+        if value_weight is not None else k
+    o = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask)
+    if has_gating and gate_linear_weight is not None:
+        g = fused_matmul_bias(query, gate_linear_weight, gate_linear_bias)
+        o = o * F.sigmoid(g)
+    if out_linear_weight is not None:
+        o = fused_matmul_bias(o, out_linear_weight, out_linear_bias)
+    return o
+
+
+def sparse_attention(query, key, value, sparse_csr_offset=None,
+                     sparse_csr_columns=None, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """paddle.incubate.sparse_attention: attention restricted to a CSR
+    sparsity pattern (densified mask v1 — exact, not memory-sparse)."""
+    from ....ops._registry import eager
+
+    def raw(q, k, v, offs, cols):
+        B, H, S, D = q.shape
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / jnp.sqrt(float(D))
+
+        # dense mask from CSR offsets/columns per (b, h): entry j belongs
+        # to the row whose offset range contains j
+        def mask_one(off, col):
+            idx_row = jnp.searchsorted(off, jnp.arange(col.shape[0]),
+                                       side="right") - 1
+            return jnp.zeros((S, S), bool).at[idx_row, col].set(True)
+
+        m = jax.vmap(jax.vmap(mask_one))(offs, cols)
+        s = jnp.where(m, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    return eager(raw, (query, key, value, sparse_csr_offset,
+                       sparse_csr_columns), {}, name="sparse_attention")
+
+
+__all__ += ["masked_multihead_attention", "fused_multi_transformer",
+            "fused_gate_attention", "sparse_attention"]
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """paddle.incubate.nn.functional.fused_ec_moe: every-token dense MoE —
+    softmax(gate) over experts weighting each expert's 2-layer MLP."""
+    from ....ops._registry import eager
+    act = {"gelu": jax.nn.gelu, "relu": lambda a: jnp.maximum(a, 0)}[
+        act_type]
+
+    def raw(xa, ga, w0, b0, w1, b1):
+        p = jax.nn.softmax(ga.astype(jnp.float32), axis=-1)      # [B,S,E]
+        E, F = w0.shape[0], w0.shape[2]
+        D = w1.shape[2]
+        h = jnp.einsum("bsd,edf->bsef", xa.astype(jnp.float32),
+                       w0.astype(jnp.float32)) \
+            + b0.reshape(E, F)[None, None]      # paddle bias layout [E,1,F]
+        h = act(h)
+        o = jnp.einsum("bsef,efd->bsed", h, w1.astype(jnp.float32)) \
+            + b1.reshape(E, D)[None, None]
+        return jnp.einsum("bse,bsed->bsd", p, o).astype(xa.dtype)
+
+    return eager(raw, (x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
+                       bmm1_bias), {}, name="fused_ec_moe")
+
+
+__all__ += ["fused_ec_moe"]

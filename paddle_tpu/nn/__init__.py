@@ -22,6 +22,7 @@ from .layers_conv import (  # noqa: F401
     LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
     SyncBatchNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
     LocalResponseNorm, SpectralNorm, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
+    LPPool1D, LPPool2D, FractionalMaxPool2D, FractionalMaxPool3D,
 )
 from .layers_act_loss import (  # noqa: F401
     ReLU, ReLU6, GELU, SiLU, Silu, Swish, ELU, SELU, CELU, LeakyReLU,
@@ -33,9 +34,12 @@ from .layers_act_loss import (  # noqa: F401
     TripletMarginWithDistanceLoss, CosineEmbeddingLoss, HingeEmbeddingLoss,
     HuberLoss, SoftMarginLoss, MultiLabelSoftMarginLoss, MultiMarginLoss,
     PoissonNLLLoss, GaussianNLLLoss, CTCLoss, AdaptiveLogSoftmaxWithLoss,
-    HSigmoidLoss,
+    HSigmoidLoss, GumbelSoftmax,
 )
 from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+# grad-clip classes live in paddle.nn too (reference re-export)
+from ..optimizer.optimizers import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue)
 from .layers_transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,

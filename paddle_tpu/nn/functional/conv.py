@@ -434,3 +434,94 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
                                               padding, output_size,
                                               data_format),
                  (x, indices), {}, name="max_unpool3d")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """paddle.nn.functional.lp_pool1d (3.0): Lp-norm pooling —
+    (sum |x|^p)^(1/p) over each window (avg-pool of x^p, rescaled)."""
+    p = float(norm_type)
+
+    def raw(a):
+        powed = jnp.abs(a.astype(jnp.float32)) ** p
+        pooled = _pool_raw(powed, kernel_size, stride, padding, 1, "avg",
+                           data_format, ceil_mode, count_include_pad=True)
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        return ((pooled * k) ** (1.0 / p)).astype(a.dtype)
+
+    return eager(raw, (x,), {}, name="lp_pool1d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+
+    def raw(a):
+        powed = jnp.abs(a.astype(jnp.float32)) ** p
+        pooled = _pool_raw(powed, kernel_size, stride, padding, 2, "avg",
+                           data_format, ceil_mode, count_include_pad=True)
+        ks = _ntuple(kernel_size, 2)
+        return ((pooled * (ks[0] * ks[1])) ** (1.0 / p)).astype(a.dtype)
+
+    return eager(raw, (x,), {}, name="lp_pool2d")
+
+
+def _fractional_pool(a, output_size, ndim, random_u):
+    """Fractional max pooling (Graham): pseudo-random window boundaries
+    from the u in (0,1) — deterministic per call via the framework RNG
+    unless random_u is given."""
+    spatial = a.shape[2:]
+    outs = _ntuple(output_size, ndim)
+    slices = []
+    for d in range(ndim):
+        n_in, n_out = spatial[d], outs[d]
+        alpha = n_in / n_out
+        u = random_u if random_u is not None else 0.5
+        idx = jnp.floor(alpha * (jnp.arange(n_out) + u)).astype(int)
+        starts = jnp.concatenate([jnp.zeros((1,), idx.dtype), idx[:-1]])
+        ends = idx.at[-1].set(n_in)
+        slices.append((starts, ends))
+
+    def pool_axis(arr, axis, starts, ends):
+        n_out = starts.shape[0]
+        segs = []
+        for i in range(n_out):
+            s, e = int(starts[i]), int(ends[i])
+            e = max(e, s + 1)
+            segs.append(jnp.max(arr.take(
+                jnp.arange(s, e), axis=axis), axis=axis, keepdims=True))
+        return jnp.concatenate(segs, axis=axis)
+
+    out = a
+    for d in range(ndim):
+        out = pool_axis(out, 2 + d, *slices[d])
+    return out
+
+
+def _fractional_u(random_u):
+    """The pseudo-random boundary offset: framework RNG when unset (the
+    stochastic pooling the op exists for; fixed per trace under jit,
+    fresh per call eagerly)."""
+    if random_u is not None:
+        return float(random_u)
+    import jax
+    from ...core import random as _r
+    return float(jax.random.uniform(_r.next_key(), (),
+                                    minval=0.05, maxval=0.95))
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """paddle.nn.functional.fractional_max_pool2d (3.0)."""
+    u = _fractional_u(random_u)
+    out = eager(lambda a: _fractional_pool(a, output_size, 2, u),
+                (x,), {}, name="fractional_max_pool2d")
+    return (out, None) if return_mask else out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    u = _fractional_u(random_u)
+    out = eager(lambda a: _fractional_pool(a, output_size, 3, u),
+                (x,), {}, name="fractional_max_pool3d")
+    return (out, None) if return_mask else out

@@ -421,3 +421,18 @@ class HSigmoidLoss(Layer):
     def forward(self, input, label):
         return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
                                self.bias)
+
+
+class GumbelSoftmax(Layer):
+    """paddle.nn.GumbelSoftmax — layer form of F.gumbel_softmax."""
+
+    def __init__(self, temperature=1.0, hard=False, axis=-1, name=None):
+        super().__init__()
+        self._temperature = temperature
+        self._hard = hard
+        self._axis = axis
+
+    def forward(self, x):
+        from . import functional as F
+        return F.gumbel_softmax(x, temperature=self._temperature,
+                                hard=self._hard, axis=self._axis)

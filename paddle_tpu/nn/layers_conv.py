@@ -499,3 +499,57 @@ class MaxUnPool3D(MaxUnPool1D):
     def forward(self, x, indices):
         return F.max_unpool3d(x, indices, self.k, self.s, self.p,
                               self.data_format, self.output_size)
+
+
+class LPPool1D(Layer):
+    """paddle.nn.LPPool1D (3.0) — Lp-norm pooling."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self._a = (norm_type, kernel_size, stride, padding, ceil_mode,
+                   data_format)
+
+    def forward(self, x):
+        from .functional.conv import lp_pool1d
+        n, k, s, p, c, df = self._a
+        return lp_pool1d(x, n, k, s, p, c, df)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self._a = (norm_type, kernel_size, stride, padding, ceil_mode,
+                   data_format)
+
+    def forward(self, x):
+        from .functional.conv import lp_pool2d
+        n, k, s, p, c, df = self._a
+        return lp_pool2d(x, n, k, s, p, c, df)
+
+
+class FractionalMaxPool2D(Layer):
+    """paddle.nn.FractionalMaxPool2D (3.0)."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        from .functional.conv import fractional_max_pool2d
+        o, k, u, m = self._a
+        return fractional_max_pool2d(x, o, k, u, m)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        from .functional.conv import fractional_max_pool3d
+        o, k, u, m = self._a
+        return fractional_max_pool3d(x, o, k, u, m)

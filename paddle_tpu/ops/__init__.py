@@ -63,7 +63,7 @@ _INPLACE = [
     "atanh", "expm1", "log2", "log10", "log1p", "square",
     # masking / clamping / rounding
     "trunc", "frac", "nan_to_num", "logit", "renorm", "copysign", "hypot",
-    "i0", "ldexp", "digamma", "lgamma", "polygamma", "gamma",
+    "i0", "ldexp", "digamma", "lgamma", "polygamma", "gamma", "erf",
     # comparison / logical / bitwise inplace (2.6)
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "logical_and", "logical_or", "logical_xor",
@@ -214,7 +214,9 @@ def register_surface(module, prefix: str = "") -> int:
     once nn.functional exists (importing it here would be circular).
     setdefault: ops already registered by defop keep their entry."""
     n = 0
-    _machinery = ("paddle_tpu.ops._registry", "paddle_tpu.core.tensor")
+    _machinery = ("paddle_tpu.ops._registry", "paddle_tpu.core.tensor",
+                  "paddle_tpu.core.flags", "paddle_tpu.core.dtype",
+                  "paddle_tpu.core.device")
     for name in dir(module):
         if name.startswith("_"):
             continue
@@ -229,5 +231,26 @@ def register_surface(module, prefix: str = "") -> int:
     return n
 
 
-
+# the list-input/manipulation ops (concat, split, stack, where, nonzero,
+# unique, ...) are defined as plain eager() callers — count them into the
+# registry like every defop (they ARE ops.yaml entries in the reference)
 register_surface(creation)
+register_surface(manipulation)
+register_surface(math)
+register_surface(reduction)
+register_surface(comparison)
+register_surface(linalg)
+REGISTRY.setdefault("fft.fftfreq", linalg.fft.fftfreq)
+REGISTRY.setdefault("fft.rfftfreq", linalg.fft.rfftfreq)
+
+# round-3 breadth families (VERDICT r2 next 3): detection, sequence_*,
+# AMP/optimizer-step kernels — defop-registered at import and exported
+# into the functional namespace (their reference homes re-export them:
+# vision.ops for detection, fluid.layers for sequence_*)
+from . import detection, sequence, train_ops  # noqa: F401,E402
+
+for _m in (detection, sequence, train_ops):
+    for _n in dir(_m):
+        if not _n.startswith("_") and _n in REGISTRY and _n not in globals():
+            globals()[_n] = getattr(_m, _n)
+del _m, _n

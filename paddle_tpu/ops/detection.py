@@ -1,0 +1,494 @@
+"""Detection ops — the reference's detection op family.
+
+Reference analog: paddle/phi/kernels detection ops + paddle.vision.ops
+(box_coder, prior_box, yolo_box, iou_similarity, matrix_nms, ... —
+upstream-canonical, unverified, SURVEY.md §0; §2.1 'PHI CPU kernels'
+row). TPU-native: pure jnp formulas with STATIC shapes — selection ops
+(nms-style) return fixed-size padded outputs + valid counts instead of
+the reference's dynamic LoD outputs, the standard XLA detection idiom.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._registry import defop, eager, as_array
+
+
+def _iou_matrix(a, b):
+    """a [N,4], b [M,4] xyxy → IoU [N, M] (f32)."""
+    a = a.astype(jnp.float32)
+    b = b.astype(jnp.float32)
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-10)
+
+
+iou_similarity = defop(
+    "iou_similarity", lambda x, y, name=None: _iou_matrix(x, y))
+
+
+def _box_clip(inp, im_info):
+    """Clip [N, 4] xyxy boxes to image bounds [h, w(, scale)]."""
+    h = im_info[..., 0] - 1.0
+    w = im_info[..., 1] - 1.0
+    return jnp.stack([
+        jnp.clip(inp[..., 0], 0, w), jnp.clip(inp[..., 1], 0, h),
+        jnp.clip(inp[..., 2], 0, w), jnp.clip(inp[..., 3], 0, h)], axis=-1)
+
+
+box_clip = defop("box_clip", lambda inp, im_info, name=None:
+                 _box_clip(inp, as_array(im_info)))
+
+
+def _box_coder(prior_box, prior_box_var, target_box, code_type,
+               box_normalized, axis):
+    pb = prior_box.astype(jnp.float32)
+    tb = target_box.astype(jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    px = (pb[:, 0] + pb[:, 2]) / 2
+    py = (pb[:, 1] + pb[:, 3]) / 2
+    var = (jnp.ones((pb.shape[0], 4), jnp.float32)
+           if prior_box_var is None else
+           jnp.broadcast_to(jnp.asarray(prior_box_var, jnp.float32),
+                            (pb.shape[0], 4)))
+    if code_type in ("encode_center_size", "encode"):
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tx = (tb[:, 0] + tb[:, 2]) / 2
+        ty = (tb[:, 1] + tb[:, 3]) / 2
+        out = jnp.stack([
+            (tx[:, None] - px[None]) / pw[None],
+            (ty[:, None] - py[None]) / ph[None],
+            jnp.log(jnp.maximum(tw[:, None] / pw[None], 1e-10)),
+            jnp.log(jnp.maximum(th[:, None] / ph[None], 1e-10)),
+        ], axis=-1) / var[None]
+        return out
+    # decode_center_size: tb [N, M, 4] deltas against priors on `axis`
+    if tb.ndim == 2:
+        tb = tb[:, None, :]
+    exp = (lambda a: a[None]) if axis == 0 else (lambda a: a[:, None])
+    dx, dy, dw, dh = (tb[..., i] * exp(var[:, i]) for i in range(4))
+    ox = dx * exp(pw) + exp(px)
+    oy = dy * exp(ph) + exp(py)
+    ow = jnp.exp(dw) * exp(pw)
+    oh = jnp.exp(dh) * exp(ph)
+    return jnp.stack([ox - ow / 2 + norm / 2, oy - oh / 2 + norm / 2,
+                      ox + ow / 2 - norm / 2, oy + oh / 2 - norm / 2],
+                     axis=-1)
+
+
+def box_coder(prior_box, prior_box_var=None, target_box=None,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """paddle.vision.ops.box_coder parity."""
+    var = prior_box_var._data if hasattr(prior_box_var, "_data") else \
+        prior_box_var
+    return eager(
+        lambda pb, tb: _box_coder(pb, var, tb, code_type, box_normalized,
+                                  axis),
+        (prior_box, target_box), {}, name="box_coder")
+
+
+from ._registry import REGISTRY
+REGISTRY.setdefault("box_coder", box_coder)
+
+
+def _prior_box(inp_shape, image_shape, min_sizes, max_sizes, aspect_ratios,
+               variances, flip, clip, steps, offset, min_max_aspect_ratios_order):
+    """Anchor/prior generation (SSD-style): [H, W, P, 4] boxes + vars."""
+    h, w = inp_shape[2], inp_shape[3]
+    img_h, img_w = image_shape[2], image_shape[3]
+    step_w = steps[0] or img_w / w
+    step_h = steps[1] or img_h / h
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        sizes = []
+        for ar in ars:
+            sizes.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        if max_sizes:
+            bs = math.sqrt(ms * max_sizes[ms_i])
+            sizes.insert(1, (bs, bs))
+        boxes.extend(sizes)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    cx = (xx + offset) * step_w
+    cy = (yy + offset) * step_h
+    out = np.zeros((h, w, len(boxes), 4), np.float32)
+    for i, (bw, bh) in enumerate(boxes):
+        out[..., i, 0] = (cx - bw / 2) / img_w
+        out[..., i, 1] = (cy - bh / 2) / img_h
+        out[..., i, 2] = (cx + bw / 2) / img_w
+        out[..., i, 3] = (cy + bh / 2) / img_h
+    if clip:
+        out = np.clip(out, 0, 1)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          out.shape).copy()
+    return jnp.asarray(out), jnp.asarray(var)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    from ..core.tensor import Tensor
+    b, v = _prior_box(tuple(as_array(input).shape),
+                      tuple(as_array(image).shape),
+                      list(min_sizes), list(max_sizes or []),
+                      list(aspect_ratios), list(variance), flip, clip,
+                      list(steps), offset, min_max_aspect_ratios_order)
+    return Tensor(b), Tensor(v)
+
+
+REGISTRY.setdefault("prior_box", prior_box)
+
+
+def _yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+              clip_bbox, scale_x_y):
+    """YOLO head decode: x [N, A*(5+C), H, W] → (boxes [N, A*H*W, 4],
+    scores [N, A*H*W, C])."""
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    x = x.reshape(n, na, 5 + class_num, h, w).astype(jnp.float32)
+    gy, gx = jnp.mgrid[0:h, 0:w]
+    bias = (scale_x_y - 1.0) / 2.0
+    cx = (jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - bias + gx[None, None]) / w
+    cy = (jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - bias + gy[None, None]) / h
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / (
+        downsample_ratio * w)
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / (
+        downsample_ratio * h)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (cx - bw / 2) * img_w
+    y1 = (cy - bh / 2) * img_h
+    x2 = (cx + bw / 2) * img_w
+    y2 = (cy + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+    keep = (conf > conf_thresh)[..., None]
+    scores = jnp.where(keep, probs.transpose(0, 1, 3, 4, 2),
+                       0.0).reshape(n, -1, class_num)
+    boxes = jnp.where((conf > conf_thresh).reshape(n, -1, 1), boxes, 0.0)
+    return boxes, scores
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0, name=None,
+             iou_aware=False, iou_aware_factor=0.5):
+    return eager(
+        lambda xx, sz: _yolo_box(xx, sz, list(anchors), class_num,
+                                 conf_thresh, downsample_ratio, clip_bbox,
+                                 scale_x_y),
+        (x, img_size), {}, name="yolo_box")
+
+
+REGISTRY.setdefault("yolo_box", yolo_box)
+
+
+def _matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+                keep_top_k, use_gaussian, gaussian_sigma):
+    """Matrix NMS (SOLOv2): decay scores by overlap with higher-scored
+    same-class boxes — one [B] batch entry, static [keep_top_k] output."""
+    C, N = scores.shape
+    flat_scores = scores.reshape(-1)
+    # pre-NMS filter: sub-threshold boxes neither decay others nor appear
+    flat_scores = jnp.where(flat_scores >= score_threshold, flat_scores,
+                            0.0)
+    flat_cls = jnp.repeat(jnp.arange(C), N)
+    flat_box = jnp.tile(jnp.arange(N), C)
+    k = min(nms_top_k if nms_top_k > 0 else N * C, N * C)
+    top_s, top_i = jax.lax.top_k(flat_scores, k)
+    cls = flat_cls[top_i]
+    box = bboxes[flat_box[top_i]]
+    iou = _iou_matrix(box, box)
+    same = (cls[:, None] == cls[None, :]).astype(jnp.float32)
+    higher = (jnp.arange(k)[:, None] > jnp.arange(k)[None, :]).astype(
+        jnp.float32)
+    ious = iou * same * higher                      # [k, k]
+    max_iou = jnp.max(ious, axis=1)
+    if use_gaussian:
+        decay = jnp.min(jnp.where(
+            (same * higher) > 0,
+            jnp.exp(-(ious ** 2 - max_iou[None, :] ** 2) / gaussian_sigma),
+            1.0), axis=1)
+    else:
+        decay = jnp.min(jnp.where((same * higher) > 0,
+                                  (1 - ious) / (1 - max_iou[None, :]),
+                                  1.0), axis=1)
+    dec_s = top_s * decay
+    dec_s = jnp.where(dec_s >= post_threshold, dec_s, 0.0)
+    kk = min(keep_top_k if keep_top_k > 0 else k, k)
+    out_s, oi = jax.lax.top_k(dec_s, kk)
+    out = jnp.concatenate([
+        cls[oi].astype(jnp.float32)[:, None], out_s[:, None], box[oi]],
+        axis=1)
+    valid = jnp.sum((out_s > 0).astype(jnp.int32))
+    return out, oi.astype(jnp.int32), valid
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """paddle.vision.ops.matrix_nms (static-shape: [B, keep_top_k, 6]
+    padded outputs + per-image valid counts)."""
+    def raw(bb, sc):
+        out, idx, valid = jax.vmap(
+            lambda b, s: _matrix_nms(b, s, score_threshold, post_threshold,
+                                     nms_top_k, keep_top_k, use_gaussian,
+                                     gaussian_sigma))(bb, sc)
+        return out, idx, valid
+
+    out = eager(raw, (bboxes, scores), {}, name="matrix_nms")
+    res = [out[0]]
+    if return_index:
+        res.append(out[1])
+    if return_rois_num:
+        res.append(out[2])
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+REGISTRY.setdefault("matrix_nms", matrix_nms)
+
+
+def _psroi_pool(x, boxes, box_nums, output_size, spatial_scale, C_out):
+    """Position-sensitive RoI pooling: x [N, C_out*ps*ps, H, W],
+    boxes [R, 4] → [R, C_out, ps, ps] (boxes all on image 0 when
+    box_nums is None — single-image static case)."""
+    ps = output_size
+    N, C, H, W = x.shape
+
+    def one(box):
+        x1, y1, x2, y2 = box * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1) / ps
+        rw = jnp.maximum(x2 - x1, 0.1) / ps
+
+        def cell(ci, py, px):
+            ys = jnp.clip(jnp.floor(y1 + py * rh), 0, H - 1).astype(int)
+            ye = jnp.clip(jnp.ceil(y1 + (py + 1) * rh), 1, H).astype(int)
+            xs = jnp.clip(jnp.floor(x1 + px * rw), 0, W - 1).astype(int)
+            xe = jnp.clip(jnp.ceil(x1 + (px + 1) * rw), 1, W).astype(int)
+            chan = ci * ps * ps + py * ps + px
+            yy = jnp.arange(H)
+            xx = jnp.arange(W)
+            m = ((yy[:, None] >= ys) & (yy[:, None] < ye) &
+                 (xx[None, :] >= xs) & (xx[None, :] < xe))
+            cnt = jnp.maximum(jnp.sum(m), 1)
+            return jnp.sum(jnp.where(m, x[0, chan], 0.0)) / cnt
+
+        ci_g, py_g, px_g = jnp.mgrid[0:C_out, 0:ps, 0:ps]
+        return jax.vmap(lambda c, a, b: cell(c, a, b))(
+            ci_g.reshape(-1), py_g.reshape(-1), px_g.reshape(-1)
+        ).reshape(C_out, ps, ps)
+
+    return jax.vmap(one)(boxes.astype(jnp.float32))
+
+
+def psroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+               name=None):
+    C = as_array(x).shape[1]
+    ps = output_size if isinstance(output_size, int) else output_size[0]
+    C_out = C // (ps * ps)
+    return eager(lambda xx, bb: _psroi_pool(xx, bb, None, ps,
+                                            spatial_scale, C_out),
+                 (x, boxes), {}, name="psroi_pool")
+
+
+REGISTRY.setdefault("psroi_pool", psroi_pool)
+
+
+def _multiclass_nms3(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                     nms_threshold, normalized, background_label):
+    """multiclass_nms3: hard-NMS per class, static padded output
+    [keep_top_k, 6] + valid count, one batch entry."""
+    C, N = scores.shape
+
+    def per_class(c_scores):
+        s = jnp.where(c_scores > score_threshold, c_scores, 0.0)
+        k = min(nms_top_k if nms_top_k > 0 else N, N)
+        top_s, top_i = jax.lax.top_k(s, k)
+        box = bboxes[top_i]
+        iou = _iou_matrix(box, box)
+
+        def body(keep, i):
+            # suppressed iff it overlaps an already-KEPT earlier box
+            sup = jnp.any((jnp.where(jnp.arange(k) < i, iou[i], 0.0)
+                           * keep) > nms_threshold)
+            keep = keep.at[i].set(jnp.where(
+                (top_s[i] > 0) & ~sup, 1.0, 0.0))
+            return keep, None
+
+        keep, _ = jax.lax.scan(body, jnp.zeros((k,)), jnp.arange(k))
+        return top_s * keep, top_i
+
+    cs, ci = jax.vmap(per_class)(scores)
+    flat_s = cs.reshape(-1)
+    flat_cls = jnp.repeat(jnp.arange(C), cs.shape[1])
+    flat_idx = ci.reshape(-1)
+    kk = min(keep_top_k if keep_top_k > 0 else flat_s.shape[0],
+             flat_s.shape[0])
+    out_s, oi = jax.lax.top_k(flat_s, kk)
+    out = jnp.concatenate([
+        flat_cls[oi].astype(jnp.float32)[:, None], out_s[:, None],
+        bboxes[flat_idx[oi]]], axis=1)
+    valid = jnp.sum((out_s > 0).astype(jnp.int32))
+    return out, flat_idx[oi].astype(jnp.int32), valid
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=-1,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=-1, return_index=False,
+                   return_rois_num=True, rois_num=None, name=None):
+    """paddle.vision.ops.multiclass_nms parity, static-shape outputs."""
+    def raw(bb, sc):
+        return jax.vmap(lambda b, s: _multiclass_nms3(
+            b, s, score_threshold, nms_top_k, keep_top_k, nms_threshold,
+            normalized, background_label))(bb, sc)
+
+    out = eager(raw, (bboxes, scores), {}, name="multiclass_nms")
+    res = [out[0]]
+    if return_index:
+        res.append(out[1])
+    if return_rois_num:
+        res.append(out[2])
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+REGISTRY.setdefault("multiclass_nms", multiclass_nms)
+
+
+def _anchor_generator(inp_shape, anchor_sizes, aspect_ratios, variances,
+                      stride, offset):
+    h, w = inp_shape[2], inp_shape[3]
+    boxes = []
+    for ar in aspect_ratios:
+        for s in anchor_sizes:
+            bw = s / math.sqrt(ar)
+            bh = s * math.sqrt(ar)
+            boxes.append((bw, bh))
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    cx = (xx + offset) * stride[0]
+    cy = (yy + offset) * stride[1]
+    out = np.zeros((h, w, len(boxes), 4), np.float32)
+    for i, (bw, bh) in enumerate(boxes):
+        out[..., i] = np.stack([cx - bw / 2, cy - bh / 2,
+                                cx + bw / 2, cy + bh / 2], axis=-1)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          out.shape).copy()
+    return jnp.asarray(out), jnp.asarray(var)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
+                     stride, offset=0.5, name=None):
+    """The RPN anchor_generator op (reference: detection op family)."""
+    from ..core.tensor import Tensor
+    b, v = _anchor_generator(tuple(as_array(input).shape),
+                             list(anchor_sizes), list(aspect_ratios),
+                             list(variances), list(stride), offset)
+    return Tensor(b), Tensor(v)
+
+
+REGISTRY.setdefault("anchor_generator", anchor_generator)
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """SSD density prior box op."""
+    from ..core.tensor import Tensor
+    ish = tuple(as_array(input).shape)
+    img = tuple(as_array(image).shape)
+    h, w = ish[2], ish[3]
+    img_h, img_w = img[2], img[3]
+    step_w = steps[0] or img_w / w
+    step_h = steps[1] or img_h / h
+    boxes = []
+    for density, fs in zip(densities, fixed_sizes):
+        for fr in fixed_ratios:
+            bw = fs * math.sqrt(fr)
+            bh = fs / math.sqrt(fr)
+            shift = fs / density
+            for di in range(density):
+                for dj in range(density):
+                    ox = (dj + 0.5) * shift - fs / 2
+                    oy = (di + 0.5) * shift - fs / 2
+                    boxes.append((bw, bh, ox, oy))
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    cx = (xx + offset) * step_w
+    cy = (yy + offset) * step_h
+    out = np.zeros((h, w, len(boxes), 4), np.float32)
+    for i, (bw, bh, ox, oy) in enumerate(boxes):
+        out[..., i, 0] = (cx + ox - bw / 2) / img_w
+        out[..., i, 1] = (cy + oy - bh / 2) / img_h
+        out[..., i, 2] = (cx + ox + bw / 2) / img_w
+        out[..., i, 3] = (cy + oy + bh / 2) / img_h
+    if clip:
+        out = np.clip(out, 0, 1)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    if flatten_to_2d:
+        out = out.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    from ..core.tensor import Tensor
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+REGISTRY.setdefault("density_prior_box", density_prior_box)
+
+
+def _bipartite_match(dist):
+    """Greedy bipartite matching (reference bipartite_match op): for each
+    column, the best unmatched row — static greedy sweep over rows sorted
+    by best score."""
+    R, C = dist.shape
+
+    def body(carry, _):
+        row_match, col_match, d = carry
+        flat = jnp.argmax(d)
+        r = (flat // C).astype(jnp.int32)
+        c = (flat % C).astype(jnp.int32)
+        ok = d[r, c] > 0
+        row_match = jnp.where(ok, row_match.at[r].set(c), row_match)
+        col_match = jnp.where(ok, col_match.at[c].set(r), col_match)
+        d = jnp.where(ok, d.at[r, :].set(-1.0).at[:, c].set(-1.0), d)
+        return (row_match, col_match, d), None
+
+    n = min(R, C)
+    (rm, cm, _), _ = jax.lax.scan(
+        body, (jnp.full((R,), -1, jnp.int32), jnp.full((C,), -1, jnp.int32),
+               dist.astype(jnp.float32)), None, length=n)
+    matched_dist = jnp.where(
+        cm >= 0, dist[jnp.clip(cm, 0), jnp.arange(C)], 0.0)
+    return cm, matched_dist
+
+
+bipartite_match = defop(
+    "bipartite_match", lambda dist_matrix, match_type="bipartite",
+    dist_threshold=0.5, name=None: _bipartite_match(dist_matrix))

@@ -1,0 +1,191 @@
+"""Sequence ops — the reference's sequence_* op family, static-shape.
+
+Reference analog: the paddle/fluid sequence operators
+(sequence_pad/unpad/pool/softmax/reverse/expand/first_step/last_step —
+upstream-canonical, unverified, SURVEY.md §0; §2.1 'PHI CPU kernels').
+The reference drives these with LoD (ragged) tensors; the TPU-native
+encoding is the standard (data, lengths) pair over PADDED static shapes
+— every op takes an explicit `length` [B] int tensor where the
+reference reads LoD, and masks/indexes with it. sequence_mask (already
+in the table) is the shared primitive.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._registry import REGISTRY, defop, eager, as_array
+
+NEG_INF = -1e30
+
+
+def _mask(length, maxlen):
+    return jnp.arange(maxlen)[None, :] < length[:, None]
+
+
+def _seq_pad(x, pad_value, maxlen, length):
+    """x [B, T, ...] padded rows beyond length become pad_value; crops or
+    pads time to maxlen when given."""
+    B, T = x.shape[0], x.shape[1]
+    tgt = maxlen if maxlen is not None else T
+    if tgt > T:
+        pad = [(0, 0), (0, tgt - T)] + [(0, 0)] * (x.ndim - 2)
+        x = jnp.pad(x, pad)
+    elif tgt < T:
+        x = x[:, :tgt]
+    m = _mask(length, tgt).reshape(
+        (B, tgt) + (1,) * (x.ndim - 2))
+    return jnp.where(m, x, jnp.asarray(pad_value, x.dtype))
+
+
+sequence_pad = defop(
+    "sequence_pad",
+    lambda x, length, pad_value=0.0, maxlen=None, name=None:
+    _seq_pad(x, pad_value, maxlen, as_array(length)))
+
+
+def _seq_unpad(x, length):
+    """Inverse of pad for the static world: zero the padded tail (the
+    ragged concatenation of the reference has no static-shape analog, so
+    unpad == re-mask; lengths ride alongside)."""
+    return _seq_pad(x, 0.0, None, length)
+
+
+sequence_unpad = defop(
+    "sequence_unpad", lambda x, length, name=None:
+    _seq_unpad(x, as_array(length)))
+
+
+def _seq_pool(x, length, pool_type):
+    m = _mask(length, x.shape[1]).reshape(
+        (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2))
+    lf = jnp.maximum(length.astype(jnp.float32), 1.0).reshape(
+        (-1,) + (1,) * (x.ndim - 2))
+    if pool_type in ("sum", "SUM"):
+        return jnp.sum(jnp.where(m, x, 0), axis=1)
+    if pool_type in ("average", "AVERAGE", "mean"):
+        return jnp.sum(jnp.where(m, x, 0), axis=1) / lf
+    if pool_type in ("sqrt", "SQRT"):
+        return jnp.sum(jnp.where(m, x, 0), axis=1) / jnp.sqrt(lf)
+    if pool_type in ("max", "MAX"):
+        return jnp.max(jnp.where(m, x, NEG_INF), axis=1)
+    if pool_type in ("last", "LAST"):
+        idx = jnp.maximum(length - 1, 0)
+        return jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    if pool_type in ("first", "FIRST"):
+        return x[:, 0]
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+sequence_pool = defop(
+    "sequence_pool", lambda x, length, pool_type="average", name=None:
+    _seq_pool(x, as_array(length), pool_type))
+
+sequence_first_step = defop(
+    "sequence_first_step", lambda x, length=None, name=None: x[:, 0])
+
+sequence_last_step = defop(
+    "sequence_last_step", lambda x, length, name=None:
+    _seq_pool(x, as_array(length), "last"))
+
+
+def _seq_softmax(x, length):
+    m = _mask(length, x.shape[1]).reshape(
+        (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2))
+    z = jnp.where(m, x.astype(jnp.float32), NEG_INF)
+    out = jax.nn.softmax(z, axis=1)
+    return jnp.where(m, out, 0.0).astype(x.dtype)
+
+
+sequence_softmax = defop(
+    "sequence_softmax", lambda x, length, name=None:
+    _seq_softmax(x, as_array(length)))
+
+
+def _seq_reverse(x, length):
+    """Reverse each row's VALID prefix, padding stays in place."""
+    T = x.shape[1]
+    idx = jnp.arange(T)[None, :]
+    rev = length[:, None] - 1 - idx
+    src = jnp.where(idx < length[:, None], rev, idx)
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+sequence_reverse = defop(
+    "sequence_reverse", lambda x, length, name=None:
+    _seq_reverse(x, as_array(length)))
+
+
+def _seq_expand(x, y_length, maxlen):
+    """sequence_expand: repeat row b of x y_length[b] times along a new
+    time axis (static: broadcast to [B, maxlen, ...] + mask; maxlen
+    defaults to max(y_length), which requires concrete lengths — pass
+    maxlen explicitly under jit)."""
+    T = int(y_length.max()) if maxlen is None else int(maxlen)
+    out = jnp.repeat(x[:, None], T, axis=1)
+    return _seq_pad(out, 0.0, None, y_length)
+
+
+sequence_expand = defop(
+    "sequence_expand", lambda x, y_length, maxlen=None, name=None:
+    _seq_expand(x, as_array(y_length), maxlen))
+
+
+def _seq_conv(x, length, filt, stride=1):
+    """sequence_conv: 1D conv over time with context window = filter
+    rows / input dim, masked to valid steps. x [B, T, D], filt
+    [ctx*D, F]."""
+    B, T, D = x.shape
+    ctx = filt.shape[0] // D
+    pad_lo = (ctx - 1) // 2
+    xp = jnp.pad(x, ((0, 0), (pad_lo, ctx - 1 - pad_lo), (0, 0)))
+    cols = jnp.stack([xp[:, i:i + T] for i in range(ctx)], axis=2)
+    cols = cols.reshape(B, T, ctx * D)
+    out = cols @ filt
+    m = _mask(length, T)[..., None]
+    return jnp.where(m, out, 0.0)
+
+
+sequence_conv = defop(
+    "sequence_conv", lambda x, length, filter, stride=1, name=None:
+    _seq_conv(x, as_array(length), filter, stride))
+
+
+def sequence_concat(inputs, name=None):
+    """Concatenate along time (static: plain concat; lengths add)."""
+    return eager(lambda *xs: jnp.concatenate(xs, axis=1), tuple(inputs),
+                 {}, name="sequence_concat")
+
+
+REGISTRY.setdefault("sequence_concat", sequence_concat)
+
+
+def _seq_slice(x, offset, length_arg):
+    T = x.shape[1]
+    idx = offset[:, None] + jnp.arange(T)[None, :]
+    idx = jnp.clip(idx, 0, T - 1)
+    out = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    return _seq_pad(out, 0.0, None, length_arg)
+
+
+sequence_slice = defop(
+    "sequence_slice", lambda x, offset, length, name=None:
+    _seq_slice(x, as_array(offset), as_array(length)))
+
+
+def _seq_enumerate(x, win_size, pad_value):
+    T = x.shape[-1]
+    idx = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]
+    ok = idx < T
+    safe = jnp.clip(idx, 0, T - 1)
+    out = x[..., safe]
+    return jnp.where(ok, out, jnp.asarray(pad_value, x.dtype))
+
+
+sequence_enumerate = defop(
+    "sequence_enumerate", lambda x, win_size, pad_value=0, name=None:
+    _seq_enumerate(x, win_size, pad_value))

@@ -1,0 +1,322 @@
+"""Training-infrastructure ops: AMP scaling + optimizer-step kernels.
+
+Reference analog: the PHI kernels behind mixed precision
+(check_finite_and_unscale, update_loss_scaling — paddle/phi/kernels/
+gpu/amp_kernel.cu) and the per-optimizer fused update kernels
+(sgd_kernel, momentum, adam, adamw, adagrad, adadelta, adamax, rmsprop,
+lamb — SURVEY.md §2.1 'PHI CPU kernels' ~800-op row; §3.1's
+`adamw_ad_func → fused AdamWKernel`). Upstream-canonical, unverified §0.
+
+TPU-native: each is a pure jnp function (param, grad, state..., hyper)
+→ (new param, new state...); the eager optimizer classes jit per leaf,
+and these op forms expose the same kernels functionally — XLA fuses the
+elementwise chains exactly like the reference's fused CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._registry import REGISTRY, defop, eager
+
+
+# ---------------------------------------------------------------------------
+# AMP ops
+# ---------------------------------------------------------------------------
+
+def check_finite_and_unscale(xs, scale, name=None):
+    """(grads list, scale) → (unscaled grads, found_inf[1] bool)."""
+    arrs = list(xs)
+
+    def raw(s, *gs):
+        inv = 1.0 / s
+        outs = tuple(g * inv.astype(g.dtype) for g in gs)
+        finite = jnp.stack([jnp.all(jnp.isfinite(
+            g.astype(jnp.float32))) for g in gs])
+        return outs + (~jnp.all(finite).reshape(1),)
+
+    res = eager(raw, (scale,) + tuple(arrs), {},
+                name="check_finite_and_unscale")
+    return list(res[:-1]), res[-1]
+
+
+REGISTRY.setdefault("check_finite_and_unscale", check_finite_and_unscale)
+
+
+def _update_loss_scaling(scale, good, bad, found_inf, incr_every,
+                         decr_every, incr_ratio, decr_ratio):
+    inf = found_inf.reshape(()).astype(bool)
+    bad2 = jnp.where(inf, bad + 1, 0)
+    good2 = jnp.where(inf, 0, good + 1)
+    grow = good2 >= incr_every
+    shrink = bad2 >= decr_every
+    scale2 = jnp.where(grow, scale * incr_ratio,
+                       jnp.where(shrink, scale * decr_ratio, scale))
+    scale2 = jnp.maximum(scale2, 1e-10)
+    return (scale2, jnp.where(grow, 0, good2).astype(good.dtype),
+            jnp.where(shrink, 0, bad2).astype(bad.dtype))
+
+
+update_loss_scaling = defop(
+    "update_loss_scaling",
+    lambda scale, good_steps, bad_steps, found_inf, incr_every_n_steps=1000,
+    decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.5, name=None:
+    _update_loss_scaling(scale, good_steps, bad_steps, found_inf,
+                         incr_every_n_steps, decr_every_n_nan_or_inf,
+                         incr_ratio, decr_ratio))
+
+
+# ---------------------------------------------------------------------------
+# Optimizer step kernels (functional `name_` forms like the PHI ops)
+# ---------------------------------------------------------------------------
+
+sgd_ = defop("sgd_", lambda param, grad, learning_rate=0.01, name=None:
+             param - learning_rate * grad.astype(param.dtype))
+
+
+def _momentum(p, g, v, lr, mu, use_nesterov):
+    v2 = mu * v + g
+    upd = (g + mu * v2) if use_nesterov else v2
+    return p - lr * upd.astype(p.dtype), v2
+
+
+momentum_ = defop(
+    "momentum_", lambda param, grad, velocity, learning_rate=0.01, mu=0.9,
+    use_nesterov=False, name=None:
+    _momentum(param, grad, velocity, learning_rate, mu, use_nesterov))
+
+
+def _adam(p, g, m, v, step, lr, b1, b2, eps):
+    g = g.astype(jnp.float32)
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    t = step.astype(jnp.float32)
+    mhat = m2 / (1 - b1 ** t)
+    vhat = v2 / (1 - b2 ** t)
+    return (p - (lr * mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype),
+            m2, v2, step + 1)
+
+
+adam_ = defop(
+    "adam_", lambda param, grad, moment1, moment2, step, learning_rate=1e-3,
+    beta1=0.9, beta2=0.999, epsilon=1e-8, name=None:
+    _adam(param, grad, moment1, moment2, step, learning_rate, beta1, beta2,
+          epsilon))
+
+
+def _adamw(p, g, m, v, step, lr, b1, b2, eps, wd):
+    p2, m2, v2, s2 = _adam(p, g, m, v, step, lr, b1, b2, eps)
+    return (p2 - (lr * wd) * p).astype(p.dtype), m2, v2, s2
+
+
+adamw_ = defop(
+    "adamw_", lambda param, grad, moment1, moment2, step, learning_rate=1e-3,
+    beta1=0.9, beta2=0.999, epsilon=1e-8, weight_decay=0.01, name=None:
+    _adamw(param, grad, moment1, moment2, step, learning_rate, beta1, beta2,
+           epsilon, weight_decay))
+
+adagrad_ = defop(
+    "adagrad_", lambda param, grad, moment, learning_rate=0.01,
+    epsilon=1e-6, name=None:
+    ((lambda m2: (param - learning_rate * grad / (jnp.sqrt(m2) + epsilon),
+                  m2))(moment + grad * grad)))
+
+
+def _adadelta(p, g, avg_sq, avg_dx, rho, eps):
+    a2 = rho * avg_sq + (1 - rho) * g * g
+    dx = jnp.sqrt(avg_dx + eps) / jnp.sqrt(a2 + eps) * g
+    d2 = rho * avg_dx + (1 - rho) * dx * dx
+    return p - dx.astype(p.dtype), a2, d2
+
+
+adadelta_ = defop(
+    "adadelta_", lambda param, grad, avg_squared_grad, avg_squared_update,
+    rho=0.95, epsilon=1e-6, name=None:
+    _adadelta(param, grad, avg_squared_grad, avg_squared_update, rho,
+              epsilon))
+
+
+def _adamax(p, g, m, u, step, lr, b1, b2, eps):
+    m2 = b1 * m + (1 - b1) * g
+    u2 = jnp.maximum(b2 * u, jnp.abs(g))
+    t = step.astype(jnp.float32)
+    return (p - (lr / (1 - b1 ** t)) * m2 / (u2 + eps), m2, u2, step + 1)
+
+
+adamax_ = defop(
+    "adamax_", lambda param, grad, moment, inf_norm, step,
+    learning_rate=1e-3, beta1=0.9, beta2=0.999, epsilon=1e-8, name=None:
+    _adamax(param, grad, moment, inf_norm, step, learning_rate, beta1,
+            beta2, epsilon))
+
+
+def _rmsprop(p, g, ms, mom, lr, rho, eps, momentum, centered, mg):
+    ms2 = rho * ms + (1 - rho) * g * g
+    if centered:
+        mg2 = rho * mg + (1 - rho) * g
+        denom = ms2 - mg2 * mg2
+    else:
+        mg2 = mg
+        denom = ms2
+    mom2 = momentum * mom + lr * g / jnp.sqrt(denom + eps)
+    return p - mom2.astype(p.dtype), ms2, mom2, mg2
+
+
+rmsprop_ = defop(
+    "rmsprop_", lambda param, grad, mean_square, moment, learning_rate=0.01,
+    rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+    mean_grad=0.0, name=None:
+    _rmsprop(param, grad, mean_square, moment, learning_rate, rho, epsilon,
+             momentum, centered, mean_grad))
+
+
+def _lamb(p, g, m, v, step, lr, b1, b2, eps, wd):
+    g = g.astype(jnp.float32)
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    t = step.astype(jnp.float32)
+    r = (m2 / (1 - b1 ** t)) / (jnp.sqrt(v2 / (1 - b2 ** t)) + eps) + wd * p
+    w_norm = jnp.sqrt(jnp.sum(p.astype(jnp.float32) ** 2))
+    r_norm = jnp.sqrt(jnp.sum(r ** 2))
+    trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return p - (lr * trust * r).astype(p.dtype), m2, v2, step + 1
+
+
+lamb_ = defop(
+    "lamb_", lambda param, grad, moment1, moment2, step, learning_rate=1e-3,
+    beta1=0.9, beta2=0.999, epsilon=1e-6, lamb_weight_decay=0.01, name=None:
+    _lamb(param, grad, moment1, moment2, step, learning_rate, beta1, beta2,
+          epsilon, lamb_weight_decay))
+
+
+def _lars(p, g, v, lr, mu, coeff, wd):
+    w_norm = jnp.sqrt(jnp.sum(p.astype(jnp.float32) ** 2))
+    g_norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+    local_lr = jnp.where(
+        (w_norm > 0) & (g_norm > 0),
+        coeff * w_norm / (g_norm + wd * w_norm + 1e-12), 1.0)
+    v2 = mu * v + lr * local_lr * (g + wd * p)
+    return p - v2.astype(p.dtype), v2
+
+
+lars_momentum_ = defop(
+    "lars_momentum_", lambda param, grad, velocity, learning_rate=0.01,
+    mu=0.9, lars_coeff=1e-3, lars_weight_decay=5e-4, name=None:
+    _lars(param, grad, velocity, learning_rate, mu, lars_coeff,
+          lars_weight_decay))
+
+
+# ---------------------------------------------------------------------------
+# Classic PHI op stragglers (reference: paddle/phi/kernels + fluid
+# operators with 2.x-visible surfaces)
+# ---------------------------------------------------------------------------
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter."""
+    from ..core.tensor import Parameter
+    from ..core import dtype as dtypes
+    import numpy as np
+    dt = dtypes.convert_dtype(dtype)
+    if default_initializer is not None:
+        data = jnp.zeros(tuple(shape), dt)
+        p = Parameter(data)
+        default_initializer(p)
+        return p
+    if is_bias:
+        return Parameter(jnp.zeros(tuple(shape), dt))
+    fan_in = shape[0] if shape else 1
+    std = float(np.sqrt(2.0 / max(fan_in, 1)))
+    from ..core import random as _r
+    return Parameter((jax.random.normal(_r.next_key(), tuple(shape))
+                      * std).astype(dt))
+
+
+REGISTRY.setdefault("create_parameter", create_parameter)
+
+
+def _sampling_id(x):
+    from ..core import random as _r
+    return jax.random.categorical(
+        _r.next_key(), jnp.log(jnp.maximum(x.astype(jnp.float32), 1e-38)),
+        axis=-1).astype(jnp.int64)
+
+
+sampling_id = defop("sampling_id",
+                    lambda x, min=0.0, max=1.0, seed=0, name=None:
+                    _sampling_id(x))
+
+
+def _ctc_align(x, blank):
+    """ctc_align: merge repeats then drop blanks; static shape with -1
+    padding (the reference emits LoD)."""
+    prev = jnp.concatenate([jnp.full_like(x[..., :1], -1), x[..., :-1]],
+                           axis=-1)
+    keep = (x != prev) & (x != blank)
+    T = x.shape[-1]
+    order = jnp.where(keep, jnp.arange(T), T)
+    perm = jnp.argsort(order, axis=-1)
+    gathered = jnp.take_along_axis(x, perm, axis=-1)
+    n_keep = jnp.sum(keep, axis=-1, keepdims=True)
+    return jnp.where(jnp.arange(T) < n_keep, gathered, -1)
+
+
+ctc_align = defop("ctc_align", lambda x, blank=0, name=None:
+                  _ctc_align(x, blank))
+
+
+def _row_conv(x, filt):
+    """row_conv: future-context causal conv over time — x [B, T, D],
+    filt [ctx, D]; out[t] = sum_k x[t+k] * filt[k]."""
+    ctx = filt.shape[0]
+    T = x.shape[1]
+    xp = jnp.pad(x, ((0, 0), (0, ctx - 1), (0, 0)))
+    return sum(xp[:, k:k + T] * filt[k][None, None] for k in range(ctx))
+
+
+row_conv = defop("row_conv", lambda x, filter, name=None:
+                 _row_conv(x, filter))
+
+
+def partial_concat(xs, start_index=0, length=-1, name=None):
+    """partial_concat: concat a column slice of each input."""
+    from ._registry import eager
+
+    def raw(*arrs):
+        outs = []
+        for a in arrs:
+            end = a.shape[1] if length < 0 else start_index + length
+            outs.append(a[:, start_index:end])
+        return jnp.concatenate(outs, axis=1)
+
+    return eager(raw, tuple(xs), {}, name="partial_concat")
+
+
+REGISTRY.setdefault("partial_concat", partial_concat)
+
+
+def partial_sum(xs, start_index=0, length=-1, name=None):
+    from ._registry import eager
+
+    def raw(*arrs):
+        total = None
+        for a in arrs:
+            end = a.shape[1] if length < 0 else start_index + length
+            sl = a[:, start_index:end]
+            total = sl if total is None else total + sl
+        return total
+
+    return eager(raw, tuple(xs), {}, name="partial_sum")
+
+
+REGISTRY.setdefault("partial_sum", partial_sum)
+
+
+def _shuffle_batch(x):
+    from ..core import random as _r
+    perm = jax.random.permutation(_r.next_key(), x.shape[0])
+    return x[perm]
+
+
+shuffle_batch = defop("shuffle_batch", lambda x, seed=0, name=None:
+                      _shuffle_batch(x))

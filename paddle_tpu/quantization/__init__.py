@@ -252,3 +252,82 @@ class PTQ(_Quantizer):
 
                     setattr(sub, attr, frozen)
         return model
+
+
+# ---------------------------------------------------------------------------
+# Round-3: the fake-quant PHI op family (paddle/phi/kernels/
+# fake_quantize_kernel — the ops QAT/PTQ passes insert; upstream-canonical,
+# unverified SURVEY.md §0)
+# ---------------------------------------------------------------------------
+
+import jax.numpy as jnp
+
+from ..ops._registry import REGISTRY as _REG, defop as _defop
+
+
+def _fq_abs_max(x, bit_length=8):
+    bound = 2.0 ** (bit_length - 1) - 1
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    q = jnp.round(x / jnp.maximum(scale, 1e-9) * bound)
+    return (jnp.clip(q, -bound, bound) / bound * scale).astype(x.dtype), \
+        scale.reshape(1)
+
+
+fake_quantize_abs_max = _defop(
+    "fake_quantize_abs_max",
+    lambda x, bit_length=8, name=None: _fq_abs_max(x, bit_length))
+
+
+def _fq_channel_wise(x, bit_length=8, quant_axis=0):
+    bound = 2.0 ** (bit_length - 1) - 1
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axes,
+                    keepdims=True)
+    q = jnp.round(x / jnp.maximum(scale, 1e-9) * bound)
+    out = (jnp.clip(q, -bound, bound) / bound * scale).astype(x.dtype)
+    return out, scale.reshape(-1)
+
+
+fake_channel_wise_quantize_abs_max = _defop(
+    "fake_channel_wise_quantize_abs_max",
+    lambda x, bit_length=8, quant_axis=0, name=None:
+    _fq_channel_wise(x, bit_length, quant_axis))
+
+
+def _fq_moving_avg(x, in_scale, accum, state, moving_rate, bit_length):
+    bound = 2.0 ** (bit_length - 1) - 1
+    cur = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    state2 = state * moving_rate + 1.0
+    accum2 = accum * moving_rate + cur
+    scale = accum2 / state2
+    q = jnp.round(x / jnp.maximum(scale, 1e-9) * bound)
+    out = (jnp.clip(q, -bound, bound) / bound * scale).astype(x.dtype)
+    return out, scale.reshape(1), accum2, state2
+
+
+fake_quantize_moving_average_abs_max = _defop(
+    "fake_quantize_moving_average_abs_max",
+    lambda x, in_scale, accum, state, moving_rate=0.9, bit_length=8,
+    name=None: _fq_moving_avg(x, in_scale, accum, state, moving_rate,
+                              bit_length))
+
+
+quantize_linear = _defop(
+    "quantize_linear",
+    lambda x, scale, zero_point=0.0, bit_length=8, quant_axis=-1,
+    name=None: jnp.clip(
+        jnp.round(x / scale + zero_point),
+        -(2.0 ** (bit_length - 1)), 2.0 ** (bit_length - 1) - 1))
+
+dequantize_linear = _defop(
+    "dequantize_linear",
+    lambda x, scale, zero_point=0.0, bit_length=8, quant_axis=-1,
+    name=None: (x - zero_point) * scale)
+
+moving_average_abs_max_scale = _defop(
+    "moving_average_abs_max_scale",
+    lambda x, accum, state, moving_rate=0.9, name=None:
+    ((lambda c, a2, s2: (x, (a2 / s2).reshape(1), a2, s2))(
+        jnp.max(jnp.abs(x.astype(jnp.float32))),
+        accum * moving_rate + jnp.max(jnp.abs(x.astype(jnp.float32))),
+        state * moving_rate + 1.0)))

@@ -312,4 +312,129 @@ class _SparseNN:
                 jsparse.BCOO((out[nz], idx), shape=out.shape))
 
 
-nn = _SparseNN()
+
+
+# ---------------------------------------------------------------------------
+# Round-3 breadth: the rest of the paddle.sparse unary zoo + utilities
+# (python/paddle/sparse/unary.py — each is a values-buffer map; SURVEY.md
+# §2.4 sparse row)
+# ---------------------------------------------------------------------------
+
+def asin(x):
+    return _unary(x, jnp.arcsin)
+
+
+def atan(x):
+    return _unary(x, jnp.arctan)
+
+
+def asinh(x):
+    return _unary(x, jnp.arcsinh)
+
+
+def atanh(x):
+    return _unary(x, jnp.arctanh)
+
+
+def sinh(x):
+    return _unary(x, jnp.sinh)
+
+
+def expm1(x):
+    return _unary(x, jnp.expm1)
+
+
+def log1p(x):
+    return _unary(x, jnp.log1p)
+
+
+def square(x):
+    return _unary(x, jnp.square)
+
+
+def deg2rad(x):
+    return _unary(x, jnp.deg2rad)
+
+
+def rad2deg(x):
+    return _unary(x, jnp.rad2deg)
+
+
+def coalesce(x):
+    return x.coalesce()
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def mask_as(x, mask):
+    """Keep x's entries at mask's sparsity pattern (paddle.sparse.mask_as):
+    gather dense x at the mask's indices."""
+    dense = x._data if isinstance(x, Tensor) else jnp.asarray(
+        _dense(x) if isinstance(x, (SparseCooTensor, SparseCsrTensor))
+        else x)
+    m = mask if isinstance(mask, SparseCooTensor) else mask.to_sparse_coo() \
+        if hasattr(mask, "to_sparse_coo") else mask
+    idx = m._bcoo.indices
+    vals = dense[tuple(idx[:, i] for i in range(idx.shape[1]))]
+    return SparseCooTensor(
+        jsparse.BCOO((vals.astype(dense.dtype), idx), shape=dense.shape),
+        getattr(x, "stop_gradient", True))
+
+
+def softmax(x, axis=-1):
+    """Sparse softmax over the stored entries of each row (CSR/COO 2D)."""
+    coo = x if isinstance(x, SparseCooTensor) else SparseCooTensor(
+        x._bcsr.to_bcoo() if hasattr(x, "_bcsr") else x._bcoo)
+    dense = coo._bcoo.todense()
+    filled = coo._bcoo.todense() != 0
+    z = jnp.where(filled, dense.astype(jnp.float32), -1e30)
+    out = jax.nn.softmax(z, axis=axis)
+    out = jnp.where(filled, out, 0.0)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out.astype(dense.dtype)),
+                           coo.stop_gradient)
+
+
+def slice(x, axes, starts, ends):
+    """paddle.sparse.slice (shadows the builtin inside this module, like
+    the reference's paddle.sparse.slice)."""
+    import builtins
+    d = _dense(x)
+    slicer = [builtins.slice(None)] * d.ndim
+    for a, s, e in zip(axes, starts, ends):
+        slicer[a] = builtins.slice(s, e)
+    out = d[tuple(slicer)]
+    return SparseCooTensor(jsparse.BCOO.fromdense(out),
+                           getattr(x, "stop_gradient", True))
+
+
+def pca_lowrank(*a, **k):
+    raise NotImplementedError(
+        "paddle.sparse.pca_lowrank: use paddle.linalg.pca_lowrank on the "
+        "densified tensor (paddle_tpu/sparse/__init__.py)")
+
+
+def add_coo_coo(x, y):
+    return add(x, y)
+
+
+def add_coo_dense(x, y):
+    return add(x, y)
+
+
+def matmul_coo_dense(x, y):
+    return matmul(x, y)
+
+
+def matmul_csr_dense(x, y):
+    return matmul(x, y)
+
+
+__all__ += ["asin", "atan", "asinh", "atanh", "sinh", "expm1", "log1p",
+            "square", "deg2rad", "rad2deg", "coalesce", "is_same_shape",
+            "mask_as", "softmax", "slice", "add_coo_coo", "add_coo_dense",
+            "matmul_coo_dense", "matmul_csr_dense"]
+
+
+from . import nn as nn  # noqa: E402  (real sparse.nn module, round 3)

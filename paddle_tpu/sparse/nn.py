@@ -1,0 +1,221 @@
+"""paddle.sparse.nn — the sparse layer zoo + functional.
+
+Reference analog: python/paddle/sparse/nn/ (Conv3D/SubmConv3D/BatchNorm/
+activation layers over SparseCooTensor — upstream-canonical, unverified,
+SURVEY.md §0, §2.4 sparse row). TPU-native v1: submanifold/spatial sparse
+conv densify through jax.lax.conv (XLA has no gather-scatter sparse conv;
+the densified form is exact, just not memory-sparse), elementwise layers
+map the values buffer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from . import (SparseCooTensor, SparseCsrTensor, _dense, _unary, relu as
+               _relu_fn, softmax as _softmax_fn)
+from ..nn.layer import Layer
+
+
+# -- functional -------------------------------------------------------------
+
+def relu(x):
+    return _relu_fn(x)
+
+
+def relu6(x):
+    return _unary(x, lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _unary(x, lambda v: jnp.where(v >= 0, v, negative_slope * v))
+
+
+def softmax(x, axis=-1):
+    return _softmax_fn(x, axis)
+
+
+def _to_dense_ndhwc(x):
+    return _dense(x)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC"):
+    """Sparse conv3d (densified): x SparseCooTensor [N,D,H,W,C]."""
+    d = _to_dense_ndhwc(x)
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    dil = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    if isinstance(padding, int):
+        pad = [(padding, padding)] * 3
+    else:
+        pad = [(p, p) for p in padding]
+    out = jax.lax.conv_general_dilated(
+        d.astype(jnp.float32), jnp.asarray(weight, jnp.float32),
+        window_strides=s, padding=pad, rhs_dilation=dil,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + jnp.asarray(bias, jnp.float32)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out.astype(d.dtype)))
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None):
+    """Submanifold conv3d: conv, then mask outputs to the INPUT's active
+    sites (the defining property of submanifold convolution)."""
+    y = conv3d(x, weight, bias, stride, padding, dilation, groups,
+               data_format)
+    if list(y.shape[:-1]) != list(x.shape[:-1]):  # spatial dims must match
+        return y
+    active = _to_dense_ndhwc(x) != 0
+    active = jnp.any(active, axis=-1, keepdims=True)
+    masked = jnp.where(active, _dense(y), 0)
+    return SparseCooTensor(jsparse.BCOO.fromdense(masked))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC"):
+    d = _to_dense_ndhwc(x)
+    k = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(
+        kernel_size)
+    s = tuple(k) if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    pad = ((0, 0),) + tuple(
+        (padding, padding) if isinstance(padding, int) else (p, p)
+        for p in ((padding,) * 3 if isinstance(padding, int) else padding)
+    ) + ((0, 0),)
+    out = jax.lax.reduce_window(
+        d.astype(jnp.float32), -jnp.inf, jax.lax.max,
+        (1,) + k + (1,), (1,) + s + (1,), pad)
+    out = jnp.where(jnp.isinf(out), 0.0, out)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out.astype(d.dtype)))
+
+
+def attention(query, key, value, sparse_mask=None, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """paddle.sparse.nn.functional.attention: dense QK^T softmax V over the
+    sparse_mask's pattern (densified v1)."""
+    q = query._data if isinstance(query, Tensor) else jnp.asarray(query)
+    k = key._data if isinstance(key, Tensor) else jnp.asarray(key)
+    v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if sparse_mask is not None:
+        pattern = _dense(sparse_mask) != 0
+        s = jnp.where(pattern, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return Tensor(jnp.einsum("...qk,...kd->...qd", p,
+                             v.astype(jnp.float32)).astype(q.dtype))
+
+
+# -- layers -----------------------------------------------------------------
+
+class ReLU(Layer):
+    def forward(self, x):
+        return relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return softmax(x, self._axis)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format)
+
+    def forward(self, x):
+        return max_pool3d(x, *self._a)
+
+
+class Conv3D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        k = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(
+            kernel_size)
+        self.weight = self.create_parameter(
+            list(k) + [in_channels // groups, out_channels])
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], is_bias=True)
+        self._a = (stride, padding, dilation, groups, data_format)
+
+    def forward(self, x):
+        s, p, d, g, df = self._a
+        return conv3d(x, self.weight._data,
+                      None if self.bias is None else self.bias._data,
+                      s, p, d, g, df)
+
+
+class SubmConv3D(Conv3D):
+    def forward(self, x):
+        s, p, d, g, df = self._a
+        return subm_conv3d(x, self.weight._data,
+                           None if self.bias is None else self.bias._data,
+                           s, p, d, g, df)
+
+
+class BatchNorm(Layer):
+    """Sparse BatchNorm: normalizes the values buffer over active sites."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ..nn import initializer as I
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], default_initializer=I.Constant(0.0))
+        self._eps = epsilon
+
+    def forward(self, x):
+        vals = x._bcoo.data.astype(jnp.float32)
+        mean = jnp.mean(vals, axis=0)
+        var = jnp.var(vals, axis=0)
+        out = (vals - mean) / jnp.sqrt(var + self._eps)
+        out = out * self.weight._data + self.bias._data
+        return SparseCooTensor(jsparse.BCOO(
+            (out.astype(x._bcoo.data.dtype), x._bcoo.indices),
+            shape=x._bcoo.shape), x.stop_gradient)
+
+
+SyncBatchNorm = BatchNorm
+
+
+class _FuncNS:
+    relu = staticmethod(relu)
+    relu6 = staticmethod(relu6)
+    leaky_relu = staticmethod(leaky_relu)
+    softmax = staticmethod(softmax)
+    conv3d = staticmethod(conv3d)
+    subm_conv3d = staticmethod(subm_conv3d)
+    max_pool3d = staticmethod(max_pool3d)
+    attention = staticmethod(attention)
+
+
+functional = _FuncNS()

@@ -1,0 +1,81 @@
+"""paddle.text — ViterbiDecoder / viterbi_decode.
+
+Reference analog: python/paddle/text/viterbi_decode.py (the CRF decode
+op pair — upstream-canonical, unverified, SURVEY.md §0). TPU-native:
+the dynamic-programming recurrence is one lax.scan over time — compiled,
+no host loop; lengths mask the tail like the sequence_* family.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops._registry import REGISTRY, defop, as_array
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi(potentials, transitions, lengths, include_bos_eos_tag):
+    """potentials [B, T, N] emission scores, transitions [N, N],
+    lengths [B] → (scores [B], paths [B, T])."""
+    B, T, N = potentials.shape
+    pot = potentials.astype(jnp.float32)
+    trans = transitions.astype(jnp.float32)
+    if include_bos_eos_tag:
+        # reference convention: tag N-2 is BOS, N-1 is EOS
+        start = trans[N - 2][None, :]           # BOS -> tag
+        stop = trans[:, N - 1]                  # tag -> EOS
+    else:
+        start = jnp.zeros((1, N), jnp.float32)
+        stop = jnp.zeros((N,), jnp.float32)
+
+    alpha0 = pot[:, 0] + start
+
+    def step(carry, t):
+        alpha = carry
+        # best previous tag for each current tag
+        scores = alpha[:, :, None] + trans[None]        # [B, N, N]
+        best_prev = jnp.argmax(scores, axis=1)          # [B, N]
+        best_score = jnp.max(scores, axis=1) + pot[:, t]
+        # frozen past the sequence end
+        live = (t < lengths)[:, None]
+        alpha = jnp.where(live, best_score, alpha)
+        bp = jnp.where(live, best_prev, jnp.arange(N)[None, :])
+        return alpha, bp
+
+    alpha, bps = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    final = alpha + stop[None, :]
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1)
+
+    def back(carry, bp):
+        tag = carry
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag            # y[t] = tag at time t+1
+
+    first_tag, path_rev = jax.lax.scan(back, last_tag, bps, reverse=True)
+    paths = jnp.concatenate([first_tag[:, None], path_rev.T], axis=1)
+    # mask the padding tail with the final valid tag (reference pads 0)
+    t_idx = jnp.arange(T)[None, :]
+    paths = jnp.where(t_idx < lengths[:, None], paths, 0)
+    return scores, paths.astype(jnp.int64)
+
+
+viterbi_decode = defop(
+    "viterbi_decode",
+    lambda potentials, transitions, lengths, include_bos_eos_tag=True,
+    name=None: _viterbi(potentials, transitions, as_array(lengths),
+                        include_bos_eos_tag))
+
+
+class ViterbiDecoder:
+    """paddle.text.ViterbiDecoder parity (callable layer shape)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

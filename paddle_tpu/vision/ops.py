@@ -202,3 +202,43 @@ class DeformConv2D:
     def __init__(self, *a, **k):
         raise NotImplementedError(
             "DeformConv2D: deferred (paddle_tpu/vision/ops.py)")
+
+
+# detection op family (reference home: paddle.vision.ops re-exports the
+# detection PHI ops) — implemented in ops/detection.py
+from ..ops.detection import (  # noqa: F401,E402
+    anchor_generator, bipartite_match, box_clip, box_coder,
+    density_prior_box, iou_similarity, matrix_nms, multiclass_nms,
+    prior_box, psroi_pool, yolo_box)
+
+
+def read_file(filename, name=None):
+    """paddle.vision.ops.read_file: raw bytes as a uint8 tensor."""
+    from ..core.tensor import Tensor
+    import numpy as _np
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(_np.frombuffer(data, dtype=_np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """paddle.vision.ops.decode_jpeg via PIL (HWC uint8 -> CHW tensor)."""
+    from ..core.tensor import Tensor
+    import io as _io
+    import numpy as _np
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise NotImplementedError(
+            "decode_jpeg needs PIL (paddle_tpu/vision/ops.py)") from e
+    raw = bytes(bytearray(_np.asarray(x._data if hasattr(x, "_data")
+                                      else x, dtype=_np.uint8)))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -247,3 +247,199 @@ def hflip(img):
 
 def center_crop(img, output_size):
     return CenterCrop(output_size)(img)
+
+
+# ---------------------------------------------------------------------------
+# Functional surface — paddle.vision.transforms functional parity
+# (python/paddle/vision/transforms/functional.py, upstream-canonical,
+# unverified — SURVEY.md §0). Numpy-array HWC images in/out, like the
+# reference's numpy backend; the class transforms above compose these.
+# ---------------------------------------------------------------------------
+
+def vflip(img):
+    return _to_hwc_array(img)[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    return _to_hwc_array(img)[top:top + height, left:left + width].copy()
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    a = _to_hwc_array(img)
+    l, t, r, b = _norm_padding4(padding)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if padding_mode == "constant" else {}
+    return np.pad(a, ((t, b), (l, r), (0, 0)), mode=mode, **kw)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate by `angle` degrees counter-clockwise about the center
+    (nearest-neighbor resampling; the reference's PIL backend default)."""
+    orig = _to_hwc_array(img)
+    a = orig.astype(np.float32)
+    h, w = a.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else \
+        (center[1], center[0])
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    xs = cos * (xx - cx) + sin * (yy - cy) + cx
+    ys = -sin * (xx - cx) + cos * (yy - cy) + cy
+    xi = np.round(xs).astype(np.int64)
+    yi = np.round(ys).astype(np.int64)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    out = np.full_like(a, float(fill))
+    out[valid] = a[yi[valid], xi[valid]]
+    return out.astype(orig.dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    orig = _to_hwc_array(img)
+    a = orig.astype(np.float32)
+    hi = 255.0 if np.issubdtype(orig.dtype, np.integer) else 1.0
+    return np.clip(a * brightness_factor, 0, hi).astype(orig.dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    orig = _to_hwc_array(img)
+    a = orig.astype(np.float32)
+    mean = a.mean()
+    hi = 255.0 if np.issubdtype(orig.dtype, np.integer) else 1.0
+    return np.clip(mean + contrast_factor * (a - mean), 0, hi).astype(
+        orig.dtype)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) via RGB<->HSV."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor {hue_factor} not in [-0.5, 0.5]")
+    orig = _to_hwc_array(img)
+    hi = 255.0 if np.issubdtype(orig.dtype, np.integer) else 1.0
+    a = orig.astype(np.float32) / hi
+    r, g, b = a[..., 0], a[..., 1], a[..., 2]
+    mx, mn = a.max(-1), a.min(-1)
+    d = mx - mn
+    h = np.zeros_like(mx)
+    mask = d > 0
+    rm = mask & (mx == r)
+    gm = mask & (mx == g) & ~rm
+    bm = mask & ~rm & ~gm
+    h[rm] = ((g - b)[rm] / d[rm]) % 6
+    h[gm] = (b - r)[gm] / d[gm] + 2
+    h[bm] = (r - g)[bm] / d[bm] + 4
+    h = (h / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, d / np.maximum(mx, 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(np.int64) % 6
+    rgb = np.stack([
+        np.choose(i, [v, q, p, p, t, v]),
+        np.choose(i, [t, v, v, q, p, p]),
+        np.choose(i, [p, p, t, v, v, q])], axis=-1)
+    return (rgb * hi).astype(orig.dtype)
+
+
+def to_grayscale(img, num_output_channels=1):
+    orig = _to_hwc_array(img)
+    a = orig.astype(np.float32)
+    gray = 0.299 * a[..., 0] + 0.587 * a[..., 1] + 0.114 * a[..., 2]
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return out.astype(orig.dtype)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """paddle.vision.transforms.erase: fill region [i:i+h, j:j+w] with v.
+    Tensor input stays CHW tensor (reference semantics); arrays are HWC."""
+    from ..core.tensor import Tensor
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+        data = img._data
+        val = jnp.asarray(v, data.dtype)
+        patch = jnp.broadcast_to(val, (data.shape[0], h, w))
+        new = data.at[:, i:i + h, j:j + w].set(patch)
+        if inplace:
+            img._data = new
+            return img
+        return Tensor(new)
+    a = _to_hwc_array(img)
+    out = a if inplace else a.copy()
+    out[i:i + h, j:j + w] = np.broadcast_to(
+        np.asarray(v, a.dtype), (h, w, a.shape[2]))
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine transform: rotate(angle) + translate + scale + shear, about
+    the image center (inverse-map nearest resampling)."""
+    orig = _to_hwc_array(img)
+    a = orig.astype(np.float32)
+    h, w = a.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else \
+        (center[1], center[0])
+    rad = np.deg2rad(angle)
+    sx = np.deg2rad(shear[0] if isinstance(shear, (list, tuple)) else shear)
+    sy = np.deg2rad(shear[1] if isinstance(shear, (list, tuple))
+                    and len(shear) > 1 else 0.0)
+    # forward matrix M = R(angle) @ Shear @ diag(scale); sample via M^-1
+    m = np.array([
+        [np.cos(rad + sy) / np.cos(sy),
+         -np.cos(rad + sy) * np.tan(sx) / np.cos(sy) - np.sin(rad)],
+        [np.sin(rad + sy) / np.cos(sy),
+         -np.sin(rad + sy) * np.tan(sx) / np.cos(sy) + np.cos(rad)],
+    ]) * scale
+    minv = np.linalg.inv(m)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    dx = xx - cx - translate[0]
+    dy = yy - cy - translate[1]
+    xs = minv[0, 0] * dx + minv[0, 1] * dy + cx
+    ys = minv[1, 0] * dx + minv[1, 1] * dy + cy
+    xi, yi = np.round(xs).astype(np.int64), np.round(ys).astype(np.int64)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    out = np.full_like(a, float(fill))
+    out[valid] = a[yi[valid], xi[valid]]
+    return out.astype(orig.dtype)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective transform mapping startpoints -> endpoints (4 corner
+    pairs), inverse-map nearest resampling."""
+    orig = _to_hwc_array(img)
+    a = orig.astype(np.float32)
+    h, w = a.shape[:2]
+    # solve the 8-dof homography sending endpoints -> startpoints
+    A, bvec = [], []
+    for (ex, ey), (sx_, sy_) in zip(endpoints, startpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx_ * ex, -sx_ * ey])
+        bvec.append(sx_)
+        A.append([0, 0, 0, ex, ey, 1, -sy_ * ex, -sy_ * ey])
+        bvec.append(sy_)
+    coef = np.linalg.solve(np.asarray(A, np.float64),
+                           np.asarray(bvec, np.float64))
+    hm = np.append(coef, 1.0).reshape(3, 3)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float64)
+    den = hm[2, 0] * xx + hm[2, 1] * yy + hm[2, 2]
+    xs = (hm[0, 0] * xx + hm[0, 1] * yy + hm[0, 2]) / den
+    ys = (hm[1, 0] * xx + hm[1, 1] * yy + hm[1, 2]) / den
+    xi, yi = np.round(xs).astype(np.int64), np.round(ys).astype(np.int64)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    out = np.full_like(a, float(fill))
+    out[valid] = a[yi[valid], xi[valid]]
+    return out.astype(orig.dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    orig = _to_hwc_array(img)
+    a = orig.astype(np.float32)
+    gray = (0.299 * a[..., 0] + 0.587 * a[..., 1]
+            + 0.114 * a[..., 2])[..., None]
+    hi = 255.0 if np.issubdtype(orig.dtype, np.integer) else 1.0
+    return np.clip(gray + saturation_factor * (a - gray), 0, hi).astype(
+        orig.dtype)

@@ -1,0 +1,296 @@
+"""Round-3 breadth families: detection, sequence, train ops, transforms,
+sparse zoo, viterbi, fused incubate ops, registry/zoo size gates.
+
+Reference analog: the per-op test_*_op.py files of test/legacy_test
+(SURVEY.md §4) — numpy-reference checks per family; the size gates pin
+the VERDICT r2 item-3 targets (>=800 registry ops, >=160 Layer classes).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+class TestRegistrySize:
+    def test_at_least_800_ops(self):
+        from paddle_tpu.ops._registry import REGISTRY
+        assert len(REGISTRY) >= 800, len(REGISTRY)
+
+    def test_at_least_160_layers_across_zoo(self):
+        import inspect
+        import paddle_tpu.nn as nn
+        import paddle_tpu.incubate.nn as inn
+        import paddle_tpu.sparse.nn as snn
+        import paddle_tpu.distributed.fleet.mpu as mpu
+        import paddle_tpu.audio.features as af
+        import paddle_tpu.quantization as q
+        seen = set()
+        total = 0
+        for m in (nn, inn, snn, mpu, af, q):
+            for name in dir(m):
+                o = getattr(m, name, None)
+                if (inspect.isclass(o) and issubclass(o, nn.Layer)
+                        and o is not nn.Layer and id(o) not in seen):
+                    seen.add(id(o))
+                    total += 1
+        assert total >= 160, total
+
+
+class TestDetectionOps:
+    def test_iou_identity(self):
+        b = paddle.to_tensor(np.array([[0., 0., 2., 2.], [1., 1., 3., 3.]],
+                                      np.float32))
+        iou = paddle.iou_similarity(b, b).numpy()
+        np.testing.assert_allclose(np.diag(iou), [1.0, 1.0], rtol=1e-6)
+        assert abs(iou[0, 1] - 2.0 / 14.0) < 1e-6  # inter 1, union 7... 4+4-1
+
+    def test_box_clip(self):
+        boxes = paddle.to_tensor(np.array([[-5., -5., 50., 50.]], np.float32))
+        out = paddle.box_clip(boxes, paddle.to_tensor(
+            np.array([20., 30., 1.], np.float32))).numpy()
+        np.testing.assert_allclose(out, [[0., 0., 29., 19.]])
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.RandomState(0)
+        priors = paddle.to_tensor(
+            np.abs(rng.rand(5, 4)).cumsum(axis=1).astype(np.float32))
+        targets = paddle.to_tensor(
+            np.abs(rng.rand(5, 4)).cumsum(axis=1).astype(np.float32) * 2)
+        enc = paddle.vision.ops.box_coder(priors, None, targets,
+                                          code_type="encode_center_size")
+        dec = paddle.vision.ops.box_coder(priors, None, enc,
+                                          code_type="decode_center_size",
+                                          axis=0)
+        got = dec.numpy()[np.arange(5), np.arange(5)]
+        np.testing.assert_allclose(got, targets.numpy(), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_multiclass_nms_suppresses_overlaps(self):
+        boxes = np.zeros((1, 4, 4), np.float32)
+        boxes[0, 0] = [0, 0, 10, 10]
+        boxes[0, 1] = [0.5, 0.5, 10.5, 10.5]   # heavy overlap with 0
+        boxes[0, 2] = [20, 20, 30, 30]
+        boxes[0, 3] = [40, 40, 50, 50]
+        scores = np.zeros((1, 1, 4), np.float32)
+        scores[0, 0] = [0.9, 0.8, 0.7, 0.6]
+        out, num = paddle.vision.ops.multiclass_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, keep_top_k=4, nms_threshold=0.5)
+        assert int(num.numpy()[0]) == 3  # box 1 suppressed
+
+    def test_matrix_nms_decays(self):
+        boxes = np.zeros((1, 3, 4), np.float32)
+        boxes[0, 0] = [0, 0, 10, 10]
+        boxes[0, 1] = [0, 0, 10, 10]
+        boxes[0, 2] = [20, 20, 30, 30]
+        scores = np.zeros((1, 1, 3), np.float32)
+        scores[0, 0] = [0.9, 0.8, 0.7]
+        out, num = paddle.vision.ops.matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.0, nms_top_k=3,
+            keep_top_k=3)
+        s = out.numpy()[0][:, 1]
+        assert s[0] > 0.89 and s[2] < 0.1  # duplicate decayed to ~0
+
+
+class TestSequenceOps:
+    def test_pool_and_softmax_respect_lengths(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(2, 3, 2))
+        ln = paddle.to_tensor(np.array([2, 3]))
+        avg = paddle.sequence_pool(x, ln, "average").numpy()
+        np.testing.assert_allclose(avg[0], [1.0, 2.0])  # mean of rows 0,1
+        sm = paddle.sequence_softmax(x, ln).numpy()
+        np.testing.assert_allclose(sm[0].sum(0), [1.0, 1.0], rtol=1e-5)
+        assert sm[0, 2].sum() == 0  # padded step zeroed
+
+    def test_reverse_valid_prefix(self):
+        x = paddle.to_tensor(np.array([[1., 2., 3., 9.]]).reshape(1, 4, 1))
+        out = paddle.sequence_reverse(
+            x, paddle.to_tensor(np.array([3]))).numpy().reshape(-1)
+        np.testing.assert_allclose(out, [3., 2., 1., 9.])
+
+    def test_sequence_conv_shapes(self):
+        x = paddle.to_tensor(np.random.randn(2, 5, 3).astype(np.float32))
+        f = paddle.to_tensor(np.random.randn(9, 4).astype(np.float32))
+        out = paddle.sequence_conv(x, paddle.to_tensor(np.array([5, 2])), f)
+        assert out.shape == [2, 5, 4]
+        assert np.all(out.numpy()[1, 2:] == 0)  # masked beyond length
+
+
+class TestTrainOps:
+    def test_adam_matches_reference_formula(self):
+        p = paddle.to_tensor(np.ones((4,), np.float32))
+        g = paddle.to_tensor(np.full((4,), 0.5, np.float32))
+        m = paddle.to_tensor(np.zeros((4,), np.float32))
+        v = paddle.to_tensor(np.zeros((4,), np.float32))
+        step = paddle.to_tensor(np.ones((), np.int64))
+        p2, m2, v2, s2 = paddle.adam_(p, g, m, v, step, learning_rate=0.1)
+        # first step: mhat = g, vhat = g^2 -> p - lr*g/(|g|+eps) ~= p - lr
+        np.testing.assert_allclose(p2.numpy(), 1.0 - 0.1, rtol=1e-4)
+
+    def test_check_finite_and_unscale(self):
+        gs = [paddle.to_tensor(np.array([2.0, 4.0], np.float32)),
+              paddle.to_tensor(np.array([np.inf], np.float32))]
+        outs, found = paddle.check_finite_and_unscale(
+            gs, paddle.to_tensor(np.array(2.0, np.float32)))
+        np.testing.assert_allclose(outs[0].numpy(), [1.0, 2.0])
+        assert bool(found.numpy()[0])
+
+    def test_update_loss_scaling_shrinks_on_inf(self):
+        s, good, bad = (paddle.to_tensor(np.array(1024.0, np.float32)),
+                        paddle.to_tensor(np.array(5, np.int32)),
+                        paddle.to_tensor(np.array(1, np.int32)))
+        inf = paddle.to_tensor(np.array([True]))
+        s2, g2, b2 = paddle.update_loss_scaling(
+            s, good, bad, inf, decr_every_n_nan_or_inf=2)
+        assert float(s2.numpy()) == 512.0
+
+
+class TestTransformsFunctional:
+    def test_flips_and_identity_affine(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.RandomState(0).rand(6, 8, 3) * 255).astype(np.uint8)
+        np.testing.assert_array_equal(T.hflip(T.hflip(img)), img)
+        np.testing.assert_array_equal(T.vflip(T.vflip(img)), img)
+        np.testing.assert_array_equal(
+            T.affine(img, 0, (0, 0), 1.0, 0), img)
+        np.testing.assert_array_equal(T.rotate(img, 0), img)
+
+    def test_adjusts(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.RandomState(1).rand(6, 8, 3)).astype(np.float32)
+        np.testing.assert_allclose(T.adjust_brightness(img, 1.0), img,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=1e-5)
+        gray = T.to_grayscale(img)
+        assert gray.shape == (6, 8, 1)
+
+
+class TestViterbi:
+    def test_decode_prefers_high_potentials(self):
+        import paddle_tpu.text as text
+        pot = np.full((1, 4, 3), -10.0, np.float32)
+        best = [0, 2, 1, 0]
+        for t, tag in enumerate(best):
+            pot[0, t, tag] = 10.0
+        scores, path = text.viterbi_decode(
+            paddle.to_tensor(pot),
+            paddle.to_tensor(np.zeros((3, 3), np.float32)),
+            paddle.to_tensor(np.array([4])), False)
+        assert path.numpy()[0].tolist() == best
+
+
+class TestSparseZoo:
+    def test_unary_zoo_values_only(self):
+        import paddle_tpu.sparse as sp
+        st = sp.sparse_coo_tensor([[0, 1], [1, 0]], [0.5, -0.25], [2, 2])
+        out = sp.asin(st).to_dense().numpy()
+        assert abs(out[0, 1] - np.arcsin(0.5)) < 1e-6
+        assert out[0, 0] == 0.0
+        assert sp.expm1(st).nnz == 2
+
+    def test_sparse_nn_layers(self):
+        import paddle_tpu.sparse as sp
+        from jax.experimental import sparse as jsp
+        dense = np.zeros((1, 3, 3, 3, 2), np.float32)
+        dense[0, 1, 1, 1] = [1.0, -2.0]
+        xs = sp.SparseCooTensor(jsp.BCOO.fromdense(jnp.asarray(dense)))
+        out = sp.nn.ReLU()(xs).to_dense().numpy()
+        assert out[0, 1, 1, 1, 0] == 1.0 and out[0, 1, 1, 1, 1] == 0.0
+        conv = sp.nn.SubmConv3D(2, 4, 3, padding=1)
+        y = conv(xs)
+        # submanifold: output active only at the input's active site
+        yd = y.to_dense().numpy()
+        assert np.all(yd[0, 0, 0, 0] == 0)
+
+    def test_mask_as(self):
+        import paddle_tpu.sparse as sp
+        st = sp.sparse_coo_tensor([[0, 1], [1, 0]], [1.0, 1.0], [2, 2])
+        dense = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+        out = sp.mask_as(dense, st).to_dense().numpy()
+        np.testing.assert_allclose(out, [[0., 1.], [2., 0.]])
+
+
+class TestFusedIncubate:
+    def test_swiglu_split(self):
+        import paddle_tpu.incubate.nn.functional as inf
+        x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+        out = inf.swiglu(x).numpy()
+        a, b = x.numpy()[:, :4], x.numpy()[:, 4:]
+        ref = (a / (1 + np.exp(-a))) * b
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_fused_ec_moe_single_expert_is_mlp(self):
+        import paddle_tpu.incubate.nn.functional as inf
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(1, 3, 4).astype(np.float32))
+        gate = paddle.to_tensor(np.zeros((1, 3, 1), np.float32))
+        w0 = paddle.to_tensor(rng.randn(1, 4, 8).astype(np.float32) * 0.1)
+        b0 = paddle.to_tensor(np.zeros((1, 1, 8), np.float32))
+        w1 = paddle.to_tensor(rng.randn(1, 8, 4).astype(np.float32) * 0.1)
+        b1 = paddle.to_tensor(np.zeros((1, 1, 4), np.float32))
+        out = inf.fused_ec_moe(x, gate, w0, b0, w1, b1).numpy()
+        # single expert, uniform gate -> plain gelu MLP
+        h = x.numpy() @ w0.numpy()[0]
+        h = 0.5 * h * (1 + np.vectorize(np.math.erf if hasattr(np, "math")
+                                        else __import__("math").erf)(
+            h / np.sqrt(2.0)))
+        ref = h @ w1.numpy()[0]
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+    def test_masked_mha_appends(self):
+        import paddle_tpu.incubate.nn.functional as inf
+        x = paddle.to_tensor(np.random.randn(1, 3 * 2 * 4).astype(
+            np.float32))
+        cache = paddle.to_tensor(np.zeros((2, 1, 2, 4, 4), np.float32))
+        out, newc = inf.masked_multihead_attention(x, cache)
+        assert out.shape == [1, 8]
+        assert np.any(newc.numpy()[0, 0, :, 0] != 0)   # slot 0 filled
+
+
+class TestQuantOps:
+    def test_fake_quant_roundtrip_small_error(self):
+        import paddle_tpu.quantization as q
+        x = paddle.to_tensor(np.random.RandomState(0).randn(16).astype(
+            np.float32))
+        out, scale = q.fake_quantize_abs_max(x)
+        assert np.max(np.abs(out.numpy() - x.numpy())) < \
+            float(scale.numpy()[0]) / 100
+
+    def test_quant_dequant_linear(self):
+        import paddle_tpu.quantization as q
+        x = paddle.to_tensor(np.array([0.5, -0.25], np.float32))
+        s = paddle.to_tensor(np.array(0.01, np.float32))
+        qd = q.dequantize_linear(q.quantize_linear(x, s), s)
+        np.testing.assert_allclose(qd.numpy(), x.numpy(), atol=0.01)
+
+
+class TestGeometricSampling:
+    def test_sample_neighbors_counts(self):
+        # CSC: node 0 has neighbors [1, 2]; node 1 has [0]
+        row = paddle.to_tensor(np.array([1, 2, 0]))
+        colptr = paddle.to_tensor(np.array([0, 2, 3]))
+        nodes = paddle.to_tensor(np.array([0, 1]))
+        neigh, cnt = paddle.geometric.sample_neighbors(
+            row, colptr, nodes, sample_size=2)
+        assert cnt.numpy().tolist() == [2, 1]
+        assert neigh.numpy()[1, 1] == -1   # padded
+
+
+class TestEngineOpsSurface:
+    def test_edit_distance_known(self):
+        a = paddle.to_tensor(np.array([[1, 2, 3, 4, -1]], np.int64))
+        b = paddle.to_tensor(np.array([[1, 3, 4, -1]], np.int64))
+        d = paddle.edit_distance(a, b, normalized=False).numpy()
+        assert d[0] == 1.0
+
+    def test_top_p_keeps_nucleus(self):
+        x = paddle.to_tensor(np.array([[0.6, 0.3, 0.09, 0.01]], np.float32))
+        ids = set()
+        for seed in range(8):
+            _, i = paddle.top_p_sampling(x, paddle.to_tensor(
+                np.array([0.5], np.float32)), seed=seed)
+            ids.add(int(i.numpy()[0, 0]))
+        assert ids == {0}  # 0.6 alone exceeds p=0.5
