@@ -106,7 +106,7 @@ def _seq(x):
 U("erfc", lambda x: 1.0 - jax.scipy.special.erf(x),
   ref=lambda x: 1.0 - np.vectorize(_math.erf)(x).astype(x.dtype))
 U("i0e", lambda x: jax.scipy.special.i0e(x),
-  ref=None)  # scipy-free env: identity checked via i0 relation test below
+  ref=lambda x: (np.exp(-np.abs(x)) * np.i0(x)).astype(x.dtype))
 U("i1e", lambda x: jax.scipy.special.i1e(x), ref=None)
 U("sgn", lambda x: jnp.where(x == 0, 0, x / jnp.abs(x))
   if jnp.iscomplexobj(x) else jnp.sign(x),
@@ -115,7 +115,9 @@ U("positive", lambda x: x, ref=lambda x: +x, grad=False)
 U("negative", jnp.negative, ref=lambda x: -x, aliases=())
 C("increment", lambda x, value=1.0: x + value,
   ref=lambda x: x + 1.0, inplace=True)
-B("reduce_as", lambda x, y: _reduce_as(x, y), ref=None, grad=False)
+C("reduce_as", lambda x, y: _reduce_as(x, y),
+  ref=lambda x, y: x.sum(0, keepdims=True).astype(x.dtype), n_in=2,
+  shapes=((3, 4), (1, 4)), grad=False)
 
 
 def _reduce_as(x, target):
@@ -138,7 +140,10 @@ def _frexp(x):
 
 
 C("multigammaln", lambda x, p: _multigammaln(x, p),
-  ref=None, grad=False, kwargs={"p": 2}, domain=(2.0, 5.0))
+  ref=lambda x, p=2: (np.log(np.pi) * p * (p - 1) / 4.0 + sum(
+      np.vectorize(_math.lgamma)(x + (1.0 - j) / 2.0)
+      for j in range(1, p + 1))).astype(x.dtype),
+  grad=False, kwargs={"p": 2}, domain=(2.0, 5.0))
 
 
 def _multigammaln(x, p):
@@ -180,7 +185,9 @@ C("nanargmin", lambda x, axis=None, keepdim=False:
   jnp.nanargmin(x, axis=axis, keepdims=keepdim),
   ref=np.nanargmin, grad=False)
 C("nanstd", lambda x, axis=None, unbiased=True, keepdim=False:
-  _nanstd(x, axis, unbiased, keepdim), ref=None, grad=False)
+  _nanstd(x, axis, unbiased, keepdim),
+  ref=lambda x, axis=0: np.nanstd(x, axis=axis, ddof=1).astype(x.dtype),
+  kwargs={"axis": 0}, grad=False)
 
 
 def _nanstd(x, axis, unbiased, keepdim):
@@ -237,8 +244,9 @@ def _unflatten(x, axis, shape):
     return x.reshape(x.shape[:axis] + tuple(shape) + x.shape[axis + 1:])
 
 
-C("view_as", lambda x, other: x.reshape(other.shape), ref=None, n_in=2,
-  grad=False)
+C("view_as", lambda x, other: x.reshape(other.shape),
+  ref=lambda x, y: x.reshape(y.shape), n_in=2,
+  shapes=((3, 4), (12,)), grad=False)
 C("matrix_transpose", lambda x: jnp.swapaxes(x, -1, -2),
   ref=lambda x: np.swapaxes(x, -1, -2), shapes=((3, 4),))
 C("crop", lambda x, shape=None, offsets=None: _crop(x, shape, offsets),
@@ -253,8 +261,9 @@ def _crop(x, shape, offsets):
     return jax.lax.dynamic_slice(x, offsets, shape)
 
 
-C("take", lambda x, index, mode="raise": _take(x, index, mode),
-  ref=None, grad=False)
+C("take", lambda x, index=None, mode="raise": _take(x, index, mode),
+  ref=lambda x: x.reshape(-1)[np.array([1, 5, 10])],
+  kwargs={"index": np.array([1, 5, 10])}, grad=False)
 
 
 def _take(x, index, mode):
@@ -298,8 +307,9 @@ def _index_fill(x, index, axis, value):
 
 
 C("diagonal_scatter", lambda x, y, offset=0, axis1=0, axis2=1:
-  _diagonal_scatter(x, y, offset, axis1, axis2), ref=None, n_in=2,
-  grad=False)
+  _diagonal_scatter(x, y, offset, axis1, axis2),
+  ref=lambda x, y: _np_diag_scatter(x, y), n_in=2,
+  shapes=((4, 4), (4,)), grad=False)
 
 
 def _diagonal_scatter(x, y, offset, axis1, axis2):
@@ -315,8 +325,11 @@ def _diagonal_scatter(x, y, offset, axis1, axis2):
     return jnp.moveaxis(moved, (0, 1), (axis1, axis2))
 
 
-C("select_scatter", lambda x, values, axis, index:
-  _select_scatter(x, values, axis, index), ref=None, n_in=2, grad=False)
+C("select_scatter", lambda x, values, axis=0, index=0:
+  _select_scatter(x, values, axis, index),
+  ref=lambda x, v, axis=0, index=1: _np_select_scatter(x, v, index),
+  kwargs={"axis": 0, "index": 1}, n_in=2, shapes=((4, 4), (4,)),
+  grad=False)
 
 
 def _select_scatter(x, values, axis, index):
@@ -505,7 +518,22 @@ def _cumtrapz(y, x, dx, axis):
     return jnp.moveaxis(out, -1, axis)
 
 
-C("pdist", lambda x, p=2.0: _pdist(x, p), ref=None, shapes=((5, 3),))
+C("pdist", lambda x, p=2.0: _pdist(x, p),
+  ref=lambda x: np.sqrt((((x[:, None] - x[None]) ** 2).sum(-1))[
+      np.triu_indices(x.shape[0], 1)]).astype(x.dtype),
+  shapes=((5, 3),))
+
+
+def _np_diag_scatter(x, y):
+    out = x.copy()
+    np.fill_diagonal(out, y)
+    return out
+
+
+def _np_select_scatter(x, v, index):
+    out = x.copy()
+    out[index] = v
+    return out
 
 
 def _pdist(x, p):
@@ -515,7 +543,8 @@ def _pdist(x, p):
     return full[r, c]
 
 
-C("is_complex", lambda x: jnp.iscomplexobj(x), ref=None, grad=False)
+C("is_complex", lambda x: jnp.iscomplexobj(x),
+  ref=lambda x: np.asarray(np.iscomplexobj(x)), grad=False)
 C("is_floating_point", lambda x: jnp.issubdtype(x.dtype, jnp.floating),
   ref=None, grad=False)
 C("is_integer", lambda x: jnp.issubdtype(x.dtype, jnp.integer), ref=None,
