@@ -35,6 +35,24 @@ def peak_for(device) -> float:
     return 0.5e12
 
 
+def _timed_steps(step, state, tokens, warmup, timed):
+    """Shared timing protocol: warmup, host-sync via float() (the axon
+    remote queue does not drain on block_until_ready alone), timed loop,
+    then free the config's HBM (lingering buffers slow the next config)."""
+    for _ in range(max(warmup, 1)):
+        state, m = step(state, tokens)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(timed):
+        state, m = step(state, tokens)
+    loss_val = float(m["loss"])
+    dt = time.perf_counter() - t0
+    del state, m
+    import gc
+    gc.collect()
+    return dt, loss_val
+
+
 def run_config(cfg, batch, seq, timed_steps, state_quant=None,
                warmup_steps=2, grad_clip=1.0):
     import jax
@@ -50,28 +68,52 @@ def run_config(cfg, batch, seq, timed_steps, state_quant=None,
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
                          jnp.int32)
 
-    # warmup (compile) then timed loop. Sync via host transfer (float()):
-    # block_until_ready alone does not drain the axon remote queue.
-    for _ in range(max(warmup_steps, 1)):
-        state, m = step(state, tokens)
-    float(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(timed_steps):
-        state, m = step(state, tokens)
-    float(m["loss"])
-    dt = time.perf_counter() - t0
-
+    dt, loss_val = _timed_steps(step, state, tokens, warmup_steps,
+                                timed_steps)
     tok_s = batch * seq * timed_steps / dt
-    flops_tok = llama.flops_per_token(cfg, seq)
-    mfu = tok_s * flops_tok / peak_for(dev)
-    loss_val = float(m["loss"])
-    # free this config's HBM before the next one — lingering buffers
-    # measurably slow the following config (fragmentation)
-    del state, m, step, tx, tokens
-    import gc
-    gc.collect()
+    mfu = tok_s * llama.flops_per_token(cfg, seq) / peak_for(dev)
     return {"tok_s": tok_s, "mfu": mfu, "loss": loss_val,
             "params": llama.num_params(cfg)}
+
+
+def run_moe(batch=16, seq=2048, timed_steps=6):
+    """BASELINE config 4 (DeepSeekMoE/Qwen2-MoE-class EP workload) on one
+    chip: a ~1.6B-total / ~0.5B-active DeepSeek-style MoE (16 experts
+    top-2 + 1 shared, index-form GShard routing with the Pallas ragged
+    gather) trained with bf16 params + 8-bit Adam. MFU counts ACTIVE
+    FLOPs (the MoE convention — only routed experts do work)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nlp import moe, train
+
+    dev = jax.devices()[0]
+    cfg = moe.MoeConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        moe_intermediate_size=1024, num_experts=16, num_experts_per_tok=2,
+        num_shared_experts=1, num_hidden_layers=12,
+        num_attention_heads=16, num_key_value_heads=8,
+        max_position_embeddings=2048, param_dtype=jnp.bfloat16)
+    tx = train.make_optimizer(1e-4, state_quant="8bit", grad_clip=1.0)
+    state = train.init_state(jax.random.key(0), cfg, tx, mesh=None,
+                             model=moe)
+    step = train.make_train_step(cfg, tx, mesh=None, model=moe)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    dt_total, _ = _timed_steps(step, state, tokens, 2, timed_steps)
+    dt = dt_total / timed_steps
+
+    D, Fm = cfg.hidden_size, cfg.moe_intermediate_size
+    H, KV, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    L, E = cfg.num_hidden_layers, cfg.num_experts
+    k, sh = cfg.num_experts_per_tok, cfg.num_shared_experts
+    matmul = L * (D * (H + 2 * KV) * hd + H * hd * D + D * E
+                  + 3 * D * Fm * (k + sh)) + cfg.vocab_size * D
+    attn = L * H * hd * seq
+    mfu = 6.0 * (matmul + attn) * batch * seq / dt / peak_for(dev)
+    return {"mfu": mfu, "tok_s": batch * seq / dt,
+            "params": moe.num_params(cfg)}
 
 
 def run_8b_layer(seq, batch=1, timed_steps=8):
@@ -152,12 +194,13 @@ def main():
         # the 8B layer shape at north-star sequence lengths (missing 7)
         layer8b_4k = run_8b_layer(seq=4096)
         layer8b_8k = run_8b_layer(seq=8192)
+        moe_res = run_moe()
         batch, seq = 8, 2048
     else:
         big = run_config(llama.LlamaConfig.tiny(), batch=4, seq=128,
                          timed_steps=3)
         small = None  # off-TPU there is no 0.5B comparison run (ADVICE r2)
-        layer8b_4k = layer8b_8k = None
+        layer8b_4k = layer8b_8k = moe_res = None
         batch, seq = 4, 128
 
     print(json.dumps({
@@ -174,6 +217,9 @@ def main():
         "tok_s_05b": round(small["tok_s"], 1) if small else None,
         "mfu_8b_layer": round(layer8b_4k, 4) if layer8b_4k else None,
         "mfu_8b_layer_s8k": round(layer8b_8k, 4) if layer8b_8k else None,
+        "mfu_moe": round(moe_res["mfu"], 4) if moe_res else None,
+        "tok_s_moe": round(moe_res["tok_s"], 1) if moe_res else None,
+        "moe_params": moe_res["params"] if moe_res else None,
     }))
 
 

@@ -36,10 +36,13 @@ def _gather_rows_jnp(src, idx):
 
 def _gather_rows_kernel(idx_ref, src_ref, out_ref, scratch, sems, *, bm):
     """Grid (B, M // bm). idx_ref: scalar-prefetched [B, M] (SMEM);
-    src_ref: [B, N, D] in ANY (HBM); out block [1, bm, D]; scratch VMEM
-    [bm, D] + one DMA semaphore per row. All row copies START before any
-    WAIT (disjoint scratch rows, own semaphores) so the bm HBM reads
-    overlap instead of serializing."""
+    src_ref: [B, N, D/128, 128] in ANY (HBM) — rows are laid out as
+    (D/128, 128) tiles so the per-row slice cuts only MAJOR (untiled)
+    dims; Mosaic rejects size-1 slices of the sublane dim, which a flat
+    [B, N, D] layout would require. out block [1, bm, D]; scratch VMEM
+    [bm, D/128, 128] + one DMA semaphore per row. All row copies START
+    before any WAIT (disjoint scratch rows, own semaphores) so the bm HBM
+    reads overlap instead of serializing."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -63,7 +66,7 @@ def _gather_rows_kernel(idx_ref, src_ref, out_ref, scratch, sems, *, bm):
         i, cp = row_copy(r)
         pl.when(i >= 0)(cp.wait)
 
-    out_ref[0] = scratch[...]
+    out_ref[0] = scratch[...].reshape(out_ref.shape[1:])
 
 
 @functools.partial(jax.jit, static_argnames=("bm", "interpret"))
@@ -77,6 +80,8 @@ def gather_rows_pallas(src, idx, bm=8, interpret=False):
     while M % bm:
         bm //= 2
     grid = (B, M // bm)
+    lanes = 128
+    src4 = src.reshape(B, N, D // lanes, lanes)
     with jax.enable_x64(False):  # Mosaic: i64 index arithmetic untileable
         return pl.pallas_call(
             functools.partial(_gather_rows_kernel, bm=bm),
@@ -85,12 +90,13 @@ def gather_rows_pallas(src, idx, bm=8, interpret=False):
                 grid=grid,
                 in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
                 out_specs=pl.BlockSpec((1, bm, D), lambda b, m, idx: (b, m, 0)),
-                scratch_shapes=[pltpu.VMEM((bm, D), src.dtype),
+                scratch_shapes=[pltpu.VMEM((bm, D // lanes, lanes),
+                                           src.dtype),
                                 pltpu.SemaphoreType.DMA((bm,))],
             ),
             out_shape=jax.ShapeDtypeStruct((B, M, D), src.dtype),
             interpret=interpret,
-        )(idx.astype(jnp.int32), src)
+        )(idx.astype(jnp.int32), src4)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))

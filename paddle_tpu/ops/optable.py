@@ -261,7 +261,7 @@ def _crop(x, shape, offsets):
     return jax.lax.dynamic_slice(x, offsets, shape)
 
 
-C("take", lambda x, index=None, mode="raise": _take(x, index, mode),
+C("take", lambda x, index, mode="raise": _take(x, index, mode),
   ref=lambda x: x.reshape(-1)[np.array([1, 5, 10])],
   kwargs={"index": np.array([1, 5, 10])}, grad=False)
 
@@ -325,9 +325,9 @@ def _diagonal_scatter(x, y, offset, axis1, axis2):
     return jnp.moveaxis(moved, (0, 1), (axis1, axis2))
 
 
-C("select_scatter", lambda x, values, axis=0, index=0:
+C("select_scatter", lambda x, values, axis, index:
   _select_scatter(x, values, axis, index),
-  ref=lambda x, v, axis=0, index=1: _np_select_scatter(x, v, index),
+  ref=lambda x, v: _np_select_scatter(x, v, 1),
   kwargs={"axis": 0, "index": 1}, n_in=2, shapes=((4, 4), (4,)),
   grad=False)
 
