@@ -47,9 +47,6 @@ def _timed_steps(step, state, tokens, warmup, timed):
         state, m = step(state, tokens)
     loss_val = float(m["loss"])
     dt = time.perf_counter() - t0
-    del state, m
-    import gc
-    gc.collect()
     return dt, loss_val
 
 
@@ -72,6 +69,11 @@ def run_config(cfg, batch, seq, timed_steps, state_quant=None,
                                 timed_steps)
     tok_s = batch * seq * timed_steps / dt
     mfu = tok_s * llama.flops_per_token(cfg, seq) / peak_for(dev)
+    # free this config's HBM before the next one (lingering buffers slow
+    # the following config) — the CALLER holds the big references
+    del state, step, tx, tokens
+    import gc
+    gc.collect()
     return {"tok_s": tok_s, "mfu": mfu, "loss": loss_val,
             "params": llama.num_params(cfg)}
 
@@ -102,16 +104,10 @@ def run_moe(batch=16, seq=2048, timed_steps=6):
                          jnp.int32)
     dt_total, _ = _timed_steps(step, state, tokens, 2, timed_steps)
     dt = dt_total / timed_steps
-
-    D, Fm = cfg.hidden_size, cfg.moe_intermediate_size
-    H, KV, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
-                 cfg.head_dim)
-    L, E = cfg.num_hidden_layers, cfg.num_experts
-    k, sh = cfg.num_experts_per_tok, cfg.num_shared_experts
-    matmul = L * (D * (H + 2 * KV) * hd + H * hd * D + D * E
-                  + 3 * D * Fm * (k + sh)) + cfg.vocab_size * D
-    attn = L * H * hd * seq
-    mfu = 6.0 * (matmul + attn) * batch * seq / dt / peak_for(dev)
+    mfu = moe.flops_per_token(cfg, seq) * batch * seq / dt / peak_for(dev)
+    del state, step, tx, tokens
+    import gc
+    gc.collect()
     return {"mfu": mfu, "tok_s": batch * seq / dt,
             "params": moe.num_params(cfg)}
 

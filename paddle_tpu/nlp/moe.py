@@ -535,3 +535,19 @@ def active_params(cfg: MoeConfig) -> int:
     if cfg.num_shared_experts:
         per += 3 * D * Fm * cfg.num_shared_experts
     return V * D + L * per + D + D * V
+
+
+def flops_per_token(cfg: MoeConfig, seq_len: int) -> float:
+    """Approx. train FLOPs/token over ACTIVE params (the MoE convention —
+    only routed + shared experts do work), same 6x fwd+bwd and
+    causal-halved attention accounting as llama.flops_per_token."""
+    D, Fm, L = (cfg.hidden_size, cfg.moe_intermediate_size,
+                cfg.num_hidden_layers)
+    H, KV, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    matmul = L * (D * (H + 2 * KV) * hd + H * hd * D + D * cfg.num_experts
+                  + 3 * D * Fm * (cfg.num_experts_per_tok
+                                  + cfg.num_shared_experts)) \
+        + cfg.vocab_size * D
+    attn = L * H * hd * seq_len
+    return 6.0 * (matmul + attn)
