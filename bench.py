@@ -15,6 +15,7 @@ flash attention + remat):
 """
 from __future__ import annotations
 
+import gc
 import json
 import time
 
@@ -35,10 +36,18 @@ def peak_for(device) -> float:
     return 0.5e12
 
 
+def _free():
+    """Force collection AFTER the caller has del'd its big references —
+    lingering HBM buffers measurably slow the following config
+    (fragmentation). Usage: `del state, step, tx, tokens; _free()`."""
+    gc.collect()
+
+
 def _timed_steps(step, state, tokens, warmup, timed):
-    """Shared timing protocol: warmup, host-sync via float() (the axon
-    remote queue does not drain on block_until_ready alone), timed loop,
-    then free the config's HBM (lingering buffers slow the next config)."""
+    """Shared timing protocol: warmup, then host-sync via float() (the
+    axon remote queue does not drain on block_until_ready alone), then
+    the timed loop. HBM cleanup is the CALLER's job (_free) — it holds
+    the big references."""
     for _ in range(max(warmup, 1)):
         state, m = step(state, tokens)
     float(m["loss"])
@@ -69,11 +78,8 @@ def run_config(cfg, batch, seq, timed_steps, state_quant=None,
                                 timed_steps)
     tok_s = batch * seq * timed_steps / dt
     mfu = tok_s * llama.flops_per_token(cfg, seq) / peak_for(dev)
-    # free this config's HBM before the next one (lingering buffers slow
-    # the following config) — the CALLER holds the big references
     del state, step, tx, tokens
-    import gc
-    gc.collect()
+    _free()
     return {"tok_s": tok_s, "mfu": mfu, "loss": loss_val,
             "params": llama.num_params(cfg)}
 
@@ -106,8 +112,7 @@ def run_moe(batch=16, seq=2048, timed_steps=6):
     dt = dt_total / timed_steps
     mfu = moe.flops_per_token(cfg, seq) * batch * seq / dt / peak_for(dev)
     del state, step, tx, tokens
-    import gc
-    gc.collect()
+    _free()
     return {"mfu": mfu, "tok_s": batch * seq / dt,
             "params": moe.num_params(cfg)}
 
@@ -154,8 +159,7 @@ def run_8b_layer(seq, batch=1, timed_steps=8):
     flops = 6.0 * (matmul + attn) * batch * seq
     mfu = flops / dt / peak_for(dev)
     del lp, x, g, step
-    import gc
-    gc.collect()
+    _free()
     return mfu
 
 
