@@ -200,6 +200,21 @@ def _attach():
     Tensor.where = lambda s, x, y, name=None: where(s, x, y)
     Tensor.nonzero = lambda s, as_tuple=False: nonzero(s, as_tuple)
     Tensor.unique = lambda s, **kw: unique(s, **kw)
+    Tensor.reverse = lambda s, axis, name=None: flip(s, axis)  # 1.x alias
+    Tensor.unfold = lambda s, axis, size, step, name=None: \
+        g["tensor_unfold"](s, axis, size, step)
+
+    # dense<->sparse bridge (paddle.Tensor.to_sparse_coo/to_dense)
+    def _to_sparse_coo(s, sparse_dim=None):
+        from ..sparse import SparseCooTensor
+        from jax.experimental import sparse as jsparse
+        return SparseCooTensor(jsparse.BCOO.fromdense(s._data),
+                               s.stop_gradient)
+
+    Tensor.to_sparse_coo = _to_sparse_coo
+    Tensor.to_sparse_csr = lambda s: _to_sparse_coo(s).to_sparse_csr()
+    Tensor.to_dense = lambda s: s  # dense tensors are their own dense form
+    Tensor.values = lambda s: s    # paddle: values() of a dense tensor
 
 
 _attach()
