@@ -208,8 +208,9 @@ def _attach():
     def _to_sparse_coo(s, sparse_dim=None):
         from ..sparse import SparseCooTensor
         from jax.experimental import sparse as jsparse
-        return SparseCooTensor(jsparse.BCOO.fromdense(s._data),
-                               s.stop_gradient)
+        nd = 0 if sparse_dim is None else s.ndim - int(sparse_dim)
+        return SparseCooTensor(
+            jsparse.BCOO.fromdense(s._data, n_dense=nd), s.stop_gradient)
 
     Tensor.to_sparse_coo = _to_sparse_coo
     Tensor.to_sparse_csr = lambda s: _to_sparse_coo(s).to_sparse_csr()

@@ -414,3 +414,78 @@ class TestIncubateRegressions:
         spec = paddle.to_tensor(np.zeros((1, 33, 4), np.complex64))
         with pytest.raises(ValueError):
             paddle.signal.istft(spec, 64, return_complex=True)
+
+
+class TestRound3Distributions:
+    """The remaining paddle.distribution surface (round 3): closed-form
+    log_prob/moment checks like the reference's distribution tests."""
+
+    def test_multivariate_normal(self):
+        import math
+        D = paddle.distribution
+        mvn = D.MultivariateNormal(
+            paddle.to_tensor(np.zeros(3, np.float32)),
+            covariance_matrix=paddle.to_tensor(
+                np.eye(3, dtype=np.float32) * 2))
+        lp = float(mvn.log_prob(
+            paddle.to_tensor(np.zeros(3, np.float32))).numpy())
+        expect = -1.5 * math.log(2 * math.pi) - 1.5 * math.log(2.0)
+        assert abs(lp - expect) < 1e-5
+        ent = float(mvn.entropy().numpy())
+        assert abs(ent - (1.5 * (1 + math.log(2 * math.pi))
+                          + 1.5 * math.log(2.0))) < 1e-5
+        s = mvn.sample((500,))
+        assert np.allclose(np.var(s.numpy(), 0), 2.0, atol=0.6)
+
+    def test_binomial_and_cauchy(self):
+        import math
+        D = paddle.distribution
+        b = D.Binomial(paddle.to_tensor(10.0), paddle.to_tensor(0.5))
+        assert float(b.mean.numpy()) == 5.0
+        assert abs(float(b.log_prob(paddle.to_tensor(5.0)).numpy())
+                   - math.log(math.comb(10, 5) * 0.5 ** 10)) < 1e-5
+        c = D.Cauchy(paddle.to_tensor(0.0), paddle.to_tensor(1.0))
+        assert abs(float(c.log_prob(paddle.to_tensor(0.0)).numpy())
+                   + math.log(math.pi)) < 1e-5
+        assert abs(float(c.cdf(paddle.to_tensor(0.0)).numpy()) - 0.5) < 1e-6
+
+    def test_chisq_continuous_bernoulli_lkj(self):
+        D = paddle.distribution
+        chi = D.ChiSquared(paddle.to_tensor(4.0))
+        assert abs(float(np.mean(chi.sample((3000,)).numpy())) - 4.0) < 0.5
+        cb = D.ContinuousBernoulli(paddle.to_tensor(0.3))
+        # density integrates to ~1 over a grid
+        xs = np.linspace(1e-4, 1 - 1e-4, 2001, dtype=np.float32)
+        pdf = np.exp(cb.log_prob(paddle.to_tensor(xs)).numpy())
+        assert abs(np.trapezoid(pdf, xs) - 1.0) < 1e-2
+        lkj = D.LKJCholesky(4, 1.5)
+        L = lkj.sample()
+        corr = L.numpy() @ L.numpy().T
+        assert np.allclose(np.diag(corr), 1.0, atol=1e-5)
+        assert np.isfinite(float(lkj.log_prob(
+            paddle.to_tensor(L.numpy())).numpy()))
+
+    def test_transform_long_tail(self):
+        import math
+        D = paddle.distribution
+        sb = D.StickBreakingTransform()
+        x = paddle.to_tensor(np.array([0.2, -0.3, 0.5], np.float32))
+        y = sb.forward(x)
+        assert abs(float(y.numpy().sum()) - 1.0) < 1e-5
+        np.testing.assert_allclose(sb.inverse(y).numpy(), x.numpy(),
+                                   atol=1e-5)
+        ch = D.ChainTransform([
+            D.AffineTransform(paddle.to_tensor(1.0), paddle.to_tensor(2.0)),
+            D.ExpTransform()])
+        assert abs(float(ch.forward(paddle.to_tensor(0.0)).numpy())
+                   - math.e) < 1e-5
+        pw = D.PowerTransform(paddle.to_tensor(2.0))
+        np.testing.assert_allclose(
+            pw.inverse(pw.forward(paddle.to_tensor(3.0))).numpy(), 3.0,
+            rtol=1e-6)
+        sm = D.SoftmaxTransform()
+        v = sm.forward(paddle.to_tensor(np.array([1., 2., 3.], np.float32)))
+        assert abs(float(v.numpy().sum()) - 1.0) < 1e-6
+        rs = D.ReshapeTransform((4,), (2, 2))
+        out = rs.forward(paddle.to_tensor(np.zeros((3, 4), np.float32)))
+        assert out.shape == [3, 2, 2]
