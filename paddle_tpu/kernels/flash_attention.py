@@ -186,8 +186,10 @@ def _fwd_call(off, qt, kt, vt, grid, block_q, block_k, causal, scale, sk, b,
 #   RESIDENT (seq <= _RESIDENT_MAX_SEQ): the counterpart tensor stays in a
 #   full-seq VMEM window and an in-kernel fori_loop streams blocks with a
 #   DYNAMIC trip count — causal blocks past the diagonal cost zero
-#   iterations. Fastest at training lengths (2-4k), but the windows hit
-#   Mosaic's 16MB scoped-vmem stack limit at seq 8192.
+#   iterations. Fastest at the common 2k training length, but the windows
+#   hit Mosaic's 16MB scoped-vmem stack limit from seq 4096 up (measured:
+#   the 2B model at seq 4096 batch 4 fails to compile resident, compiles
+#   and runs streamed).
 #   STREAMED (longer): 3D grid — dq over (bh, qb, kb) with an f32 scratch
 #   accumulator, dk/dv over (bh, kb, qb) — every ref is ONE block, nothing
 #   full-sequence in VMEM, so seq scales to the 8B north-star 8k+ shapes;
@@ -195,7 +197,7 @@ def _fwd_call(off, qt, kt, vt, grid, block_q, block_k, causal, scale, sk, b,
 #   runs, ~1pt MFU at 2k — why the resident path is kept).
 # ---------------------------------------------------------------------------
 
-_RESIDENT_MAX_SEQ = 4096
+_RESIDENT_MAX_SEQ = 2048
 
 
 def _flash_bwd_dq_kernel_res(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
