@@ -294,3 +294,42 @@ class TestEngineOpsSurface:
                 np.array([0.5], np.float32)), seed=seed)
             ids.add(int(i.numpy()[0, 0]))
         assert ids == {0}  # 0.6 alone exceeds p=0.5
+
+
+class TestFusedLayers:
+    """The incubate fused Layer zoo forwards + trains."""
+
+    def test_encoder_layer_and_parts(self):
+        import paddle_tpu.incubate.nn as inn
+        x = paddle.to_tensor(np.random.RandomState(0).randn(
+            2, 6, 16).astype(np.float32))
+        enc = inn.FusedTransformerEncoderLayer(16, 4, 32)
+        assert enc(x).shape == [2, 6, 16]
+        rms = inn.FusedRMSNorm(16)
+        assert rms(x).shape == [2, 6, 16]
+        lin = inn.FusedLinear(16, 8)
+        assert lin(x).shape == [2, 6, 8]
+        bdr = inn.FusedBiasDropoutResidualLayerNorm(16, dropout_rate=0.0)
+        assert bdr(x, x).shape == [2, 6, 16]
+
+    def test_fused_encoder_trains(self):
+        import paddle_tpu.incubate.nn as inn
+        import paddle_tpu.nn as nn
+        model = nn.Sequential(
+            inn.FusedTransformerEncoderLayer(8, 2, 16),
+            nn.Flatten(), nn.Linear(8 * 4, 2))
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(
+            4, 4, 8).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 1, 0, 1]))
+        lossf = nn.CrossEntropyLoss()
+        first = None
+        for i in range(6):
+            loss = lossf(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss.numpy())
+        assert float(loss.numpy()) < first
