@@ -443,3 +443,175 @@ def adjust_saturation(img, saturation_factor):
     hi = 255.0 if np.issubdtype(orig.dtype, np.integer) else 1.0
     return np.clip(gray + saturation_factor * (a - gray), 0, hi).astype(
         orig.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Round-3: transform classes over the functional surface
+# (python/paddle/vision/transforms/transforms.py parity). House contract:
+# implement _apply_image (BaseTransform.__call__ owns the HWC conversion)
+# and draw randomness from pyrandom, like every other class here — one
+# seedable RNG source for the whole pipeline.
+# ---------------------------------------------------------------------------
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError(f"contrast value must be >= 0, got {value}")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        # reference clamps the low end at 0 — no contrast inversion
+        f = pyrandom.uniform(max(0.0, 1.0 - self.value), 1.0 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError(f"saturation value must be >= 0, got {value}")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = pyrandom.uniform(max(0.0, 1.0 - self.value), 1.0 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError(
+                f"hue value must be in [0, 0.5], got {value}")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, pyrandom.uniform(-self.value, self.value))
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if expand:
+            raise NotImplementedError(
+                "RandomRotation(expand=True): canvas growth is not "
+                "implemented — rotate() keeps the input extent "
+                "(paddle_tpu/vision/transforms.py)")
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, numbers.Number) else tuple(degrees)
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = pyrandom.uniform(*self.degrees)
+        return rotate(img, angle, center=self.center, fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, numbers.Number) else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        angle = pyrandom.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = pyrandom.uniform(-self.translate[0], self.translate[0]) * w
+            ty = pyrandom.uniform(-self.translate[1], self.translate[1]) * h
+        sc = 1.0 if self.scale is None else pyrandom.uniform(*self.scale)
+        if self.shear is None:
+            sh = 0.0
+        elif isinstance(self.shear, numbers.Number):
+            sh = pyrandom.uniform(-self.shear, self.shear)
+        else:
+            sh = pyrandom.uniform(*self.shear)
+        return affine(img, angle, (tx, ty), sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if pyrandom.random() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+        # reference semantics: corners displace strictly INTO the image
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        signs = [(1, 1), (-1, 1), (-1, -1), (1, -1)]
+        end = [(x + sx * pyrandom.randint(0, max(dx, 0)),
+                y + sy * pyrandom.randint(0, max(dy, 0)))
+               for (x, y), (sx, sy) in zip(start, signs)]
+        return perspective(img, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def __call__(self, img):
+        # CHW Tensors keep their type — erase() has a dedicated Tensor
+        # branch; everything else takes the HWC array path
+        from ..core.tensor import Tensor
+        if isinstance(img, Tensor):
+            c, h, w = img.shape[-3], img.shape[-2], img.shape[-1]
+            box = self._pick(h, w)
+            if box is None:
+                return img
+            i, j, eh, ew = box
+            return erase(img, i, j, eh, ew, self.value,
+                         inplace=self.inplace)
+        return super().__call__(img)
+
+    def _pick(self, h, w):
+        if pyrandom.random() >= self.prob:
+            return None
+        area = h * w
+        for _ in range(10):
+            target = pyrandom.uniform(*self.scale) * area
+            log_lo, log_hi = np.log(self.ratio[0]), np.log(self.ratio[1])
+            ar = np.exp(pyrandom.uniform(log_lo, log_hi))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if 0 < eh < h and 0 < ew < w:
+                # INCLUSIVE bounds: edge-flush placements are reachable
+                return (pyrandom.randint(0, h - eh),
+                        pyrandom.randint(0, w - ew), eh, ew)
+        return None
+
+    def _apply_image(self, img):
+        box = self._pick(img.shape[0], img.shape[1])
+        if box is None:
+            return img
+        i, j, eh, ew = box
+        return erase(img, i, j, eh, ew, self.value, inplace=self.inplace)

@@ -333,3 +333,59 @@ class TestFusedLayers:
             if first is None:
                 first = float(loss.numpy())
         assert float(loss.numpy()) < first
+
+
+class TestTransformClasses:
+    """The round-3 transform class zoo composes into working pipelines."""
+
+    def test_full_augmentation_pipeline(self):
+        import random as pyrandom
+        from paddle_tpu.vision import transforms as T
+        pyrandom.seed(0)
+        np.random.seed(0)
+        img = (np.random.rand(16, 20, 3) * 255).astype(np.uint8)
+        pipe = T.Compose([
+            T.RandomRotation(10),
+            T.RandomAffine(5, translate=(0.1, 0.1)),
+            T.RandomPerspective(prob=1.0, distortion_scale=0.2),
+            T.ContrastTransform(0.2), T.SaturationTransform(0.2),
+            T.HueTransform(0.1), T.RandomErasing(prob=1.0),
+            T.Grayscale(3),
+        ])
+        out = pipe(img)
+        assert out.shape == (16, 20, 3) and out.dtype == np.uint8
+        # grayscale: all three channels equal
+        assert np.array_equal(out[..., 0], out[..., 1])
+
+    def test_zero_value_transforms_are_identity(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.RandomState(1).rand(8, 8, 3) * 255).astype(
+            np.uint8)
+        np.testing.assert_array_equal(T.ContrastTransform(0)(img), img)
+        np.testing.assert_array_equal(T.HueTransform(0)(img), img)
+        np.testing.assert_array_equal(
+            T.RandomErasing(prob=0.0)(img), img)
+
+    def test_seeded_pipeline_is_deterministic(self):
+        import random as pyrandom
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.RandomState(2).rand(12, 12, 3) * 255).astype(
+            np.uint8)
+        pipe = T.Compose([T.RandomRotation(15), T.RandomErasing(prob=1.0),
+                          T.ContrastTransform(0.3)])
+        pyrandom.seed(11)
+        a = pipe(img)
+        pyrandom.seed(11)
+        b = pipe(img)
+        np.testing.assert_array_equal(a, b)
+
+    def test_random_erasing_tensor_chw(self):
+        import random as pyrandom
+        pyrandom.seed(0)
+        from paddle_tpu.vision import transforms as T
+        t = paddle.to_tensor(np.ones((3, 8, 10), np.float32))
+        out = T.RandomErasing(prob=1.0)(t)
+        assert type(out).__name__ == "Tensor" and out.shape == [3, 8, 10]
+        # a SPATIAL patch is erased identically across channels
+        z = out.numpy() == 0
+        assert z.any() and np.array_equal(z[0], z[1])
