@@ -75,3 +75,92 @@ def segment_max(data, segment_ids, name=None):
 def segment_min(data, segment_ids, name=None):
     from .. import geometric
     return geometric.segment_min(data, segment_ids)
+
+
+class LookAhead:
+    """paddle.incubate.LookAhead optimizer wrapper: every k steps the
+    slow weights pull toward the fast weights by alpha."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = None
+        self._count = 0
+
+    def _params(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        import jax.numpy as jnp
+        self.inner_optimizer.step()
+        self._count += 1
+        if self._slow is None:
+            self._slow = [p._data for p in self._params()]
+        if self._count % self.k == 0:
+            for i, p in enumerate(self._params()):
+                slow = self._slow[i] + self.alpha * (p._data - self._slow[i])
+                self._slow[i] = slow
+                p._rebind(slow.astype(p._data.dtype))
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage:
+    """paddle.incubate.ModelAverage: maintains an exponential/window
+    average of params; apply()/restore() swap it in and out for eval."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("ModelAverage needs parameters=")
+        self._params = list(parameters)
+        self._sum = None
+        self._n = 0
+        self._backup = None
+
+    def step(self):
+        if self._sum is None:
+            self._sum = [p._data.astype("float32") for p in self._params]
+            self._n = 1
+        else:
+            self._sum = [s + p._data.astype("float32")
+                         for s, p in zip(self._sum, self._params)]
+            self._n += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap the averaged params in (restore() swaps back; the
+        need_restore flag is informational, as in the reference's
+        context-manager form)."""
+        if self._sum is None:
+            raise RuntimeError(
+                "ModelAverage.apply() before any step(): nothing has been "
+                "averaged yet (paddle_tpu/incubate/__init__.py)")
+        self._backup = [p._data for p in self._params]
+        for p, s in zip(self._params, self._sum):
+            p._rebind((s / self._n).astype(p._data.dtype))
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._rebind(b)
+            self._backup = None
+
+    def clear_grad(self):
+        for p in self._params:
+            p.grad = None

@@ -2,7 +2,7 @@
 (upstream-canonical, unverified — SURVEY.md §0)."""
 from .optimizers import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp,
-    Lamb, L1Decay, L2Decay,
+    Lamb, Rprop, ASGD, NAdam, RAdam, LBFGS, L1Decay, L2Decay,
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
 )
 from . import lr  # noqa: F401

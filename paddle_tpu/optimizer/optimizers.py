@@ -450,3 +450,229 @@ class L1Decay:
 class L2Decay:
     def __init__(self, coeff=0.0):
         self._coeff = coeff
+
+
+class Rprop(Optimizer):
+    """paddle.optimizer.Rprop (3.0): sign-based resilient propagation."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _init_state(self, p):
+        return {"prev_grad": jnp.zeros_like(p._data),
+                "step_size": jnp.full_like(
+                    p._data, float(self.get_lr()))}
+
+    def _update(self, value, grad, state, lr, lr_mult, wd):
+        eta_n, eta_p = self._etas
+        lo, hi = self._lr_range
+        sign = jnp.sign(grad * state["prev_grad"])
+        factor = jnp.where(sign > 0, eta_p, jnp.where(sign < 0, eta_n, 1.0))
+        step = jnp.clip(state["step_size"] * factor, lo, hi)
+        # on sign change: no move, zero the carried grad (classic Rprop-)
+        g_eff = jnp.where(sign < 0, 0.0, grad)
+        new_value = value - jnp.sign(g_eff) * step
+        return new_value, {"prev_grad": g_eff, "step_size": step}
+
+
+class ASGD(Optimizer):
+    """paddle.optimizer.ASGD (3.0): averaged SGD — the returned params are
+    the running average of the SGD iterates."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._batch_num = batch_num
+
+    def _init_state(self, p):
+        # f32 state: step() feeds master-dtype (f32) grads under
+        # multi_precision, and dynamic_update_slice requires equal dtypes
+        return {"d": jnp.zeros_like(p._data, jnp.float32),
+                "ys": jnp.zeros((max(self._batch_num, 1),)
+                                + tuple(p._data.shape), jnp.float32),
+                "idx": jnp.zeros((), jnp.int32)}
+
+    def _update(self, value, grad, state, lr, lr_mult, wd):
+        grad = (grad + wd * value).astype(jnp.float32)
+        n = state["ys"].shape[0]
+        old = jax.lax.dynamic_index_in_dim(state["ys"], state["idx"], 0,
+                                           keepdims=False)
+        d = state["d"] - old + grad
+        ys = jax.lax.dynamic_update_index_in_dim(state["ys"], grad,
+                                                 state["idx"], 0)
+        new_value = value - lr * lr_mult * d / n
+        return new_value, {"d": d, "ys": ys,
+                           "idx": (state["idx"] + 1) % n}
+
+
+class NAdam(Optimizer):
+    """paddle.optimizer.NAdam (3.0)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._b1, self._b2 = beta1, beta2
+        self._eps = epsilon
+        self._psi = momentum_decay
+
+    def _init_state(self, p):
+        return {"m": jnp.zeros_like(p._data, jnp.float32),
+                "v": jnp.zeros_like(p._data, jnp.float32),
+                "mu_prod": jnp.ones((), jnp.float32),
+                "t": jnp.zeros((), jnp.float32)}
+
+    def _update(self, value, grad, state, lr, lr_mult, wd):
+        b1, b2, eps, psi = self._b1, self._b2, self._eps, self._psi
+        grad = grad + wd * value
+        t = state["t"] + 1
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * psi))
+        mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * psi))
+        mu_prod = state["mu_prod"] * mu_t
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * jnp.square(grad)
+        m_hat = (mu_t1 * m / (1 - mu_prod * mu_t1)
+                 + (1 - mu_t) * grad / (1 - mu_prod))
+        v_hat = v / (1 - b2 ** t)
+        new_value = value - lr * lr_mult * m_hat / (jnp.sqrt(v_hat) + eps)
+        return new_value, {"m": m, "v": v, "mu_prod": mu_prod, "t": t}
+
+
+class RAdam(Optimizer):
+    """paddle.optimizer.RAdam (3.0): rectified Adam."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._b1, self._b2 = beta1, beta2
+        self._eps = epsilon
+
+    def _init_state(self, p):
+        return {"m": jnp.zeros_like(p._data, jnp.float32),
+                "v": jnp.zeros_like(p._data, jnp.float32),
+                "t": jnp.zeros((), jnp.float32)}
+
+    def _update(self, value, grad, state, lr, lr_mult, wd):
+        b1, b2, eps = self._b1, self._b2, self._eps
+        grad = grad + wd * value
+        t = state["t"] + 1
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * jnp.square(grad)
+        m_hat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * t * b2 ** t / (1 - b2 ** t)
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                     / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t,
+                                   1e-12))
+        v_hat = jnp.sqrt(v / (1 - b2 ** t))
+        rect = value - lr * lr_mult * r * m_hat / (v_hat + eps)
+        plain = value - lr * lr_mult * m_hat
+        new_value = jnp.where(rho_t > 5.0, rect, plain)
+        return new_value, {"m": m, "v": v, "t": t}
+
+
+class LBFGS(Optimizer):
+    """paddle.optimizer.LBFGS: closure-driven two-loop-recursion L-BFGS.
+
+    step(closure) recomputes loss+grads via the closure like the
+    reference; history lives host-side (this optimizer is for small
+    full-batch problems, not the jitted train-step path)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        if weight_decay is not None or grad_clip is not None:
+            raise NotImplementedError(
+                "LBFGS does not support weight_decay/grad_clip (fold decay "
+                "into the closure's loss; paddle_tpu/optimizer/"
+                "optimizers.py)")
+        super().__init__(learning_rate, parameters, None, None, name)
+        self._max_iter = max_iter
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._hist = history_size
+        self._s, self._y = [], []
+        self._prev_flat_grad = None
+
+    def _active(self):
+        """Params the closure actually produced grads for — the same
+        filter the base step() applies; the SAME subset must be used for
+        grads, params, and writes or the flat offsets shear."""
+        return [p for p in self._parameter_list
+                if p.grad is not None and not p.stop_gradient]
+
+    def _flat_grads(self, params):
+        return jnp.concatenate([
+            p.grad._data.reshape(-1).astype(jnp.float32) for p in params])
+
+    def _set_flat_params(self, params, flat):
+        off = 0
+        for p in params:
+            n = int(np.prod(p._data.shape)) if p._data.shape else 1
+            p._rebind(flat[off:off + n].reshape(p._data.shape
+                                                ).astype(p._data.dtype))
+            off += n
+
+    def _flat_params(self, params):
+        return jnp.concatenate([
+            p._data.reshape(-1).astype(jnp.float32) for p in params])
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step needs a closure that recomputes "
+                             "the loss and calls backward()")
+        loss = None
+        for _ in range(max(self._max_iter, 1)):
+            loss = closure()
+            params = self._active()
+            if not params:
+                return loss
+            g = self._flat_grads(params)
+            if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
+                break
+            if self._prev_flat_grad is not None and                     self._prev_flat_grad.shape == g.shape:
+                s = self._flat_params(params) - self._prev_params
+                y = g - self._prev_flat_grad
+                ys = float(jnp.dot(y, s))
+                if ys > 1e-10:
+                    self._s.append(s)
+                    self._y.append(y)
+                    if len(self._s) > self._hist:
+                        self._s.pop(0)
+                        self._y.pop(0)
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y in zip(reversed(self._s), reversed(self._y)):
+                rho = 1.0 / float(jnp.dot(y, s))
+                a = rho * float(jnp.dot(s, q))
+                alphas.append((a, rho))
+                q = q - a * y
+            if self._s:
+                gamma = float(jnp.dot(self._s[-1], self._y[-1])
+                              / jnp.maximum(
+                                  jnp.dot(self._y[-1], self._y[-1]),
+                                  1e-12))
+                q = q * gamma
+            for (a, rho), s, y in zip(reversed(alphas), self._s, self._y):
+                b = rho * float(jnp.dot(y, q))
+                q = q + (a - b) * s
+            direction = -q
+            self._prev_flat_grad = g
+            self._prev_params = self._flat_params(params)
+            step_vec = self.get_lr() * direction
+            self._set_flat_params(params, self._prev_params + step_vec)
+            self._step_count += 1
+            if float(jnp.max(jnp.abs(step_vec))) <= self._tol_change:
+                break
+        return loss

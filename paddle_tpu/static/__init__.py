@@ -27,8 +27,10 @@ from ..core.tensor import Tensor, Parameter
 from ..core import dtype as dtypes
 from ..ops import _registry
 
+from .. import static_nn as nn  # noqa: F401  (paddle.static.nn)
+
 __all__ = [
-    "Program", "program_guard", "default_main_program",
+    "nn", "Program", "program_guard", "default_main_program",
     "default_startup_program", "data", "Executor", "InputSpec",
     "save_inference_model", "load_inference_model", "global_scope",
     "name_scope", "enable_static", "disable_static", "in_static_mode",

@@ -1,0 +1,135 @@
+"""paddle.static.nn — static-graph layer helpers over the eager layers.
+
+Reference analog: python/paddle/static/nn/ (fc, conv2d, batch_norm,
+embedding ... build ops into the Program — upstream-canonical,
+unverified SURVEY.md §0, §2.4 paddle.static row). Here every call runs
+through the SAME eager dispatch that static capture hooks (static/
+__init__._capture), so inside a paddle.static Program these record ops
+exactly like any eager call — the helpers just construct the layer
+parameters inline, matching the reference's signature shape.
+"""
+from __future__ import annotations
+
+from . import nn as _nn
+from .nn import functional as _F
+
+__all__ = ["fc", "embedding", "batch_norm", "layer_norm", "conv2d",
+           "conv2d_transpose", "dropout", "prelu", "sequence_expand"]
+
+_layer_cache = {}
+
+
+def _cached(key, factory):
+    """NAMED helpers reuse parameters across builds (the reference's
+    parameter scope: same param_attr name -> same weights). UNNAMED calls
+    each create a fresh layer — capture runs a helper exactly once per
+    call site, and sharing by shape would silently alias distinct layers
+    (two same-width fc's training one weight matrix)."""
+    name = key[1]
+    if name is None:
+        return factory()
+    if key not in _layer_cache:
+        _layer_cache[key] = factory()
+    return _layer_cache[key]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    in_f = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_f *= int(d)
+    layer = _cached(("fc", name, id_shape(x, size)),
+                    lambda: _nn.Linear(in_f, size))
+    # batch dims stay dynamic: a captured Program replays at any batch
+    lead = list(x.shape[:num_flatten_dims])
+    lead[0] = -1
+    flat = x.reshape(lead + [in_f])
+    out = layer(flat)
+    if activation:
+        out = getattr(_F, activation)(out)
+    return out
+
+
+def id_shape(x, size):
+    return (tuple(int(d) for d in x.shape), size)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    layer = _cached(("embedding", name, tuple(size)),
+                    lambda: _nn.Embedding(size[0], size[1],
+                                          padding_idx=padding_idx))
+    return layer(input)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None, **kw):
+    c = int(input.shape[1 if data_layout == "NCHW" else -1])
+    layer = _cached(("batch_norm", name, c),
+                    lambda: _nn.BatchNorm(c, momentum=momentum,
+                                          epsilon=epsilon))
+    layer.training = not is_test
+    out = layer(input)
+    if act:
+        out = getattr(_F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = [int(d) for d in input.shape[begin_norm_axis:]]
+    layer = _cached(("layer_norm", name, tuple(shape)),
+                    lambda: _nn.LayerNorm(shape, epsilon=epsilon))
+    out = layer(input)
+    if act:
+        out = getattr(_F, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCHW", name=None):
+    c = int(input.shape[1 if data_format == "NCHW" else -1])
+    layer = _cached(
+        ("conv2d", name, (c, num_filters, filter_size)),
+        lambda: _nn.Conv2D(c, num_filters, filter_size, stride=stride,
+                           padding=padding, dilation=dilation,
+                           groups=groups))
+    out = layer(input)
+    if act:
+        out = getattr(_F, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, stride=1,
+                     padding=0, groups=1, param_attr=None, bias_attr=None,
+                     act=None, data_format="NCHW", name=None, **kw):
+    c = int(input.shape[1 if data_format == "NCHW" else -1])
+    layer = _cached(
+        ("conv2d_transpose", name, (c, num_filters, filter_size)),
+        lambda: _nn.Conv2DTranspose(c, num_filters, filter_size,
+                                    stride=stride, padding=padding,
+                                    groups=groups))
+    out = layer(input)
+    if act:
+        out = getattr(_F, act)(out)
+    return out
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, name=None, **kw):
+    return _F.dropout(x, p=dropout_prob, training=not is_test)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    c = 1 if mode == "all" else int(x.shape[1])
+    layer = _cached(("prelu", name, mode),
+                    lambda: _nn.PReLU(num_parameters=c))
+    return layer(x)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    from .ops import sequence as _seq  # noqa: F401
+    from . import ops as _ops
+    return _ops.sequence_expand(x, y)

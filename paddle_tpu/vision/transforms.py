@@ -542,6 +542,9 @@ class RandomAffine(BaseTransform):
             sh = 0.0
         elif isinstance(self.shear, numbers.Number):
             sh = pyrandom.uniform(-self.shear, self.shear)
+        elif len(self.shear) == 4:   # [min_x, max_x, min_y, max_y]
+            sh = (pyrandom.uniform(self.shear[0], self.shear[1]),
+                  pyrandom.uniform(self.shear[2], self.shear[3]))
         else:
             sh = pyrandom.uniform(*self.shear)
         return affine(img, angle, (tx, ty), sc, sh, fill=self.fill,
@@ -589,9 +592,19 @@ class RandomErasing(BaseTransform):
             if box is None:
                 return img
             i, j, eh, ew = box
-            return erase(img, i, j, eh, ew, self.value,
-                         inplace=self.inplace)
+            v = self._fill_value((c, eh, ew), img.numpy().dtype)
+            return erase(img, i, j, eh, ew, v, inplace=self.inplace)
         return super().__call__(img)
+
+    def _fill_value(self, shape, dtype):
+        if isinstance(self.value, str):
+            if self.value != "random":
+                raise ValueError(f"RandomErasing value {self.value!r}: "
+                                 "'random' or a number/sequence")
+            if np.issubdtype(np.dtype(dtype), np.integer):
+                return np.random.randint(0, 256, shape).astype(dtype)
+            return np.random.standard_normal(shape).astype(dtype)
+        return self.value
 
     def _pick(self, h, w):
         if pyrandom.random() >= self.prob:
@@ -614,4 +627,5 @@ class RandomErasing(BaseTransform):
         if box is None:
             return img
         i, j, eh, ew = box
-        return erase(img, i, j, eh, ew, self.value, inplace=self.inplace)
+        v = self._fill_value((eh, ew, img.shape[2]), img.dtype)
+        return erase(img, i, j, eh, ew, v, inplace=self.inplace)

@@ -389,3 +389,120 @@ class TestTransformClasses:
         # a SPATIAL patch is erased identically across channels
         z = out.numpy() == 0
         assert z.any() and np.array_equal(z[0], z[1])
+
+
+class TestOptimizerZoo:
+    """Round-3 optimizer/scheduler additions converge on a regression."""
+
+    def _fit(self, opt_cls, steps=60, **kw):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        m = nn.Linear(4, 1)
+        opt = opt_cls(parameters=m.parameters(), **kw)
+        rng = np.random.RandomState(0)
+        X = paddle.to_tensor(rng.randn(32, 4).astype("float32"))
+        Y = paddle.to_tensor(
+            X.numpy() @ np.array([[1.], [2.], [-1.], [.5]], np.float32))
+        lossf = nn.MSELoss()
+        first = None
+        for _ in range(steps):
+            l = lossf(m(X), Y)
+            l.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(l.numpy())
+        return first, float(l.numpy())
+
+    @pytest.mark.parametrize("name,kw", [
+        ("Rprop", {}),
+        ("ASGD", dict(learning_rate=0.05, batch_num=4)),
+        ("NAdam", dict(learning_rate=0.1)),
+        ("RAdam", dict(learning_rate=0.1)),
+    ])
+    def test_new_optimizers_converge(self, name, kw):
+        first, last = self._fit(getattr(paddle.optimizer, name), **kw)
+        assert last < first * 0.5, (name, first, last)
+
+    def test_lbfgs_closure(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        m = nn.Linear(4, 1)
+        opt = paddle.optimizer.LBFGS(learning_rate=0.5,
+                                     parameters=m.parameters())
+        rng = np.random.RandomState(0)
+        X = paddle.to_tensor(rng.randn(32, 4).astype("float32"))
+        Y = paddle.to_tensor(
+            X.numpy() @ np.array([[1.], [2.], [-1.], [.5]], np.float32))
+        lossf = nn.MSELoss()
+
+        def closure():
+            opt.clear_grad()
+            l = lossf(m(X), Y)
+            l.backward()
+            return l
+
+        for _ in range(15):
+            l = opt.step(closure)
+        assert float(l.numpy()) < 1e-3
+
+    def test_new_schedulers(self):
+        from paddle_tpu.optimizer.lr import LinearLR, MultiplicativeDecay
+        sch = LinearLR(0.1, total_steps=10, start_factor=0.5)
+        assert abs(sch() - 0.05) < 1e-9
+        for _ in range(10):
+            sch.step()
+        assert abs(sch() - 0.1) < 1e-9
+        md = MultiplicativeDecay(0.1, lambda e: 0.9)
+        md.step()
+        md.step()
+        assert abs(md() - 0.1 * 0.81) < 1e-9
+
+    def test_lookahead_and_model_average(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        m = nn.Linear(4, 1)
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=m.parameters())
+        la = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+        rng = np.random.RandomState(0)
+        X = paddle.to_tensor(rng.randn(16, 4).astype("float32"))
+        Y = paddle.to_tensor(rng.randn(16, 1).astype("float32"))
+        lossf = nn.MSELoss()
+        first = None
+        for _ in range(10):
+            l = lossf(m(X), Y)
+            l.backward()
+            la.step()
+            la.clear_grad()
+            if first is None:
+                first = float(l.numpy())
+        assert float(l.numpy()) < first
+        ma = paddle.incubate.ModelAverage(parameters=m.parameters())
+        for _ in range(3):
+            ma.step()
+        w0 = m.weight.numpy().copy()
+        ma.apply()
+        ma.restore()
+        np.testing.assert_allclose(m.weight.numpy(), w0)
+
+
+class TestStaticNN:
+    def test_program_with_static_nn_layers(self):
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [None, 1, 8, 8], "float32")
+                h = static.nn.conv2d(x, 4, 3, act="relu")
+                h = static.nn.batch_norm(h)
+                h = static.nn.fc(h, 10, activation="softmax")
+        finally:
+            paddle.disable_static()
+        exe = static.Executor()
+        out = exe.run(prog, feed={
+            "x": np.random.rand(2, 1, 8, 8).astype("float32")},
+            fetch_list=[h])
+        assert out[0].shape == (2, 10)
+        np.testing.assert_allclose(out[0].sum(1), 1.0, rtol=1e-5)
