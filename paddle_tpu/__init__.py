@@ -100,6 +100,7 @@ from .hapi import Model, callbacks  # noqa: F401
 from .hapi.summary import summary, flops  # noqa: F401
 
 from . import text  # noqa: F401
+from . import hub  # noqa: F401
 
 # yaml-parity accounting for the remaining op surfaces (SURVEY.md §2.1:
 # signal/audio/vision/sparse/geometric kernels are all ops.yaml entries in

@@ -106,3 +106,5 @@ def parallelize(model, optimizer=None, mesh=None, config=None):
         from .auto_parallel import shard_layer
         model = shard_layer(model, mesh)
     return model, optimizer  # two-value contract even when optimizer=None
+
+from . import sharding  # noqa: E402,F401  (group_sharded facade)

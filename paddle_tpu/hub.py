@@ -1,0 +1,61 @@
+"""paddle.hub — load models from local repo directories.
+
+Reference analog: python/paddle/hapi/hub.py (hub.load/list/help over a
+hubconf.py in a github/local repo — upstream-canonical, unverified,
+SURVEY.md §0). TPU-native v1: the LOCAL source works fully (a directory
+with hubconf.py); github sources raise a clear error — this environment
+has no network egress, and model download belongs to the deployment
+layer, not the framework.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source: str):
+    if source not in ("local",):
+        raise NotImplementedError(
+            f"paddle.hub source {source!r}: only 'local' directories are "
+            "supported (no network egress; paddle_tpu/hub.py)")
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):
+    """Entrypoint names exposed by the repo's hubconf.py."""
+    _check_source("local" if os.path.isdir(repo_dir) else source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False):
+    _check_source("local" if os.path.isdir(repo_dir) else source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir: str, model: str, *args, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Instantiate `model` from the repo's hubconf.py entrypoint."""
+    _check_source("local" if os.path.isdir(repo_dir) else source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(
+            f"hubconf.py in {repo_dir} has no entrypoint {model!r}; "
+            f"available: {[n for n in dir(mod) if not n.startswith('_')]}")
+    return getattr(mod, model)(*args, **kwargs)

@@ -506,3 +506,36 @@ class TestStaticNN:
             fetch_list=[h])
         assert out[0].shape == (2, 10)
         np.testing.assert_allclose(out[0].sum(1), 1.0, rtol=1e-5)
+
+
+class TestHubAndSharding:
+    def test_hub_local_roundtrip(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "import paddle_tpu.nn as nn\n"
+            "def tiny(width=8):\n"
+            "    '''tiny model.'''\n"
+            "    return nn.Linear(4, width)\n")
+        repo = str(tmp_path)
+        assert paddle.hub.list(repo) == ["tiny"]
+        assert "tiny model" in paddle.hub.help(repo, "tiny")
+        m = paddle.hub.load(repo, "tiny", width=16)
+        assert m(paddle.to_tensor(
+            np.ones((2, 4), np.float32))).shape == [2, 16]
+        with pytest.raises(NotImplementedError):
+            paddle.hub.load("user/repo", "x", source="github")
+
+    def test_group_sharded_parallel_places_params(self):
+        from paddle_tpu.parallel.topology import build_mesh, set_mesh
+        from paddle_tpu.distributed.sharding import (
+            group_sharded_parallel, save_group_sharded_model)
+        import paddle_tpu.nn as nn
+        set_mesh(build_mesh(dp=2, sharding=4))
+        model = nn.Linear(16, 64)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+        assert "sharding" in str(model.weight._data.sharding)
+        import tempfile, os
+        with tempfile.TemporaryDirectory() as d:
+            save_group_sharded_model(model, d, opt)
+            assert os.path.exists(os.path.join(d, "model.pdparams"))
