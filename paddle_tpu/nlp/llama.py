@@ -299,7 +299,7 @@ def forward_pp(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
     mb = x.reshape((M, B // M) + x.shape[1:])
     if virtual_pp > 1:
         chunks = stack_virtual_chunks(
-            params["layers"], n, virtual_pp)
+            params["layers"], n, virtual_pp, mesh=mesh)
         chunk_fn = interleaved(stage_fn, mesh, v=virtual_pp,
                                remat=cfg.remat)
         outs = chunk_fn(chunks, mb)
