@@ -280,16 +280,30 @@ def stack_virtual_chunks(layer_params: Any, n_stages: int, v: int,
                 f"need {n_stages} (the staging pins assume one stage per "
                 f"{axis_name} shard)")
         if pp_on and v % n_stages:
-            w = lax.with_sharding_constraint(w, NamedSharding(mesh, P()))
+            w = lax.with_sharding_constraint(
+                w, NamedSharding(mesh, _lead_spec((None,), w.ndim, 1)))
         out = w.reshape((v, n_stages, per) + w.shape[1:])
         if pp_on:
             if v % n_stages == 0:
                 out = lax.with_sharding_constraint(
-                    out, NamedSharding(mesh, P(axis_name)))
+                    out, NamedSharding(mesh, _lead_spec((axis_name,),
+                                                        out.ndim, 3)))
             out = lax.with_sharding_constraint(
-                out, NamedSharding(mesh, P(None, axis_name)))
+                out, NamedSharding(mesh, _lead_spec((None, axis_name),
+                                                    out.ndim, 3)))
         return out
     return jax.tree.map(reshape, layer_params)
+
+
+def _lead_spec(lead, ndim, stack) -> P:
+    """PartitionSpec pinning only the `stack` leading (layer-stack) dims
+    (`lead` padded with None up to `stack`); every trailing weight dim
+    stays UNCONSTRAINED so the relayout never strips a leaf's
+    mp/'sharding' axes (pinning them None would all-gather every TP/ZeRO-
+    sharded weight — the staging must move ONLY the pp axis)."""
+    pad = (stack - len(lead)) * (None,)
+    rest = (P.UNCONSTRAINED,) * (ndim - stack)
+    return P(*lead, *pad, *rest)
 
 
 def unstack_virtual_chunks(chunk_grads: Any, mesh: Optional[Mesh] = None,
@@ -301,12 +315,14 @@ def unstack_virtual_chunks(chunk_grads: Any, mesh: Optional[Mesh] = None,
         v, p = g.shape[0], g.shape[1]
         pp_on = mesh is not None and mesh.shape.get(axis_name, 1) > 1
         if pp_on:
-            spec = P(axis_name) if v % p == 0 else P()
-            g = lax.with_sharding_constraint(g, NamedSharding(mesh, spec))
+            lead = (axis_name,) if v % p == 0 else (None,)
+            g = lax.with_sharding_constraint(
+                g, NamedSharding(mesh, _lead_spec(lead, g.ndim, 3)))
         out = g.reshape((-1,) + g.shape[3:])
         if pp_on:
             out = lax.with_sharding_constraint(
-                out, NamedSharding(mesh, P(axis_name)))
+                out, NamedSharding(mesh, _lead_spec((axis_name,),
+                                                    out.ndim, 1)))
         return out
     return jax.tree.map(unshape, chunk_grads)
 

@@ -491,3 +491,38 @@ class TestVirtualChunkRelayout:
         w = jnp.zeros((8, 4), jnp.float32)
         with pytest.raises(ValueError, match="one stage per"):
             stack_virtual_chunks({"w": w}, 2, 4, mesh=pp_mesh)
+
+    @pytest.mark.parametrize("v", [2, 4])
+    def test_trailing_tp_zero_axes_survive(self, v):
+        """Finding from review: the staging pins must move ONLY the pp
+        axis — a TP/ZeRO-sharded weight leaf keeps its mp/'sharding'
+        trailing-dim sharding through the relayout (pinning them None
+        would all-gather every weight)."""
+        from jax.sharding import NamedSharding
+        from paddle_tpu.parallel.pipeline import (
+            stack_virtual_chunks, unstack_virtual_chunks)
+        mesh = build_mesh(pp=2, sharding=2, mp=2)
+        p = mesh.shape["pp"]
+        L, d1, d2 = p * v, 8, 8
+        w = jnp.asarray(np.random.RandomState(0).randn(L, d1, d2),
+                        jnp.float32)
+        w = jax.device_put(
+            w, NamedSharding(mesh, P("pp", "sharding", "mp")))
+
+        chunks = jax.jit(lambda x: stack_virtual_chunks(
+            {"w": x}, p, v, mesh=mesh))(w)["w"]
+        np.testing.assert_array_equal(
+            np.asarray(chunks), np.asarray(w).reshape(v, p, L // (p * v),
+                                                      d1, d2))
+        cspec = chunks.sharding.spec
+        assert cspec[1] == "pp", cspec
+        assert "sharding" in cspec and "mp" in cspec, (
+            f"TP/ZeRO axes stripped by the relayout: {cspec}")
+
+        back = jax.jit(lambda c: unstack_virtual_chunks(
+            {"w": c}, mesh=mesh))(chunks)["w"]
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(w))
+        bspec = back.sharding.spec
+        assert bspec[0] == "pp", bspec
+        assert "sharding" in bspec and "mp" in bspec, (
+            f"TP/ZeRO axes stripped on the grad path: {bspec}")
