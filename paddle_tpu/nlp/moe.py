@@ -305,27 +305,76 @@ def moe_block(x: jax.Array, lp: Dict[str, jax.Array], cfg: MoeConfig,
     C = cfg.capacity(S)
 
     logits = x.astype(jnp.float32) @ lp["gate"].astype(jnp.float32)  # [B,S,E]
-    eidx, slot, probs, valid, inv, aux = jax.vmap(
+    # routing's token-inverse map is NOT consumed here: dispatch/combine
+    # need the POSITION-inverse map (inv_pos below) too, and deriving the
+    # token map from it (inv_pos // k) keeps the pair consistent by
+    # construction instead of by parallel scatters
+    eidx, slot, probs, valid, _, aux = jax.vmap(
         lambda lg: top_k_routing(lg, k, C))(logits)
     aux = jax.tree.map(jnp.mean, aux)
 
-    from ..kernels.moe_dispatch import gather_rows
-    use_pallas = mesh is None
-    # dispatch: expert_in[b,e,c] = x[b, inv[b,e,c]] (zero when slot empty)
-    expert_in = gather_rows(x.astype(cd), inv.reshape(B, E * C),
-                            use_pallas=use_pallas).reshape(B, E, C, D)
-    g = jnp.einsum("becd,edf->becf", expert_in,
-                   lp["expert_gate_proj"].astype(cd))
-    u = jnp.einsum("becd,edf->becf", expert_in,
-                   lp["expert_up_proj"].astype(cd))
-    expert_out = jnp.einsum("becf,efd->becd", jax.nn.silu(g) * u,
-                            lp["expert_down_proj"].astype(cd))
-    # combine: y[b,s] = Σ_j probs[b,s,j] · expert_out[b, eidx, slot]
-    flat = eidx * C + slot                                   # [B, S, k]
-    flat = jnp.where(valid, flat, -1)
-    got = gather_rows(expert_out.reshape(B, E * C, D),
-                      flat.reshape(B, S * k),
-                      use_pallas=use_pallas).reshape(B, S, k, D)
+    from jax.ad_checkpoint import checkpoint_name
+    from ..kernels.moe_dispatch import combine_gather, dispatch_gather
+    # both directions of dispatch AND their gradients are masked row
+    # gathers over a pair of inverse index maps (slot assignment is
+    # injective — kernels.moe_dispatch): flat maps (token, choice) → slot,
+    # inv_pos maps slot → token position. Nothing in the MoE path scatters.
+    flat = jnp.where(valid, eidx * C + slot, -1).reshape(B, S * k)
+    if mesh is None:
+        # single chip: EXPERT-LEADING global layout [E, B*C, D]. The
+        # (b, e)-batched einsums made XLA shuffle every expert tensor
+        # between {b-major} and {e-major} layouts in fwd AND bwd (~170
+        # ms/step of pure transposes on the config-4 bench); with e
+        # leading and one flat row index space, dispatch/GEMMs/combine
+        # all agree on the layout. Rows: slot (e, b, c) at e*B*C + b*C + c,
+        # token position (b, s, j) at b*S*k + s*k + j.
+        boff = (jnp.arange(B, dtype=jnp.int32) * C)[:, None]
+        flat_g = jnp.where(flat >= 0, (eidx * (B * C)).reshape(B, S * k)
+                           + boff + slot.reshape(B, S * k), -1)
+        flat_g = flat_g.reshape(1, B * S * k)
+        safe = jnp.where(flat_g >= 0, flat_g, E * B * C)
+        inv_pos = jnp.full((E * B * C + 1,), -1, jnp.int32).at[safe[0]].set(
+            jnp.arange(B * S * k, dtype=jnp.int32), mode="drop")[None, :-1]
+        inv_tok = jnp.where(inv_pos >= 0, inv_pos // k, -1)
+        flat_g, inv_pos, inv_tok, probs = (
+            checkpoint_name(t, "moe_routing")
+            for t in (flat_g, inv_pos, inv_tok, probs))
+        expert_in = dispatch_gather(
+            x.reshape(1, B * S, D).astype(cd), inv_tok, flat_g, k,
+            True).reshape(E, B * C, D)
+        g = jnp.einsum("emd,edf->emf", expert_in,
+                       lp["expert_gate_proj"].astype(cd))
+        u = jnp.einsum("emd,edf->emf", expert_in,
+                       lp["expert_up_proj"].astype(cd))
+        expert_out = jnp.einsum("emf,efd->emd", jax.nn.silu(g) * u,
+                                lp["expert_down_proj"].astype(cd))
+        got = combine_gather(expert_out.reshape(1, E * B * C, D), flat_g,
+                             inv_pos, True).reshape(B, S, k, D)
+    else:
+        # under GSPMD: per-batch-row index space — groups align with the
+        # dp/sharding batch shards so the jnp gathers stay shard-local
+        safe = jnp.where(flat >= 0, flat, E * C)
+        pos_ids = jnp.broadcast_to(
+            jnp.arange(S * k, dtype=jnp.int32)[None], (B, S * k))
+        inv_pos = jax.vmap(
+            lambda ip, s, p: ip.at[s].set(p, mode="drop"))(
+                jnp.full((B, E * C + 1), -1, jnp.int32), safe,
+                pos_ids)[:, :-1]
+        inv_tok = jnp.where(inv_pos >= 0, inv_pos // k, -1)
+        flat, inv_pos, inv_tok, probs = (
+            checkpoint_name(t, "moe_routing")
+            for t in (flat, inv_pos, inv_tok, probs))
+        expert_in = dispatch_gather(x.astype(cd), inv_tok, flat, k,
+                                    False).reshape(B, E, C, D)
+        g = jnp.einsum("becd,edf->becf", expert_in,
+                       lp["expert_gate_proj"].astype(cd))
+        u = jnp.einsum("becd,edf->becf", expert_in,
+                       lp["expert_up_proj"].astype(cd))
+        expert_out = jnp.einsum("becf,efd->becd", jax.nn.silu(g) * u,
+                                lp["expert_down_proj"].astype(cd))
+        got = combine_gather(expert_out.reshape(B, E * C, D), flat,
+                             inv_pos, False).reshape(B, S, k, D)
+    # combine: y[b,s] = Σ_j probs[b,s,j] · expert_out[slot(b,s,j)]
     y = jnp.einsum("bskd,bsk->bsd", got, probs.astype(cd))
 
     if cfg.num_shared_experts:
@@ -374,8 +423,12 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig,
                              constrain=maybe_constrain), None
 
     if cfg.remat:
+        # save the (tiny) routing index maps so the backward refwd skips
+        # the router; everything big is still rematerialized
         body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.nothing_saveable)
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "moe_routing"))
     (x, lb, zl), _ = jax.lax.scan(
         body, (x, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
         params["layers"])

@@ -138,16 +138,21 @@ def scale_by_adam_q(b1: float = 0.9, b2: float = 0.999,
             gscale = jnp.float32(1.0)
 
         def blockwise(gb, mq, vq):
-            """One chunk: gb [c, BLOCK] f32; mq/vq _QTensor over [c] blocks."""
-            gb = gb * gscale
+            """One chunk: gb [c, BLOCK] in the grad dtype (cast to f32 HERE
+            so the lax.map stream never materializes a full-leaf f32 copy —
+            the old pre-cast cost two extra full-leaf HBM passes); mq/vq
+            _QTensor over [c] blocks. The update leaves in the grad dtype
+            for the same reason (the f32 math stays inside the chunk)."""
+            out_dt = gb.dtype if gb.dtype != jnp.float64 else jnp.float32
+            gb = gb.astype(jnp.float32) * gscale
             m = b1 * _dq_blocks(mq, False) + (1 - b1) * gb
             v = b2 * _dq_blocks(vq, True) + (1 - b2) * gb * gb
             upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
-            return upd, _q_blocks(m, False), _q_blocks(v, True)
+            return upd.astype(out_dt), _q_blocks(m, False), _q_blocks(v, True)
 
         def leaf(g, mq, vq):
             nb = mq.codes.shape[0]
-            gf = jnp.pad(g.astype(jnp.float32).reshape(-1),
+            gf = jnp.pad(g.reshape(-1),
                          (0, _pad_len(g.size))).reshape(nb, BLOCK)
             if nb <= chunk_blocks:
                 upd, new_m, new_v = blockwise(gf, mq, vq)
