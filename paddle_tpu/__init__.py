@@ -245,3 +245,9 @@ linalg.norm = _norm
 linalg.matmul = _matmul
 linalg.inv = linalg.inverse
 del _types, _n
+
+# method-surface completion must run LAST: the functional/activation ops it
+# attaches register during the nn/vision imports above, after ops/__init__
+from .ops import method_ext as _method_ext  # noqa: E402
+_method_ext._attach_ext()
+del _method_ext

@@ -158,7 +158,82 @@ class FusedTransformerEncoderLayer(Layer):
         return self.ffn(self.fused_attn(src, attn_mask=src_mask))
 
 
+class FusedEcMoe(Layer):
+    """paddle.incubate.nn.FusedEcMoe: every-token (dense) MoE block over
+    the fused_ec_moe functional — softmax gate weights each expert's
+    2-layer MLP (reference: incubate/nn/layer/fused_ec_moe.py)."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError(f"unsupported act_type {act_type!r}")
+        self.act_type = act_type
+        self.bmm0_weight = self.create_parameter(
+            [num_experts, hidden_size, inter_size])
+        self.bmm0_bias = self.create_parameter(
+            [num_experts, 1, inter_size], is_bias=True)
+        self.bmm1_weight = self.create_parameter(
+            [num_experts, inter_size, hidden_size])
+        self.bmm1_bias = self.create_parameter(
+            [num_experts, 1, hidden_size], is_bias=True)
+
+    def forward(self, x, gate):
+        return functional.fused_ec_moe(
+            x, gate, self.bmm0_weight, self.bmm0_bias, self.bmm1_weight,
+            self.bmm1_bias, act_type=self.act_type)
+
+
+class FusedMultiTransformer(Layer):
+    """paddle.incubate.nn.FusedMultiTransformer: the packed multi-layer
+    inference transformer over fused_multi_transformer (reference:
+    incubate/nn/layer/fused_transformer.py — per-layer weight LISTS, one
+    fused op call)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, num_layers=1, **kw):
+        super().__init__()
+        self.num_heads = num_heads
+        D, F = embed_dim, dim_feedforward
+        import jax.numpy as jnp
+        mk = self.create_parameter
+        self.ln_scales = [mk([D]) for _ in range(num_layers)]
+        self.ln_biases = [mk([D], is_bias=True) for _ in range(num_layers)]
+        self.qkv_weights = [mk([D, 3 * D]) for _ in range(num_layers)]
+        self.qkv_biases = [mk([3 * D], is_bias=True)
+                           for _ in range(num_layers)]
+        self.out_weights = [mk([D, D]) for _ in range(num_layers)]
+        self.out_biases = [mk([D], is_bias=True) for _ in range(num_layers)]
+        self.ffn_ln_scales = [mk([D]) for _ in range(num_layers)]
+        self.ffn_ln_biases = [mk([D], is_bias=True)
+                              for _ in range(num_layers)]
+        self.ffn1_weights = [mk([D, F]) for _ in range(num_layers)]
+        self.ffn1_biases = [mk([F], is_bias=True)
+                            for _ in range(num_layers)]
+        self.ffn2_weights = [mk([F, D]) for _ in range(num_layers)]
+        self.ffn2_biases = [mk([D], is_bias=True)
+                            for _ in range(num_layers)]
+        for i, group in enumerate((self.ln_scales, self.ln_biases,
+                                   self.qkv_weights, self.qkv_biases,
+                                   self.out_weights, self.out_biases,
+                                   self.ffn_ln_scales, self.ffn_ln_biases,
+                                   self.ffn1_weights, self.ffn1_biases,
+                                   self.ffn2_weights, self.ffn2_biases)):
+            for j, p in enumerate(group):
+                self.add_parameter(f"p_{i}_{j}", p)
+
+    def forward(self, x, attn_mask=None, caches=None, **kw):
+        return functional.fused_multi_transformer(
+            x, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.out_weights, self.out_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            num_heads=self.num_heads)
+
+
 __all__ = ["functional", "FusedRMSNorm", "FusedLayerNorm", "FusedLinear",
            "FusedDropoutAdd", "FusedBiasDropoutResidualLayerNorm",
            "FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer"]
+           "FusedTransformerEncoderLayer", "FusedEcMoe",
+           "FusedMultiTransformer"]

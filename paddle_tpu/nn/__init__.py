@@ -9,6 +9,7 @@ from . import functional as F  # noqa: F401
 
 from .layers_common import (  # noqa: F401
     Identity, Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    FeatureAlphaDropout,
     Flatten, Unflatten, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
     PixelShuffle, PixelUnshuffle, ChannelShuffle, Pad1D, Pad2D, Pad3D,
     ZeroPad2D, ZeroPad1D, ZeroPad3D, CosineSimilarity, PairwiseDistance,
@@ -182,3 +183,4 @@ class _Utils:
 utils = _Utils()
 
 from ..parallel.env import DataParallel  # noqa: F401,E402
+from . import quant  # noqa: F401,E402  (paddle.nn.quant)

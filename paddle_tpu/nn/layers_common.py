@@ -112,6 +112,33 @@ class AlphaDropout(Layer):
         return F.alpha_dropout(x, self.p, training=self.training)
 
 
+class FeatureAlphaDropout(Layer):
+    """Alpha dropout that drops whole channels (dim 1) — the SELU-safe
+    counterpart of Dropout2D/3D (upstream paddle.nn.FeatureAlphaDropout)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        import numpy as np
+        from ..core import random as _rnd
+        import jax
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        alpha_p = -1.7580993408473766
+        shape = (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
+        keep = jax.random.bernoulli(
+            _rnd.next_key(), 1.0 - self.p, shape)
+        a = (1.0 / np.sqrt((alpha_p ** 2 * self.p + 1) * (1 - self.p))
+             ) if self.p < 1 else 0.0
+        b = -a * alpha_p * self.p
+        data = jnp.where(keep, x._data, alpha_p)
+        return Tensor((a * data + b).astype(x._data.dtype))
+
+
 class Flatten(Layer):
     def __init__(self, start_axis=1, stop_axis=-1):
         super().__init__()

@@ -1,0 +1,156 @@
+"""paddle.nn.quant — the quantization layer zoo.
+
+Reference analog: python/paddle/nn/quant/quant_layers.py (FakeQuant*
+observers + Quantized* wrapped layers used by the slim/QAT passes;
+upstream-canonical, unverified — SURVEY.md §0, §2.4 quantization row).
+
+TPU-native design: fake-quant is quantize-dequantize with a straight-
+through estimator (quantization/__init__.py single-sources the math —
+these classes are the paddle.nn.quant-shaped face over the same ops, so
+nn.quant, paddle.quantization.QAT and the fake_quantize_* ops all agree
+bit-for-bit). int8 matmuls stay simulated: the MXU computes bf16/int8
+natively via XLA; a dedicated int8 kernel path is a perf project, not an
+API gap.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import Layer
+from ..ops._registry import REGISTRY
+from ..quantization import (
+    FakeQuanterWithAbsMax,
+    QuantedConv2D,
+    QuantedLinear,
+    quant_dequant,
+)
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor abs-max fake quantization (QAT observer+quant in one)."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32"):
+        super().__init__()
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        out, _ = REGISTRY["fake_quantize_abs_max"](x,
+                                                   bit_length=self.quant_bits)
+        return out
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Per-output-channel abs-max fake quantization (weights)."""
+
+    def __init__(self, name=None, channel_num=None, quant_bits=8,
+                 quant_axis=0, dtype="float32"):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.quant_axis = quant_axis
+
+    def forward(self, x):
+        out, _ = REGISTRY["fake_channel_wise_quantize_abs_max"](
+            x, bit_length=self.quant_bits, quant_axis=self.quant_axis)
+        return out
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Activation fake quant with a moving-average abs-max scale."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype="float32"):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.quant_bits = quant_bits
+        self.scale = self.create_parameter([1])
+        self.scale.set_value(jnp.ones((1,), jnp.float32))
+        self._accum = jnp.ones((1,), jnp.float32)
+        self._state = jnp.ones((1,), jnp.float32)
+
+    def forward(self, x):
+        if not self.training:
+            # inference quantizes on the CALIBRATED moving-average scale,
+            # not the current batch's abs-max (review finding)
+            return quant_dequant(x, self.scale, self.quant_bits)
+        out, scale, accum, state = REGISTRY[
+            "fake_quantize_moving_average_abs_max"](
+            x, self.scale, self._accum, self._state,
+            moving_rate=self.moving_rate, bit_length=self.quant_bits)
+        self.scale.set_value(scale._data if hasattr(scale, "_data")
+                             else scale)
+        self._accum = accum._data if hasattr(accum, "_data") else accum
+        self._state = state._data if hasattr(state, "_data") else state
+        return out
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Observer-only: tracks the moving-average abs-max scale, passes x
+    through unchanged (upstream's output-scale collector)."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32"):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.scale = self.create_parameter([1])
+        self.scale.set_value(jnp.ones((1,), jnp.float32))
+
+    def forward(self, x):
+        if self.training:
+            amax = jnp.max(jnp.abs(x._data)).reshape(1)
+            new = (self.moving_rate * self.scale._data
+                   + (1 - self.moving_rate) * amax)
+            self.scale.set_value(new)
+        return x
+
+
+def weight_quantize(w, algo="abs_max", bits=8):
+    """Quantize a weight tensor -> (int8 codes, scales) (paddle.nn.quant
+    helper for weight-only serving)."""
+    import jax.numpy as jnp
+    data = w._data if hasattr(w, "_data") else jnp.asarray(w)
+    bound = 2.0 ** (bits - 1) - 1
+    scale = jnp.maximum(jnp.max(jnp.abs(data), axis=0, keepdims=True),
+                        1e-9) / bound
+    codes = jnp.clip(jnp.round(data / scale), -bound - 1, bound
+                     ).astype(jnp.int8)
+    from ..core.tensor import Tensor
+    return Tensor(codes), Tensor(scale)
+
+
+def weight_dequantize(codes, scale):
+    from ..core.tensor import Tensor
+    return Tensor(codes._data.astype(scale._data.dtype) * scale._data)
+
+
+def llm_int8_linear(x, w_int8, scale, threshold=6.0):
+    """Weight-only int8 linear: dequantize-on-the-fly matmul (the XLA
+    fusion keeps codes in HBM; outlier split is a no-op at bf16 compute)."""
+    from ..core.tensor import Tensor
+    w = w_int8._data.astype(x._data.dtype) * scale._data.astype(
+        x._data.dtype)
+    return Tensor(x._data @ w)
+
+
+class Stub(Layer):
+    """paddle.nn.quant.Stub: placeholder the quantization passes replace
+    with a configured observer; identity until converted."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x if self._observer is None else self._observer(x)
+
+
+QuantStub = Stub
+QuantizedLinear = QuantedLinear
+QuantizedConv2D = QuantedConv2D
+
+__all__ = [
+    "FakeQuantAbsMax", "FakeQuantChannelWiseAbsMax",
+    "FakeQuantMovingAverageAbsMax", "MovingAverageAbsMaxScale",
+    "QuantedLinear", "QuantedConv2D", "QuantizedLinear", "QuantizedConv2D",
+    "Stub", "QuantStub",
+    "FakeQuanterWithAbsMax", "quant_dequant", "weight_quantize",
+    "weight_dequantize", "llm_int8_linear",
+]

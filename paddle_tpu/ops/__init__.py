@@ -220,6 +220,8 @@ def _attach():
 
 _attach()
 
+from . import method_ext  # noqa: F401,E402  (method-surface completion)
+
 del _bt
 
 def register_surface(module, prefix: str = "") -> int:

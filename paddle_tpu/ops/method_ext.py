@@ -1,0 +1,150 @@
+"""Tensor method-surface completion (VERDICT r3 missing 1 / weak 4).
+
+Reference analog: python/paddle/tensor/__init__.py's tensor_method_func
+monkey-patch plus the eager pybind methods — upstream attaches virtually
+every paddle.tensor.* function, a dtype-cast family, sparse/dist probes,
+and a large `name_` in-place wave to paddle.Tensor (upstream-canonical,
+unverified — SURVEY.md §0, §2.4 row 1).
+
+This module closes the attachment gap mechanically, on top of
+ops/__init__._attach:
+  * single-tensor-first functional ops (activations, softmax family,
+    normalize...) as methods — a SUPERSET of upstream's method set where
+    upstream keeps some nn.functional-only (harmless for migration:
+    nothing upstream-valid breaks, documented in COVERAGE.md),
+  * torch-parity dtype casts paddle also ships (bool/int/long/float/...),
+  * sparse/layout/dist probes (is_sparse, is_dense, layout, strides...),
+  * in-place twins for the remaining elementwise wave (the random
+    fillers normal_/uniform_/... come from optable's INPLACE overrides).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._registry import REGISTRY, adopt_inplace as _adopt
+
+
+# --------------------------------------------------------------------------
+# single-tensor-first ops that remained unattached
+# --------------------------------------------------------------------------
+_ATTACH = [
+    # activations / functional unary
+    "relu", "relu6", "elu", "celu", "selu", "silu", "gelu", "swish",
+    "mish", "leaky_relu", "hardtanh", "hardshrink", "softshrink",
+    "hardsigmoid", "hardswish", "log_sigmoid", "softplus", "softsign",
+    "tanhshrink", "thresholded_relu", "stanh", "softmax", "log_softmax",
+    "glu", "maxout", "prelu", "rrelu", "gumbel_softmax",
+    # normalization / similarity on x
+    "normalize", "cosine_similarity", "pairwise_distance", "label_smooth",
+    # sampling / counting on x
+    "multinomial", "bernoulli", "binomial", "poisson",
+    # (linalg decompositions stay namespace-only like upstream:
+    # paddle.linalg.lu_unpack/ormqr/... are NOT Tensor methods)
+    # structure
+    "block_diag", "cartesian_prod", "tensor_unfold", "view", "view_as",
+    "as_strided", "unflatten", "slice_scatter",
+    # misc
+    "histogram_bin_edges", "sinc", "i0e", "i1e", "sgn",
+]
+
+# --------------------------------------------------------------------------
+# in-place twins paddle ships that ops/__init__._INPLACE did not yet cover
+# --------------------------------------------------------------------------
+_MORE_INPLACE = [
+    "deg2rad", "rad2deg", "sign", "relu6", "elu", "celu", "selu", "silu",
+    "gelu", "leaky_relu", "hardtanh", "hardsigmoid", "hardswish",
+    "softplus", "softsign", "tanhshrink", "stanh", "flip",
+    "scatter_nd_add", "maximum", "minimum", "fmax", "fmin", "atan2",
+    "hypot", "copysign", "ldexp", "heaviside", "nextafter", "logit",
+    "lgamma", "digamma", "erf", "i0", "gcd", "lcm", "frac",
+    "nan_to_num", "logical_and", "logical_or", "logical_xor",
+    "logical_not", "roll", "rot90", "take_along_axis", "index_select",
+    "gather", "tile", "repeat_interleave", "broadcast_to", "expand",
+    "diff", "kron", "cross", "dot", "outer", "inner",
+    "thresholded_relu", "hardshrink", "softshrink", "mish",
+    "log_sigmoid", "swish",
+]
+
+# (the in-place distribution fillers normal_/uniform_/... are attached by
+# ops/__init__._attach via optable.INPLACE_NAME_OVERRIDES — nothing to do
+# here)
+
+_CASTS = {
+    "bool": "bool", "byte": "uint8", "char": "int8", "short": "int16",
+    "int": "int32", "long": "int64", "half": "float16",
+    "float": "float32", "double": "float64", "bfloat16": "bfloat16",
+    "cfloat": "complex64", "cdouble": "complex128",
+}
+
+
+def _attach_ext():
+    g = globals()
+
+    for name in dict.fromkeys(_ATTACH):
+        fn = REGISTRY.get(name)
+        if fn is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    for name in dict.fromkeys(_MORE_INPLACE):
+        fn = REGISTRY.get(name)
+        ip_name = name + "_"
+        if fn is None or hasattr(Tensor, ip_name):
+            continue
+
+        def make_inplace(f):
+            def inplace(self, *args, **kwargs):
+                return _adopt(self, f(self, *args, **kwargs))
+            return inplace
+
+        ip = make_inplace(fn)
+        ip.__name__ = ip_name
+        g[ip_name] = ip
+        setattr(Tensor, ip_name, ip)
+        REGISTRY.setdefault(ip_name, ip)
+
+    # dtype-cast family
+    for meth, dt in _CASTS.items():
+        if not hasattr(Tensor, meth):
+            setattr(Tensor, meth,
+                    (lambda d: lambda s: s.astype(d))(dt))
+
+    # layout / storage probes — dense jnp tensors on one logical device
+    Tensor.is_dense = lambda s: True
+    Tensor.is_sparse = lambda s: False
+    Tensor.is_sparse_coo = lambda s: False
+    Tensor.is_sparse_csr = lambda s: False
+    Tensor.is_selected_rows = lambda s: False
+    Tensor.is_dist = lambda s: False
+    Tensor.layout = property(lambda s: "NCHW")
+    Tensor.strides = property(lambda s: _row_major_strides(s.shape))
+    Tensor.get_tensor = lambda s: s
+    Tensor.value = lambda s: s
+    Tensor.data = property(lambda s: s, lambda s, v: _adopt(s, v))
+    Tensor.coalesce = lambda s: s
+    Tensor.lod = property(lambda s: [])
+    Tensor.type = property(lambda s: "DenseTensor")
+    Tensor.inplace_version = property(lambda s: getattr(
+        s, "_inplace_version", 0))
+    Tensor.grad_fn = property(lambda s: getattr(s, "_grad_node", None))
+    Tensor.apply = lambda s, fn: fn(s)
+    # sparse accessors raise like upstream on dense tensors
+    for probe in ("crows", "cols", "indices", "nnz"):
+        def make_raise(p):
+            def bad(self, *a, **k):
+                raise ValueError(
+                    f"Tensor.{p}() is only valid on sparse tensors — "
+                    f"convert with to_sparse_coo()/to_sparse_csr()")
+            return bad
+        setattr(Tensor, probe, make_raise(probe))
+
+
+def _row_major_strides(shape):
+    out, acc = [], 1
+    for d in reversed(shape):
+        out.append(acc)
+        acc *= int(d)
+    return tuple(reversed(out))
+
+
+_attach_ext()

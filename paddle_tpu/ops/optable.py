@@ -647,6 +647,83 @@ C("log_normal_sample", lambda x, mean=1.0, std=2.0:
           ).astype(x.dtype),
   ref=None, grad=False, inplace=True, method=False)
 
+# --------------------------------------------------------------------------
+# round-4 audit closures (COVERAGE.md): the last genuinely-missing public
+# forward ops surfaced by the upstream-name diff
+# --------------------------------------------------------------------------
+C("baddbmm", lambda inp, x, y, beta=1.0, alpha=1.0:
+  beta * inp + alpha * jnp.matmul(x, y),
+  ref=lambda inp, x, y: inp + np.matmul(x, y), n_in=3,
+  shapes=((2, 3, 5), (2, 3, 4), (2, 4, 5)))
+C("vdot", lambda x, y: jnp.vdot(x, y),
+  ref=lambda x, y: np.vdot(x, y), n_in=2, shapes=((4,), (4,)))
+C("index_copy", lambda x, index, value, axis=0:
+  _index_copy(x, index, value, axis),
+  ref=None, n_in=3, grad=False)
+C("logaddexp2", jnp.logaddexp2, ref=np.logaddexp2, n_in=2)
+U("bitwise_invert", lambda x: jnp.invert(x), ref=np.bitwise_not,
+  int_op=True, grad=False)
+C("rnnt_loss", lambda logits, labels, logit_lengths, label_lengths,
+  blank=0, fastemit_lambda=0.0, reduction="mean":
+  _rnnt_loss_stub(logits, labels, logit_lengths, label_lengths,
+                  blank, fastemit_lambda, reduction),
+  ref=None, grad=False, method=False)
+
+
+def _index_copy(x, index, value, axis=0):
+    """paddle.index_copy: write `value` rows at `index` along axis."""
+    index = jnp.asarray(index, jnp.int32)
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].set(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def _rnnt_loss_stub(logits, labels, logit_lengths, label_lengths,
+                    blank=0, fastemit_lambda=0.0, reduction="mean"):
+    """RNN-T loss via the exact log-space forward recursion (small-scale
+    reference semantics; the reference's warprnnt CUDA kernel is a fused
+    version of the same recursion)."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: fastemit_lambda regularization is not implemented")
+    B, T, U, V = logits.shape
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    def one(lp, lab, t_len, u_len):
+        # alpha[t, u]: log-prob of emitting lab[:u] after t frames
+        neg = jnp.float32(-1e30)
+
+        def row(carry, t):
+            prev = carry
+
+            def col(c, u):
+                a_blank = jnp.where(t > 0, prev[u] + lp[t - 1, u, blank],
+                                    neg)
+                lab_u = jnp.where(u > 0, lab[jnp.maximum(u - 1, 0)], 0)
+                a_emit = jnp.where(u > 0, c + lp[t, u - 1, lab_u], neg)
+                first = (t == 0) & (u == 0)
+                val = jnp.where(first, 0.0, jnp.logaddexp(a_blank, a_emit))
+                return val, val
+
+            _, alpha_t = jax.lax.scan(col, neg, jnp.arange(U))
+            return alpha_t, alpha_t
+
+        _, alpha = jax.lax.scan(row, jnp.full((U,), neg), jnp.arange(T))
+        return -(alpha[t_len - 1, u_len] + lp[t_len - 1, u_len, blank])
+
+    losses = jax.vmap(one)(logp, labels,
+                           jnp.asarray(logit_lengths, jnp.int32),
+                           jnp.asarray(label_lengths, jnp.int32))
+    if reduction == "mean":
+        return jnp.mean(losses)
+    if reduction == "sum":
+        return jnp.sum(losses)
+    if reduction == "none":
+        return losses
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
 # table op name -> the paddle `name_` its in-place variant binds as
 INPLACE_NAME_OVERRIDES = {
     "cauchy_sample": "cauchy_",

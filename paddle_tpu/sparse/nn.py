@@ -75,6 +75,37 @@ def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
     return SparseCooTensor(jsparse.BCOO.fromdense(masked))
 
 
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC"):
+    """Sparse conv2d (densified): x SparseCooTensor [N,H,W,C] — the 2-D
+    member of the upstream sparse conv family (paddle.sparse.nn.Conv2D)."""
+    d = _to_dense_ndhwc(x)
+    s = (stride,) * 2 if isinstance(stride, int) else tuple(stride)
+    dil = (dilation,) * 2 if isinstance(dilation, int) else tuple(dilation)
+    pad = ([(padding, padding)] * 2 if isinstance(padding, int)
+           else [(p, p) for p in padding])
+    out = jax.lax.conv_general_dilated(
+        d.astype(jnp.float32), jnp.asarray(weight, jnp.float32),
+        window_strides=s, padding=pad, rhs_dilation=dil,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + jnp.asarray(bias, jnp.float32)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out.astype(d.dtype)))
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None):
+    """Submanifold conv2d: conv, then mask to the input's active sites."""
+    y = conv2d(x, weight, bias, stride, padding, dilation, groups,
+               data_format)
+    if list(y.shape[:-1]) != list(x.shape[:-1]):
+        return y
+    active = jnp.any(_to_dense_ndhwc(x) != 0, axis=-1, keepdims=True)
+    return SparseCooTensor(jsparse.BCOO.fromdense(
+        jnp.where(active, _to_dense_ndhwc(y), 0)))
+
+
 def max_pool3d(x, kernel_size, stride=None, padding=0,
                data_format="NDHWC"):
     d = _to_dense_ndhwc(x)
@@ -179,6 +210,34 @@ class SubmConv3D(Conv3D):
                            s, p, d, g, df)
 
 
+class Conv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__()
+        k = (kernel_size,) * 2 if isinstance(kernel_size, int) else tuple(
+            kernel_size)
+        self.weight = self.create_parameter(
+            list(k) + [in_channels // groups, out_channels])
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], is_bias=True)
+        self._a = (stride, padding, dilation, groups, data_format)
+
+    def forward(self, x):
+        s, p, d, g, df = self._a
+        return conv2d(x, self.weight._data,
+                      None if self.bias is None else self.bias._data,
+                      s, p, d, g, df)
+
+
+class SubmConv2D(Conv2D):
+    def forward(self, x):
+        s, p, d, g, df = self._a
+        return subm_conv2d(x, self.weight._data,
+                           None if self.bias is None else self.bias._data,
+                           s, p, d, g, df)
+
+
 class BatchNorm(Layer):
     """Sparse BatchNorm: normalizes the values buffer over active sites."""
 
@@ -214,6 +273,8 @@ class _FuncNS:
     softmax = staticmethod(softmax)
     conv3d = staticmethod(conv3d)
     subm_conv3d = staticmethod(subm_conv3d)
+    conv2d = staticmethod(conv2d)
+    subm_conv2d = staticmethod(subm_conv2d)
     max_pool3d = staticmethod(max_pool3d)
     attention = staticmethod(attention)
 

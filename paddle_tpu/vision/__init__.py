@@ -4,5 +4,6 @@ from . import transforms  # noqa: F401
 from . import models  # noqa: F401
 from . import datasets  # noqa: F401
 from . import ops  # noqa: F401
-from .datasets import FakeData, MNIST, Cifar10, Cifar100, DatasetFolder, ImageFolder  # noqa: F401
+from .datasets import (FakeData, MNIST, Cifar10, Cifar100, DatasetFolder,  # noqa: F401
+                       ImageFolder, Flowers, VOC2012)
 from .models import *  # noqa: F401,F403

@@ -172,3 +172,85 @@ class DatasetFolder(Dataset):
 
 
 ImageFolder = DatasetFolder
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers from the upstream triple (images tgz +
+    imagelabels.mat + setid.mat) — paddle.vision.datasets.Flowers parity,
+    local files only (zero egress)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend="pil"):
+        for f, n in ((data_file, "data_file"), (label_file, "label_file"),
+                     (setid_file, "setid_file")):
+            if f is None or not os.path.exists(f):
+                raise RuntimeError(
+                    f"Flowers download unavailable (zero-egress "
+                    f"environment); pass {n}= pointing at the upstream "
+                    f"archive (paddle_tpu/vision/datasets.py)")
+        import scipy.io as sio
+        labels = sio.loadmat(label_file)["labels"].reshape(-1)
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = setid[key].reshape(-1)
+        self.labels = labels
+        self.transform = transform
+        self._tar = tarfile.open(data_file)
+        self._members = {os.path.basename(m.name): m
+                         for m in self._tar.getmembers() if m.isfile()}
+
+    def __getitem__(self, idx):
+        import io as _io
+        from PIL import Image
+        flower_id = int(self.indexes[idx])
+        member = self._members[f"image_{flower_id:05d}.jpg"]
+        img = np.asarray(Image.open(
+            _io.BytesIO(self._tar.extractfile(member).read())
+        ).convert("RGB"))
+        label = np.asarray(int(self.labels[flower_id - 1]), np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs from the upstream devkit tar —
+    paddle.vision.datasets.VOC2012 parity ((image, label-mask) uint8
+    arrays), local files only."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="pil"):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "VOC2012 download unavailable (zero-egress environment); "
+                "pass data_file= pointing at the upstream devkit tar "
+                "(paddle_tpu/vision/datasets.py)")
+        self.transform = transform
+        self._tar = tarfile.open(data_file)
+        names = self._tar.getnames()
+        seg_list = next(n for n in names if n.endswith(
+            f"ImageSets/Segmentation/{'train' if mode == 'train' else 'val'}"
+            f".txt"))
+        ids = self._tar.extractfile(seg_list).read().decode().split()
+        base = seg_list.split("ImageSets/")[0]
+        self.pairs = [(f"{base}JPEGImages/{i}.jpg",
+                       f"{base}SegmentationClass/{i}.png") for i in ids]
+
+    def __getitem__(self, idx):
+        import io as _io
+        from PIL import Image
+        ipath, lpath = self.pairs[idx]
+        img = np.asarray(Image.open(
+            _io.BytesIO(self._tar.extractfile(ipath).read())).convert("RGB"))
+        label = np.asarray(Image.open(
+            _io.BytesIO(self._tar.extractfile(lpath).read())))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label.astype(np.uint8)
+
+    def __len__(self):
+        return len(self.pairs)
