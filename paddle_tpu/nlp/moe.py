@@ -400,9 +400,8 @@ def _decoder_body(carry, lp, cfg: MoeConfig, lcfg, cos, sin, mesh,
     return (h, lb + aux["load_balance_loss"], zl + aux["router_z_loss"])
 
 
-def forward(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig,
-            mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """tokens [B,S] → (logits [B,S,V] f32, aux losses)."""
+def _backbone(params, tokens, cfg: MoeConfig, mesh=None):
+    """Embed + MoE decoder stack → (pre-norm x [B,S,D], aux losses)."""
     lcfg = _llama_cfg(cfg)
     cd = cfg.dtype
     x = jnp.take(params["embed_tokens"], tokens, axis=0).astype(cd)
@@ -432,10 +431,18 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig,
     (x, lb, zl), _ = jax.lax.scan(
         body, (x, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
         params["layers"])
+    L = cfg.num_hidden_layers
+    return x, {"load_balance_loss": lb / L, "router_z_loss": zl / L}
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig,
+            mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """tokens [B,S] → (logits [B,S,V] f32, aux losses)."""
+    cd = cfg.dtype
+    x, aux = _backbone(params, tokens, cfg, mesh)
     x = rms_norm_ref(x, params["norm"], cfg.rms_norm_eps)
     logits = (x.astype(cd) @ params["lm_head"].astype(cd)).astype(jnp.float32)
-    L = cfg.num_hidden_layers
-    return logits, {"load_balance_loss": lb / L, "router_z_loss": zl / L}
+    return logits, aux
 
 
 def forward_pp(params: Dict[str, Any], tokens: jax.Array, cfg: MoeConfig,
@@ -555,14 +562,14 @@ def loss_fn(params, tokens, cfg: MoeConfig, mesh=None,
     if (pp_microbatches and mesh is not None
             and "pp" in mesh.axis_names and mesh.shape["pp"] > 1):
         logits, aux = forward_pp(params, tokens, cfg, mesh, pp_microbatches)
+        ce = _llama._mb_loss(logits, tokens)
     else:
-        logits, aux = forward(params, tokens, cfg, mesh)
-    targets = jnp.roll(tokens, -1, axis=1)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    seq = tokens.shape[1]
-    valid = (jnp.arange(seq) < seq - 1).astype(logits.dtype)
-    ce = jnp.sum((logz - gold) * valid[None]) / (tokens.shape[0] * (seq - 1))
+        x, aux = _backbone(params, tokens, cfg, mesh)
+        x = rms_norm_ref(x, params["norm"], cfg.rms_norm_eps)
+        # fused head+CE: no [B, S, V] f32 logits materialization
+        ce = _llama.fused_head_ce(x.astype(cfg.dtype),
+                                  params["lm_head"].astype(cfg.dtype),
+                                  tokens)
     return (ce + cfg.router_aux_loss_coef * aux["load_balance_loss"]
             + cfg.router_z_loss_coef * aux["router_z_loss"])
 
