@@ -49,6 +49,12 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32      # storage dtype (master weights)
     remat: bool = True                  # jax.checkpoint each layer body
     use_flash: bool = True
+    # loss path: True routes loss_fn through fused_head_ce (no [B,S,V] f32
+    # materialization — frees ~6GB at the 2B bench shape). Default False:
+    # the dense 2B single-chip bench measures ~6pt MFU SLOWER through the
+    # chunked scan (r4, consistent with r3's chunked-vocab finding); the
+    # MoE model uses the fused path unconditionally for the memory headroom.
+    fused_ce: bool = False
     # attention schedule: "flash" (single-device / GSPMD-sharded), or the
     # context-parallel schedules over the sep mesh axis — "ring"
     # (ppermute KV rotation, SURVEY.md §2.3 CP row) / "ulysses" (all_to_all
@@ -267,13 +273,18 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
     return _final_head(params, _backbone(params, tokens, cfg, mesh), cfg)
 
 
+def _head_weights(params, cfg: LlamaConfig):
+    """The LM head matrix [D, V] — ONE selection point for the tied /
+    untied choice (shared by the logits and fused-CE paths)."""
+    return (params["embed_tokens"].T if cfg.tie_word_embeddings
+            else params["lm_head"])
+
+
 def _final_head(params, x, cfg: LlamaConfig):
     """Final RMSNorm + LM head: x [B,S,D] → logits [B,S,V] (f32)."""
     cd = cfg.dtype
     x = rms_norm_ref(x, params["norm"], cfg.rms_norm_eps)
-    head = (params["embed_tokens"].T if cfg.tie_word_embeddings
-            else params["lm_head"])
-    logits = x.astype(cd) @ head.astype(cd)
+    logits = x.astype(cd) @ _head_weights(params, cfg).astype(cd)
     return logits.astype(jnp.float32)
 
 
@@ -387,7 +398,10 @@ def fused_head_ce(x, head, tokens):
 
 def _ce_scan_chunks(x, tokens):
     B, S, D = x.shape
-    nc = _CE_CHUNKS if S % _CE_CHUNKS == 0 else 1
+    # largest chunk count <= _CE_CHUNKS dividing S — never silently fall
+    # back to one chunk (nc=1 would materialize the full [B, S, V] f32
+    # logits this function exists to avoid)
+    nc = next(n for n in range(_CE_CHUNKS, 0, -1) if S % n == 0)
     c = S // nc
     xs = x.reshape(B, nc, c, D).swapaxes(0, 1)           # [nc, B, c, D]
     tg = jnp.roll(tokens, -1, axis=1).reshape(B, nc, c).swapaxes(0, 1)
@@ -447,9 +461,8 @@ def _head_ce(params, x, cfg: LlamaConfig, tokens):
     """Final norm + fused head/CE (the loss-path twin of _final_head)."""
     cd = cfg.dtype
     x = rms_norm_ref(x, params["norm"], cfg.rms_norm_eps)
-    head = (params["embed_tokens"].T if cfg.tie_word_embeddings
-            else params["lm_head"])
-    return fused_head_ce(x.astype(cd), head.astype(cd), tokens)
+    return fused_head_ce(x.astype(cd),
+                         _head_weights(params, cfg).astype(cd), tokens)
 
 
 def loss_and_grad_pp(params: Dict[str, Any], tokens: jax.Array,
@@ -531,8 +544,10 @@ def loss_fn(params, tokens, cfg: LlamaConfig, mesh=None,
         logits = forward_pp(params, tokens, cfg, mesh, pp_microbatches,
                             pp_virtual)
         return _mb_loss(logits, tokens)
-    return _head_ce(params, _backbone(params, tokens, cfg, mesh), cfg,
-                    tokens)
+    if cfg.fused_ce:
+        return _head_ce(params, _backbone(params, tokens, cfg, mesh), cfg,
+                        tokens)
+    return _mb_loss(forward(params, tokens, cfg, mesh), tokens)
 
 
 def num_params(cfg: LlamaConfig) -> int:

@@ -567,9 +567,9 @@ def loss_fn(params, tokens, cfg: MoeConfig, mesh=None,
         x, aux = _backbone(params, tokens, cfg, mesh)
         x = rms_norm_ref(x, params["norm"], cfg.rms_norm_eps)
         # fused head+CE: no [B, S, V] f32 logits materialization
-        ce = _llama.fused_head_ce(x.astype(cfg.dtype),
-                                  params["lm_head"].astype(cfg.dtype),
-                                  tokens)
+        ce = _llama.fused_head_ce(
+            x.astype(cfg.dtype),
+            params["lm_head"].astype(cfg.dtype), tokens)
     return (ce + cfg.router_aux_loss_coef * aux["load_balance_loss"]
             + cfg.router_z_loss_coef * aux["router_z_loss"])
 
