@@ -34,41 +34,28 @@ def _gather_rows_jnp(src, idx):
     return take * (idx >= 0)[..., None].astype(src.dtype)
 
 
-def _gather_rows_kernel(idx_ref, src_ref, out_ref, scratch, sems, *, bm):
-    """Grid (B, M // bm). idx_ref: scalar-prefetched [B, M] (SMEM);
-    src_ref: [B, N, D/128, 128] in ANY (HBM) — rows are laid out as
-    (D/128, 128) tiles so the per-row slice cuts only MAJOR (untiled)
-    dims; Mosaic rejects size-1 slices of the sublane dim, which a flat
-    [B, N, D] layout would require. out block [1, bm, D].
-
-    DOUBLE-BUFFERED across grid steps: scratch/sems are [2, bm, ...]; at
-    step m the kernel waits the copies started for block m one step
-    earlier (buffer m%2) while block m+1's row DMAs (buffer (m+1)%2) are
-    already in flight — the 4KB-row random reads overlap the previous
-    block's drain instead of serializing behind it (the single-buffer
-    version measured ~117 GB/s on the MoE bench; random row reads are
-    latency-bound, so keeping two blocks of DMAs outstanding is the
-    lever). Grid iteration order is minor-dim-first, so steps of one
-    batch row run consecutively; the b-boundary prologue refills the
-    pipe."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    b = pl.program_id(0)
-    mb = pl.program_id(1)
-    nmb = pl.num_programs(1)
-
+def _row_dma_pipeline(pl, pltpu, idx_ref, src_ref, scratch, sems, b, mb,
+                      nmb, rows, masked):
+    """Shared double-buffer discipline for the row-gather kernels: start
+    block 0 in the prologue, keep block mb+1's DMAs in flight while
+    waiting block mb's (buffer mb%2). `rows` = DMAs per block; `masked`
+    skips DMAs for idx < 0 and zeroes those scratch rows (the pre-clipped
+    kernels pass masked=False and mask via weights instead)."""
     def start_block(mb_, buf):
-        for r in range(bm):
-            i = idx_ref[b, mb_ * bm + r]
-            cp = pltpu.make_async_copy(
-                src_ref.at[b, jnp.maximum(i, 0)], scratch.at[buf, r],
-                sems.at[buf, r])
-            pl.when(i >= 0)(cp.start)
+        for r in range(rows):
+            i = idx_ref[b, mb_ * rows + r]
+            if masked:
+                cp = pltpu.make_async_copy(
+                    src_ref.at[b, jnp.maximum(i, 0)], scratch.at[buf, r],
+                    sems.at[buf, r])
+                pl.when(i >= 0)(cp.start)
 
-            @pl.when(i < 0)
-            def _zero():
-                scratch[buf, r] = jnp.zeros_like(scratch[buf, r])
+                @pl.when(i < 0)
+                def _zero():
+                    scratch[buf, r] = jnp.zeros_like(scratch[buf, r])
+            else:
+                pltpu.make_async_copy(src_ref.at[b, i], scratch.at[buf, r],
+                                      sems.at[buf, r]).start()
 
     @pl.when(mb == 0)
     def _prologue():
@@ -78,14 +65,38 @@ def _gather_rows_kernel(idx_ref, src_ref, out_ref, scratch, sems, *, bm):
     def _next():
         start_block(mb + 1, (mb + 1) % 2)
 
-    for r in range(bm):
-        i = idx_ref[b, mb * bm + r]
-        cp = pltpu.make_async_copy(
-            src_ref.at[b, jnp.maximum(i, 0)], scratch.at[mb % 2, r],
-            sems.at[mb % 2, r])
-        pl.when(i >= 0)(cp.wait)
+    for r in range(rows):
+        i = idx_ref[b, mb * rows + r]
+        if masked:
+            cp = pltpu.make_async_copy(
+                src_ref.at[b, jnp.maximum(i, 0)], scratch.at[mb % 2, r],
+                sems.at[mb % 2, r])
+            pl.when(i >= 0)(cp.wait)
+        else:
+            pltpu.make_async_copy(src_ref.at[b, i], scratch.at[mb % 2, r],
+                                  sems.at[mb % 2, r]).wait()
 
-    out_ref[0] = scratch[mb % 2].reshape(out_ref.shape[1:])
+
+def _gather_rows_kernel(idx_ref, src_ref, out_ref, scratch, sems, *, bm):
+    """Grid (B, M // bm). idx_ref: scalar-prefetched [B, M] (SMEM);
+    src_ref: [B, N, D/128, 128] in ANY (HBM) — rows are laid out as
+    (D/128, 128) tiles so the per-row slice cuts only MAJOR (untiled)
+    dims; Mosaic rejects size-1 slices of the sublane dim, which a flat
+    [B, N, D] layout would require. out block [1, bm, D].
+
+    DOUBLE-BUFFERED across grid steps (_row_dma_pipeline): the 4KB-row
+    random reads of block mb+1 overlap block mb's drain — random row
+    reads are latency/issue-bound, so keeping two blocks of DMAs
+    outstanding is the lever. Grid iteration order is minor-dim-first, so
+    steps of one batch row run consecutively; the b-boundary prologue
+    refills the pipe."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _row_dma_pipeline(pl, pltpu, idx_ref, src_ref, scratch, sems,
+                      pl.program_id(0), pl.program_id(1), pl.num_programs(1),
+                      bm, masked=True)
+    out_ref[0] = scratch[pl.program_id(1) % 2].reshape(out_ref.shape[1:])
 
 
 @functools.partial(jax.jit, static_argnames=("bm", "interpret"))
@@ -195,13 +206,288 @@ def _dispatch_fwd(x, inv_tok, flat, k, use_pallas):
 def _dispatch_bwd(k, use_pallas, flat, g):
     import numpy as np
     B, M = flat.shape
-    rows = gather_rows(g, flat, use_pallas=use_pallas)     # [B, S*k, D]
-    dx = rows.reshape(B, M // k, k, -1).sum(axis=2)
+    # fused k-sum gather: dx[t] = sum_j g[flat[t, j]] — the old
+    # gather-then-reshape-sum materialized a [B, S, k, D] intermediate
+    # whose k-minor axis tiled as T(2,128) (~35 ms/step of physical
+    # reshape+reduce on the round-4 profile)
+    idx_tk = jnp.clip(flat, 0).reshape(B, M // k, k)
+    w = (flat >= 0).reshape(B, M // k, k).astype(jnp.float32)
+    dx = gather_wsum(g, idx_tk, w, use_pallas=use_pallas)
     return (dx, np.zeros((B, g.shape[1]), jax.dtypes.float0),
             np.zeros(flat.shape, jax.dtypes.float0))
 
 
 dispatch_gather.defvjp(_dispatch_fwd, _dispatch_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused weighted combine (round 4). The einsum formulation of the MoE
+# combine (gather to [B, S, k, D] `got`, then "bskd,bsk->bsd") made XLA
+# materialize [B, S, k, D] intermediates whose k=2 minor axis tiles as
+# T(2,128) — the round-4 xplane profile shows ~100 ms/step of physical
+# reshape/reduce traffic at ~20 GB/s on exactly these tensors. Folding the
+# probs-weighted k-sum INTO the gather kernel removes those intermediates:
+#   y[t] = sum_j w[t,j] * src[idx[t,j]]
+# and the backward gathers dy rows ONCE, producing BOTH d_eout (scaled
+# rows) and the per-slot dot that yields d_probs — zero extra row DMAs
+# versus the unfused backward.
+# ---------------------------------------------------------------------------
+
+
+def _gather_wsum_kernel(idx_ref, src_ref, w_ref, out_ref, scratch, sems,
+                        *, bm, k):
+    """out[0, m] = sum_j w[0, m, j] * src[b, idx[b, m*k+j]] — idx is
+    pre-clipped (invalid slots carry w=0). Double-buffered via
+    _row_dma_pipeline (bm*k DMAs per block)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    mb = pl.program_id(1)
+    _row_dma_pipeline(pl, pltpu, idx_ref, src_ref, scratch, sems,
+                      pl.program_id(0), mb, pl.num_programs(1),
+                      bm * k, masked=False)
+    rows = scratch[mb % 2].reshape(bm, k, -1)
+    w = w_ref[0]                                     # [bm, k] f32
+    # f32 weights/accum: Mosaic only supports non-no-op minor-dim
+    # inserts/broadcasts for 32-bit types
+    acc = rows[:, 0, :].astype(jnp.float32) * w[:, 0:1]
+    for j in range(1, k):
+        acc = acc + rows[:, j, :].astype(jnp.float32) * w[:, j:j + 1]
+    out_ref[0] = acc.astype(out_ref.dtype).reshape(out_ref.shape[1:])
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "interpret"))
+def gather_wsum_pallas(src, idx, w, bm=64, interpret=False):
+    """src [B, N, D]; idx [B, M, k] int32 PRE-CLIPPED to [0, N); w
+    [B, M, k] (w = 0 marks dropped choices) → [B, M, D]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, N, D = src.shape
+    M, k = idx.shape[1], idx.shape[2]
+    while M % bm:
+        bm //= 2
+    lanes = 128
+    src4 = src.reshape(B, N, D // lanes, lanes)
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            functools.partial(_gather_wsum_kernel, bm=bm, k=k),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(B, M // bm),
+                in_specs=[
+                    pl.BlockSpec(memory_space=pl.ANY),
+                    pl.BlockSpec((1, bm, k), lambda b, m, idx: (b, m, 0)),
+                ],
+                out_specs=pl.BlockSpec((1, bm, D), lambda b, m, idx: (b, m, 0)),
+                scratch_shapes=[
+                    pltpu.VMEM((2, bm * k, D // lanes, lanes), src.dtype),
+                    pltpu.SemaphoreType.DMA((2, bm * k))],
+            ),
+            out_shape=jax.ShapeDtypeStruct((B, M, D), src.dtype),
+            interpret=interpret,
+        )(idx.reshape(B, M * k).astype(jnp.int32), src4,
+          w.astype(jnp.float32))
+
+
+def _gather_wsum_jnp(src, idx, w):
+    B, M, k = idx.shape
+    rows = jnp.take_along_axis(
+        src, idx.reshape(B, M * k, 1), axis=1).reshape(B, M, k, -1)
+    return jnp.einsum("bmkd,bmk->bmd", rows, w.astype(src.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def gather_wsum(src, idx, w, use_pallas=True):
+    """Weighted k-row gather-sum (idx pre-clipped; w zeros mark drops).
+
+    Carries its own (jnp-formulated) VJP so the fused MoE backwards that
+    call it remain differentiable — grad-of-grad through moe_block
+    (double-grad, HVPs) transposes this op; a bare pallas_call would
+    raise there."""
+    from .flash_attention import _interpret
+    if use_pallas and _use_pallas_here(src):
+        return gather_wsum_pallas(src, idx, w, interpret=_interpret())
+    return _gather_wsum_jnp(src, idx, w)
+
+
+def _gather_wsum_fwd(src, idx, w, use_pallas):
+    return gather_wsum(src, idx, w, use_pallas), (src, idx, w)
+
+
+def _gather_wsum_bwd(use_pallas, res, dy):
+    import numpy as np
+    src, idx, w = res
+    B, N, D = src.shape
+    M, k = idx.shape[1], idx.shape[2]
+    contrib = dy[:, :, None, :] * w[..., None].astype(dy.dtype)  # [B,M,k,D]
+    bidx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, M * k))
+    dsrc = jnp.zeros((B, N, D), jnp.float32).at[
+        bidx, idx.reshape(B, M * k)].add(
+            contrib.reshape(B, M * k, D).astype(jnp.float32))
+    rows = jnp.take_along_axis(
+        src, idx.reshape(B, M * k, 1), axis=1).reshape(B, M, k, D)
+    dw = jnp.einsum("bmd,bmkd->bmk", dy.astype(jnp.float32),
+                    rows.astype(jnp.float32)).astype(w.dtype)
+    return (dsrc.astype(src.dtype),
+            np.zeros(idx.shape, jax.dtypes.float0), dw)
+
+
+gather_wsum.defvjp(_gather_wsum_fwd, _gather_wsum_bwd)
+
+
+def _gather_scale_dot_kernel(idx_ref, src_ref, s_ref, other_ref, out_ref,
+                             dot_ref, scratch, sems, *, bm):
+    """One dy-row gather serving the fused-combine backward:
+    out[0, m] = s[0, m] * src[b, idx[b, m]]           (d_eout rows)
+    dot[0, m] = sum_d src[b, idx[b, m]] * other[0, m] (d_probs per slot).
+    Double-buffered via _row_dma_pipeline."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    mb = pl.program_id(1)
+    _row_dma_pipeline(pl, pltpu, idx_ref, src_ref, scratch, sems,
+                      pl.program_id(0), mb, pl.num_programs(1),
+                      bm, masked=False)
+    rows = scratch[mb % 2].reshape(bm, -1)           # [bm, D]
+    sf = s_ref[0].astype(jnp.float32)[:, None]       # f32: see wsum kernel
+    out_ref[0] = (rows.astype(jnp.float32) * sf).astype(
+        out_ref.dtype).reshape(out_ref.shape[1:])
+    other = other_ref[0].reshape(bm, -1)
+    dot_ref[0] = jnp.sum(rows.astype(jnp.float32)
+                         * other.astype(jnp.float32), axis=-1,
+                         keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "interpret"))
+def gather_scale_dot_pallas(src, idx, scale, other, bm=128, interpret=False):
+    """src [B, N, D]; idx [B, M] PRE-CLIPPED; scale [B, M]; other
+    [B, M, D] → (out [B, M, D] = scale*src[idx],
+                 dot [B, M] f32 = src[idx]·other)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, N, D = src.shape
+    M = idx.shape[1]
+    while M % bm:
+        bm //= 2
+    lanes = 128
+    src4 = src.reshape(B, N, D // lanes, lanes)
+    with jax.enable_x64(False):
+        out, dot = pl.pallas_call(
+            functools.partial(_gather_scale_dot_kernel, bm=bm),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(B, M // bm),
+                in_specs=[
+                    pl.BlockSpec(memory_space=pl.ANY),
+                    pl.BlockSpec((1, bm), lambda b, m, idx: (b, m)),
+                    pl.BlockSpec((1, bm, D), lambda b, m, idx: (b, m, 0)),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, bm, D), lambda b, m, idx: (b, m, 0)),
+                    pl.BlockSpec((1, bm, 1), lambda b, m, idx: (b, m, 0)),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((2, bm, D // lanes, lanes), src.dtype),
+                    pltpu.SemaphoreType.DMA((2, bm))],
+            ),
+            out_shape=[jax.ShapeDtypeStruct((B, M, D), src.dtype),
+                       jax.ShapeDtypeStruct((B, M, 1), jnp.float32)],
+            interpret=interpret,
+        )(idx.astype(jnp.int32), src4, scale.astype(jnp.float32), other)
+    return out, dot[..., 0]
+
+
+def _gather_scale_dot_jnp(src, idx, scale, other):
+    B, M = idx.shape
+    rows = jnp.take_along_axis(src, idx[..., None], axis=1)
+    out = rows * scale[..., None].astype(src.dtype)
+    dot = jnp.sum(rows.astype(jnp.float32) * other.astype(jnp.float32),
+                  axis=-1)
+    return out, dot
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def gather_scale_dot(src, idx, scale, other, use_pallas=True):
+    """out = scale⊙src[idx]; dot = src[idx]·other — with a jnp VJP so the
+    fused combine backward stays twice-differentiable (see gather_wsum)."""
+    from .flash_attention import _interpret
+    if use_pallas and _use_pallas_here(src):
+        return gather_scale_dot_pallas(src, idx, scale, other,
+                                       interpret=_interpret())
+    return _gather_scale_dot_jnp(src, idx, scale, other)
+
+
+def _gather_scale_dot_fwd(src, idx, scale, other, use_pallas):
+    return (gather_scale_dot(src, idx, scale, other, use_pallas),
+            (src, idx, scale, other))
+
+
+def _gather_scale_dot_bwd(use_pallas, res, cots):
+    import numpy as np
+    src, idx, scale, other = res
+    d_out, d_dot = cots
+    B, N, D = src.shape
+    rows = jnp.take_along_axis(src, idx[..., None], axis=1)  # [B, M, D]
+    contrib = (d_out.astype(jnp.float32)
+               * scale[..., None].astype(jnp.float32)
+               + d_dot[..., None].astype(jnp.float32)
+               * other.astype(jnp.float32))
+    bidx = jnp.broadcast_to(jnp.arange(B)[:, None], idx.shape)
+    dsrc = jnp.zeros((B, N, D), jnp.float32).at[bidx, idx].add(contrib)
+    d_scale = jnp.sum(d_out.astype(jnp.float32) * rows.astype(jnp.float32),
+                      axis=-1).astype(scale.dtype)
+    d_other = (d_dot[..., None].astype(jnp.float32)
+               * rows.astype(jnp.float32)).astype(other.dtype)
+    return (dsrc.astype(src.dtype),
+            np.zeros(idx.shape, jax.dtypes.float0), d_scale, d_other)
+
+
+gather_scale_dot.defvjp(_gather_scale_dot_fwd, _gather_scale_dot_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def combine_wsum(eout, idx_tk, w, inv_pos, use_pallas=True):
+    """Fused MoE combine: y[b,t] = sum_j w[b,t,j] * eout[b, idx_tk[b,t,j]].
+
+    idx_tk [B, T, k]: PRE-CLIPPED slot id per (token, choice); w [B, T, k]
+    f32 gate probs with 0 at dropped choices. inv_pos [B, M] is the inverse
+    map (flat (t*k+j) position filling each slot, -1 = empty), consumed by
+    the backward only."""
+    return gather_wsum(eout, idx_tk, w, use_pallas=use_pallas)
+
+
+def _combine_wsum_fwd(eout, idx_tk, w, inv_pos, use_pallas):
+    return (combine_wsum(eout, idx_tk, w, inv_pos, use_pallas),
+            (eout, idx_tk, w, inv_pos))
+
+
+def _combine_wsum_bwd(use_pallas, res, dy):
+    import numpy as np
+    eout, idx_tk, w, inv_pos = res
+    B, T, k = idx_tk.shape
+    M = inv_pos.shape[1]
+    # per-slot scale = the gate prob of the (token, choice) filling it
+    w_slot = jnp.where(
+        inv_pos >= 0,
+        jnp.take_along_axis(w.reshape(B, T * k),
+                            jnp.clip(inv_pos, 0), axis=1), 0.0)
+    safe_inv = jnp.where(inv_pos >= 0, inv_pos // k, 0)
+    d_eout, dot = gather_scale_dot(dy, safe_inv, w_slot, eout,
+                                   use_pallas=use_pallas)
+    # d_w[t,j] = dy[t] · eout[slot(t,j)] — route the per-slot dot back to
+    # (t, j) positions through the forward map (scalar gather)
+    dp_flat = jnp.zeros((B, T * k + 1), jnp.float32)
+    pos = jnp.where(inv_pos >= 0, inv_pos, T * k)
+    dp_flat = jax.vmap(lambda d, p, v: d.at[p].set(v, mode="drop"))(
+        dp_flat, pos, dot)
+    d_w = dp_flat[:, :T * k].reshape(B, T, k)
+    return (d_eout, np.zeros(idx_tk.shape, jax.dtypes.float0), d_w,
+            np.zeros(inv_pos.shape, jax.dtypes.float0))
+
+
+combine_wsum.defvjp(_combine_wsum_fwd, _combine_wsum_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))

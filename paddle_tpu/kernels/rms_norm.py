@@ -80,3 +80,169 @@ def rms_norm(x, weight=None, epsilon: float = 1e-6):
         except Exception:
             pass  # fall back to the reference path (e.g. interpret contexts)
     return rms_norm_ref(x, weight, epsilon)
+
+
+# ---------------------------------------------------------------------------
+# Differentiable fused RMSNorm (round 4). XLA's autodiff of rms_norm_ref
+# emits backward fusions whose cross-lane reductions run at ~50 GB/s — the
+# dense-2B xplane profile shows ~210 ms/step (of a ~930 ms step) in the
+# norm fusions alone, ~7x the HBM-bound floor. The Pallas pair below does
+# the forward in one VMEM pass (saving rstd as the residual) and the
+# backward in one pass producing dx and accumulating d_weight across grid
+# steps. Formulas (out = x·r·w, r = rsqrt(mean(x²)+eps)):
+#   dx  = r·(w⊙dy) − x · (r³/D) · Σ_j dy_j w_j x_j      (per row)
+#   dw  = Σ_rows dy ⊙ x ⊙ r
+# ---------------------------------------------------------------------------
+
+
+def _blk_rows(d: int) -> int:
+    # ~5 f32 row-temps of [blk, d] must fit scoped VMEM (16MB)
+    return 128 if d >= 4096 else 256
+
+
+def _rms_fwd_kernel(x_ref, w_ref, o_ref, r_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    r = jax.lax.rsqrt(ms + eps)
+    o_ref[...] = (x * r * w_ref[0].astype(jnp.float32)).astype(o_ref.dtype)
+    r_ref[...] = r
+
+
+def _rms_bwd_kernel(x_ref, w_ref, r_ref, dy_ref, dx_ref, dw_ref, *, d):
+    from jax.experimental import pallas as pl
+
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    w = w_ref[0].astype(jnp.float32)
+    r = r_ref[...]
+    dyw = dy * w
+    s = jnp.sum(dyw * x, axis=-1, keepdims=True)
+    dx = r * dyw - x * (r * r * r / d) * s
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    part = jnp.sum(dy * x * r, axis=0, keepdims=True)     # [1, d] f32
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw_ref[...] = part
+
+    @pl.when(pl.program_id(0) != 0)
+    def _acc():
+        dw_ref[...] += part
+
+
+def _rows(x, blk):
+    d = x.shape[-1]
+    xr = x.reshape(-1, d)
+    pad = (-xr.shape[0]) % blk
+    if pad:
+        xr = jnp.pad(xr, ((0, pad), (0, 0)))
+    return xr, pad
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def _rms_fwd_pallas(x, weight, eps, interpret=False):
+    from jax.experimental import pallas as pl
+
+    d = x.shape[-1]
+    blk = _blk_rows(d)
+    xr, pad = _rows(x, blk)
+    n = xr.shape[0]
+    with jax.enable_x64(False):
+        out, rstd = pl.pallas_call(
+            functools.partial(_rms_fwd_kernel, eps=eps),
+            grid=(n // blk,),
+            in_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                      pl.BlockSpec((1, d), lambda i: (0, 0))],
+            out_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                       pl.BlockSpec((blk, 1), lambda i: (i, 0))],
+            out_shape=[jax.ShapeDtypeStruct((n, d), x.dtype),
+                       jax.ShapeDtypeStruct((n, 1), jnp.float32)],
+            interpret=interpret,
+        )(xr, weight.reshape(1, d))
+    nrows = n - pad
+    return (out[:nrows].reshape(x.shape) if pad else out.reshape(x.shape),
+            rstd[:nrows])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _rms_bwd_pallas(x, weight, rstd, dy, interpret=False):
+    from jax.experimental import pallas as pl
+
+    d = x.shape[-1]
+    blk = _blk_rows(d)
+    xr, pad = _rows(x, blk)
+    dyr, _ = _rows(dy, blk)
+    rr = jnp.pad(rstd, ((0, pad), (0, 0))) if pad else rstd
+    n = xr.shape[0]
+    with jax.enable_x64(False):
+        dx, dw = pl.pallas_call(
+            functools.partial(_rms_bwd_kernel, d=d),
+            grid=(n // blk,),
+            in_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                      pl.BlockSpec((1, d), lambda i: (0, 0)),
+                      pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+                      pl.BlockSpec((blk, d), lambda i: (i, 0))],
+            out_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                       pl.BlockSpec((1, d), lambda i: (0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((n, d), x.dtype),
+                       jax.ShapeDtypeStruct((1, d), jnp.float32)],
+            interpret=interpret,
+        )(xr, weight.reshape(1, d), rr, dyr)
+    nrows = n - pad
+    dx = dx[:nrows].reshape(x.shape) if pad else dx.reshape(x.shape)
+    return dx, dw[0].astype(weight.dtype)
+
+
+def _rms_train_ref_bwd(x, weight, dy, eps):
+    """jnp twin of the backward kernel (CPU / GSPMD / double-grad path)."""
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    wf = weight.astype(jnp.float32)
+    d = x.shape[-1]
+    r = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    dyw = dyf * wf
+    s = jnp.sum(dyw * xf, axis=-1, keepdims=True)
+    dx = (r * dyw - xf * (r * r * r / d) * s).astype(x.dtype)
+    dw = jnp.sum(
+        (dyf * xf * r).reshape(-1, d), axis=0).astype(weight.dtype)
+    return dx, dw
+
+
+def _use_pallas_norm(x):
+    from .flash_attention import _use_pallas
+    return _use_pallas(x) and x.shape[-1] % 128 == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def rms_norm_train(x, weight, epsilon: float = 1e-6, use_pallas=True):
+    """Fused-backward RMSNorm for the training stacks. Matches
+    rms_norm_ref in value; callers pass use_pallas=False under a mesh so
+    GSPMD can partition the jnp formulation."""
+    from .flash_attention import _interpret
+    if use_pallas and _use_pallas_norm(x):
+        return _rms_fwd_pallas(x, weight, epsilon,
+                               interpret=_interpret())[0]
+    return rms_norm_ref(x, weight, epsilon)
+
+
+def _rms_train_fwd(x, weight, epsilon, use_pallas):
+    from .flash_attention import _interpret
+    if use_pallas and _use_pallas_norm(x):
+        out, rstd = _rms_fwd_pallas(x, weight, epsilon,
+                                    interpret=_interpret())
+        return out, (x, weight, rstd)
+    return rms_norm_ref(x, weight, epsilon), (x, weight, None)
+
+
+def _rms_train_bwd(epsilon, use_pallas, res, dy):
+    from .flash_attention import _interpret
+    x, weight, rstd = res
+    if rstd is not None:
+        dx, dw = _rms_bwd_pallas(x, weight, rstd, dy,
+                                 interpret=_interpret())
+    else:
+        dx, dw = _rms_train_ref_bwd(x, weight, dy, epsilon)
+    return dx, dw
+
+
+rms_norm_train.defvjp(_rms_train_fwd, _rms_train_bwd)

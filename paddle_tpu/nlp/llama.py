@@ -29,7 +29,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..kernels.flash_attention import flash_attention_fwd
-from ..kernels.rms_norm import rms_norm_ref
+from ..kernels.rms_norm import rms_norm_ref, rms_norm_train
 from ..kernels.rope import rope_freqs, apply_rope_half
 
 
@@ -229,9 +229,14 @@ def _mlp(x, lp, cfg: LlamaConfig):
 
 
 def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, mesh=None):
-    h = rms_norm_ref(x, lp["input_layernorm"], cfg.rms_norm_eps)
+    # fused-backward norm on one chip; jnp under a mesh so GSPMD can
+    # partition it (XLA's autodiff of the ref emits ~7x-slower backward
+    # fusions — the round-4 dense-2B profile's largest non-GEMM cost)
+    norm = lambda h, w: rms_norm_train(h, w, cfg.rms_norm_eps,  # noqa: E731
+                                       mesh is None)
+    h = norm(x, lp["input_layernorm"])
     x = x + _attention(h, lp, cfg, cos, sin, mesh)
-    h = rms_norm_ref(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
+    h = norm(x, lp["post_attention_layernorm"])
     x = x + _mlp(h, lp, cfg)
     return x
 

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..kernels.rms_norm import rms_norm_ref
+from ..kernels.rms_norm import rms_norm_ref, rms_norm_train
 from ..kernels.rope import rope_freqs
 from . import llama as _llama
 
@@ -314,7 +314,8 @@ def moe_block(x: jax.Array, lp: Dict[str, jax.Array], cfg: MoeConfig,
     aux = jax.tree.map(jnp.mean, aux)
 
     from jax.ad_checkpoint import checkpoint_name
-    from ..kernels.moe_dispatch import combine_gather, dispatch_gather
+    from ..kernels.moe_dispatch import (combine_gather, combine_wsum,
+                                        dispatch_gather)
     # both directions of dispatch AND their gradients are masked row
     # gathers over a pair of inverse index maps (slot assignment is
     # injective — kernels.moe_dispatch): flat maps (token, choice) → slot,
@@ -348,8 +349,17 @@ def moe_block(x: jax.Array, lp: Dict[str, jax.Array], cfg: MoeConfig,
                        lp["expert_up_proj"].astype(cd))
         expert_out = jnp.einsum("emf,efd->emd", jax.nn.silu(g) * u,
                                 lp["expert_down_proj"].astype(cd))
-        got = combine_gather(expert_out.reshape(1, E * B * C, D), flat_g,
-                             inv_pos, True).reshape(B, S, k, D)
+        # FUSED weighted combine: y[t] = sum_j probs[t,j]·eout[slot(t,j)]
+        # in one kernel — the unfused gather-to-[B,S,k,D] + einsum path
+        # cost ~100 ms/step of T(2,128)-tiled reshape/reduce traffic
+        # (round-4 profile); its backward gathers dy rows once for BOTH
+        # d_eout and d_probs (kernels.moe_dispatch.combine_wsum)
+        idx_tk = jnp.clip(flat_g, 0).reshape(1, B * S, k)
+        w_tk = jnp.where(flat_g >= 0,
+                         probs.reshape(1, B * S * k).astype(jnp.float32),
+                         0.0).reshape(1, B * S, k)
+        y = combine_wsum(expert_out.reshape(1, E * B * C, D), idx_tk,
+                         w_tk, inv_pos, True).reshape(B, S, D).astype(cd)
     else:
         # under GSPMD: per-batch-row index space — groups align with the
         # dp/sharding batch shards so the jnp gathers stay shard-local
@@ -374,8 +384,9 @@ def moe_block(x: jax.Array, lp: Dict[str, jax.Array], cfg: MoeConfig,
                                 lp["expert_down_proj"].astype(cd))
         got = combine_gather(expert_out.reshape(B, E * C, D), flat,
                              inv_pos, False).reshape(B, S, k, D)
-    # combine: y[b,s] = Σ_j probs[b,s,j] · expert_out[slot(b,s,j)]
-    y = jnp.einsum("bskd,bsk->bsd", got, probs.astype(cd))
+        # combine: y[b,s] = Σ_j probs[b,s,j] · expert_out[slot(b,s,j)]
+        # (the single-chip branch fuses this einsum into combine_wsum)
+        y = jnp.einsum("bskd,bsk->bsd", got, probs.astype(cd))
 
     if cfg.num_shared_experts:
         sg = x @ lp["shared_gate_proj"].astype(cd)
@@ -390,9 +401,11 @@ def _decoder_body(carry, lp, cfg: MoeConfig, lcfg, cos, sin, mesh,
     for both the plain scan (forward) and the pipeline stage (forward_pp);
     `constrain` optionally re-annotates activation sharding."""
     h, lb, zl = carry
-    a = rms_norm_ref(h, lp["input_layernorm"], cfg.rms_norm_eps)
+    norm = lambda t, w: rms_norm_train(t, w, cfg.rms_norm_eps,  # noqa: E731
+                                       mesh is None)
+    a = norm(h, lp["input_layernorm"])
     h = h + _llama._attention(a, lp, lcfg, cos, sin, mesh)
-    a = rms_norm_ref(h, lp["post_attention_layernorm"], cfg.rms_norm_eps)
+    a = norm(h, lp["post_attention_layernorm"])
     y, aux = moe_block(a, lp, cfg, mesh)
     h = h + y
     if constrain is not None:

@@ -87,6 +87,17 @@ def _dequantize(q: _QTensor, shape, sqrt_space: bool) -> jax.Array:
     return blocks.reshape(-1)[:n].reshape(shape)
 
 
+def _global_norm_scale(grads, clip_norm):
+    """Streamed ClipGradByGlobalNorm factor: min(1, clip/(norm + 1e-6)) —
+    the single source for both the chunked update and the fused apply."""
+    if clip_norm is None:
+        return jnp.float32(1.0)
+    gnorm = jnp.sqrt(sum(
+        jnp.sum(jnp.square(g.astype(jnp.float32)))
+        for g in jax.tree.leaves(grads)))
+    return jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
+
+
 class ScaleByAdamQState(NamedTuple):
     count: jax.Array
     m: Any   # pytree of _QTensor
@@ -129,13 +140,7 @@ def scale_by_adam_q(b1: float = 0.9, b2: float = 0.999,
         bc1 = 1.0 - b1 ** count.astype(jnp.float32)
         bc2 = 1.0 - b2 ** count.astype(jnp.float32)
 
-        if clip_norm is not None:
-            gnorm = jnp.sqrt(sum(
-                jnp.sum(jnp.square(g.astype(jnp.float32)))
-                for g in jax.tree.leaves(grads)))
-            gscale = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
-        else:
-            gscale = jnp.float32(1.0)
+        gscale = _global_norm_scale(grads, clip_norm)
 
         def blockwise(gb, mq, vq):
             """One chunk: gb [c, BLOCK] in the grad dtype (cast to f32 HERE
@@ -202,3 +207,168 @@ def adamw_q(learning_rate, b1: float = 0.9, b2: float = 0.999,
         optax.add_decayed_weights(weight_decay),
         optax.scale_by_learning_rate(learning_rate),
     )
+
+
+# ---------------------------------------------------------------------------
+# Fused single-pass AdamW-8bit (Pallas). The optax chain above makes ~5
+# full-tree HBM passes per step (adam update tree, decayed-weights pass,
+# lr pass, apply_updates pass, plus the serialized lax.map chunk streams —
+# the round-4 xplane profile of the config-4 bench shows ~170-270 ms of
+# serialized optimizer DMA per step). One Pallas kernel reads g/p/m8/v8 and
+# writes p'/m8'/v8' in a single pipelined pass: ~10 bytes/param of traffic,
+# HBM-bound (~30 ms at 1.6B params).
+# ---------------------------------------------------------------------------
+
+_FUSED_ROWS = 512    # block rows (x BLOCK lanes) per grid step: 128K params
+# (bm=2048 put ~24MB of f32 temporaries on the scoped-VMEM stack, over the
+# 16MB limit; 512 keeps the kernel ~6MB with the DMA chunks still 256KB)
+
+
+def _fused_adamw_kernel(sc_ref, g_ref, p_ref, mc_ref, ms_ref, vc_ref,
+                        vs_ref, po_ref, mco_ref, mso_ref, vco_ref, vso_ref,
+                        *, b1, b2, eps, wd):
+    """One row-chunk of the fused update. sc = [gscale, lr, bc1, bc2] in
+    SMEM; moments decode/requant and the AdamW param update all happen in
+    one VPU pass over the chunk."""
+    gscale, lr, bc1, bc2 = sc_ref[0], sc_ref[1], sc_ref[2], sc_ref[3]
+    g = g_ref[...].astype(jnp.float32) * gscale
+    m = b1 * (mc_ref[...].astype(jnp.float32) * ms_ref[...]) + (1 - b1) * g
+    sv = vc_ref[...].astype(jnp.float32) * vs_ref[...]
+    v = b2 * sv * sv + (1 - b2) * g * g
+    upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    p = p_ref[...].astype(jnp.float32)
+    po_ref[...] = (p - lr * (upd + wd * p)).astype(po_ref.dtype)
+    amax = jnp.maximum(jnp.max(jnp.abs(m), axis=1, keepdims=True), 1e-30)
+    ms_new = amax / F8_MAX
+    mco_ref[...] = (m / ms_new).astype(F8)
+    mso_ref[...] = ms_new
+    sq = jnp.sqrt(v)
+    amax = jnp.maximum(jnp.max(sq, axis=1, keepdims=True), 1e-30)
+    vs_new = amax / F8_MAX
+    vco_ref[...] = (sq / vs_new).astype(F8)
+    vso_ref[...] = vs_new
+
+
+def _fused_leaf_update(scalars, g, p, mq, vq, *, b1, b2, eps, wd,
+                       interpret=False):
+    """Run the fused kernel over one leaf. g/p keep their shapes (flatten
+    is a bitcast for the contiguous [.., BLOCK]-divisible leaves this
+    optimizer stores); returns (p', m', v')."""
+    import functools
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nb = mq.codes.shape[0]
+    gf = g.reshape(-1)
+    if gf.size != nb * BLOCK:
+        gf = jnp.pad(gf, (0, nb * BLOCK - gf.size))
+    gf = gf.reshape(nb, BLOCK)
+    pf = p.reshape(-1)
+    if pf.size != nb * BLOCK:
+        pf = jnp.pad(pf, (0, nb * BLOCK - pf.size))
+    pf = pf.reshape(nb, BLOCK)
+
+    bm = min(_FUSED_ROWS, nb)
+    grid = (-(-nb // bm),)
+    row = lambda i: (i, 0)  # noqa: E731
+    with jax.enable_x64(False):
+        po, mc, ms, vc, vs = pl.pallas_call(
+            functools.partial(_fused_adamw_kernel, b1=b1, b2=b2, eps=eps,
+                              wd=wd),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((bm, BLOCK), row),
+                pl.BlockSpec((bm, BLOCK), row),
+                pl.BlockSpec((bm, BLOCK), row),
+                pl.BlockSpec((bm, 1), row),
+                pl.BlockSpec((bm, BLOCK), row),
+                pl.BlockSpec((bm, 1), row),
+            ],
+            out_specs=[
+                pl.BlockSpec((bm, BLOCK), row),
+                pl.BlockSpec((bm, BLOCK), row),
+                pl.BlockSpec((bm, 1), row),
+                pl.BlockSpec((bm, BLOCK), row),
+                pl.BlockSpec((bm, 1), row),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((nb, BLOCK), p.dtype),
+                jax.ShapeDtypeStruct((nb, BLOCK), F8),
+                jax.ShapeDtypeStruct((nb, 1), jnp.float32),
+                jax.ShapeDtypeStruct((nb, BLOCK), F8),
+                jax.ShapeDtypeStruct((nb, 1), jnp.float32),
+            ],
+            input_output_aliases={2: 0, 3: 1, 4: 2, 5: 3, 6: 4},
+            interpret=interpret,
+        )(scalars, gf, pf, mq.codes, mq.scale, vq.codes, vq.scale)
+    pnew = po.reshape(-1)[:p.size].reshape(p.shape)
+    return pnew, _QTensor(mc, ms), _QTensor(vc, vs)
+
+
+class FusedTransformation(NamedTuple):
+    """optax.GradientTransformation plus a fused param-updating apply —
+    duck-type compatible everywhere a (init, update) pair is expected."""
+    init: Any
+    update: Any
+    apply_fused: Any
+
+
+def adamw_q_fused(learning_rate, b1: float = 0.9, b2: float = 0.999,
+                  eps: float = 1e-8, weight_decay: float = 0.0,
+                  clip_norm: Optional[float] = None) -> FusedTransformation:
+    """Single-transform AdamW-8bit: state is one ScaleByAdamQState (no
+    chain tuple). `update` keeps the pure-jnp chunked stream (GSPMD-able,
+    used under a mesh / in tests); `apply_fused(grads, state, params)`
+    runs the one-pass Pallas kernel and returns (new_params, new_state)
+    directly — the single-chip training benches call this. learning_rate
+    may be a float or an optax schedule of the step count."""
+    sched = (learning_rate if callable(learning_rate)
+             else (lambda _: learning_rate))
+    inner = scale_by_adam_q(b1, b2, eps, clip_norm=clip_norm)
+
+    def init(params):
+        return inner.init(params)
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("adamw_q_fused.update needs params (AdamW)")
+        # lr/wd folded into the update tree so apply_updates is the only
+        # remaining pass (legacy path; apply_fused skips even that)
+        upd, new_state = inner.update(grads, state, params)
+        lr = sched(state.count)
+        out = jax.tree.map(
+            lambda u, p: (-lr * (u.astype(jnp.float32)
+                                 + weight_decay * p.astype(jnp.float32))
+                          ).astype(u.dtype), upd, params)
+        return out, new_state
+
+    def apply_fused(grads, state, params):
+        from ..kernels.flash_attention import _interpret, _use_pallas
+        probe = jax.tree.leaves(params)[0]
+        interpret = _interpret()
+        if not (_use_pallas(probe) or interpret):
+            upd, new_state = update(grads, state, params)
+            return optax.apply_updates(params, upd), new_state
+        count = state.count + 1
+        bc1 = 1.0 - b1 ** count.astype(jnp.float32)
+        bc2 = 1.0 - b2 ** count.astype(jnp.float32)
+        lr = jnp.asarray(sched(state.count), jnp.float32)
+        gscale = _global_norm_scale(grads, clip_norm)
+        scalars = jnp.stack([gscale, lr, bc1, bc2])
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_p = treedef.flatten_up_to(params)
+        flat_m = treedef.flatten_up_to(state.m)
+        flat_v = treedef.flatten_up_to(state.v)
+        out = [_fused_leaf_update(scalars, g, p, mq, vq, b1=b1, b2=b2,
+                                  eps=eps, wd=weight_decay,
+                                  interpret=interpret)
+               for g, p, mq, vq in zip(flat_g, flat_p, flat_m, flat_v)]
+        new_params = treedef.unflatten([o[0] for o in out])
+        new_m = treedef.unflatten([o[1] for o in out])
+        new_v = treedef.unflatten([o[2] for o in out])
+        return new_params, ScaleByAdamQState(count, new_m, new_v)
+
+    return FusedTransformation(init, update, apply_fused)

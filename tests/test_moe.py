@@ -179,6 +179,65 @@ class TestIndexDispatch:
         finally:
             F.set_flags({"FLAGS_pallas_interpret": False})
 
+    def test_combine_wsum_matches_einsum_formulation(self):
+        """Fused weighted combine (kernel + jnp fallback) must match the
+        unfused gather-to-[B,T,k,D] + einsum path in value AND in the
+        eout/probs gradients (the fused backward gathers dy rows once for
+        both d_eout and d_probs)."""
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import moe_dispatch as md
+        from paddle_tpu.core import flags as F
+        rng = np.random.RandomState(1)
+        B, T, k, M, D = 2, 16, 2, 24, 128
+        eout = jnp.asarray(rng.randn(B, M, D), jnp.float32)
+        # a consistent routing: injective (t, j) -> slot map with drops
+        flat = np.full((B, T * k), -1, np.int32)
+        inv = np.full((B, M), -1, np.int32)
+        for b in range(B):
+            perm = rng.permutation(M)
+            for i, pos in enumerate(rng.permutation(T * k)[:20]):
+                flat[b, pos] = perm[i]
+                inv[b, perm[i]] = pos
+        flat_j, inv_j = jnp.asarray(flat), jnp.asarray(inv)
+        probs = jnp.asarray(rng.rand(B, T, k), jnp.float32)
+        idx_tk = jnp.clip(flat_j, 0).reshape(B, T, k)
+        w = jnp.where(flat_j >= 0, probs.reshape(B, T * k),
+                      0.0).reshape(B, T, k)
+
+        def ref(eo, pw):
+            got = md._gather_rows_jnp(eo, flat_j).reshape(B, T, k, D)
+            wv = jnp.where(flat_j.reshape(B, T, k) >= 0, pw, 0.0)
+            return jnp.einsum("btkd,btk->btd", got, wv)
+
+        def fused(eo, pw, use_pallas):
+            wv = jnp.where(flat_j.reshape(B, T, k) >= 0, pw, 0.0)
+            return md.combine_wsum(eo, idx_tk, wv, inv_j, use_pallas)
+
+        for use_pallas in (False, True):
+            if use_pallas:
+                F.set_flags({"FLAGS_pallas_interpret": True})
+            try:
+                y = fused(eout, probs, use_pallas)
+                np.testing.assert_allclose(np.asarray(y),
+                                           np.asarray(ref(eout, probs)),
+                                           rtol=1e-5, atol=1e-5)
+                ge_f, gp_f = jax.grad(
+                    lambda eo, pw: jnp.sum(fused(eo, pw, use_pallas) ** 2),
+                    argnums=(0, 1))(eout, probs)
+                ge_r, gp_r = jax.grad(
+                    lambda eo, pw: jnp.sum(ref(eo, pw) ** 2),
+                    argnums=(0, 1))(eout, probs)
+                np.testing.assert_allclose(np.asarray(ge_f),
+                                           np.asarray(ge_r),
+                                           rtol=1e-5, atol=1e-5)
+                np.testing.assert_allclose(np.asarray(gp_f),
+                                           np.asarray(gp_r),
+                                           rtol=1e-5, atol=1e-5)
+            finally:
+                F.set_flags({"FLAGS_pallas_interpret": False})
+
     def test_routing_matches_onehot_gating(self):
         """top_k_gating (one-hot facade) is derived from top_k_routing —
         dispatch/combine rebuilt from indices must satisfy the GShard

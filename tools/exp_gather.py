@@ -1,0 +1,150 @@
+"""One-off experiments for the gather-kernel redesign (not a test).
+
+Variants:
+  base     — current shipped kernel (per-row sems, per-row conditional)
+  nocond   — always-DMA clipped index + mask multiply, per-row sems
+  agg      — nocond + ONE shared DMA sem per buffer, aggregate wait
+             (discovers the semaphore unit: count vs bytes)
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _force(out):
+    float(jnp.sum(out[:1, :1, :8].astype(jnp.float32)))
+
+
+def timeit(f, *args, n=10):
+    out = f(*args)
+    _force(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = f(*args)
+    _force(out)
+    return (time.perf_counter() - t0) / n
+
+
+def _kernel_nocond(idx_ref, src_ref, out_ref, scratch, sems, *, bm):
+    b = pl.program_id(0)
+    mb = pl.program_id(1)
+    nmb = pl.num_programs(1)
+
+    def start_block(mb_, buf):
+        for r in range(bm):
+            i = jnp.maximum(idx_ref[b, mb_ * bm + r], 0)
+            pltpu.make_async_copy(src_ref.at[b, i], scratch.at[buf, r],
+                                  sems.at[buf, r]).start()
+
+    @pl.when(mb == 0)
+    def _prologue():
+        start_block(0, 0)
+
+    @pl.when(mb + 1 < nmb)
+    def _next():
+        start_block(mb + 1, (mb + 1) % 2)
+
+    for r in range(bm):
+        i = jnp.maximum(idx_ref[b, mb * bm + r], 0)
+        pltpu.make_async_copy(src_ref.at[b, i], scratch.at[mb % 2, r],
+                              sems.at[mb % 2, r]).wait()
+    out_ref[0] = scratch[mb % 2].reshape(out_ref.shape[1:])
+
+
+def _kernel_agg(idx_ref, src_ref, out_ref, scratch, sems, *, bm, unit):
+    b = pl.program_id(0)
+    mb = pl.program_id(1)
+    nmb = pl.num_programs(1)
+
+    def start_block(mb_, buf):
+        for r in range(bm):
+            i = jnp.maximum(idx_ref[b, mb_ * bm + r], 0)
+            pltpu.make_async_copy(src_ref.at[b, i], scratch.at[buf, r],
+                                  sems.at[buf]).start()
+
+    @pl.when(mb == 0)
+    def _prologue():
+        start_block(0, 0)
+
+    @pl.when(mb + 1 < nmb)
+    def _next():
+        start_block(mb + 1, (mb + 1) % 2)
+
+    # one aggregate wait: DMA sems count bytes; a wait descriptor sized
+    # as the WHOLE buffer consumes all bm row-copy completions at once
+    pltpu.make_async_copy(scratch.at[mb % 2], scratch.at[mb % 2],
+                          sems.at[mb % 2]).wait()
+    out_ref[0] = scratch[mb % 2].reshape(out_ref.shape[1:])
+
+
+def build(variant, B, N, M, D, bm, unit=1):
+    lanes = 128
+    if variant == "nocond":
+        kern = functools.partial(_kernel_nocond, bm=bm)
+        sems = pltpu.SemaphoreType.DMA((2, bm))
+    else:
+        kern = functools.partial(_kernel_agg, bm=bm, unit=unit)
+        sems = pltpu.SemaphoreType.DMA((2,))
+
+    @jax.jit
+    def f(src, idx, mask):
+        src4 = src.reshape(B, N, D // lanes, lanes)
+        with jax.enable_x64(False):
+            out = pl.pallas_call(
+                kern,
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=1,
+                    grid=(B, M // bm),
+                    in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                    out_specs=pl.BlockSpec((1, bm, D), lambda b, m, idx: (b, m, 0)),
+                    scratch_shapes=[
+                        pltpu.VMEM((2, bm, D // lanes, lanes), src.dtype), sems],
+                ),
+                out_shape=jax.ShapeDtypeStruct((B, M, D), src.dtype),
+            )(idx, src4)
+        return out * mask[..., None]
+
+    return f
+
+
+def main():
+    from paddle_tpu.kernels.moe_dispatch import (gather_rows_pallas,
+                                                 _gather_rows_jnp)
+    from devloop import loop_time
+    rng = np.random.default_rng(0)
+    B, N, M, D = 1, 81920, 102400, 2048
+    src = jnp.asarray(rng.normal(size=(B, N, D)), jnp.bfloat16)
+    idx_np = rng.integers(0, N, (B, M)).astype(np.int32)
+    idx_np[rng.random((B, M)) > 0.8] = -1
+    idx = jnp.asarray(idx_np)
+    mask = (idx >= 0).astype(jnp.bfloat16)
+    gb = (0.8 * M + M) * D * 2 / 1e9
+
+    ref = np.where(idx_np[..., None] >= 0,
+                   np.asarray(src)[0][np.clip(idx_np, 0, None)[0]][None], 0)
+
+    t = loop_time(lambda s, i: gather_rows_pallas(s, i, bm=128), (src, idx),
+                  roll_arg=1)
+    print(f"base bm=128             {t*1e3:7.2f} ms  {gb/t:6.1f} GB/s")
+    t = loop_time(_gather_rows_jnp, (src, idx), roll_arg=1)
+    print(f"jnp                     {t*1e3:7.2f} ms  {gb/t:6.1f} GB/s")
+
+    for bm in (64, 128):
+        f = build("nocond", B, N, M, D, bm)
+        out = f(src, idx, mask)
+        ok = np.allclose(np.asarray(out), ref)
+        t = loop_time(f, (src, idx, mask), roll_arg=1)
+        print(f"nocond bm={bm:4d}          {t*1e3:7.2f} ms  {gb/t:6.1f} GB/s  ok={ok}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,171 @@
+"""On-chip xplane profile of a bench workload, aggregated by op category.
+
+Usage: python tools/profile_step.py [moe|dense2b|dit|ernie] [steps]
+
+Traces `steps` post-warmup train steps with jax.profiler, parses the
+xplane via jax.profiler.ProfileData, and prints per-op-category device
+time so perf work (VERDICT r3 next-1) is evidence-driven rather than
+guessed. Categories are keyed on the fusion/op names XLA emits for this
+codebase (pallas kernel names survive into the xplane as custom-calls).
+"""
+from __future__ import annotations
+
+import collections
+import glob
+import os
+import re
+import sys
+import tempfile
+
+import numpy as np
+
+
+def build(which):
+    import jax
+    import jax.numpy as jnp
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    if which == "moe":
+        from paddle_tpu.nlp import moe, train
+        cfg = moe.MoeConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            moe_intermediate_size=1024, num_experts=16,
+            num_experts_per_tok=2, num_shared_experts=1,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            param_dtype=jnp.bfloat16)
+        tx = train.make_optimizer(1e-4, state_quant="8bit", grad_clip=1.0)
+        state = train.init_state(jax.random.key(0), cfg, tx, mesh=None,
+                                 model=moe)
+        step = train.make_train_step(cfg, tx, mesh=None, model=moe)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (20, 2048)),
+                             jnp.int32)
+        return step, state, tokens
+    if which == "dense2b":
+        from paddle_tpu.nlp import llama, train
+        cfg = bench.flagship_2b_cfg()
+        tx = train.make_optimizer(1e-4, state_quant="8bit", grad_clip=1.0)
+        state = train.init_state(jax.random.key(0), cfg, tx, mesh=None)
+        step = train.make_train_step(cfg, tx, mesh=None)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 2048)),
+                             jnp.int32)
+        return step, state, tokens
+    if which == "dit":
+        return bench.build_dit_step()
+    raise SystemExit(f"unknown workload {which}")
+
+
+CATS = [
+    ("flash_attn", re.compile(r"flash|attention", re.I)),
+    ("moe_gather", re.compile(r"gather_rows|_gather_rows", re.I)),
+    ("fusion", re.compile(r"^(loop_)?fusion", re.I)),
+    ("convolution", re.compile(r"convolution|conv", re.I)),
+    ("matmul", re.compile(r"dot|einsum|matmul", re.I)),
+    ("copy/transpose", re.compile(r"copy|transpose|bitcast", re.I)),
+    ("dynamic-slice/update", re.compile(r"dynamic", re.I)),
+    ("scatter", re.compile(r"scatter", re.I)),
+    ("gather(jnp)", re.compile(r"gather", re.I)),
+    ("reduce", re.compile(r"reduce", re.I)),
+    ("sort/cumsum", re.compile(r"sort|cumulative|scan", re.I)),
+]
+
+
+def categorize(name):
+    # classify on the op's own name only (text before " = "), not its
+    # operand list — operand names polluted whole-text matching
+    own = name.split(" = ")[0]
+    for cat, pat in CATS:
+        if pat.search(own):
+            return cat
+    return "other"
+
+
+def main():
+    import jax
+    which = sys.argv[1] if len(sys.argv) > 1 else "moe"
+    nsteps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    step, state, tokens = build(which)
+    # warmup/compile
+    state, loss = step(state, tokens)
+    jax.block_until_ready(loss)
+    import time
+    t0 = time.perf_counter()
+    state, loss = step(state, tokens)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    print(f"[{which}] step time {dt*1e3:.0f} ms")
+
+    tmpd = tempfile.mkdtemp(prefix="prof_")
+    with jax.profiler.trace(tmpd):
+        for _ in range(nsteps):
+            state, loss = step(state, tokens)
+        jax.block_until_ready(loss)
+
+    from jax.profiler import ProfileData
+    files = glob.glob(os.path.join(tmpd, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not files:
+        raise SystemExit(f"no xplane under {tmpd}")
+    pd = ProfileData.from_file(files[0])
+    by_op = collections.Counter()     # EXCLUSIVE ns per op name
+    total = 0
+    for plane in pd.planes:
+        if "TPU" not in plane.name and "/device" not in plane.name.lower():
+            continue
+        for line in plane.lines:
+            if "xla ops" not in line.name.lower():
+                continue
+            evs = sorted(((ev.start_ns, ev.duration_ns, ev.name)
+                          for ev in line.events), key=lambda t: (t[0], -t[1]))
+            # exclusive time: walk a stack of open intervals; a nested
+            # event's duration is subtracted from its parent
+            stack = []  # (end_ns, name, child_ns_accum) — mutable via list
+            def close_until(start):
+                while stack and stack[-1][0] <= start:
+                    end, name, child = stack.pop()
+                    dur = end - stack_start.pop()
+                    excl = dur - child
+                    by_op[name] += excl
+                    if stack:
+                        stack[-1][2] += dur
+            stack_start = []
+            for s, d, name in evs:
+                close_until(s)
+                stack.append([s + d, name, 0])
+                stack_start.append(s)
+            close_until(float("inf"))
+    # async copy lifetimes (slice-start/copy-start/async-start) overlap
+    # real compute on the core timeline — report them separately, never in
+    # the core total (round-4 lesson: counting them pointed at the
+    # optimizer's DMA streams, which measured at only 14 ms in isolation)
+    async_ns = sum(ns for n, ns in by_op.items()
+                   if "-start" in n.split(" = ")[0])
+    by_op = collections.Counter(
+        {n: ns for n, ns in by_op.items()
+         if "-start" not in n.split(" = ")[0]})
+    total = sum(by_op.values())
+    by_cat = collections.Counter()
+    for name, ns in by_op.items():
+        by_cat[categorize(name)] += ns
+    print(f"core time {total/1e6/nsteps:.0f} ms/step over {nsteps} steps "
+          f"(+{async_ns/1e6/nsteps:.0f} ms async-copy lifetimes, overlapped)")
+    print("\n== by category (ms/step) ==")
+    for cat, ns in by_cat.most_common():
+        print(f"  {cat:22s} {ns/1e6/nsteps:8.1f}")
+    print("\n== top 60 ops (ms/step) ==")
+    for name, ns in by_op.most_common(60):
+        print(f"  {ns/1e6/nsteps:8.1f}  {name[:130]}")
+    conv = [(ns, n) for n, ns in by_op.items()
+            if "convolution" in n or "dot" in n]
+    conv.sort(reverse=True)
+    print(f"\n== all dot/conv ops ({sum(ns for ns,_ in conv)/1e6/nsteps:.0f} ms/step) ==")
+    for ns, name in conv[:40]:
+        print(f"  {ns/1e6/nsteps:8.1f}  {name[:130]}")
+
+
+if __name__ == "__main__":
+    main()
