@@ -230,23 +230,27 @@ def _fused_adamw_kernel(sc_ref, g_ref, p_ref, mc_ref, ms_ref, vc_ref,
     """One row-chunk of the fused update. sc = [gscale, lr, bc1, bc2] in
     SMEM; moments decode/requant and the AdamW param update all happen in
     one VPU pass over the chunk."""
+    # the kernel is VPU-bound (~25 elementwise ops/param) — per-element
+    # divides cost ~7x a multiply, so every div below is either hoisted to
+    # a scalar or turned into a per-ROW reciprocal broadcast; the two
+    # sqrt(v)-family values share one sqrt
     gscale, lr, bc1, bc2 = sc_ref[0], sc_ref[1], sc_ref[2], sc_ref[3]
+    inv_bc1 = 1.0 / bc1
+    rs_bc2 = jax.lax.rsqrt(bc2)
     g = g_ref[...].astype(jnp.float32) * gscale
     m = b1 * (mc_ref[...].astype(jnp.float32) * ms_ref[...]) + (1 - b1) * g
     sv = vc_ref[...].astype(jnp.float32) * vs_ref[...]
     v = b2 * sv * sv + (1 - b2) * g * g
-    upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
-    p = p_ref[...].astype(jnp.float32)
-    po_ref[...] = (p - lr * (upd + wd * p)).astype(po_ref.dtype)
-    amax = jnp.maximum(jnp.max(jnp.abs(m), axis=1, keepdims=True), 1e-30)
-    ms_new = amax / F8_MAX
-    mco_ref[...] = (m / ms_new).astype(F8)
-    mso_ref[...] = ms_new
     sq = jnp.sqrt(v)
+    upd = (m * inv_bc1) / (sq * rs_bc2 + eps)
+    p = p_ref[...].astype(jnp.float32)
+    po_ref[...] = (p * (1.0 - lr * wd) - lr * upd).astype(po_ref.dtype)
+    amax = jnp.maximum(jnp.max(jnp.abs(m), axis=1, keepdims=True), 1e-30)
+    mco_ref[...] = (m * (F8_MAX / amax)).astype(F8)
+    mso_ref[...] = amax * (1.0 / F8_MAX)
     amax = jnp.maximum(jnp.max(sq, axis=1, keepdims=True), 1e-30)
-    vs_new = amax / F8_MAX
-    vco_ref[...] = (sq / vs_new).astype(F8)
-    vso_ref[...] = vs_new
+    vco_ref[...] = (sq * (F8_MAX / amax)).astype(F8)
+    vso_ref[...] = amax * (1.0 / F8_MAX)
 
 
 def _fused_leaf_update(scalars, g, p, mq, vq, *, b1, b2, eps, wd,

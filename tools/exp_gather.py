@@ -9,7 +9,6 @@ Variants:
 import functools
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -18,20 +17,6 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-
-
-def _force(out):
-    float(jnp.sum(out[:1, :1, :8].astype(jnp.float32)))
-
-
-def timeit(f, *args, n=10):
-    out = f(*args)
-    _force(out)
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = f(*args)
-    _force(out)
-    return (time.perf_counter() - t0) / n
 
 
 def _kernel_nocond(idx_ref, src_ref, out_ref, scratch, sems, *, bm):

@@ -1,6 +1,6 @@
 """On-chip xplane profile of a bench workload, aggregated by op category.
 
-Usage: python tools/profile_step.py [moe|dense2b|dit|ernie] [steps]
+Usage: python tools/profile_step.py [moe|dense2b|dit] [steps]
 
 Traces `steps` post-warmup train steps with jax.profiler, parses the
 xplane via jax.profiler.ProfileData, and prints per-op-category device
