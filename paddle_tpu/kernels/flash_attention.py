@@ -196,11 +196,14 @@ def _fwd_call(off, qt, kt, vt, grid, block_q, block_k, causal, scale, sk, b,
 #   hit Mosaic's 16MB scoped-vmem stack limit from seq 4096 up (measured:
 #   the 2B model at seq 4096 batch 4 fails to compile resident, compiles
 #   and runs streamed).
-#   STREAMED (longer): 3D grid — dq over (bh, qb, kb) with an f32 scratch
-#   accumulator, dk/dv over (bh, kb, qb) — every ref is ONE block, nothing
-#   full-sequence in VMEM, so seq scales to the 8B north-star 8k+ shapes;
-#   causal invisibility is a pl.when compute skip (the block DMA still
-#   runs, ~1pt MFU at 2k — why the resident path is kept).
+#   STREAMED (longer): primary path is the COMBINED (bh, kb, qb) kernel —
+#   block operands only, except a seq-scaling full-seq f32 dq accumulator
+#   (+ the dq output block); when those exceed the scoped-VMEM budget
+#   (seq ~16k+ at d=128) it falls back to the SPLIT kernels — dq over
+#   (bh, qb, kb), dk/dv over (bh, kb, qb) — where truly nothing is
+#   full-sequence. Causal invisibility is a pl.when compute skip (the
+#   block DMA still runs, ~1pt MFU at 2k — why the resident path is
+#   kept).
 # ---------------------------------------------------------------------------
 
 _RESIDENT_MAX_SEQ = 2048
@@ -266,6 +269,83 @@ def _flash_bwd_combined_kernel_res(off_ref, q_ref, k_ref, v_ref, do_ref,
     @pl.when(kb == n_kb - 1)
     def _flush():
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_combined_kernel_str(off_ref, q_ref, k_ref, v_ref, do_ref,
+                                   lse_ref, dcap_ref, dq_ref, dk_ref,
+                                   dv_ref, dq_sc, dk_acc, dv_acc, *,
+                                   causal, scale, n_kb, n_qb):
+    """Combined STREAMED backward: grid (bh, kb, qb) with every operand a
+    single block; dk/dv accumulate over the inner qb loop, dq accumulates
+    into a full-seq f32 scratch across the whole (kb, qb) sub-grid and is
+    flushed at the last step. Shares s/p/dp between the dq and dk/dv
+    halves (7 block matmuls -> 5), like the resident combined kernel but
+    with nothing full-sequence in VMEM except the dq accumulator
+    (seq*d*4 bytes — the wrapper falls back to the split kernels when
+    that exceeds the scoped-VMEM budget)."""
+    from jax.experimental import pallas as pl
+
+    block_k, d = int(k_ref.shape[1]), int(k_ref.shape[2])
+    block_q = int(q_ref.shape[1])
+    kb = pl.program_id(1)
+    qb = pl.program_id(2)
+    k_offset = kb * block_k
+    q_offset = qb * block_q
+    off = off_ref[0, 0] if causal else 0
+
+    @pl.when((kb == 0) & (qb == 0))
+    def _init_dq():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    @pl.when(qb == 0)
+    def _init_dkv():
+        dk_acc[...] = jnp.zeros((block_k, d), jnp.float32)
+        dv_acc[...] = jnp.zeros((block_k, d), jnp.float32)
+
+    visible = True
+    if causal:
+        # block contributes iff its LAST q row reaches this kv block:
+        # row iq sees ik <= iq + off
+        visible = (q_offset + block_q - 1 + off) >= k_offset
+
+    @pl.when(visible)
+    def _compute():
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        dcap = dcap_ref[0, :, 0]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            q_idx = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + q_offset
+            k_idx = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1) + k_offset
+            # mask p, not s: fully-masked rows have lse == -inf and
+            # exp(NEG_INF - lse) would be exp(0) == 1 there
+            p = jnp.where((q_idx + off) >= k_idx, p, 0.0)
+        dv_acc[...] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dcap[:, None]) * scale
+        dk_acc[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        dq_sc[pl.ds(q_offset, block_q), :] += jnp.dot(
+            ds, k_blk, preferred_element_type=jnp.float32)
+
+    @pl.when(qb == n_qb - 1)
+    def _flush_dkv():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+    @pl.when((kb == n_kb - 1) & (qb == n_qb - 1))
+    def _flush_dq():
+        dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
+
+
+# dq scratch budget for the combined streamed kernel: seq*d*4 bytes of
+# scoped VMEM (16MB limit, leave room for the block operands)
+_COMBINED_STREAMED_DQ_BYTES = 12 * 1024 * 1024
 
 
 def _flash_bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -422,6 +502,44 @@ def _bwd_call(off, qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d, block_q,
 
     n_kb = sk // block_k
     n_qb = sq // block_q
+    # budget: the f32 dq scratch AND the full-seq dq output block both
+    # live in VMEM and scale with seq — count both or near-budget configs
+    # compile-fail instead of falling back to the split kernels
+    dq_vmem = sq * d * (4 + jnp.dtype(q_dtype).itemsize)
+    if dq_vmem <= _COMBINED_STREAMED_DQ_BYTES and sq == sk:
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_combined_kernel_str, causal=causal,
+                              scale=scale, n_kb=n_kb, n_qb=n_qb),
+            out_shape=[jax.ShapeDtypeStruct((b * h, sq, d), q_dtype),
+                       jax.ShapeDtypeStruct((b * h, sk, d), k_dtype),
+                       jax.ShapeDtypeStruct((b * h, sk, d), v_dtype)],
+            grid=(b * h, n_kb, n_qb),
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda bh, kb, qb: (0, 0)),
+                pl.BlockSpec((1, block_q, d), lambda bh, kb, qb: (bh, qb, 0)),
+                pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (bh, kb, 0)),
+                pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (bh, kb, 0)),
+                pl.BlockSpec((1, block_q, d), lambda bh, kb, qb: (bh, qb, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda bh, kb, qb: (bh, qb, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda bh, kb, qb: (bh, qb, 0)),
+            ],
+            out_specs=[
+                # dq revisits one full-seq block per bh (flush at the end)
+                pl.BlockSpec((1, sq, d), lambda bh, kb, qb: (bh, 0, 0)),
+                pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (bh, kb, 0)),
+                pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (bh, kb, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((sq, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+            interpret=interpret,
+        )(off, qt, kt, vt, dot, lse_t, dcap)
+
+        def back(x):
+            return x.reshape(b, h, -1, d).transpose(0, 2, 1, 3)
+
+        return back(dq), back(dk), back(dv)
+
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale,
                           n_kb=n_kb),
