@@ -167,17 +167,15 @@ def run_ernie(batch=64, seq=512, timed_steps=10):
     return {"mfu": mfu, "tok_s": tok_s, "params": ernie.num_params(cfg)}
 
 
-def run_dit(batch=64, timed_steps=10):
-    """BASELINE config 3 (DiT-XL/2-class diffusion): epsilon-prediction
-    train step on 32x32x4 latents, depth-28 DiT (675M params), bf16
-    compute + 8-bit Adam moments. MFU per dit.flops_per_image."""
+def build_dit_step(batch=64):
+    """DiT train-step builder shared by run_dit and tools/profile_step.py
+    (one definition so the profiler always measures the benched step)."""
     import jax
     import jax.numpy as jnp
     import optax
     from paddle_tpu.mix import dit
     from paddle_tpu.optimizer.quant_state import adamw_q
 
-    dev = jax.devices()[0]
     cfg = dit.DiTConfig.dit_xl_2()
     params = dit.init_params(jax.random.key(0), cfg)
     tx = adamw_q(1e-4)
@@ -197,11 +195,22 @@ def run_dit(batch=64, timed_steps=10):
         upd, opt = tx.update(g, opt, params)
         return (optax.apply_updates(params, upd), opt), {"loss": loss}
 
-    state = (params, tx.init(params))
-    dt, _ = _timed_steps(step, state, (x0, y), 2, timed_steps)
+    return step, (params, tx.init(params)), (x0, y), cfg
+
+
+def run_dit(batch=64, timed_steps=10):
+    """BASELINE config 3 (DiT-XL/2-class diffusion): epsilon-prediction
+    train step on 32x32x4 latents, depth-28 DiT (675M params), bf16
+    compute + 8-bit Adam moments. MFU per dit.flops_per_image."""
+    import jax
+    from paddle_tpu.mix import dit
+
+    dev = jax.devices()[0]
+    step, state, batch_xy, cfg = build_dit_step(batch)
+    dt, _ = _timed_steps(step, state, batch_xy, 2, timed_steps)
     img_s = batch * timed_steps / dt
     mfu = img_s * dit.flops_per_image(cfg) / peak_for(dev)
-    del params, state, x0, y, step
+    del state, batch_xy, step
     _free()
     return {"mfu": mfu, "img_s": img_s, "params": dit.num_params(cfg)}
 

@@ -229,7 +229,8 @@ import types as _types
 linalg = _types.SimpleNamespace()
 from .ops import linalg as _linalg_mod  # noqa: E402
 for _n in ("cholesky", "cholesky_solve", "inverse", "pinv", "solve",
-           "triangular_solve", "lu", "qr", "svd", "svdvals", "eig", "eigh",
+           "triangular_solve", "lu", "lu_solve", "qr", "svd", "svdvals",
+           "eig", "eigh",
            "eigvals", "eigvalsh", "matrix_power", "matrix_rank", "det",
            "slogdet", "cond", "lstsq", "householder_product", "corrcoef",
            "cov", "matrix_exp", "multi_dot"):

@@ -3,10 +3,12 @@
 Reference parity: python/paddle/audio/ (features/layers.py Spectrogram/
 MelSpectrogram/LogMelSpectrogram/MFCC over paddle.signal.stft;
 functional/functional.py hz_to_mel/mel_to_hz/compute_fbank_matrix/
-create_dct; functional/window.py get_window — upstream-canonical,
+create_dct; functional/window.py get_window; backends/ wave-based
+load/save/info; datasets/ TESS + ESC50 — upstream-canonical,
 unverified, SURVEY.md §0). TPU-native: everything composes from the
 framework stft (batched FFT) + one fbank matmul — XLA fuses the chain.
 """
-from . import functional  # noqa: F401
+from . import backends, datasets, functional  # noqa: F401
+from .backends import load, save, info  # noqa: F401
 from .features import (Spectrogram, MelSpectrogram,  # noqa: F401
                        LogMelSpectrogram, MFCC)

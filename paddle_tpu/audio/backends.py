@@ -1,0 +1,115 @@
+"""paddle.audio.backends — wav I/O (load/save/info) + backend registry.
+
+Reference parity: python/paddle/audio/backends/ (init_backend.py's
+get_current_audio_backend/list_available_backends/set_backend and
+wave_backend.py's load/save/info over the stdlib wave module —
+upstream-canonical, unverified, SURVEY.md §0). The default (and, in this
+zero-egress build, only) backend is the stdlib-wave PCM backend, exactly
+like the reference's fallback when paddleaudio is not installed; the
+registry shape is kept so a soundfile-style backend can slot in.
+"""
+from __future__ import annotations
+
+import dataclasses
+import wave as _wave
+
+import numpy as _np
+
+from ..core.tensor import Tensor
+
+_BACKENDS = ["wave"]
+_current = "wave"
+
+
+def list_available_backends():
+    """Names of usable audio I/O backends (parity:
+    paddle.audio.backends.list_available_backends)."""
+    return list(_BACKENDS)
+
+
+def get_current_audio_backend():
+    return _current
+
+
+def set_backend(backend_name: str):
+    global _current
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"backend {backend_name!r} not available; choices: {_BACKENDS} "
+            "(the paddleaudio soundfile backend needs an external package — "
+            "zero-egress build ships the stdlib wave backend)")
+    _current = backend_name
+
+
+@dataclasses.dataclass
+class AudioInfo:
+    """Parity with paddle.audio.backends' AudioInfo."""
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+def info(filepath: str) -> AudioInfo:
+    with _wave.open(filepath, "rb") as f:
+        width = f.getsampwidth()
+        return AudioInfo(
+            sample_rate=f.getframerate(), num_samples=f.getnframes(),
+            num_channels=f.getnchannels(), bits_per_sample=8 * width,
+            # wav width-1 is unsigned PCM — matches _decode_pcm's reading
+            encoding="PCM_U" if width == 1 else "PCM_S")
+
+
+def _decode_pcm(raw: bytes, width: int, channels: int, normalize: bool):
+    if width == 2:
+        x = _np.frombuffer(raw, _np.int16)
+        scale = 32768.0
+    elif width == 1:  # unsigned 8-bit PCM
+        x = _np.frombuffer(raw, _np.uint8).astype(_np.int16) - 128
+        scale = 128.0
+    elif width == 4:
+        x = _np.frombuffer(raw, _np.int32)
+        scale = 2147483648.0
+    else:
+        raise ValueError(f"unsupported PCM sample width {width}")
+    x = x.reshape(-1, channels).T  # [C, T]
+    if normalize:
+        return (x.astype(_np.float32) / scale, _np.float32)
+    return (x, x.dtype)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Read a PCM wav → (Tensor waveform, sample_rate). Normalized f32 in
+    [-1, 1) by default; channels_first gives [C, T] (the reference's
+    wave_backend.load contract)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+        data, _ = _decode_pcm(raw, f.getsampwidth(), f.getnchannels(),
+                              normalize)
+    if not channels_first:
+        data = data.T
+    return Tensor(data), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         bits_per_sample: int = 16):
+    """Write a [C, T] (or [T, C]) float waveform in [-1, 1] as PCM wav."""
+    if bits_per_sample != 16:
+        raise NotImplementedError(
+            "wave backend writes 16-bit PCM (parity: the reference's "
+            "wave_backend.save)")
+    x = _np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+    if channels_first:
+        x = x.T  # → [T, C]
+    pcm = _np.clip(_np.asarray(x, _np.float64) * 32768.0,
+                   -32768, 32767).astype("<i2")
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(pcm.shape[1] if pcm.ndim > 1 else 1)
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())

@@ -1,0 +1,145 @@
+"""paddle.audio.datasets — TESS and ESC-50 (local-archive loaders).
+
+Reference parity: python/paddle/audio/datasets/{tess,esc50}.py
+(upstream-canonical, unverified — SURVEY.md §0): TESS labels come from
+the `..._emotion.wav` filename suffix with an n_folds/split train/dev
+partition; ESC-50 labels and folds come from meta/esc50.csv, with
+`split` naming the held-out fold. Zero-egress build: archives are not
+downloaded — pass the upstream zip via data_file= (the same pattern as
+the text/vision dataset zoo; tests build synthetic archives in the
+upstream layouts). feat_type composes the paddle.audio.features layers.
+"""
+from __future__ import annotations
+
+import io as _io
+import os as _os
+import posixpath as _pp
+import wave as _wave
+import zipfile as _zipfile
+
+import numpy as _np
+
+from ..io.dataset import Dataset as _Dataset
+
+_FEATS = ("raw", "spectrogram", "melspectrogram", "logmelspectrogram",
+          "mfcc")
+
+
+def _need(data_file, cls):
+    if data_file is None or not _os.path.exists(data_file):
+        raise RuntimeError(
+            f"{cls} download unavailable (zero-egress environment); place "
+            f"the upstream archive locally and pass data_file= "
+            f"(paddle_tpu/audio/datasets.py)")
+
+
+def _read_wav(buf: bytes):
+    from .backends import _decode_pcm
+    with _wave.open(_io.BytesIO(buf), "rb") as f:
+        raw = f.readframes(f.getnframes())
+        x, _ = _decode_pcm(raw, f.getsampwidth(), f.getnchannels(),
+                           normalize=True)          # [C, T], width 1/2/4
+        return x.mean(axis=0), f.getframerate()
+
+
+class _AudioDataset(_Dataset):
+    """Shared (waveform | feature, label) plumbing."""
+
+    def __init__(self, feat_type, feat_kwargs):
+        if feat_type not in _FEATS:
+            raise ValueError(f"feat_type {feat_type!r} not in {_FEATS}")
+        self.feat_type = feat_type
+        self._feat = None
+        if feat_type != "raw":
+            from ..audio.features import (MFCC, LogMelSpectrogram,
+                                          MelSpectrogram, Spectrogram)
+            cls = {"spectrogram": Spectrogram,
+                   "melspectrogram": MelSpectrogram,
+                   "logmelspectrogram": LogMelSpectrogram,
+                   "mfcc": MFCC}[feat_type]
+            self._feat = cls(**(feat_kwargs or {}))
+
+    def _emit(self, wav: _np.ndarray, label: int):
+        if self._feat is None:
+            return wav, _np.int64(label)
+        from ..core.tensor import Tensor
+        out = self._feat(Tensor(wav[None, :]))
+        return out.numpy()[0], _np.int64(label)
+
+    def __len__(self):
+        return len(self._labels)
+
+    def __getitem__(self, idx):
+        # lazy: decode one clip per access (the real ESC-50 is ~1.7 GB
+        # of f32 if decoded wholesale at construction)
+        with _zipfile.ZipFile(self._data_file) as zf:
+            wav, _ = _read_wav(zf.read(self._names[idx]))
+        return self._emit(wav, self._labels[idx])
+
+
+class TESS(_AudioDataset):
+    """Toronto Emotional Speech Set: 7-way emotion from the filename
+    suffix (`OAF_back_angry.wav` → angry), n_folds round-robin
+    train/dev split like the reference."""
+
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_file=None, **feat_kwargs):
+        super().__init__(feat_type, feat_kwargs)
+        _need(data_file, "TESS")
+        if not 1 <= split <= n_folds:
+            raise ValueError(f"split {split} outside 1..{n_folds}")
+        self._data_file = data_file
+        keep_names, labels = [], []
+        with _zipfile.ZipFile(data_file) as zf:
+            names = sorted(n for n in zf.namelist()
+                           if n.lower().endswith(".wav"))
+        for i, name in enumerate(names):
+            fold = i % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if not keep:
+                continue
+            emotion = _pp.basename(name).rsplit(".", 1)[0] \
+                .split("_")[-1].lower()
+            if emotion not in self.label_list:
+                continue
+            keep_names.append(name)
+            labels.append(self.label_list.index(emotion))
+        self._names, self._labels = keep_names, labels
+
+
+class ESC50(_AudioDataset):
+    """ESC-50 environmental sounds: labels + folds from meta/esc50.csv;
+    `split` is the held-out fold (the reference's scheme)."""
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_file=None, **feat_kwargs):
+        super().__init__(feat_type, feat_kwargs)
+        _need(data_file, "ESC50")
+        self._data_file = data_file
+        keep_names, labels = [], []
+        with _zipfile.ZipFile(data_file) as zf:
+            meta_name = next(n for n in zf.namelist()
+                             if n.endswith("esc50.csv"))
+            rows = zf.read(meta_name).decode("utf-8").strip().split("\n")
+        header = rows[0].split(",")
+        fn_i, fold_i, tgt_i = (header.index(c)
+                               for c in ("filename", "fold", "target"))
+        # zip members are always '/'-separated; the audio dir is the
+        # meta dir's SIBLING (replace only the final path component)
+        audio_dir = _pp.join(_pp.dirname(_pp.dirname(meta_name)), "audio")
+        folds = {int(r.split(",")[fold_i]) for r in rows[1:]}
+        if split not in folds:
+            raise ValueError(f"split {split} not among csv folds "
+                             f"{sorted(folds)}")
+        for row in rows[1:]:
+            cols = row.split(",")
+            fold, target = int(cols[fold_i]), int(cols[tgt_i])
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if not keep:
+                continue
+            keep_names.append(_pp.join(audio_dir, cols[fn_i]))
+            labels.append(target)
+        self._names, self._labels = keep_names, labels

@@ -142,6 +142,57 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                  name="sample_neighbors")
 
 
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """paddle.geometric.weighted_sample_neighbors: like sample_neighbors
+    but each neighbor is drawn with probability proportional to its edge
+    weight (static-shape: WITH replacement via per-slot Gumbel draws over
+    the node's weighted neighbor window, -1 padding past the degree).
+    The Gumbel table is bounded by the graph's MAX DEGREE (computed from
+    the concrete colptr before tracing), not the edge count — memory is
+    O(nodes * sample_size * max_degree)."""
+    import numpy as _host_np
+
+    from ..core import random as _r
+    from ..ops._registry import eager
+    if sample_size < 0:
+        raise ValueError("static-shape weighted_sample_neighbors needs an "
+                         "explicit sample_size")
+    if return_eids:
+        raise NotImplementedError(
+            "return_eids is not implemented "
+            "(paddle_tpu/geometric/__init__.py weighted_sample_neighbors)")
+    cp_host = _host_np.asarray(
+        colptr.numpy() if hasattr(colptr, "numpy") else colptr)
+    max_deg = max(int(_host_np.max(_host_np.diff(cp_host), initial=0)), 1)
+    key = _r.next_key()
+
+    def raw(rw, cp, w, nodes):
+        n_edges = rw.shape[0]
+
+        def one(k, n):
+            start = cp[n]
+            deg = cp[n + 1] - start
+            pos = jnp.arange(max_deg)
+            logw = jnp.where(pos < deg,
+                             jnp.log(jnp.maximum(
+                                 w[jnp.clip(start + pos, 0, n_edges - 1)],
+                                 1e-30)), -jnp.inf)
+            g = jax.random.gumbel(k, (sample_size, max_deg))
+            pick = jnp.argmax(logw[None, :] + g, axis=1)
+            neigh = rw[jnp.clip(start + pick, 0, n_edges - 1)]
+            valid = jnp.arange(sample_size) < deg
+            return (jnp.where(valid, neigh, -1),
+                    jnp.minimum(deg, sample_size))
+
+        keys = jax.random.split(key, nodes.shape[0])
+        return jax.vmap(one)(keys, nodes)
+
+    return eager(raw, (row, colptr, edge_weight, input_nodes), {},
+                 name="weighted_sample_neighbors")
+
+
 def reindex_graph(x, neighbors, count=None, value_buffer=None,
                   index_buffer=None, name=None):
     """paddle.geometric.reindex_graph: renumber x ∪ neighbors to a dense

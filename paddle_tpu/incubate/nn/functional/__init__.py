@@ -20,6 +20,7 @@ from ....kernels import rope as _rope
 from ....kernels.flash_attention import flash_attention_fwd
 
 __all__ = ["fused_rms_norm", "fused_layer_norm",
+           "fused_gemm_epilogue", "block_multihead_attention",
            "fused_rotary_position_embedding", "variable_length_memory_efficient_attention",
            "fused_multi_head_attention"]
 
@@ -50,12 +51,18 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
                      begin_norm_axis=-1, **kwargs):
-    """Last-axis LayerNorm — delegates to nn.functional.layer_norm (the
-    formula lives once; XLA fuses it)."""
+    """Last-axis LayerNorm with the fused-backward Pallas kernel on TPU
+    (kernels.layer_norm.layer_norm_train: one pass for dx + accumulated
+    d_weight/d_bias); jnp formula elsewhere. Single-device semantics —
+    under GSPMD use nn.functional.layer_norm, which XLA partitions."""
     _check_last_axis(x, begin_norm_axis, "fused_layer_norm")
-    from ....nn import functional as F
-    return F.layer_norm(x, x.shape[-1], weight=norm_weight, bias=norm_bias,
-                        epsilon=epsilon)
+    from ....kernels.layer_norm import layer_norm_train
+
+    def raw(xa, wa, ba):
+        return layer_norm_train(xa, wa, ba, epsilon, True)
+
+    return eager(raw, (x, norm_weight, norm_bias), {},
+                 name="fused_layer_norm")
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -175,6 +182,54 @@ def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
     return fused_matmul_bias(x, weight, bias, False, transpose_weight)
+
+
+def fused_gemm_epilogue(x, y, bias, trans_x=False, trans_y=False,
+                        activation="none", name=None):
+    """paddle.incubate.nn.functional.fused_gemm_epilogue: GEMM + bias +
+    optional activation in one op (the reference's cublasLt epilogue
+    fusion; XLA fuses the same chain on TPU)."""
+    def raw(xa, ya, ba):
+        if trans_x:
+            xa = xa.swapaxes(-1, -2)
+        if trans_y:
+            ya = ya.swapaxes(-1, -2)
+        out = xa @ ya + ba
+        if activation in ("relu",):
+            out = jnp.maximum(out, 0)
+        elif activation in ("gelu",):
+            out = jax.nn.gelu(out)
+        elif activation not in ("none", None):
+            raise ValueError(f"unknown activation {activation!r}")
+        return out
+
+    return eager(raw, (x, y, bias), {}, name="fused_gemm_epilogue")
+
+
+def block_multihead_attention(qkv, cache_k, cache_v, seq_lens, *,
+                              num_heads, head_dim, causal=True, name=None):
+    """paddle.incubate.nn.functional.block_multihead_attention (the
+    PaddleNLP paged/blocked serving attention), static-shape form: qkv
+    [B, S, 3*H*D] prefills the caches and attends causally with per-row
+    valid lengths; returns (out [B, S, H*D], cache_k, cache_v updated).
+    The reference's block tables become plain [B, T, H, D] caches here —
+    paging exists to fight CUDA fragmentation; XLA preallocates."""
+    def raw(qkv_a, ck, cv, lens):
+        B, S, _ = qkv_a.shape
+        q, k, v = jnp.split(qkv_a, 3, axis=-1)
+        q = q.reshape(B, S, num_heads, head_dim)
+        k = k.reshape(B, S, num_heads, head_dim)
+        v = v.reshape(B, S, num_heads, head_dim)
+        ck = ck.at[:, :S].set(k)
+        cv = cv.at[:, :S].set(v)
+        from ....kernels.flash_attention import mha_ref
+        mask = (jnp.arange(S)[None, None, None, :]
+                < lens[:, None, None, None])
+        out = mha_ref(q, k, v, causal=causal, mask=mask)
+        return out.reshape(B, S, num_heads * head_dim), ck, cv
+
+    return eager(raw, (qkv, cache_k, cache_v, seq_lens), {},
+                 name="block_multihead_attention")
 
 
 def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,

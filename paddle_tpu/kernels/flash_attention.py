@@ -765,6 +765,9 @@ def _pallas_ok(q, k, causal=True):
         return False
     if causal:
         return 128 <= q.shape[1] <= k.shape[1]
+    # non-causal: KV length must already be block-aligned (padded keys
+    # would join the softmax; _pad_len returns the aligned LENGTH, so
+    # equality means "already aligned"); padded q rows are sliced off.
     return _pad_len(k.shape[1]) == k.shape[1]
 
 

@@ -1,0 +1,203 @@
+"""Fused-backward LayerNorm (the ERNIE/DiT training-stack norm).
+
+Reference analog: paddle/phi/kernels/fusion layer_norm kernels
+(upstream-canonical, unverified — SURVEY.md §0). Same rationale as
+kernels/rms_norm.rms_norm_train: XLA's autodiff of the jnp layer norm
+emits backward fusions whose cross-lane reductions run far below the
+HBM floor; the Pallas pair saves (mu, rstd) as residuals and produces
+dx plus accumulated d_weight/d_bias in one pass. Formulas
+(x_hat = (x - mu)·r, out = x_hat·w + b, r = rsqrt(var + eps)):
+  dx = r·(dyw − mean(dyw) − x_hat·mean(dyw·x_hat))   (per row, dyw = dy·w)
+  dw = Σ_rows dy ⊙ x_hat ;  db = Σ_rows dy
+Affine-free (weight/bias None — DiT's modulated LN) is the w = 1, no
+dw/db special case. Callers gate use_pallas on the single-chip path; the
+jnp twin stays for CPU / GSPMD / double-grad.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .rms_norm import _blk_rows, _rows
+
+
+def layer_norm_ref(x, weight=None, bias=None, epsilon: float = 1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, o_ref, mu_ref, r_ref, *, eps,
+                   affine):
+    x = x_ref[...].astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    r = jax.lax.rsqrt(jnp.mean(xc * xc, axis=-1, keepdims=True) + eps)
+    out = xc * r
+    if affine:
+        out = out * w_ref[0].astype(jnp.float32) \
+            + b_ref[0].astype(jnp.float32)
+    o_ref[...] = out.astype(o_ref.dtype)
+    mu_ref[...] = mu
+    r_ref[...] = r
+
+
+def _ln_bwd_kernel(x_ref, w_ref, mu_ref, r_ref, dy_ref, dx_ref, dw_ref,
+                   db_ref, *, d, affine):
+    from jax.experimental import pallas as pl
+
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    mu = mu_ref[...]
+    r = r_ref[...]
+    xhat = (x - mu) * r
+    dyw = dy * w_ref[0].astype(jnp.float32) if affine else dy
+    m1 = jnp.mean(dyw, axis=-1, keepdims=True)
+    m2 = jnp.mean(dyw * xhat, axis=-1, keepdims=True)
+    dx_ref[...] = (r * (dyw - m1 - xhat * m2)).astype(dx_ref.dtype)
+    dw_part = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_part = jnp.sum(dy, axis=0, keepdims=True)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw_ref[...] = dw_part
+        db_ref[...] = db_part
+
+    @pl.when(pl.program_id(0) != 0)
+    def _acc():
+        dw_ref[...] += dw_part
+        db_ref[...] += db_part
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "affine", "interpret"))
+def _ln_fwd_pallas(x, weight, bias, eps, affine, interpret=False):
+    from jax.experimental import pallas as pl
+
+    d = x.shape[-1]
+    blk = _blk_rows(d)
+    xr, pad = _rows(x, blk)
+    n = xr.shape[0]
+    w = (weight if affine else jnp.ones((d,), x.dtype)).reshape(1, d)
+    b = (bias if affine else jnp.zeros((d,), x.dtype)).reshape(1, d)
+    with jax.enable_x64(False):
+        out, mu, rstd = pl.pallas_call(
+            functools.partial(_ln_fwd_kernel, eps=eps, affine=affine),
+            grid=(n // blk,),
+            in_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                      pl.BlockSpec((1, d), lambda i: (0, 0)),
+                      pl.BlockSpec((1, d), lambda i: (0, 0))],
+            out_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                       pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+                       pl.BlockSpec((blk, 1), lambda i: (i, 0))],
+            out_shape=[jax.ShapeDtypeStruct((n, d), x.dtype),
+                       jax.ShapeDtypeStruct((n, 1), jnp.float32),
+                       jax.ShapeDtypeStruct((n, 1), jnp.float32)],
+            interpret=interpret,
+        )(xr, w, b)
+    nrows = n - pad
+    return (out[:nrows].reshape(x.shape) if pad else out.reshape(x.shape),
+            mu[:nrows], rstd[:nrows])
+
+
+@functools.partial(jax.jit, static_argnames=("affine", "interpret"))
+def _ln_bwd_pallas(x, weight, mu, rstd, dy, affine, interpret=False):
+    from jax.experimental import pallas as pl
+
+    d = x.shape[-1]
+    blk = _blk_rows(d)
+    xr, pad = _rows(x, blk)
+    dyr, _ = _rows(dy, blk)
+    mur = jnp.pad(mu, ((0, pad), (0, 0))) if pad else mu
+    rr = jnp.pad(rstd, ((0, pad), (0, 0))) if pad else rstd
+    n = xr.shape[0]
+    w = (weight if affine else jnp.ones((d,), x.dtype)).reshape(1, d)
+    with jax.enable_x64(False):
+        dx, dw, db = pl.pallas_call(
+            functools.partial(_ln_bwd_kernel, d=d, affine=affine),
+            grid=(n // blk,),
+            in_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                      pl.BlockSpec((1, d), lambda i: (0, 0)),
+                      pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+                      pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+                      pl.BlockSpec((blk, d), lambda i: (i, 0))],
+            out_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                       pl.BlockSpec((1, d), lambda i: (0, 0)),
+                       pl.BlockSpec((1, d), lambda i: (0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((n, d), x.dtype),
+                       jax.ShapeDtypeStruct((1, d), jnp.float32),
+                       jax.ShapeDtypeStruct((1, d), jnp.float32)],
+            interpret=interpret,
+        )(xr, w, mur, rr, dyr)
+    nrows = n - pad
+    dx = dx[:nrows].reshape(x.shape) if pad else dx.reshape(x.shape)
+    return dx, dw[0], db[0]
+
+
+def _ln_ref_bwd(x, weight, dy, eps, affine):
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    d = x.shape[-1]
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mu
+    r = jax.lax.rsqrt(jnp.mean(xc * xc, axis=-1, keepdims=True) + eps)
+    xhat = xc * r
+    dyw = dyf * weight.astype(jnp.float32) if affine else dyf
+    m1 = jnp.mean(dyw, axis=-1, keepdims=True)
+    m2 = jnp.mean(dyw * xhat, axis=-1, keepdims=True)
+    dx = (r * (dyw - m1 - xhat * m2)).astype(x.dtype)
+    dw = jnp.sum((dyf * xhat).reshape(-1, d), axis=0)
+    db = jnp.sum(dyf.reshape(-1, d), axis=0)
+    return dx, dw, db
+
+
+def _use_pallas_ln(x):
+    from .flash_attention import _use_pallas
+    return _use_pallas(x) and x.shape[-1] % 128 == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def layer_norm_train(x, weight, bias, epsilon: float = 1e-5,
+                     use_pallas=True):
+    """Fused-backward LayerNorm. weight/bias may BOTH be None (DiT's
+    affine-free form); matches layer_norm_ref in value."""
+    from .flash_attention import _interpret
+    affine = weight is not None
+    if use_pallas and _use_pallas_ln(x):
+        return _ln_fwd_pallas(x, weight, bias, epsilon, affine,
+                              interpret=_interpret())[0]
+    return layer_norm_ref(x, weight, bias, epsilon)
+
+
+def _ln_train_fwd(x, weight, bias, epsilon, use_pallas):
+    from .flash_attention import _interpret
+    affine = weight is not None
+    if use_pallas and _use_pallas_ln(x):
+        out, mu, rstd = _ln_fwd_pallas(x, weight, bias, epsilon, affine,
+                                       interpret=_interpret())
+        return out, (x, weight, mu, rstd)
+    return layer_norm_ref(x, weight, bias, epsilon), (x, weight, None, None)
+
+
+def _ln_train_bwd(epsilon, use_pallas, res, dy):
+    from .flash_attention import _interpret
+    x, weight, mu, rstd = res
+    affine = weight is not None
+    if mu is not None:
+        dx, dw, db = _ln_bwd_pallas(x, weight, mu, rstd, dy, affine,
+                                    interpret=_interpret())
+    else:
+        dx, dw, db = _ln_ref_bwd(x, weight, dy, epsilon, affine)
+    if not affine:
+        return dx, None, None
+    return dx, dw.astype(weight.dtype), db.astype(weight.dtype)
+
+
+layer_norm_train.defvjp(_ln_train_fwd, _ln_train_bwd)

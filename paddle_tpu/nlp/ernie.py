@@ -137,6 +137,10 @@ def batch_spec() -> P:
 
 
 def _layer_norm(x, scale, bias, eps):
+    # plain jnp on purpose: kernels.layer_norm.layer_norm_train measured
+    # NEUTRAL on the ERNIE bench (the encoder is embedding/GEMM-bound,
+    # not norm-bound), and this module's API has no mesh handle to gate
+    # the GSPMD-opaque pallas path the way llama/moe do
     x32 = x.astype(jnp.float32)
     mu = jnp.mean(x32, axis=-1, keepdims=True)
     var = jnp.var(x32, axis=-1, keepdims=True)

@@ -43,6 +43,18 @@ def _lu_raw(x, get_infos):
 qr = defop("qr", lambda x, mode="reduced", name=None: tuple(jnp.linalg.qr(x, mode=mode)))
 
 
+def _lu_solve_raw(b, lu_data, lu_pivots, trans="N", name=None):
+    # paddle.linalg.lu_solve: solve A x = b from paddle.linalg.lu's
+    # (LU, 1-based pivots) factorization
+    piv = as_array(lu_pivots).astype(np.int32) - 1
+    tr = {"N": 0, "T": 1, "H": 2}[trans]
+    return jax.scipy.linalg.lu_solve((as_array(lu_data), piv),
+                                     as_array(b), trans=tr)
+
+
+lu_solve = defop("lu_solve", _lu_solve_raw)
+
+
 def _svd_raw(x, full_matrices=False, name=None):
     u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
     return u, s, vh.swapaxes(-1, -2).conj()  # paddle returns V not V^H

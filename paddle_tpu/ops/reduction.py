@@ -124,6 +124,8 @@ def _histogram_raw(x, bins=100, min=0, max=0, name=None):
 
 
 histogram = defop("histogram", _histogram_raw)
+# torch-compat alias surface the reference also exposes
+histc = defop("histc", _histogram_raw)
 bincount = defop("bincount", lambda x, weights=None, minlength=0, name=None:
                  jnp.bincount(x, weights=None if weights is None else as_array(weights),
                               minlength=minlength, length=None))

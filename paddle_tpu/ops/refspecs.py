@@ -404,4 +404,253 @@ INT_IDX_OPS = {"gather": 5, "index_select": 5, "index_sample": 4,
 # inputs that must be pre-sorted for defined semantics
 SORTED_INPUT_OPS = {"bucketize": 1, "searchsorted": 0}
 
+# --------------------------------------------------------------------------
+# round-4 additions (VERDICT r3 task 6: >=300 ops with numpy refs).
+# Creation ops run as zero-input value checks; linalg rows condition their
+# inputs through INPUT_TRANSFORMS (SPD / triangular / boosted-diagonal);
+# label-taking losses check forward only (finite differences over the
+# +-1 / integer label inputs are meaningless).
+# --------------------------------------------------------------------------
+
+RG("arange", lambda: np.arange(1.0, 9.0, 2.0, dtype=np.float32),
+   n_in=0, kwargs={"start": 1.0, "end": 9.0, "step": 2.0})
+RG("eye", lambda: np.eye(4, 3, dtype=np.float32),
+   n_in=0, kwargs={"num_rows": 4, "num_columns": 3})
+RG("linspace", lambda: np.linspace(0.0, 1.0, 7, dtype=np.float32),
+   n_in=0, kwargs={"start": 0.0, "stop": 1.0, "num": 7})
+RG("logspace", lambda: np.logspace(0.0, 2.0, 5, dtype=np.float32),
+   n_in=0, kwargs={"start": 0.0, "stop": 2.0, "num": 5})
+RG("ones", lambda: np.ones((3, 4), np.float32), n_in=0,
+   kwargs={"shape": [3, 4]})
+RG("zeros", lambda: np.zeros((3, 4), np.float32), n_in=0,
+   kwargs={"shape": [3, 4]})
+RG("full", lambda: np.full((2, 3), 2.5, np.float32), n_in=0,
+   kwargs={"shape": [2, 3], "fill_value": 2.5})
+RG("ones_like", lambda x: np.ones_like(x))
+RG("zeros_like", lambda x: np.zeros_like(x))
+RG("full_like", lambda x: np.full_like(x, 3.0), kwargs={"fill_value": 3.0})
+RG("tril_indices", lambda: np.stack(np.tril_indices(4, 0, 4)),
+   n_in=0, kwargs={"row": 4, "col": 4, "offset": 0})
+RG("triu_indices", lambda: np.stack(np.triu_indices(4, 0, 4)),
+   n_in=0, kwargs={"row": 4, "col": 4, "offset": 0})
+
+# linalg (inputs conditioned via INPUT_TRANSFORMS below)
+R("cholesky", lambda a: np.linalg.cholesky(a), shapes=((4, 4),))
+R("cholesky_solve",
+  lambda x, L: np.linalg.solve(L @ L.T, x),
+  n_in=2, shapes=((4, 2), (4, 4)))
+R("cholesky_inverse", lambda L: np.linalg.inv(L @ L.T), shapes=((4, 4),))
+R("triangular_solve",
+  lambda U, y: np.linalg.solve(U, y),
+  n_in=2, shapes=((4, 4), (4, 2)))
+R("solve", lambda a, b: np.linalg.solve(a, b), n_in=2,
+  shapes=((4, 4), (4, 2)))
+R("inverse", lambda a: np.linalg.inv(a), shapes=((4, 4),))
+R("pinv", lambda a: np.linalg.pinv(a), shapes=((4, 3),), rtol=1e-4)
+RG("svdvals", lambda a: np.linalg.svd(a, compute_uv=False),
+   shapes=((4, 3),))
+RG("eigvalsh", lambda a: np.linalg.eigvalsh(a), shapes=((4, 4),))
+R("matrix_exp", lambda a: __import__("scipy.linalg", fromlist=["expm"]
+                                     ).expm(np.asarray(a, np.float64)),
+  shapes=((3, 3),), rtol=1e-4)
+
+# special functions (scipy refs; x-only grads are defined, a-grads not)
+RG("gammainc", lambda x, y: _sp.gammainc(x, y), n_in=2, domain=(0.5, 3.0))
+RG("gammaincc", lambda x, y: _sp.gammaincc(x, y), n_in=2, domain=(0.5, 3.0))
+# paddle igamma is the REGULARIZED UPPER incomplete gamma (igammac the
+# lower complement) — opposite of scipy's naming
+RG("igamma", lambda x, y: _sp.gammaincc(x, y), n_in=2, domain=(0.5, 3.0))
+RG("igammac", lambda x, y: _sp.gammainc(x, y), n_in=2, domain=(0.5, 3.0))
+R("polygamma", lambda x: _sp.polygamma(2, x), domain=(0.5, 3.0),
+  kwargs={"n": 2}, rtol=1e-4)
+
+# audio functional (htk-variant closed forms)
+# numpy-backed host conversions (mel-filterbank construction helpers) —
+# value parity only, no tape grads
+RG("hz_to_mel", lambda f: 2595.0 * np.log10(1.0 + f / 700.0),
+   domain=(20.0, 8000.0), kwargs={"htk": True}, rtol=1e-4)
+RG("mel_to_hz", lambda m: 700.0 * (10.0 ** (m / 2595.0) - 1.0),
+   domain=(1.0, 1000.0), kwargs={"htk": True}, rtol=1e-4)
+R("power_to_db",
+  lambda x: np.maximum(10.0 * np.log10(np.maximum(1e-10, x)),
+                       (10.0 * np.log10(np.maximum(1e-10, x))).max() - 80.0),
+  domain=(0.01, 2.0), rtol=1e-4)
+RG("create_dct", lambda: _np_create_dct(5, 8), n_in=0,
+   kwargs={"n_mfcc": 5, "n_mels": 8})
+
+# boxes (inputs conditioned to valid x1<x2, y1<y2 corners)
+R("box_area",
+  lambda b: (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), shapes=((5, 4),))
+RG("box_iou", _np_box_iou := (lambda a, b: _np_box_iou_impl(a, b)),
+   n_in=2, shapes=((4, 4), (3, 4)))
+
+# losses with +-1 / constrained labels: forward-only
+RG("soft_margin_loss",
+   lambda x, y: np.mean(np.log1p(np.exp(-y * x))), n_in=2)
+RG("margin_ranking_loss",
+   lambda a, b, y: np.mean(np.maximum(0.0, -y * (a - b) + 0.5)),
+   n_in=3, kwargs={"margin": 0.5})
+RG("hinge_embedding_loss",
+   lambda x, y: np.mean(np.where(y > 0, x, np.maximum(0.0, 1.0 - x))),
+   n_in=2)
+RG("cosine_embedding_loss",
+   lambda a, b, y: np.mean(np.where(
+       y > 0, 1.0 - _np_cos_sim(a, b),
+       np.maximum(0.0, _np_cos_sim(a, b)))), n_in=3,
+   shapes=((3, 4), (3, 4), (3,)))
+R("poisson_nll_loss",
+  lambda x, y: np.mean(np.exp(x) - y * x), n_in=2, domain=(0.1, 0.9))
+R("gaussian_nll_loss",
+  lambda x, y, var: np.mean(0.5 * (np.log(np.maximum(var, 1e-6))
+                                   + (x - y) ** 2
+                                   / np.maximum(var, 1e-6))),
+  n_in=3, domain=(0.2, 0.9))
+
+# indexed writes / fills (integer or bool operands: forward-only)
+RG("index_add",
+   lambda x, i: _np_index_add(x, i, np.full((2, 4), 0.5, np.float32)),
+   n_in=2, shapes=((3, 4), (2,)),
+   kwargs={"axis": 0, "value": np.full((2, 4), 0.5, np.float32)})
+RG("index_fill", lambda x, i: _np_index_fill(x, i, -1.5),
+   n_in=2, shapes=((3, 4), (2,)), kwargs={"axis": 0, "value": -1.5})
+RG("masked_fill", lambda x, m: np.where(m > 0, 9.0, x),
+   n_in=2, kwargs={"value": 9.0})
+RG("fill_diagonal", lambda x: _np_fill_diag(x, 7.0),
+   shapes=((4, 4),), kwargs={"value": 7.0})
+RG("put_along_axis", lambda x, i, v: _np_put_along(x, i, v),
+   n_in=3, shapes=((3, 4), (3, 2), (3, 2)), kwargs={"axis": 1})
+
+# structural / misc
+R("renorm", lambda x: x * np.minimum(
+      1.0, 1.0 / np.maximum(np.sqrt((x ** 2).sum(1)), 1e-7))[:, None],
+  shapes=((3, 4),), kwargs={"p": 2.0, "axis": 0, "max_norm": 1.0})
+R("repeat_interleave", lambda x: np.repeat(x, 2, axis=0),
+  kwargs={"repeats": 2, "axis": 0})
+R("pad", lambda x: np.pad(x, ((0, 0), (2, 3))),
+  kwargs={"pad": [2, 3], "mode": "constant"}, shapes=((3, 4),))
+R("linear", lambda x, w, b: x @ w + b, n_in=3,
+  shapes=((3, 4), (4, 5), (5,)))
+R("bilinear", lambda x1, x2, w: np.einsum("bi,oij,bj->bo", x1, w, x2),
+  n_in=3, shapes=((3, 4), (3, 5), (6, 4, 5)))
+R("prelu", lambda x, w: np.where(x > 0, x, w * x), n_in=2,
+  shapes=((3, 4), (1,)))
+R("cross", lambda a, b: np.cross(a, b, axis=1), n_in=2,
+  shapes=((2, 3), (2, 3)), kwargs={"axis": 1})
+R("cov", lambda x: np.cov(x), shapes=((3, 6),), rtol=1e-4)
+RG("corrcoef", lambda x: np.corrcoef(x), shapes=((3, 6),), rtol=1e-4)
+RG("sequence_mask", lambda x: (np.arange(5)[None, :]
+                               < np.asarray(x)[:, None]).astype(np.int64),
+   int_op=True, shapes=((4,),), kwargs={"maxlen": 5})
+
+
+def _np_create_dct(n_mfcc, n_mels):
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k)
+    dct[:, 0] *= 1.0 / np.sqrt(2.0)
+    dct *= np.sqrt(2.0 / n_mels)
+    return dct.astype(np.float32)
+
+
+def _np_box_iou_impl(a, b):
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area_a[:, None] + area_b[None, :] - inter)
+
+
+def _np_cos_sim(a, b):
+    return ((a * b).sum(-1) / (np.linalg.norm(a, axis=-1)
+                               * np.linalg.norm(b, axis=-1)))
+
+
+def _np_index_add(x, i, v):
+    out = np.array(x)
+    np.add.at(out, i, v)
+    return out
+
+
+def _np_index_fill(x, i, value):
+    out = np.array(x)
+    out[i] = value
+    return out
+
+
+def _np_fill_diag(x, value):
+    out = np.array(x)
+    np.fill_diagonal(out, value)
+    return out
+
+
+def _np_put_along(x, i, v):
+    out = np.array(x)
+    np.put_along_axis(out, i, v, axis=-1)
+    return out
+
+
+def _spd(a):
+    a = np.asarray(a, np.float32)
+    return a @ a.T + a.shape[0] * np.eye(a.shape[0], dtype=np.float32)
+
+
+def _chol_factor(a):
+    return np.linalg.cholesky(_spd(a)).astype(np.float32)
+
+
+def _upper_boosted(a):
+    a = np.triu(np.asarray(a, np.float32))
+    np.fill_diagonal(a, np.abs(np.diagonal(a)) + 2.0)
+    return a
+
+
+def _diag_boosted(a):
+    a = np.array(a, np.float32)
+    np.fill_diagonal(a, np.abs(np.diagonal(a)) + a.shape[0])
+    return a
+
+
+def _symmetric(a):
+    a = np.asarray(a, np.float32)
+    return (a + a.T) / 2
+
+
+def _pm_one(y):
+    return np.sign(np.asarray(y)) + (np.asarray(y) == 0)
+
+
+def _corners(b):
+    b = np.abs(np.asarray(b, np.float32))
+    out = np.empty_like(b)
+    out[:, :2] = b[:, :2]
+    out[:, 2:] = b[:, :2] + b[:, 2:] + 0.1
+    return out
+
+
+# per-op input conditioning applied by the sweep AFTER random sampling:
+# {op: {input_index: transform}}
+INPUT_TRANSFORMS = {
+    "cholesky": {0: _spd},
+    "cholesky_solve": {1: _chol_factor},
+    "cholesky_inverse": {0: _chol_factor},
+    "triangular_solve": {0: _upper_boosted},
+    "solve": {0: _diag_boosted},
+    "inverse": {0: _diag_boosted},
+    "eigvalsh": {0: _symmetric},
+    "box_area": {0: _corners},
+    "box_iou": {0: _corners, 1: _corners},
+    "soft_margin_loss": {1: _pm_one},
+    "margin_ranking_loss": {2: _pm_one},
+    "hinge_embedding_loss": {1: _pm_one},
+    "cosine_embedding_loss": {2: _pm_one},
+    "masked_fill": {1: lambda m: np.asarray(m) > 0},
+    "index_add": {1: lambda i: np.asarray([0, 2], np.int64)},
+    "index_fill": {1: lambda i: np.asarray([0, 2], np.int64)},
+    "put_along_axis": {1: lambda i: np.tile(
+        np.asarray([[0, 3]], np.int64), (3, 1))},
+}
+
 SPEC_NAMES = [s.name for s in RTABLE]
+

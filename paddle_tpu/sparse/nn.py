@@ -264,6 +264,10 @@ class BatchNorm(Layer):
 
 
 SyncBatchNorm = BatchNorm
+# dimension-suffixed aliases (upstream exposes BatchNorm under these
+# names in examples/configs; the sparse values-buffer normalization is
+# rank-agnostic)
+BatchNorm1D = BatchNorm2D = BatchNorm3D = BatchNorm
 
 
 class _FuncNS:

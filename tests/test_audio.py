@@ -102,3 +102,100 @@ class TestWorkerInfo:
         for batch in loader:
             ids.update(batch.numpy().tolist())
         assert ids <= {0.0, 1.0}
+
+
+class TestAudioBackends:
+    def test_save_load_info_roundtrip(self, tmp_path):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import audio
+        assert audio.backends.list_available_backends() == ["wave"]
+        assert audio.backends.get_current_audio_backend() == "wave"
+        sr = 16000
+        t = np.linspace(0, 1, sr, endpoint=False)
+        wav = np.stack([0.5 * np.sin(2 * np.pi * 440 * t),
+                        0.25 * np.sin(2 * np.pi * 220 * t)]).astype("float32")
+        f = str(tmp_path / "tone.wav")
+        audio.save(f, paddle.to_tensor(wav), sr)
+        meta = audio.info(f)
+        assert (meta.sample_rate, meta.num_channels,
+                meta.bits_per_sample) == (sr, 2, 16)
+        back, sr2 = audio.load(f)
+        assert sr2 == sr
+        np.testing.assert_allclose(back.numpy(), wav, atol=2e-4)
+        # offset/num_frames windowing
+        part, _ = audio.load(f, frame_offset=100, num_frames=50)
+        np.testing.assert_allclose(part.numpy(), wav[:, 100:150], atol=2e-4)
+
+    def test_set_backend_rejects_unknown(self):
+        import pytest
+        from paddle_tpu import audio
+        with pytest.raises(NotImplementedError):
+            audio.backends.set_backend("soundfile")
+
+
+def _tone_wav_bytes(freq, sr=4000, n=2000):
+    import io
+    import wave
+
+    import numpy as np
+    t = np.arange(n) / sr
+    pcm = (0.4 * np.sin(2 * np.pi * freq * t) * 32767).astype("<i2")
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as f:
+        f.setnchannels(1)
+        f.setsampwidth(2)
+        f.setframerate(sr)
+        f.writeframes(pcm.tobytes())
+    return buf.getvalue()
+
+
+class TestAudioDatasets:
+    def _tess_zip(self, tmp_path):
+        import zipfile
+        p = str(tmp_path / "tess.zip")
+        emotions = ["angry", "happy", "sad", "neutral", "fear"]
+        with zipfile.ZipFile(p, "w") as zf:
+            for i in range(10):
+                emo = emotions[i % len(emotions)]
+                zf.writestr(f"TESS/OAF_word{i}_{emo}.wav",
+                            _tone_wav_bytes(200 + 40 * i))
+        return p
+
+    def test_tess_split_and_labels(self, tmp_path):
+        from paddle_tpu.audio.datasets import TESS
+        p = self._tess_zip(tmp_path)
+        train = TESS(mode="train", n_folds=5, split=1, data_file=p)
+        dev = TESS(mode="dev", n_folds=5, split=1, data_file=p)
+        assert len(train) + len(dev) == 10 and len(dev) == 2
+        wav, label = train[0]
+        assert wav.ndim == 1 and wav.size == 2000
+        assert 0 <= int(label) < len(TESS.label_list)
+
+    def test_tess_feature_mode(self, tmp_path):
+        from paddle_tpu.audio.datasets import TESS
+        p = self._tess_zip(tmp_path)
+        ds = TESS(mode="dev", split=1, data_file=p,
+                  feat_type="melspectrogram", sr=4000, n_fft=256,
+                  hop_length=128, n_mels=16)
+        feat, _ = ds[0]
+        assert feat.shape[0] == 16
+
+    def test_esc50_meta_folds(self, tmp_path):
+        import zipfile
+        from paddle_tpu.audio.datasets import ESC50
+        p = str(tmp_path / "esc50.zip")
+        rows = ["filename,fold,target,category"]
+        with zipfile.ZipFile(p, "w") as zf:
+            for i in range(8):
+                name = f"{i}.wav"
+                fold = i % 4 + 1
+                rows.append(f"{name},{fold},{i % 3},cat{i % 3}")
+                zf.writestr(f"ESC-50/audio/{name}",
+                            _tone_wav_bytes(150 + 30 * i))
+            zf.writestr("ESC-50/meta/esc50.csv", "\n".join(rows))
+        train = ESC50(mode="train", split=2, data_file=p)
+        dev = ESC50(mode="dev", split=2, data_file=p)
+        assert len(train) == 6 and len(dev) == 2
+        wav, label = dev[0]
+        assert wav.ndim == 1 and 0 <= int(label) < 3

@@ -539,3 +539,80 @@ class TestHubAndSharding:
         with tempfile.TemporaryDirectory() as d:
             save_group_sharded_model(model, d, opt)
             assert os.path.exists(os.path.join(d, "model.pdparams"))
+
+
+class TestRound4OpTail:
+    """The COVERAGE.md 'known todo' tail, closed in round 4."""
+
+    def test_lu_solve_roundtrip(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        a = np.random.RandomState(0).randn(4, 4).astype("float32") \
+            + 4 * np.eye(4, dtype="float32")
+        b = np.random.RandomState(1).randn(4, 2).astype("float32")
+        lu_t, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        x = paddle.linalg.lu_solve(paddle.to_tensor(b), lu_t, piv)
+        np.testing.assert_allclose(x.numpy(), np.linalg.solve(a, b),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_histc_matches_histogram(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.arange(12, dtype="float32"))
+        np.testing.assert_array_equal(
+            paddle.histc(x, bins=4).numpy(),
+            paddle.histogram(x, bins=4).numpy())
+
+    def test_weighted_sample_neighbors_prefers_heavy_edges(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import geometric
+        # node 0 has neighbors 1 (weight ~0) and 2 (weight huge)
+        row = paddle.to_tensor(np.array([1, 2], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 2, 2, 2], np.int64))
+        w = paddle.to_tensor(np.array([1e-9, 1e9], np.float32))
+        nodes = paddle.to_tensor(np.array([0], np.int64))
+        neigh, cnt = geometric.weighted_sample_neighbors(
+            row, colptr, w, nodes, sample_size=2)
+        assert int(cnt.numpy()[0]) == 2
+        assert (neigh.numpy() == 2).all()  # heavy edge dominates
+
+    def test_fused_gemm_epilogue_activations(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import incubate
+        x = paddle.to_tensor(-np.ones((2, 3), "float32"))
+        y = paddle.to_tensor(np.ones((3, 4), "float32"))
+        b = paddle.to_tensor(np.zeros((4,), "float32"))
+        out = incubate.nn.functional.fused_gemm_epilogue(
+            x, y, b, activation="relu")
+        assert float(out.numpy().max()) == 0.0
+        out = incubate.nn.functional.fused_gemm_epilogue(
+            x, y, b, activation="none")
+        np.testing.assert_allclose(out.numpy(), -3 * np.ones((2, 4)),
+                                   rtol=1e-6)
+
+    def test_block_multihead_attention_respects_lengths(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import incubate
+        B, S, H, D = 2, 4, 2, 8
+        rng = np.random.RandomState(3)
+        qkv = paddle.to_tensor(rng.randn(B, S, 3 * H * D).astype("float32"))
+        ck = paddle.to_tensor(np.zeros((B, 8, H, D), "float32"))
+        cv = paddle.to_tensor(np.zeros((B, 8, H, D), "float32"))
+        lens = paddle.to_tensor(np.array([4, 4], np.int64))
+        out, ck2, cv2 = incubate.nn.functional.block_multihead_attention(
+            qkv, ck, cv, lens, num_heads=H, head_dim=D)
+        # caches prefilled with k/v
+        k = qkv.numpy()[..., H * D:2 * H * D].reshape(B, S, H, D)
+        np.testing.assert_allclose(ck2.numpy()[:, :S], k, rtol=1e-6)
+        # first position attends only to itself -> equals its value row
+        v = qkv.numpy()[..., 2 * H * D:].reshape(B, S, H, D)
+        np.testing.assert_allclose(out.numpy()[:, 0],
+                                   v[:, 0].reshape(B, H * D), atol=1e-5)
+
+    def test_sparse_batchnorm_dim_aliases(self):
+        from paddle_tpu import sparse
+        assert sparse.nn.BatchNorm3D is sparse.nn.BatchNorm
+        assert sparse.nn.BatchNorm1D is sparse.nn.BatchNorm

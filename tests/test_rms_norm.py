@@ -73,3 +73,49 @@ class TestRmsNormTrain:
                                        rtol=5e-2, atol=5e-2)
         finally:
             F.set_flags({"FLAGS_pallas_interpret": False})
+
+
+class TestLayerNormTrain:
+    @pytest.mark.parametrize("affine", [True, False])
+    @pytest.mark.parametrize("interpret", [False, True])
+    def test_value_and_grads_match_ref(self, affine, interpret):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core import flags as F
+        from paddle_tpu.kernels.layer_norm import (layer_norm_ref,
+                                                   layer_norm_train)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(4, 6, 256) * 2.0, jnp.float32)
+        w = jnp.asarray(1.0 + 0.1 * rng.randn(256),
+                        jnp.float32) if affine else None
+        b = jnp.asarray(0.1 * rng.randn(256),
+                        jnp.float32) if affine else None
+        if interpret:
+            F.set_flags({"FLAGS_pallas_interpret": True})
+        try:
+            out = layer_norm_train(x, w, b, 1e-5, True)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(layer_norm_ref(x, w, b, 1e-5)),
+                rtol=1e-5, atol=1e-5)
+
+            if affine:
+                def loss_t(x, w, b):
+                    return jnp.sum(jnp.sin(layer_norm_train(x, w, b, 1e-5,
+                                                            True)))
+
+                def loss_r(x, w, b):
+                    return jnp.sum(jnp.sin(layer_norm_ref(x, w, b, 1e-5)))
+
+                gt = jax.grad(loss_t, argnums=(0, 1, 2))(x, w, b)
+                gr = jax.grad(loss_r, argnums=(0, 1, 2))(x, w, b)
+            else:
+                gt = (jax.grad(lambda x: jnp.sum(jnp.sin(
+                    layer_norm_train(x, None, None, 1e-5, True))))(x),)
+                gr = (jax.grad(lambda x: jnp.sum(jnp.sin(
+                    layer_norm_ref(x, None, None, 1e-5))))(x),)
+            for a, r in zip(gt, gr):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                           rtol=1e-4, atol=1e-4)
+        finally:
+            if interpret:
+                F.set_flags({"FLAGS_pallas_interpret": False})

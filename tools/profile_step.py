@@ -55,7 +55,8 @@ def build(which):
                              jnp.int32)
         return step, state, tokens
     if which == "dit":
-        return bench.build_dit_step()
+        step, state, batch_xy, _ = bench.build_dit_step()
+        return step, state, batch_xy
     raise SystemExit(f"unknown workload {which}")
 
 
