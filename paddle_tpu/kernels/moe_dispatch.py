@@ -195,7 +195,14 @@ def dispatch_gather(x, inv_tok, flat, k, use_pallas=True):
 
     flat [B, S*k] (slot id for each (token, choice), -1 = dropped) is the
     inverse map used ONLY by the gradient: dx[t] = Σ_j d_out[flat[t, j]]
-    — a gather, not a scatter-add."""
+    — a gather, not a scatter-add. The forward runs the CONDITIONAL-FREE
+    wsum kernel (clipped indices + zero weights for empty slots): the
+    per-row pl.when/zero-scratch branches of the masked kernel cost ~20%
+    of the scalar-issue budget the gathers are bound by."""
+    if use_pallas and _use_pallas_here(x):
+        idx1 = jnp.clip(inv_tok, 0)[..., None]
+        w1 = (inv_tok >= 0)[..., None].astype(jnp.float32)
+        return gather_wsum(x, idx1, w1, use_pallas=True)
     return gather_rows(x, inv_tok, use_pallas=use_pallas)
 
 
@@ -257,7 +264,7 @@ def _gather_wsum_kernel(idx_ref, src_ref, w_ref, out_ref, scratch, sems,
 
 
 @functools.partial(jax.jit, static_argnames=("bm", "interpret"))
-def gather_wsum_pallas(src, idx, w, bm=64, interpret=False):
+def gather_wsum_pallas(src, idx, w, bm=None, interpret=False):
     """src [B, N, D]; idx [B, M, k] int32 PRE-CLIPPED to [0, N); w
     [B, M, k] (w = 0 marks dropped choices) → [B, M, D]."""
     from jax.experimental import pallas as pl
@@ -265,6 +272,8 @@ def gather_wsum_pallas(src, idx, w, bm=64, interpret=False):
 
     B, N, D = src.shape
     M, k = idx.shape[1], idx.shape[2]
+    if bm is None:
+        bm = max(128 // k, 8)   # 128 row-DMAs per block (sflag budget; 160 measured -0.1pt)
     while M % bm:
         bm //= 2
     lanes = 128
