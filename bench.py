@@ -249,6 +249,35 @@ def run_prefill(prompt_len=8192, timed=4):
     return {"prefill_tok_s": prompt_len / dt}
 
 
+def run_decode(batch=8, prompt_len=512, new_tokens=128, timed=3):
+    """Serving decode throughput: greedy batched decode on the 2B flagship
+    stack (prefill + ONE compiled lax.scan of cached single-token steps —
+    nlp.generation.generate). Reported as generated tokens/s across the
+    batch, steady-state-dominated (prompt work amortized over new_tokens;
+    SURVEY.md §3.5 serving stack)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nlp import generation, llama
+
+    cfg = flagship_2b_cfg(max_position_embeddings=prompt_len + new_tokens)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, prompt_len)), jnp.int32)
+
+    gen = jax.jit(lambda p, ids: generation.generate(
+        p, ids, cfg, max_new_tokens=new_tokens, greedy=True))
+    out = gen(params, prompt)
+    int(out[0, -1])
+    t0 = time.perf_counter()
+    for _ in range(timed):
+        out = gen(params, prompt)
+    int(out[0, -1])
+    dt = (time.perf_counter() - t0) / timed
+    del params, prompt, gen, out
+    _free()
+    return {"decode_tok_s": batch * new_tokens / dt}
+
+
 def run_8b_layer(seq, batch=1, timed_steps=8):
     """One Llama-3-8B-dimension decoder layer (d=4096, ffn=14336, GQA
     32/8, bf16), flash fwd+bwd — the north-star LAYER SHAPE measured on
@@ -325,13 +354,14 @@ def main():
         ernie_res = run_ernie()
         dit_res = run_dit()
         prefill_res = run_prefill()
+        decode_res = run_decode()
         batch, seq = 8, 2048
     else:
         big = run_config(llama.LlamaConfig.tiny(), batch=4, seq=128,
                          timed_steps=3)
         small = None  # off-TPU there is no 0.5B comparison run (ADVICE r2)
         layer8b_4k = layer8b_8k = moe_res = None
-        ernie_res = dit_res = prefill_res = None
+        ernie_res = dit_res = prefill_res = decode_res = None
         batch, seq = 4, 128
 
     print(json.dumps({
@@ -357,6 +387,8 @@ def main():
         "img_s_dit": round(dit_res["img_s"], 2) if dit_res else None,
         "prefill_tok_s": (round(prefill_res["prefill_tok_s"], 1)
                           if prefill_res else None),
+        "decode_tok_s": (round(decode_res["decode_tok_s"], 1)
+                         if decode_res else None),
     }))
 
 
