@@ -273,7 +273,7 @@ def gather_wsum_pallas(src, idx, w, bm=None, interpret=False):
     B, N, D = src.shape
     M, k = idx.shape[1], idx.shape[2]
     if bm is None:
-        bm = max(128 // k, 8)   # 128 row-DMAs per block (sflag budget; 160 measured -0.1pt)
+        bm = max(128 // k, 8)   # 128 row-DMAs per block (sflag budget; 160 and k=2 bm=80 both measured neutral)
     while M % bm:
         bm //= 2
     lanes = 128
