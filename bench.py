@@ -350,6 +350,10 @@ def main():
         # the 8B layer shape at north-star sequence lengths (missing 7)
         layer8b_4k = run_8b_layer(seq=4096)
         layer8b_8k = run_8b_layer(seq=8192)
+        # FULL 2B model long-context step (combined streamed flash bwd)
+        long8k = run_config(flagship_2b_cfg(max_position_embeddings=8192),
+                            batch=2, seq=8192, timed_steps=4,
+                            state_quant="8bit", grad_clip=1.0)
         moe_res = run_moe()
         ernie_res = run_ernie()
         dit_res = run_dit()
@@ -360,7 +364,7 @@ def main():
         big = run_config(llama.LlamaConfig.tiny(), batch=4, seq=128,
                          timed_steps=3)
         small = None  # off-TPU there is no 0.5B comparison run (ADVICE r2)
-        layer8b_4k = layer8b_8k = moe_res = None
+        layer8b_4k = layer8b_8k = moe_res = long8k = None
         ernie_res = dit_res = prefill_res = decode_res = None
         batch, seq = 4, 128
 
@@ -378,6 +382,8 @@ def main():
         "tok_s_05b": round(small["tok_s"], 1) if small else None,
         "mfu_8b_layer": round(layer8b_4k, 4) if layer8b_4k else None,
         "mfu_8b_layer_s8k": round(layer8b_8k, 4) if layer8b_8k else None,
+        "mfu_2b_seq8k": round(long8k["mfu"], 4) if long8k else None,
+        "tok_s_2b_seq8k": round(long8k["tok_s"], 1) if long8k else None,
         "mfu_moe": round(moe_res["mfu"], 4) if moe_res else None,
         "tok_s_moe": round(moe_res["tok_s"], 1) if moe_res else None,
         "moe_params": moe_res["params"] if moe_res else None,
