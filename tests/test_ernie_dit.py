@@ -96,8 +96,8 @@ class TestErnie:
         loss, grads = step(params, ids, labels)
         assert np.isfinite(float(loss))
         # grads keep the param shardings (GSPMD propagated)
-        assert grads["layers"]["qkv_w"].sharding.spec == \
-            specs["layers"]["qkv_w"]
+        assert grads["layers"]["q_w"].sharding.spec == \
+            specs["layers"]["q_w"]
 
 
 class TestDiT:

@@ -407,3 +407,125 @@ class TestStreamedBwdKernels:
         for got, ref in zip((dq, dk, dv), vjp(g)):
             np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                        rtol=2e-4, atol=2e-4)
+
+
+class TestMaskedFlash:
+    """Key-padding-mask flash path (VERDICT r4 next-1: the bidirectional
+    encoder needs flash with padding masks). Interpret mode on CPU; parity
+    vs mha_ref with the same mask across ALL backward formulations —
+    resident, combined streamed, and the split kernels (the split-forcing
+    also covers ADVICE r4 item 5: the sq==sk split fallback had no direct
+    coverage)."""
+
+    def _qkvg(self, b=2, s=256, h=2, d=32, seed=0):
+        import numpy as np
+        import jax.numpy as jnp
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(rng.standard_normal((b, s, h, d)),
+                                 jnp.float32)
+        q, k, v, g = mk(), mk(), mk(), mk()
+        # per-row prefix padding lengths (>=1 valid key), plus one row with
+        # a NON-prefix mask — the kernel takes arbitrary key visibility
+        lengths = rng.integers(1, s + 1, b)
+        mask = np.arange(s)[None, :] < lengths[:, None]
+        mask[0, : s // 4] = False
+        mask[0, 0] = True   # keep >= 1 visible key
+        return q, k, v, g, jnp.asarray(mask)
+
+    def test_fwd_matches_ref(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import flash_attention as fa
+        q, k, v, g, mask = self._qkvg()
+        out = fa.flash_attention_pallas(q, k, v, key_mask=mask,
+                                        interpret=True, block_q=128,
+                                        block_k=128)
+        ref = fa.mha_ref(q, k, v, mask=mask[:, None, None, :])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_bwd_all_paths_match_ref(self, monkeypatch):
+        import jax
+        import numpy as np
+        from paddle_tpu.kernels import flash_attention as fa
+        q, k, v, g, mask = self._qkvg(seed=1)
+        out, lse = fa.flash_attention_pallas(
+            q, k, v, key_mask=mask, interpret=True, return_lse=True,
+            block_q=128, block_k=128)
+        _, vjp = jax.vjp(lambda a, b_, c: fa.mha_ref(
+            a, b_, c, mask=mask[:, None, None, :]), q, k, v)
+        refs = vjp(g)
+
+        def check(streamed, split=False):
+            if split:  # force the split dq/dkv kernels at sq == sk
+                monkeypatch.setattr(fa, "_COMBINED_STREAMED_DQ_BYTES", 0)
+            grads = fa.flash_attention_pallas_bwd(
+                q, k, v, out, lse, g, key_mask=mask, interpret=True,
+                streamed=streamed, block_q=128, block_k=128)
+            monkeypatch.undo()
+            for got, ref in zip(grads, refs):
+                np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                           rtol=2e-3, atol=2e-3)
+
+        check(streamed=False)              # resident combined
+        check(streamed=True)               # combined streamed
+        check(streamed=True, split=True)   # split dq + dkv
+
+    def test_split_path_causal_unmasked_sq_eq_sk(self, monkeypatch):
+        # ADVICE r4 item 5: the sq==sk SPLIT streamed path (production's
+        # fallback at extreme seq) verified directly, causal, no mask
+        import jax
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import flash_attention as fa
+        rng = np.random.default_rng(7)
+        mk = lambda: jnp.asarray(rng.standard_normal((1, 256, 2, 16)),
+                                 jnp.float32)
+        q, k, v, g = mk(), mk(), mk(), mk()
+        out, lse = fa.flash_attention_pallas(
+            q, k, v, causal=True, interpret=True, return_lse=True)
+        monkeypatch.setattr(fa, "_COMBINED_STREAMED_DQ_BYTES", 0)
+        dq, dk, dv = fa.flash_attention_pallas_bwd(
+            q, k, v, out, lse, g, causal=True, interpret=True,
+            streamed=True)
+        _, vjp = jax.vjp(lambda a, b_, c: fa.mha_ref(
+            a, b_, c, causal=True), q, k, v)
+        for got, ref in zip((dq, dk, dv), vjp(g)):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_masked_entry_grads_and_gqa(self):
+        # flash_attention_masked end-to-end: custom_vjp grads vs mha_ref
+        # autodiff, GQA head reduction, unaligned seq (pad-with-masked-keys)
+        import jax
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.core import flags
+        from paddle_tpu.kernels import flash_attention as fa
+        rng = np.random.default_rng(3)
+        b, s, h, hkv, d = 2, 200, 4, 2, 16   # s=200: unaligned
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+        lengths = np.array([s, s // 2])
+        mask = jnp.asarray(np.arange(s)[None, :] < lengths[:, None])
+
+        def loss_flash(q_, k_, v_):
+            return jnp.sum(fa.flash_attention_masked(q_, k_, v_, mask, None)
+                           ** 2)
+
+        def loss_ref(q_, k_, v_):
+            return jnp.sum(fa.mha_ref(q_, k_, v_,
+                                      mask=mask[:, None, None, :]) ** 2)
+
+        old = flags.flag("FLAGS_pallas_interpret")
+        flags.set_flags({"FLAGS_pallas_interpret": True})
+        try:
+            val, grads = jax.value_and_grad(loss_flash, (0, 1, 2))(q, k, v)
+        finally:
+            flags.set_flags({"FLAGS_pallas_interpret": old})
+        rval, rgrads = jax.value_and_grad(loss_ref, (0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(val), float(rval), rtol=1e-4)
+        for got, ref in zip(grads, rgrads):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-3, atol=2e-3)

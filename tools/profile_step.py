@@ -1,6 +1,6 @@
 """On-chip xplane profile of a bench workload, aggregated by op category.
 
-Usage: python tools/profile_step.py [moe|dense2b|dit] [steps]
+Usage: python tools/profile_step.py [moe|dense2b|dit|ernie] [steps]
 
 Traces `steps` post-warmup train steps with jax.profiler, parses the
 xplane via jax.profiler.ProfileData, and prints per-op-category device
@@ -56,6 +56,9 @@ def build(which):
         return step, state, tokens
     if which == "dit":
         step, state, batch_xy, _ = bench.build_dit_step()
+        return step, state, batch_xy
+    if which == "ernie":
+        step, state, batch_xy, _ = bench.build_ernie_step()
         return step, state, batch_xy
     raise SystemExit(f"unknown workload {which}")
 
