@@ -263,18 +263,27 @@ def run_prefill(prompt_len=8192, timed=4):
     return {"prefill_tok_s": prompt_len / dt}
 
 
-def run_decode(batch=8, prompt_len=512, new_tokens=128, timed=3):
+def run_decode(batch=8, prompt_len=512, new_tokens=128, timed=3,
+               weight_only=None):
     """Serving decode throughput: greedy batched decode on the 2B flagship
     stack (prefill + ONE compiled lax.scan of cached single-token steps —
     nlp.generation.generate). Reported as generated tokens/s across the
     batch, steady-state-dominated (prompt work amortized over new_tokens;
-    SURVEY.md §3.5 serving stack)."""
+    SURVEY.md §3.5 serving stack).
+
+    weight_only=8: int8 weight-only decode (generation.quantize_for_serving
+    — VERDICT r4 next-2; the reference ecosystem's serving default). The
+    int8 codes halve the per-step weight read, roughly doubling the
+    bandwidth roofline."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.nlp import generation, llama
 
     cfg = flagship_2b_cfg(max_position_embeddings=prompt_len + new_tokens)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    if weight_only:
+        params = generation.quantize_for_serving(params, bits=weight_only)
+
     prompt = jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (batch, prompt_len)), jnp.int32)
 
@@ -373,6 +382,7 @@ def main():
         dit_res = run_dit()
         prefill_res = run_prefill()
         decode_res = run_decode()
+        decode_w8_res = run_decode(weight_only=8)
         batch, seq = 8, 2048
     else:
         big = run_config(llama.LlamaConfig.tiny(), batch=4, seq=128,
@@ -380,6 +390,7 @@ def main():
         small = None  # off-TPU there is no 0.5B comparison run (ADVICE r2)
         layer8b_4k = layer8b_8k = moe_res = long8k = None
         ernie_res = dit_res = prefill_res = decode_res = None
+        decode_w8_res = None
         batch, seq = 4, 128
 
     print(json.dumps({
@@ -409,6 +420,8 @@ def main():
                           if prefill_res else None),
         "decode_tok_s": (round(decode_res["decode_tok_s"], 1)
                          if decode_res else None),
+        "decode_tok_s_w8": (round(decode_w8_res["decode_tok_s"], 1)
+                            if decode_w8_res else None),
     }))
 
 

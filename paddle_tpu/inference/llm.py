@@ -79,9 +79,16 @@ class LLMPredictor:
         from ..nlp import llama
         if config._prefix is None:
             raise ValueError("Config has no model path")
+        from ..nlp import generation
         params, cfg = load_llm(config._prefix)
         self._cfg = cfg
         self._gen = dict(config._llm_gen or {})
+        wo = getattr(config, "_llm_weight_only", None)
+        if wo:
+            # quantize at load (host arrays): Config.enable_weight_only —
+            # the serving counterpart of PaddleNLP --quant_type
+            params = generation.quantize_for_serving(
+                params, bits=4 if wo == "int4" else 8)
         mp = int(getattr(config, "_llm_mp", 1))
         dp = int(getattr(config, "_llm_dp", 1))
         self._mesh = None
@@ -96,6 +103,8 @@ class LLMPredictor:
                                     devices=jax.devices()[:mp * dp])
             from jax.sharding import NamedSharding
             specs = llama.infer_param_specs(cfg)
+            if wo:
+                specs = generation.quantized_specs(specs, params)
             # device_put the HOST (numpy) arrays straight into their shards
             # — staging jnp.asarray first would materialize every full
             # weight on device 0 and OOM models that only fit sharded

@@ -58,6 +58,134 @@ def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int,
                    _constrain(z, mesh, cache_spec()))
 
 
+# decode matmul weights eligible for weight-only quantization (order
+# mirrors upstream PaddleNLP's weight_only serving list: every per-layer
+# projection; embed/norms stay high-precision)
+QUANT_KEYS = ("q_proj", "k_proj", "v_proj", "o_proj",
+              "gate_proj", "up_proj", "down_proj")
+
+
+def quantize_for_serving(params: Dict[str, Any], bits: int = 8,
+                         quantize_head: bool = True) -> Dict[str, Any]:
+    """Weight-only quantization of the decode matmul weights.
+
+    Reference analog: PaddleNLP llm/ predict --quant_type weight_only_int8
+    (upstream python/paddle/nn/quant/quantized_linear.py weight_quantize;
+    SURVEY.md §3.5) — the serving default in the reference ecosystem.
+
+    Each projection [L, Din, Dout] becomes int8 (or int4) codes plus a
+    per-(layer, output-channel) f32 scale stored under '<name>:scale'
+    ([L, 1, Dout] — abs-max over the contracted dim). forward_cached
+    dequantizes in-register: XLA fuses convert*scale into the dot's
+    operand read, so decode streams int codes from HBM and the
+    weight-bandwidth roofline halves (int8) or quarters (int4).
+
+    quantize_head also quantizes lm_head (skipped automatically for tied
+    embeddings — the gather path wants the full-precision table)."""
+    if bits == 8:
+        bound, store = 127.0, jnp.int8
+    elif bits == 4:
+        bound, store = 7.0, jnp.int4
+    else:
+        raise ValueError(f"weight-only bits must be 8 or 4, got {bits}")
+
+    def quant(w):
+        w32 = jnp.asarray(w, jnp.float32)
+        scale = jnp.maximum(jnp.max(jnp.abs(w32), axis=-2, keepdims=True),
+                            1e-9) / bound
+        codes = jnp.clip(jnp.round(w32 / scale), -bound, bound).astype(store)
+        return codes, scale.astype(jnp.float32)
+
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in QUANT_KEYS:
+        codes, scale = quant(layers[name])
+        layers[name] = codes
+        layers[name + ":scale"] = scale
+    out["layers"] = layers
+    if quantize_head and "lm_head" in params:
+        codes, scale = quant(params["lm_head"])
+        out["lm_head"] = codes
+        out["lm_head:scale"] = scale
+    return out
+
+
+def quantized_specs(specs: Dict[str, Any], params: Dict[str, Any]
+                    ) -> Dict[str, Any]:
+    """Extend a param-spec tree to a quantize_for_serving tree: each
+    '<name>:scale' leaf takes the weight's spec with the contracted dim
+    (size 1 in the scale) forced replicated — e.g. o_proj P(None,'mp',None)
+    → scale P(None, None, None)."""
+    out = dict(specs)
+    lspecs = dict(specs["layers"])
+    for name in QUANT_KEYS:
+        if name + ":scale" in params["layers"]:
+            s = list(lspecs[name])
+            s[-2] = None
+            lspecs[name + ":scale"] = P(*s)
+    out["layers"] = lspecs
+    if "lm_head:scale" in params and "lm_head" in specs:
+        s = list(specs["lm_head"])
+        s[-2] = None
+        out["lm_head:scale"] = P(*s)
+    return out
+
+
+def _wq(tree, name, cd):
+    """Read a possibly weight-only-quantized weight: dequantize-on-read
+    (codes * scale fuses into the consuming dot's operand)."""
+    scale = tree.get(name + ":scale")
+    w = tree[name]
+    if scale is not None:
+        return w.astype(cd) * scale.astype(cd)
+    return w.astype(cd)
+
+
+def _mlp_cached(x, lp, cfg):
+    """SwiGLU MLP over _wq reads (llama._mlp's serving twin — the train
+    path never sees quantized weights)."""
+    g = x @ _wq(lp, "gate_proj", cfg.dtype)
+    u = x @ _wq(lp, "up_proj", cfg.dtype)
+    return (jax.nn.silu(g) * u) @ _wq(lp, "down_proj", cfg.dtype)
+
+
+def _final_head_cached(params, x, cfg):
+    """Final RMSNorm + LM head with _wq on lm_head; tied-embedding (or
+    unquantized) checkpoints fall through to llama's head."""
+    if "lm_head:scale" not in params:
+        return llama._final_head(params, x, cfg)
+    cd = cfg.dtype
+    x = rms_norm_ref(x, params["norm"], cfg.rms_norm_eps)
+    return (x.astype(cd) @ _wq(params, "lm_head", cd)).astype(jnp.float32)
+
+
+def _gqa_cached_attention(q, ck, cv, pos):
+    """Cached-attention inner: q [B,P,H,hd] against THIS layer's cache
+    ck/cv [B,T,KV,hd] with causal visibility at absolute position pos.
+    Query heads are grouped per KV head (no jnp.repeat — the expansion
+    rides the einsum's free dims); scores/softmax/probs stay f32 (probs
+    are tiny next to the cache, and bf16-in/f32-accumulate dots make the
+    result bit-identical to mha_ref's cast-to-f32 formulation)."""
+    import math
+    B, P, H, hd = q.shape
+    T, KV = ck.shape[1], ck.shape[2]
+    rep = H // KV
+    qg = q.reshape(B, P, KV, rep, hd)
+    s = jnp.einsum("bpkrd,btkd->bkrpt", qg, ck,
+                   preferred_element_type=jnp.float32) / math.sqrt(hd)
+    if P == 1:
+        vis = (jnp.arange(T) <= pos)[None, None, None, None, :]
+    else:
+        # key j visible to query i (absolute pos+i) iff j <= pos+i
+        vis = ((pos + jnp.arange(P)[:, None]) >= jnp.arange(T)[None, :]
+               )[None, None, None]
+    s = jnp.where(vis, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkrpt,btkd->bpkrd", p, cv,
+                   preferred_element_type=jnp.float32)
+    return o.reshape(B, P, H, hd)
+
+
 def _attention_cached(x, lp, cfg, cos, sin, ck, cv, pos):
     """x: [B, P, D] new tokens at absolute positions pos..pos+P-1.
     ck/cv: THIS layer's cache [B, T, KV, hd]. Returns (out, ck, cv)."""
@@ -65,9 +193,9 @@ def _attention_cached(x, lp, cfg, cos, sin, ck, cv, pos):
     H, KV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     cd = cfg.dtype
     T = ck.shape[1]
-    q = (x @ lp["q_proj"].astype(cd)).reshape(B, P, H, hd)
-    k = (x @ lp["k_proj"].astype(cd)).reshape(B, P, KV, hd)
-    v = (x @ lp["v_proj"].astype(cd)).reshape(B, P, KV, hd)
+    q = (x @ _wq(lp, "q_proj", cd)).reshape(B, P, H, hd)
+    k = (x @ _wq(lp, "k_proj", cd)).reshape(B, P, KV, hd)
+    v = (x @ _wq(lp, "v_proj", cd)).reshape(B, P, KV, hd)
     positions = pos + jnp.arange(P)[None, :]          # [1, P] broadcasts
     q, k = apply_rope_half(q, k, cos, sin,
                            jnp.broadcast_to(positions, (B, P)))
@@ -91,17 +219,14 @@ def _attention_cached(x, lp, cfg, cos, sin, ck, cv, pos):
         o = fa._flash_impl(q, k, v, True, None)
     else:
         # decode (and non-flash prefill): exact attention over the full
-        # static cache. Visibility from length scalars — key j visible to
-        # query i (absolute pos+i) iff j <= pos+i; the single-row decode
-        # case never materializes a 2-D [P, T] grid.
-        if P == 1:
-            visible = (jnp.arange(T) <= pos)[None, None, None, :]
-        else:
-            visible = ((pos + jnp.arange(P)[:, None])
-                       >= jnp.arange(T)[None, :])[None, None]
-        o = fa.mha_ref(q, ck, cv, mask=visible)
+        # static cache, GQA-grouped — mha_ref here repeated K/V to H query
+        # heads IN F32 (jnp.repeat + cast), which the r5 decode profile
+        # measured as ~1.8 GB/step of broadcast traffic dwarfing the
+        # weight reads; the grouped einsums keep the cache bf16 and
+        # unexpanded with f32 accumulation only in the dots.
+        o = _gqa_cached_attention(q, ck, cv, pos)
     o = o.astype(cd)
-    return (o.reshape(B, P, H * hd) @ lp["o_proj"].astype(cd)), ck, cv
+    return (o.reshape(B, P, H * hd) @ _wq(lp, "o_proj", cd)), ck, cv
 
 
 def forward_cached(params: Dict[str, Any], tokens: jax.Array,
@@ -119,17 +244,28 @@ def forward_cached(params: Dict[str, Any], tokens: jax.Array,
     x = _constrain(x, mesh, P(("dp", "sharding"), None, None))
     cos, sin = rope_freqs(cfg.head_dim, T, cfg.rope_theta, jnp.float32)
 
-    def body(x, layer):
-        lp, ck, cv = layer
+    def body(carry, lp):
+        # the FULL cache rides the carry and each layer dynamic-updates
+        # its own [1, B, T, KV, hd] slab in place — returning per-layer
+        # caches as stacked scan outputs (the r4 formulation) made the
+        # decode loop's carry double-buffer the whole cache with real
+        # copies every token (~1 ms/step on the 2B decode profile)
+        x, ka, va, li = carry
+        ck = lax.dynamic_slice_in_dim(ka, li, 1, 0)[0]
+        cv = lax.dynamic_slice_in_dim(va, li, 1, 0)[0]
         h = rms_norm_ref(x, lp["input_layernorm"], cfg.rms_norm_eps)
         a, ck, cv = _attention_cached(h, lp, cfg, cos, sin, ck, cv, pos)
+        ka = lax.dynamic_update_slice_in_dim(ka, ck[None], li, 0)
+        va = lax.dynamic_update_slice_in_dim(va, cv[None], li, 0)
         x = x + a
         h = rms_norm_ref(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
-        x = x + llama._mlp(h, lp, cfg)
-        return _constrain(x, mesh, P(("dp", "sharding"), None, None)), (ck, cv)
+        x = x + _mlp_cached(h, lp, cfg)
+        x = _constrain(x, mesh, P(("dp", "sharding"), None, None))
+        return (x, ka, va, li + 1), None
 
-    x, (ck, cv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
-    logits = llama._final_head(params, x, cfg)
+    (x, ck, cv, _), _ = lax.scan(
+        body, (x, cache.k, cache.v, jnp.int32(0)), params["layers"])
+    logits = _final_head_cached(params, x, cfg)
     return logits, KVCache(_constrain(ck, mesh, cache_spec()),
                            _constrain(cv, mesh, cache_spec()))
 

@@ -316,3 +316,80 @@ class TestTopPNoFullSort:
                 jax.random.split(jax.random.PRNGKey(1), 256))
         # tokens beyond the candidate cap must be reachable
         assert int(jnp.max(toks)) >= generation._TOPP_CANDIDATES
+
+
+class TestWeightOnly:
+    """Weight-only-quantized serving decode (VERDICT r4 next-2): the
+    reference ecosystem's default LLM serving mode — PaddleNLP predict
+    --quant_type weight_only_int8 over paddle.nn.quant.weight_quantize."""
+
+    def test_int8_logits_close_and_greedy_decodes(self, setup):
+        cfg, params, prompt = setup
+        qp = generation.quantize_for_serving(params)
+        # structure: codes are int8, scales ride '<name>:scale'
+        assert qp["layers"]["q_proj"].dtype == jnp.int8
+        assert qp["layers"]["q_proj:scale"].shape[1] == 1
+        cache = generation.init_cache(cfg, 2, 8)
+        lb, _ = generation.forward_cached(params, prompt, cache, 0, cfg)
+        cache = generation.init_cache(cfg, 2, 8)
+        lq, _ = generation.forward_cached(qp, prompt, cache, 0, cfg)
+        # int8 per-channel weight error ~0.4% -> small logits error
+        err = float(jnp.max(jnp.abs(lb - lq)) / jnp.max(jnp.abs(lb)))
+        assert err < 0.05, err
+        out = generation.generate(qp, prompt, cfg, max_new_tokens=4,
+                                  greedy=True)
+        assert out.shape == (2, 4)
+
+    def test_int4_decodes(self, setup):
+        cfg, params, prompt = setup
+        qp = generation.quantize_for_serving(params, bits=4)
+        out = generation.generate(qp, prompt, cfg, max_new_tokens=3,
+                                  greedy=True)
+        assert out.shape == (2, 3)
+
+    def test_quantized_specs_tree_matches(self, setup):
+        cfg, params, _ = setup
+        qp = generation.quantize_for_serving(params)
+        specs = generation.quantized_specs(llama.infer_param_specs(cfg), qp)
+        # every quantized leaf has a spec; tree_map must not raise
+        jax.tree.map(lambda a, b: None, qp, specs,
+                     is_leaf=lambda x: x is None or not isinstance(x, dict))
+
+    def test_weight_only_linear_api(self):
+        import paddle_tpu as paddle
+        rng = np.random.default_rng(0)
+        w = paddle.to_tensor(rng.standard_normal((64, 32)).astype("float32"))
+        x = paddle.to_tensor(rng.standard_normal((4, 64)).astype("float32"))
+        ref = np.asarray(x._data @ w._data)
+        for algo, gs, tol in (("weight_only_int8", -1, 0.02),
+                              ("weight_only_int8", 16, 0.02),
+                              ("weight_only_int4", 16, 0.2)):
+            codes, scale = paddle.nn.quant.weight_quantize(
+                w, algo=algo, group_size=gs)
+            y = paddle.nn.quant.weight_only_linear(
+                x, codes, weight_scale=scale,
+                weight_dtype="int4" if "int4" in algo else "int8",
+                group_size=gs)
+            err = float(np.max(np.abs(np.asarray(y._data) - ref))
+                        / np.max(np.abs(ref)))
+            assert err < tol, (algo, gs, err)
+        # dequantize roundtrip
+        codes, scale = paddle.nn.quant.weight_quantize(w)
+        wd = paddle.nn.quant.weight_dequantize(codes, scale)
+        err = float(np.max(np.abs(np.asarray(wd._data) -
+                                  np.asarray(w._data))))
+        assert err < 0.05
+
+    def test_predictor_enable_weight_only(self, setup, tmp_path):
+        from paddle_tpu import inference
+        from paddle_tpu.inference.llm import save_llm
+        cfg, params, prompt = setup
+        prefix = str(tmp_path / "m")
+        save_llm(prefix, params, cfg)
+        config = inference.Config(prefix)
+        config.enable_llm_generation(max_new_tokens=4)
+        config.enable_weight_only("int8")
+        pred = inference.create_predictor(config)
+        out = pred.run([np.asarray(prompt)])[0]
+        assert out.shape == (2, 4)
+        assert pred._params["layers"]["q_proj"].dtype == jnp.int8
