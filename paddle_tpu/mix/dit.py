@@ -169,21 +169,26 @@ def _block(x, c, bp, cfg: DiTConfig):
     mods = c @ bp["ada_w"].astype(dt) + bp["ada_b"].astype(dt)
     (sh_a, sc_a, g_a, sh_m, sc_m, g_m) = jnp.split(mods, 6, axis=-1)
     h = _modulate(_ln(x), sh_a, sc_a)
-    qkv = h @ bp["qkv_w"].astype(dt) + bp["qkv_b"].astype(dt)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    # exact attention on purpose: at N=256 / head_dim=72 the non-causal
-    # flash kernel measures ~1pt MFU slower end-to-end (36.1% vs 37.1%)
-    # — 72-lane MXU underutilization and per-kernel overheads outweigh
-    # skipping the [B, H, N, N] probs materialization at this tiny N
-    q = q.reshape(B, N, H, hd).transpose(0, 2, 1, 3)
-    k = k.reshape(B, N, H, hd).transpose(0, 2, 1, 3)
-    v = v.reshape(B, N, H, hd).transpose(0, 2, 1, 3)
-    attn = jax.nn.softmax(
-        (q @ k.transpose(0, 1, 3, 2)).astype(jnp.float32) / math.sqrt(hd),
-        axis=-1).astype(dt)
-    ctx = (attn @ v).transpose(0, 2, 1, 3).reshape(B, N, D)
-    x = x + g_a[:, None] * (ctx @ bp["proj_w"].astype(dt) +
-                            bp["proj_b"].astype(dt))
+    # einsum-form head-major attention + the non-causal flash kernel in
+    # layout='bhsd' (r5; +3.3pt MFU over r4's exact path at batch 96).
+    # The r4 flash experiment measured -1pt — but that was flash ALONE
+    # with bshd relayouts; einsum-only was also ~-0.5pt. Only the
+    # combination wins: projections write head-major directly and the
+    # custom-call folds [B,H,N,hd] for free, so the [B,H,N,N] f32 score
+    # traffic disappears without adding relayout copies. The fused qkv_w
+    # keeps upstream DiT's [D, 3D] shape; its (D,3,H,hd) view means mp
+    # sharding does not propagate THROUGH the reshape (leading factor 3)
+    # — GSPMD inserts a reshard instead, acceptable for this domain
+    # model (TP serving of DiT is not a BASELINE config).
+    wqkv = bp["qkv_w"].astype(dt).reshape(D, 3, H, hd)
+    bqkv = bp["qkv_b"].astype(dt).reshape(3, H, hd)
+    q, k, v = [jnp.einsum("bnd,dhe->bhne", h, wqkv[:, i]) +
+               bqkv[i][None, :, None, :] for i in range(3)]
+    from ..kernels import flash_attention as fa
+    ctx = fa.flash_attention_fwd(q, k, v, False, None, "bhsd")
+    ctx = jnp.einsum("bhne,hed->bnd", ctx,
+                     bp["proj_w"].astype(dt).reshape(H, hd, D))
+    x = x + g_a[:, None] * (ctx + bp["proj_b"].astype(dt))
     h = _modulate(_ln(x), sh_m, sc_m)
     h = jax.nn.gelu(h @ bp["mlp_in_w"].astype(dt) +
                     bp["mlp_in_b"].astype(dt), approximate=True)
