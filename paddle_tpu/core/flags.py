@@ -59,5 +59,9 @@ define_flag("FLAGS_eager_jit_ops", False, "jit-compile each eager op (dispatch c
 define_flag("FLAGS_pallas_interpret", False,
             "run Pallas kernels in interpret mode on any backend (testing: "
             "exercises the kernel path on CPU)")
+define_flag("FLAGS_pallas_force", False,
+            "treat Pallas as available regardless of host platform — for "
+            "lowering-only tests (jax.export platforms=('tpu',) from a CPU "
+            "host); programs run on CPU with this set will fail")
 define_flag("FLAGS_allocator_strategy", "xla", "allocator is owned by XLA/PJRT on TPU")
 define_flag("FLAGS_cudnn_deterministic", False, "determinism toggle (XLA flag passthrough)")

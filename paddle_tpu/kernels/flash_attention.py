@@ -769,6 +769,14 @@ def _use_pallas(x):
 
     if not flag("FLAGS_use_pallas"):
         return False
+    if flag("FLAGS_pallas_force"):
+        # lowering-only tests: compile the REAL Mosaic kernels while
+        # lowering for platforms=('tpu',) from a CPU host (jax.export) —
+        # the HLO-golden assertion that mesh paths contain the pallas
+        # custom-call needs real lowering, which interpret mode replaces
+        # with plain jax ops. Never set this where the program will RUN
+        # on CPU.
+        return True
     if _interpret():  # testing: run the kernels in interpret mode anywhere
         return True
     # Concrete arrays know their devices; tracers (inside jit) compile for

@@ -246,3 +246,21 @@ def _rms_train_bwd(epsilon, use_pallas, res, dy):
 
 
 rms_norm_train.defvjp(_rms_train_fwd, _rms_train_bwd)
+
+
+def rms_norm_train_sharded(x, weight, epsilon, mesh, spec):
+    """Fused-backward RMSNorm UNDER A MESH: shard_map the Pallas kernel
+    over the activation shards so TP/FSDP runs the same fused kernels as
+    the single-chip bench (VERDICT r4 next-3 — a bare pallas_call is
+    opaque to the SPMD partitioner, which is why the mesh path previously
+    dropped to jnp). `spec` is x's activation PartitionSpec (the feature
+    dim must be unsharded — the norm reduces over it); weight is
+    replicated, and shard_map's transpose psums its gradient across the
+    shards. Off-TPU each shard falls through rms_norm_train's internal
+    gate to the jnp formulation, so CPU meshes behave as before."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    fn = lambda xs, ws: rms_norm_train(xs, ws, epsilon, True)  # noqa: E731
+    return shard_map(fn, mesh=mesh, in_specs=(spec, P(None)),
+                     out_specs=spec, check_vma=False)(x, weight)

@@ -229,16 +229,46 @@ def _mlp(x, lp, cfg: LlamaConfig):
 
 
 def _decoder_layer(x, lp, cfg: LlamaConfig, cos, sin, mesh=None):
-    # fused-backward norm on one chip; jnp under a mesh so GSPMD can
-    # partition it (XLA's autodiff of the ref emits ~7x-slower backward
-    # fusions — the round-4 dense-2B profile's largest non-GEMM cost)
-    norm = lambda h, w: rms_norm_train(h, w, cfg.rms_norm_eps,  # noqa: E731
-                                       mesh is None)
+    # fused-backward norm everywhere (XLA's autodiff of the ref emits
+    # ~7x-slower backward fusions — the round-4 dense-2B profile's
+    # largest non-GEMM cost): bare pallas_call on one chip, shard_mapped
+    # over the activation shards under a mesh (r5 — previously the mesh
+    # path dropped to jnp because pallas is opaque to GSPMD)
+    norm = _make_norm(cfg, mesh)
     h = norm(x, lp["input_layernorm"])
     x = x + _attention(h, lp, cfg, cos, sin, mesh)
     h = norm(x, lp["post_attention_layernorm"])
     x = x + _mlp(h, lp, cfg)
     return x
+
+
+def in_manual_axis(*names) -> bool:
+    """True when tracing inside a shard_map MANUAL over any of `names`
+    (e.g. the compiled-pipeline stage body, manual over 'pp') — a nested
+    shard_map over the remaining auto axes is unsupported there, so the
+    mesh-aware fused kernels must fall back to their jnp formulations."""
+    for n in names:
+        try:
+            jax.lax.axis_index(n)
+            return True
+        except Exception:
+            continue
+    return False
+
+
+def _make_norm(cfg: LlamaConfig, mesh):
+    """RMSNorm closure: single-chip fused kernel, or the shard_mapped
+    fused kernel over act_spec shards under a mesh (off-TPU meshes fall
+    through to jnp inside the shard, as before). Inside a pipeline
+    stage (manual over pp) the jnp path keeps GSPMD partitioning the
+    remaining axes."""
+    from ..kernels.rms_norm import rms_norm_train_sharded
+    if mesh is None:
+        return lambda h, w: rms_norm_train(h, w, cfg.rms_norm_eps, True)
+    if in_manual_axis("pp"):
+        return lambda h, w: rms_norm_train(h, w, cfg.rms_norm_eps, False)
+    return lambda h, w: rms_norm_train_sharded(h, w, cfg.rms_norm_eps,
+                                               mesh, act_spec())
 
 
 def _backbone(params, tokens, cfg: LlamaConfig, mesh=None):

@@ -362,7 +362,7 @@ def moe_block(x: jax.Array, lp: Dict[str, jax.Array], cfg: MoeConfig,
                          w_tk, inv_pos, True).reshape(B, S, D).astype(cd)
     else:
         # under GSPMD: per-batch-row index space — groups align with the
-        # dp/sharding batch shards so the jnp gathers stay shard-local
+        # dp/sharding batch shards so the gathers stay shard-local
         safe = jnp.where(flat >= 0, flat, E * C)
         pos_ids = jnp.broadcast_to(
             jnp.arange(S * k, dtype=jnp.int32)[None], (B, S * k))
@@ -374,19 +374,53 @@ def moe_block(x: jax.Array, lp: Dict[str, jax.Array], cfg: MoeConfig,
         flat, inv_pos, inv_tok, probs = (
             checkpoint_name(t, "moe_routing")
             for t in (flat, inv_pos, inv_tok, probs))
-        expert_in = dispatch_gather(x.astype(cd), inv_tok, flat, k,
-                                    False).reshape(B, E, C, D)
+        # r5 (VERDICT r4 next-3): on TPU the batch-local gathers run the
+        # SAME fused Pallas kernels as the single-chip bench, shard_mapped
+        # over the batch shards (a bare pallas_call is opaque to GSPMD —
+        # wrapping it manual over the batch axes is exactly the shard-
+        # local computation the jnp path relied on GSPMD to discover).
+        # jnp stays the fallback off-TPU and inside pipeline stages
+        # (manual-over-pp shard_map cannot nest another shard_map).
+        from ..kernels.flash_attention import _use_pallas
+        fused = _use_pallas(x) and not _llama.in_manual_axis("pp")
+        if fused:
+            from jax import shard_map
+            bax = ("dp", "sharding")
+            expert_in = shard_map(
+                lambda xs, it, fl: dispatch_gather(xs, it, fl, k, True),
+                mesh=mesh,
+                in_specs=(P(bax, None, None), P(bax, None), P(bax, None)),
+                out_specs=P(bax, None, None), check_vma=False,
+            )(x.astype(cd), inv_tok, flat)
+            expert_in = expert_in.reshape(B, E, C, D)
+        else:
+            expert_in = dispatch_gather(x.astype(cd), inv_tok, flat, k,
+                                        False).reshape(B, E, C, D)
         g = jnp.einsum("becd,edf->becf", expert_in,
                        lp["expert_gate_proj"].astype(cd))
         u = jnp.einsum("becd,edf->becf", expert_in,
                        lp["expert_up_proj"].astype(cd))
         expert_out = jnp.einsum("becf,efd->becd", jax.nn.silu(g) * u,
                                 lp["expert_down_proj"].astype(cd))
-        got = combine_gather(expert_out.reshape(B, E * C, D), flat,
-                             inv_pos, False).reshape(B, S, k, D)
-        # combine: y[b,s] = Σ_j probs[b,s,j] · expert_out[slot(b,s,j)]
-        # (the single-chip branch fuses this einsum into combine_wsum)
-        y = jnp.einsum("bskd,bsk->bsd", got, probs.astype(cd))
+        if fused:
+            # FUSED weighted combine per batch shard (same contract as
+            # the single-chip branch: idx pre-clipped, w pre-zeroed)
+            idx_tk = jnp.clip(flat, 0).reshape(B, S, k)
+            w_tk = jnp.where(flat >= 0, probs.reshape(B, S * k)
+                             .astype(jnp.float32), 0.0).reshape(B, S, k)
+            y = shard_map(
+                lambda eo, it, wt, ip: combine_wsum(eo, it, wt, ip, True),
+                mesh=mesh,
+                in_specs=(P(bax, None, None), P(bax, None, None),
+                          P(bax, None, None), P(bax, None)),
+                out_specs=P(bax, None, None), check_vma=False,
+            )(expert_out.reshape(B, E * C, D), idx_tk, w_tk,
+              inv_pos).astype(cd)
+        else:
+            got = combine_gather(expert_out.reshape(B, E * C, D), flat,
+                                 inv_pos, False).reshape(B, S, k, D)
+            # combine: y[b,s] = Σ_j probs[b,s,j] · expert_out[slot(b,s,j)]
+            y = jnp.einsum("bskd,bsk->bsd", got, probs.astype(cd))
 
     if cfg.num_shared_experts:
         sg = x @ lp["shared_gate_proj"].astype(cd)
@@ -401,8 +435,8 @@ def _decoder_body(carry, lp, cfg: MoeConfig, lcfg, cos, sin, mesh,
     for both the plain scan (forward) and the pipeline stage (forward_pp);
     `constrain` optionally re-annotates activation sharding."""
     h, lb, zl = carry
-    norm = lambda t, w: rms_norm_train(t, w, cfg.rms_norm_eps,  # noqa: E731
-                                       mesh is None)
+    norm = _llama._make_norm(cfg, mesh)  # fused kernel, shard_mapped
+    # under a mesh (r5; jnp inside pipeline stages — llama.in_manual_axis)
     a = norm(h, lp["input_layernorm"])
     h = h + _llama._attention(a, lp, lcfg, cos, sin, mesh)
     a = norm(h, lp["post_attention_layernorm"])

@@ -504,3 +504,62 @@ class TestPairedTransposeGathers:
             fd = (loss(x + d) - loss(x - d)) / (2 * eps)
             np.testing.assert_allclose(np.asarray(g[i]), np.asarray(fd),
                                        rtol=2e-2, atol=2e-3)
+
+
+class TestMeshFusedKernels:
+    """VERDICT r4 next-3: EP/TP meshes run the SAME fused Pallas kernels
+    as the single-chip bench, shard_mapped over the batch shards — with
+    parity against the jnp path and lowering evidence."""
+
+    def _setup(self):
+        from paddle_tpu.parallel.topology import build_mesh
+        mesh = build_mesh(dp=2, ep=2, mp=2)
+        cfg = moe.MoeConfig.tiny(hidden_size=128, moe_intermediate_size=128,
+                                 intermediate_size=256)
+        params = moe.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (4, 32)), jnp.int32)
+        return mesh, cfg, params, toks
+
+    def test_fused_mesh_path_matches_jnp(self):
+        from paddle_tpu.core import flags
+        mesh, cfg, params, toks = self._setup()
+
+        def run():
+            loss, grads = jax.value_and_grad(
+                lambda p: moe.loss_fn(p, toks, cfg, mesh))(params)
+            return loss, grads
+
+        ref_loss, ref_grads = run()   # jnp path (CPU gate)
+        flags.set_flags({"FLAGS_pallas_interpret": True})
+        try:
+            got_loss, got_grads = run()   # fused shard_map path, interpret
+        finally:
+            flags.set_flags({"FLAGS_pallas_interpret": False})
+        np.testing.assert_allclose(float(got_loss), float(ref_loss),
+                                   rtol=2e-4)
+        for a, b in zip(jax.tree.leaves(got_grads),
+                        jax.tree.leaves(ref_grads)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=2e-3)
+
+    def test_mesh_module_contains_pallas_custom_call(self):
+        """Lowering for platforms=('tpu',) with FLAGS_pallas_force must put
+        the Mosaic custom-call INSIDE the sharded module — the r4 mesh
+        branch silently dropped to jnp, which this would catch."""
+        import jax.export
+        from paddle_tpu.core import flags
+        mesh, cfg, params, toks = self._setup()
+        fn = jax.jit(lambda p, t: moe.loss_fn(p, t, cfg, mesh))
+        flags.set_flags({"FLAGS_pallas_force": True})
+        jax.clear_caches()  # earlier CPU-lowered inner jits poison the
+        try:                # cross-platform lowering cache (closed_call)
+            txt = jax.export.export(fn, platforms=["tpu"])(
+                params, toks).mlir_module()
+        finally:
+            flags.set_flags({"FLAGS_pallas_force": False})
+            jax.clear_caches()
+        assert "tpu_custom_call" in txt
+        # without the force flag the CPU lowering has no pallas calls
+        txt_cpu = fn.lower(params, toks).as_text()
+        assert "tpu_custom_call" not in txt_cpu

@@ -119,3 +119,40 @@ class TestLayerNormTrain:
         finally:
             if interpret:
                 F.set_flags({"FLAGS_pallas_interpret": False})
+
+
+class TestRmsNormSharded:
+    """rms_norm_train_sharded (VERDICT r4 next-3): the fused kernel under
+    a mesh via shard_map — value/grad parity with the ref path."""
+
+    def test_sharded_matches_ref(self):
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.core import flags
+        from paddle_tpu.parallel.topology import build_mesh
+        from paddle_tpu.kernels.rms_norm import (rms_norm_ref,
+                                                 rms_norm_train_sharded)
+        mesh = build_mesh(dp=2, sharding=2, mp=2)
+        spec = P(("dp", "sharding"), None, None)
+        x = jnp.asarray(np.random.RandomState(0).randn(8, 16, 128),
+                        jnp.float32)
+        w = jnp.asarray(np.random.RandomState(1).rand(128), jnp.float32)
+
+        def loss(fn):
+            def f(x_, w_):
+                return jnp.sum(fn(x_, w_) ** 2)
+            return jax.value_and_grad(f, (0, 1))
+
+        ref_v, ref_g = loss(lambda a, b: rms_norm_ref(a, b, 1e-6))(x, w)
+        flags.set_flags({"FLAGS_pallas_interpret": True})
+        try:
+            got_v, got_g = loss(lambda a, b: rms_norm_train_sharded(
+                a, b, 1e-6, mesh, spec))(x, w)
+        finally:
+            flags.set_flags({"FLAGS_pallas_interpret": False})
+        np.testing.assert_allclose(float(got_v), float(ref_v), rtol=1e-5)
+        for a, b in zip(got_g, ref_g):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
