@@ -180,19 +180,24 @@ def flash_attention_pallas(q, k, v, causal=False, scale=None, offset=None,
     (paddle dtype parity), but 64-bit index arithmetic is untileable for
     Mosaic (i64->f32 casts recurse in its lowering).
     """
+    h_ax = 1 if layout == "bhsd" else 2
+    s_ax = 2 if layout == "bhsd" else 1
     if layout == "bhsd":
         b, h, sq, d = q.shape
-        sk = k.shape[2]
     else:
         b, sq, h, d = q.shape
-        sk = k.shape[1]
+    hkv, sk = k.shape[h_ax], k.shape[s_ax]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if offset is None:
         offset = sk - sq
     block_q = _fit_block(block_q or 512, sq)
     block_k = _fit_block(block_k or 512, sk)
-    # fold batch*heads into the grid's first dim
+    # fold batch*heads into the grid's first dim. GQA: k/v may arrive
+    # with FEWER heads (h % hkv == 0) — the kernel maps each q head to
+    # its kv group via the BlockSpec index_map, so the expanded K/V
+    # (jnp.repeat — ~31 ms/step of copies on the r5 MoE profile) never
+    # materializes.
     qt, kt, vt = (_to_folded(x, layout) for x in (q, k, v))
     grid = (b * h, sq // block_q)
     with jax.enable_x64(False):
@@ -201,7 +206,7 @@ def flash_attention_pallas(q, k, v, causal=False, scale=None, offset=None,
                 key_mask.astype(jnp.int32).reshape(b, 1, sk))
         out, lse = _fwd_call(off, qt, kt, vt, grid, block_q, block_k, causal,
                              scale, sk, b, h, sq, d, q.dtype, interpret,
-                             mask)
+                             mask, hkv)
     out = _from_folded(out, b, h, layout)
     if return_lse:
         return out, lse.reshape(b, h, sq)
@@ -209,8 +214,15 @@ def flash_attention_pallas(q, k, v, causal=False, scale=None, offset=None,
 
 
 def _fwd_call(off, qt, kt, vt, grid, block_q, block_k, causal, scale, sk, b,
-              h, sq, d, out_dtype, interpret, mask=None):
+              h, sq, d, out_dtype, interpret, mask=None, hkv=None):
     from jax.experimental import pallas as pl
+
+    hkv = h if hkv is None else hkv
+    rep = h // hkv
+
+    def kv_ix(bh, qb):
+        # q head (bh % h) reads kv head (bh % h) // rep of batch bh // h
+        return ((bh // h) * hkv + (bh % h) // rep, 0, 0)
 
     in_specs = [pl.BlockSpec((1, 1), lambda bh, qb: (0, 0))]
     operands = [off]
@@ -221,8 +233,8 @@ def _fwd_call(off, qt, kt, vt, grid, block_q, block_k, causal, scale, sk, b,
         operands.append(mask)
     in_specs += [
         pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0)),
-        pl.BlockSpec((1, sk, d), lambda bh, qb: (bh, 0, 0)),
-        pl.BlockSpec((1, sk, d), lambda bh, qb: (bh, 0, 0)),
+        pl.BlockSpec((1, sk, d), kv_ix),
+        pl.BlockSpec((1, sk, d), kv_ix),
     ]
     operands += [qt, kt, vt]
     return pl.pallas_call(
@@ -263,8 +275,8 @@ _RESIDENT_MAX_SEQ = 2048
 
 
 def _flash_bwd_combined_kernel_res(off_ref, *refs, block_q, causal,
-                                   scale, seq_q, masked=False):
-    """Combined resident backward: one pass over (bh, kv-block) produces
+                                   scale, seq_q, masked=False, rep=1):
+    """Combined resident backward: one pass over (bkv, kv-block) produces
     dk/dv for this block AND accumulates dq into a full-seq f32 scratch
     (flushed at the last kv block). The separate dq/dkv kernels each
     recomputed s, p and dp — 7 block matmuls where 5 suffice; sharing
@@ -272,7 +284,13 @@ def _flash_bwd_combined_kernel_res(off_ref, *refs, block_q, causal,
 
     masked: a [1, 1, block_k] int32 key-padding-mask ref (this kv block's
     slice) precedes q_ref; p is re-masked so masked keys contribute to no
-    gradient (matches the fwd kernel's masked path)."""
+    gradient (matches the fwd kernel's masked path).
+
+    rep (r5): GQA-NATIVE — the grid's first dim runs over KV heads and
+    each program handles its group of `rep` consecutive q heads (q/do/
+    lse/dcap/dq blocks are [rep, sq, ·]); dk/dv accumulate across the
+    group IN the kernel, so the expanded K/V and the post-hoc
+    group-reduction of dk/dv never materialize."""
     from jax.experimental import pallas as pl
 
     if masked:
@@ -295,12 +313,12 @@ def _flash_bwd_combined_kernel_res(off_ref, *refs, block_q, causal,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    def body(qb, carry):
+    def body_r(r, qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0]
-        dcap = dcap_ref[0, pl.ds(qb * block_q, block_q), 0]
+        q = q_ref[r, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[r, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[r, pl.ds(qb * block_q, block_q), 0]
+        dcap = dcap_ref[r, pl.ds(qb * block_q, block_q), 0]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse[:, None])
         if causal:
@@ -315,7 +333,7 @@ def _flash_bwd_combined_kernel_res(off_ref, *refs, block_q, causal,
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - dcap[:, None]) * scale
         dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        dq_acc[pl.ds(qb * block_q, block_q), :] += jnp.dot(
+        dq_acc[r, pl.ds(qb * block_q, block_q), :] += jnp.dot(
             ds, k_blk, preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -326,15 +344,17 @@ def _flash_bwd_combined_kernel_res(off_ref, *refs, block_q, causal,
         start = jnp.clip((k_offset - off) // block_q, 0, n_qb)
     else:
         start = 0
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dv0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, n_qb, body, (dk0, dv0))
+    dk = jnp.zeros((block_k, d), jnp.float32)
+    dv = jnp.zeros((block_k, d), jnp.float32)
+    for r in range(rep):   # static unroll over the q-head group
+        dk, dv = jax.lax.fori_loop(
+            start, n_qb, functools.partial(body_r, r), (dk, dv))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
     @pl.when(kb == n_kb - 1)
     def _flush():
-        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+        dq_ref[...] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _flash_bwd_combined_kernel_str(off_ref, *refs, causal, scale, n_kb,
@@ -557,18 +577,34 @@ def flash_attention_pallas_bwd(q, k, v, out, lse, g, causal=False,
     ds = p * (dp - dcap) as dcap -> dcap - dlse.
     key_mask: optional [B, Sk] key-padding mask, as in
     flash_attention_pallas (must match what the forward used)."""
+    h_ax = 1 if layout == "bhsd" else 2
+    s_ax = 2 if layout == "bhsd" else 1
     if layout == "bhsd":
         b, h, sq, d = q.shape
-        sk = k.shape[2]
     else:
         b, sq, h, d = q.shape
-        sk = k.shape[1]
+    hkv, sk = k.shape[h_ax], k.shape[s_ax]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if offset is None:
         offset = sk - sq
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
+    if streamed is None:  # auto: resident kernels up to the VMEM-safe seq
+        streamed = max(sq, sk) > _RESIDENT_MAX_SEQ
+    # GQA (r5): the resident path can run GQA-NATIVE — grid over KV heads
+    # with the q-head group looped in-kernel, dk/dv accumulated across the
+    # group, no expanded K/V. Verified in interpret mode and compiled at
+    # sq <= 1024, but at the training shapes that matter (rep 2, sq 2048,
+    # d 128) Mosaic compilation effectively hangs (>8 min vs ~90 s for
+    # the expanded kernel; r5 measured) — so the gate holds it to the
+    # small shapes where it compiles, and larger GQA falls back to
+    # expand+reduce. Revisit if the toolchain's scheduling of the
+    # rep-unrolled double loop improves.
+    rep = h // hkv
+    native_gqa = (hkv != h and not streamed and rep * sq * d <= 2 ** 18)
+    if hkv != h and not native_gqa:
+        k, v = _expand_gqa(q, k, v, layout)
     qt, kt, vt = (_to_folded(x, layout) for x in (q, k, v))
     dot = _to_folded(g, layout)
     ot = _to_folded(out, layout)
@@ -578,8 +614,6 @@ def flash_attention_pallas_bwd(q, k, v, out, lse, g, causal=False,
                    axis=-1, keepdims=True)
     if dlse is not None:
         dcap = dcap - dlse.astype(jnp.float32).reshape(b * h, sq, 1)
-    if streamed is None:  # auto: resident kernels up to the VMEM-safe seq
-        streamed = max(sq, sk) > _RESIDENT_MAX_SEQ
     with jax.enable_x64(False):  # see flash_attention_pallas docstring
         off = jnp.asarray(offset, jnp.int32).reshape(1, 1)
         mask = (None if key_mask is None else
@@ -587,9 +621,14 @@ def flash_attention_pallas_bwd(q, k, v, out, lse, g, causal=False,
         dq, dk, dv = _bwd_call(
             off, qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d,
             block_q, block_k, causal, scale, q.dtype, k.dtype,
-            v.dtype, interpret, streamed, mask)
-    return (_from_folded(dq, b, h, layout), _from_folded(dk, b, h, layout),
-            _from_folded(dv, b, h, layout))
+            v.dtype, interpret, streamed, mask,
+            hkv if native_gqa else None)
+    h_kv_out = hkv if native_gqa else h
+    dk = _from_folded(dk, b, h_kv_out, layout)
+    dv = _from_folded(dv, b, h_kv_out, layout)
+    if hkv != h and not native_gqa:
+        dk, dv = _gqa_reduce(dk, dv, hkv, layout)
+    return _from_folded(dq, b, h, layout), dk, dv
 
 
 def _mask_spec(block_k, h, grid_order):
@@ -603,7 +642,7 @@ def _mask_spec(block_k, h, grid_order):
 
 def _bwd_call(off, qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d, block_q,
               block_k, causal, scale, q_dtype, k_dtype, v_dtype, interpret,
-              streamed, mask=None):
+              streamed, mask=None, hkv=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -611,7 +650,7 @@ def _bwd_call(off, qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d, block_q,
         return _bwd_call_resident(
             off, qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d, block_q,
             block_k, causal, scale, q_dtype, k_dtype, v_dtype, interpret,
-            mask)
+            mask, hkv)
 
     n_kb = sk // block_k
     n_qb = sq // block_q
@@ -718,41 +757,47 @@ def _bwd_call(off, qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d, block_q,
 
 def _bwd_call_resident(off, qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d,
                        block_q, block_k, causal, scale, q_dtype, k_dtype,
-                       v_dtype, interpret, mask=None):
+                       v_dtype, interpret, mask=None, hkv=None):
+    """GQA-native (r5): kt/vt come folded [b*hkv, sk, d]; the grid runs
+    over KV heads, each program owning its group of rep = h//hkv q heads,
+    and dk/dv come back UNEXPANDED [b*hkv, sk, d] — no jnp.repeat of K/V
+    and no post-hoc group reduction."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    in_specs = [pl.BlockSpec((1, 1), lambda bh, kb: (0, 0))]
+    hkv = h if hkv is None else hkv
+    rep = h // hkv
+    in_specs = [pl.BlockSpec((1, 1), lambda bkv, kb: (0, 0))]
     operands = [off]
     if mask is not None:
         in_specs.append(pl.BlockSpec((1, 1, block_k),
-                                     lambda bh, kb: (bh // h, 0, kb)))
+                                     lambda bkv, kb: (bkv // hkv, 0, kb)))
         operands.append(mask)
     operands += [qt, kt, vt, dot, lse_t, dcap]
     in_specs += [
-        pl.BlockSpec((1, sq, d), lambda bh, kb: (bh, 0, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
-        pl.BlockSpec((1, sq, d), lambda bh, kb: (bh, 0, 0)),
-        pl.BlockSpec((1, sq, 1), lambda bh, kb: (bh, 0, 0)),
-        pl.BlockSpec((1, sq, 1), lambda bh, kb: (bh, 0, 0)),
+        pl.BlockSpec((rep, sq, d), lambda bkv, kb: (bkv, 0, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bkv, kb: (bkv, kb, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bkv, kb: (bkv, kb, 0)),
+        pl.BlockSpec((rep, sq, d), lambda bkv, kb: (bkv, 0, 0)),
+        pl.BlockSpec((rep, sq, 1), lambda bkv, kb: (bkv, 0, 0)),
+        pl.BlockSpec((rep, sq, 1), lambda bkv, kb: (bkv, 0, 0)),
     ]
     dq, dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_combined_kernel_res, block_q=block_q,
                           causal=causal, scale=scale, seq_q=sq,
-                          masked=mask is not None),
+                          masked=mask is not None, rep=rep),
         out_shape=[jax.ShapeDtypeStruct((b * h, sq, d), q_dtype),
-                   jax.ShapeDtypeStruct((b * h, sk, d), k_dtype),
-                   jax.ShapeDtypeStruct((b * h, sk, d), v_dtype)],
-        grid=(b * h, sk // block_k),
+                   jax.ShapeDtypeStruct((b * hkv, sk, d), k_dtype),
+                   jax.ShapeDtypeStruct((b * hkv, sk, d), v_dtype)],
+        grid=(b * hkv, sk // block_k),
         in_specs=in_specs,
         out_specs=[
-            # dq revisits one full-seq block per bh; written at the flush
-            pl.BlockSpec((1, sq, d), lambda bh, kb: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            # dq revisits one group block per bkv; written at the flush
+            pl.BlockSpec((rep, sq, d), lambda bkv, kb: (bkv, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, kb: (bkv, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, kb: (bkv, kb, 0)),
         ],
-        scratch_shapes=[pltpu.VMEM((sq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((rep, sq, d), jnp.float32)],
         interpret=interpret,
     )(*operands)
 
@@ -762,6 +807,18 @@ def _bwd_call_resident(off, qt, kt, vt, dot, lse_t, dcap, b, h, sq, sk, d,
 def _interpret():
     from ..core.flags import flag
     return bool(flag("FLAGS_pallas_interpret"))
+
+
+def _pallas_available():
+    """Platform-level gate (no array to probe): True when Pallas kernels
+    would engage for arrays on the default backend."""
+    from ..core.flags import flag
+
+    if not flag("FLAGS_use_pallas"):
+        return False
+    if flag("FLAGS_pallas_force") or _interpret():
+        return True
+    return jax.default_backend() not in ("cpu",)
 
 
 def _use_pallas(x):
@@ -986,9 +1043,10 @@ def _ref_any(q, k, v, causal=False, scale=None, mask=None, layout="bshd"):
 
 def _flash_impl(q, k, v, causal, scale, layout="bshd"):
     if _pallas_ok(q, k, causal, layout):
-        ke, ve = _expand_gqa(q, k, v, layout)
         try:
-            return flash_attention_padded(q, ke, ve, causal=causal,
+            # GQA k/v go in UNEXPANDED — the kernel's BlockSpec index_map
+            # folds each q head onto its kv group
+            return flash_attention_padded(q, k, v, causal=causal,
                                           scale=scale, layout=layout,
                                           interpret=_interpret())
         except Exception as e:
@@ -1001,9 +1059,8 @@ def _flash_impl(q, k, v, causal, scale, layout="bshd"):
 
 def _flash_fwd_rule(q, k, v, causal, scale, layout="bshd"):
     if _pallas_ok(q, k, causal, layout):
-        ke, ve = _expand_gqa(q, k, v, layout)
         try:
-            out, lse = flash_attention_padded(q, ke, ve, causal=causal,
+            out, lse = flash_attention_padded(q, k, v, causal=causal,
                                               scale=scale, return_lse=True,
                                               layout=layout,
                                               interpret=_interpret())
@@ -1021,17 +1078,13 @@ def _flash_fwd_rule(q, k, v, causal, scale, layout="bshd"):
 
 def _flash_bwd_rule(causal, scale, layout, res, g):
     q, k, v, out, lse = res
-    h_ax = 1 if layout == "bhsd" else 2
     if lse is not None:
         try:
-            hq, hkv = q.shape[h_ax], k.shape[h_ax]
-            ke, ve = _expand_gqa(q, k, v, layout)
-            dq, dk, dv = flash_attention_padded_bwd(
-                q, ke, ve, out, lse, g, causal=causal, scale=scale,
+            # GQA handled inside the wrapper (native resident kernel or
+            # expand+reduce for the streamed paths)
+            return flash_attention_padded_bwd(
+                q, k, v, out, lse, g, causal=causal, scale=scale,
                 layout=layout, interpret=_interpret())
-            if hq != hkv:  # GQA: sum grads over each KV head's query group
-                dk, dv = _gqa_reduce(dk, dv, hkv, layout)
-            return dq, dk, dv
         except Exception as e:  # e.g. VMEM overflow at extreme seq
             _warn_fallback("flash_bwd", e)
     _, vjp = jax.vjp(lambda q_, k_, v_: _ref_any(
@@ -1069,9 +1122,8 @@ def _key_mask4(key_mask):
 
 def _flash_masked_impl(q, k, v, key_mask, scale, layout="bshd"):
     if _use_pallas(q):
-        ke, ve = _expand_gqa(q, k, v, layout)
         try:
-            return flash_attention_padded(q, ke, ve, causal=False,
+            return flash_attention_padded(q, k, v, causal=False,
                                           scale=scale, key_mask=key_mask,
                                           layout=layout,
                                           interpret=_interpret())
@@ -1083,9 +1135,8 @@ def _flash_masked_impl(q, k, v, key_mask, scale, layout="bshd"):
 
 def _flash_masked_fwd_rule(q, k, v, key_mask, scale, layout="bshd"):
     if _use_pallas(q):
-        ke, ve = _expand_gqa(q, k, v, layout)
         try:
-            out, lse = flash_attention_padded(q, ke, ve, causal=False,
+            out, lse = flash_attention_padded(q, k, v, causal=False,
                                               scale=scale, key_mask=key_mask,
                                               return_lse=True, layout=layout,
                                               interpret=_interpret())
@@ -1100,17 +1151,12 @@ def _flash_masked_fwd_rule(q, k, v, key_mask, scale, layout="bshd"):
 def _flash_masked_bwd_rule(scale, layout, res, g):
     import numpy as np
     q, k, v, key_mask, out, lse = res
-    h_ax = 1 if layout == "bhsd" else 2
     d_mask = np.zeros(key_mask.shape, jax.dtypes.float0)
     if lse is not None:
         try:
-            hq, hkv = q.shape[h_ax], k.shape[h_ax]
-            ke, ve = _expand_gqa(q, k, v, layout)
             dq, dk, dv = flash_attention_padded_bwd(
-                q, ke, ve, out, lse, g, causal=False, scale=scale,
+                q, k, v, out, lse, g, causal=False, scale=scale,
                 key_mask=key_mask, layout=layout, interpret=_interpret())
-            if hq != hkv:
-                dk, dv = _gqa_reduce(dk, dv, hkv, layout)
             return dq, dk, dv, d_mask
         except Exception as e:
             _warn_fallback("flash_masked_bwd", e)

@@ -340,6 +340,13 @@ def moe_block(x: jax.Array, lp: Dict[str, jax.Array], cfg: MoeConfig,
         flat_g, inv_pos, inv_tok, probs = (
             checkpoint_name(t, "moe_routing")
             for t in (flat_g, inv_pos, inv_tok, probs))
+        # NOT the fused gather_mlp kernel (r5 negative result, measured
+        # standalone at flagship shapes: fused dispatch+gate/up 18.6 ms
+        # vs 16.4 ms for gather_rows + XLA einsums — the per-block row
+        # DMA does not hide under the per-step MXU work at bm=128, the
+        # largest block the weight-resident formulation can afford in
+        # scoped VMEM; kernels.moe_dispatch.gather_mlp keeps the kernel
+        # + tests as the documented experiment, VERDICT r4 next-4)
         expert_in = dispatch_gather(
             x.reshape(1, B * S, D).astype(cd), inv_tok, flat_g, k,
             True).reshape(E, B * C, D)
