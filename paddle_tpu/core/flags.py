@@ -63,5 +63,40 @@ define_flag("FLAGS_pallas_force", False,
             "treat Pallas as available regardless of host platform — for "
             "lowering-only tests (jax.export platforms=('tpu',) from a CPU "
             "host); programs run on CPU with this set will fail")
+
+
+# XLA flags the stack pins for at-scale training (SURVEY.md §7 hard-part
+# 6: async collectives hidden behind compute is the whole FSDP game at
+# 8+ chips). The v5e/v5p toolchain defaults already schedule async
+# collective fusions (tests/test_hlo_golden.py::TestAsyncOverlapGolden
+# asserts start/done pairs with compute between them on an AOT 8-chip
+# compile); these pins make the intent explicit and are what a launcher
+# should export into XLA_FLAGS / pass as compiler_options for multi-host
+# jobs.
+XLA_SCALE_FLAGS = {
+    "xla_tpu_enable_latency_hiding_scheduler": "true",
+    "xla_enable_async_all_gather": "true",
+    "xla_enable_async_collective_permute": "true",
+}
+
+
+def xla_scale_options():
+    """compiler_options dict for jax AOT .compile() (or `--xla_flags`
+    material) pinning the latency-hiding/async-collective behavior the
+    framework's sharding layouts assume at scale."""
+    return dict(XLA_SCALE_FLAGS)
+
+
+def apply_xla_scale_flags():
+    """Append the scale pins to XLA_FLAGS for processes that have not yet
+    initialized a backend (the launch CLI calls this before spawning
+    ranks). No-op for flags already present."""
+    import os
+    cur = os.environ.get("XLA_FLAGS", "")
+    for k, v in XLA_SCALE_FLAGS.items():
+        if k not in cur:
+            cur = f"{cur} --{k}={v}".strip()
+    os.environ["XLA_FLAGS"] = cur
+    return cur
 define_flag("FLAGS_allocator_strategy", "xla", "allocator is owned by XLA/PJRT on TPU")
 define_flag("FLAGS_cudnn_deterministic", False, "determinism toggle (XLA flag passthrough)")

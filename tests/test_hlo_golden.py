@@ -201,3 +201,60 @@ class TestExpertParallelGolden:
         c = collective_counts(txt)
         assert sum(c[k] for k in ("all-to-all", "all-gather",
                                   "collective-permute")) >= 1, c
+
+
+class TestAsyncOverlapGolden:
+    """VERDICT r4 next-7: compiled-HLO evidence that the sharded train
+    step OVERLAPS collectives with compute — not merely that collectives
+    exist. The module is AOT-compiled for a REAL 8-chip v5e topology
+    (chipless TpuAotCompiler), so the assertion runs against the actual
+    TPU scheduler: a serialized-all-gather regression (done immediately
+    after start, no compute between) fails this test."""
+
+    def _aot_topology(self):
+        try:
+            from jax.experimental import topologies
+            return topologies.get_topology_desc(platform="tpu",
+                                                topology_name="v5e:2x4")
+        except Exception as e:  # no libtpu / AOT support in this env
+            pytest.skip(f"TPU AOT topology unavailable: {e}")
+
+    def test_fsdp_tp_step_overlaps_collectives(self):
+        import re
+        from paddle_tpu.core.flags import xla_scale_options
+        topo = self._aot_topology()
+        mesh = build_mesh(sharding=4, mp=2, devices=list(topo.devices))
+        cfg = llama.LlamaConfig.tiny(use_flash=False)
+        params = jax.eval_shape(lambda k: llama.init_params(k, cfg),
+                                jax.ShapeDtypeStruct((2,), jnp.uint32))
+        ps = jax.tree.map(
+            lambda s, sp: jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=NamedSharding(mesh, sp)),
+            params, llama.param_specs(cfg),
+            is_leaf=lambda x: not isinstance(x, dict))
+        toks = jax.ShapeDtypeStruct(
+            (8, 64), jnp.int32,
+            sharding=NamedSharding(mesh, llama.batch_spec()))
+
+        fn = jax.jit(jax.grad(lambda p, t: llama.loss_fn(p, t, cfg, mesh)))
+        txt = fn.lower(ps, toks).compile(
+            compiler_options=xla_scale_options()).as_text()
+
+        lines = txt.splitlines()
+        starts = [i for i, l in enumerate(lines)
+                  if "async-collective-start" in l and "= " in l
+                  and "get-tuple-element" not in l]
+        assert starts, "no async collective starts in the scheduled module"
+        # at least one start/done window with real compute inside
+        overlapped = 0
+        for i in starts:
+            for j in range(i + 1, len(lines)):
+                if "async-collective-done" in lines[j]:
+                    between = lines[i + 1:j]
+                    if any(re.search(r"= \S+ (fusion|convolution)\(", b)
+                           for b in between):
+                        overlapped += 1
+                    break
+        assert overlapped >= 1, (
+            "async collective start/done pairs have no compute scheduled "
+            "between them — latency hiding regressed")
