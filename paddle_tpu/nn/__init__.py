@@ -34,7 +34,7 @@ from .layers_act_loss import (  # noqa: F401
     SmoothL1Loss, KLDivLoss, MarginRankingLoss, TripletMarginLoss,
     TripletMarginWithDistanceLoss, CosineEmbeddingLoss, HingeEmbeddingLoss,
     HuberLoss, SoftMarginLoss, MultiLabelSoftMarginLoss, MultiMarginLoss,
-    PoissonNLLLoss, GaussianNLLLoss, CTCLoss, AdaptiveLogSoftmaxWithLoss,
+    PoissonNLLLoss, GaussianNLLLoss, CTCLoss, RNNTLoss, AdaptiveLogSoftmaxWithLoss,
     HSigmoidLoss, GumbelSoftmax,
 )
 from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401

@@ -282,3 +282,48 @@ class TestLayerInfra:
     def test_sublayer_iteration(self):
         m = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
         assert len(m.sublayers()) == 3
+
+
+class TestRNNTLoss:
+    """paddle.nn.RNNTLoss (VERDICT r4 missing 4 — the last nn probe miss)
+    vs an independent numpy alpha-recursion reference."""
+
+    def _ref(self, logits, labels, il, ll, blank=0):
+        out = []
+        for b in range(logits.shape[0]):
+            lp = logits[b] - np.log(
+                np.exp(logits[b]).sum(-1, keepdims=True))
+            T, U = il[b], ll[b]
+            alpha = np.full((T, U + 1), -np.inf)
+            alpha[0, 0] = 0.0
+            for u in range(1, U + 1):
+                alpha[0, u] = alpha[0, u - 1] + lp[0, u - 1, labels[b, u - 1]]
+            for t in range(1, T):
+                alpha[t, 0] = alpha[t - 1, 0] + lp[t - 1, 0, blank]
+                for u in range(1, U + 1):
+                    alpha[t, u] = np.logaddexp(
+                        alpha[t - 1, u] + lp[t - 1, u, blank],
+                        alpha[t, u - 1] + lp[t, u - 1, labels[b, u - 1]])
+            out.append(-(alpha[T - 1, U] + lp[T - 1, U, blank]))
+        return np.array(out)
+
+    def test_matches_reference_and_grads(self):
+        import paddle_tpu as paddle
+        rng = np.random.default_rng(0)
+        B, T, U, V = 3, 7, 4, 6
+        logits = rng.standard_normal((B, T, U + 1, V)).astype("float32")
+        labels = rng.integers(1, V, (B, U)).astype("int32")
+        il = np.array([7, 5, 6], "int32")
+        ll = np.array([4, 2, 3], "int32")
+        lg = paddle.to_tensor(logits, stop_gradient=False)
+        loss = paddle.nn.functional.rnnt_loss(
+            lg, paddle.to_tensor(labels), paddle.to_tensor(il),
+            paddle.to_tensor(ll), fastemit_lambda=0.0, reduction="none")
+        np.testing.assert_allclose(np.asarray(loss.numpy()),
+                                   self._ref(logits, labels, il, ll),
+                                   rtol=1e-4)
+        crit = paddle.nn.RNNTLoss()   # default fastemit_lambda
+        out = crit(lg, paddle.to_tensor(labels), paddle.to_tensor(il),
+                   paddle.to_tensor(ll))
+        out.backward()
+        assert np.isfinite(np.asarray(lg.grad.numpy())).all()
