@@ -82,7 +82,9 @@ class LLMPredictor:
         from ..nlp import generation
         params, cfg = load_llm(config._prefix)
         self._cfg = cfg
+        self._config = config
         self._gen = dict(config._llm_gen or {})
+        self._paged_stats = None
         wo = getattr(config, "_llm_weight_only", None)
         if wo:
             # quantize at load (host arrays): Config.enable_weight_only —
@@ -145,6 +147,30 @@ class LLMPredictor:
                   eos_token_id=g.get("eos_token_id"),
                   pad_token_id=int(g.get("pad_token_id", 0)),
                   mesh=self._mesh)
+
+        paged = getattr(self._config, "_llm_paged", None)
+        if paged:
+            from ..nlp import paged as paged_mod
+            pad = kw["pad_token_id"]
+            pkw = dict(max_new_tokens=kw["max_new_tokens"],
+                       temperature=kw["temperature"], top_k=kw["top_k"],
+                       top_p=kw["top_p"], greedy=kw["greedy"],
+                       pad_token_id=pad,
+                       block_size=paged["block_size"],
+                       num_blocks=paged["num_blocks"])
+
+            def run_paged(params, ids, key):
+                import numpy as np
+                lengths = np.maximum(
+                    (np.asarray(ids) != pad).cumsum(1).max(1), 1)
+                out, alloc, owned = paged_mod.paged_generate(
+                    params, ids, lengths, self._cfg, key=key, **pkw)
+                self._paged_stats = alloc.stats()
+                for blocks in owned:   # request complete → blocks reusable
+                    alloc.free(blocks)
+                return out
+
+            return run_paged
 
         def run(params, ids, key):
             return generation.generate(params, ids, self._cfg, key=key, **kw)

@@ -1,0 +1,105 @@
+"""Paged KV-cache serving (VERDICT r4 missing 2): block-table cache over
+one shared pool, ragged batch admission, decode parity vs the dense path,
+and allocator-level pool-reuse evidence.
+
+Reference analog: upstream fused block_multihead_attention + PaddleNLP
+serving's block manager (upstream-canonical, unverified — SURVEY.md §0).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nlp import llama, generation, paged
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+class TestPagedGenerate:
+    def test_equal_lengths_match_dense_greedy(self, setup):
+        cfg, params = setup
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(1, 200, (3, 12)), jnp.int32)
+        dense = generation.generate(params, prompt, cfg, max_new_tokens=6,
+                                    greedy=True)
+        out, alloc, _ = paged.paged_generate(
+            params, prompt, np.full((3,), 12), cfg, max_new_tokens=6,
+            block_size=4)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(dense))
+
+    def test_mixed_lengths_match_per_request_dense(self, setup):
+        """Requests of DIFFERENT lengths decode in ONE paged batch and
+        match each request's individual dense run — the dense batch path
+        cannot admit this shape without re-padding."""
+        cfg, params = setup
+        rng = np.random.RandomState(1)
+        lens = [5, 9, 12]
+        pmax = max(lens)
+        rows = np.zeros((3, pmax), np.int64)
+        for i, L in enumerate(lens):
+            rows[i, :L] = rng.randint(1, 200, L)
+        out, alloc, _ = paged.paged_generate(
+            params, jnp.asarray(rows, jnp.int32), np.asarray(lens), cfg,
+            max_new_tokens=5, block_size=4)
+        for i, L in enumerate(lens):
+            single = generation.generate(
+                params, jnp.asarray(rows[None, i, :L], jnp.int32), cfg,
+                max_new_tokens=5, greedy=True)
+            np.testing.assert_array_equal(np.asarray(out[i]),
+                                          np.asarray(single[0]),
+                                          err_msg=f"request {i} (len {L})")
+
+    def test_pool_reuse_and_memory_analysis(self, setup):
+        """Completed requests' blocks are reused by later admissions; the
+        pool's high-water mark tracks the SUM of ragged lengths, not
+        B x T_max (the dense cache's footprint)."""
+        cfg, params = setup
+        block_size = 4
+        max_new = 4
+        lens = np.asarray([3, 7])
+        pmax, B = 7, 2
+        rows = np.zeros((B, pmax), np.int64)
+        rng = np.random.RandomState(2)
+        for i, L in enumerate(lens):
+            rows[i, :L] = rng.randint(1, 200, L)
+        # pool sized for exactly one ragged batch
+        per_req = -(-(lens.max() + max_new) // block_size)
+        alloc = paged.BlockAllocator(B * per_req)
+        out1, alloc, owned1 = paged.paged_generate(
+            params, jnp.asarray(rows, jnp.int32), lens, cfg,
+            max_new_tokens=max_new, block_size=block_size, allocator=alloc)
+        assert alloc.stats()["blocks_in_use"] == B * per_req
+        with pytest.raises(RuntimeError):   # pool full while batch 1 holds it
+            paged.build_table(alloc, lens, int(lens.max()) + max_new,
+                              block_size)
+        for blocks in owned1:               # batch 1 completes
+            alloc.free(blocks)
+        out2, alloc, owned2 = paged.paged_generate(
+            params, jnp.asarray(rows, jnp.int32), lens, cfg,
+            max_new_tokens=max_new, block_size=block_size, allocator=alloc)
+        stats = alloc.stats()
+        assert stats["reused_blocks"] >= B * per_req    # real pool reuse
+        assert stats["high_water_blocks"] == B * per_req
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+    def test_predictor_enable_paged_kv(self, setup, tmp_path):
+        from paddle_tpu import inference
+        from paddle_tpu.inference.llm import save_llm
+        cfg, params = setup
+        prefix = str(tmp_path / "m")
+        save_llm(prefix, params, cfg)
+        config = inference.Config(prefix)
+        config.enable_llm_generation(max_new_tokens=4, pad_token_id=0)
+        config.enable_paged_kv(block_size=4)
+        pred = inference.create_predictor(config)
+        rows = np.zeros((2, 8), np.int64)
+        rows[0, :8] = np.arange(1, 9)
+        rows[1, :5] = np.arange(1, 6)
+        out = pred.run([rows])[0]
+        assert out.shape == (2, 4)
+        assert pred._paged_stats["high_water_blocks"] > 0
