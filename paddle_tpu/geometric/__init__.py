@@ -179,8 +179,13 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
                              jnp.log(jnp.maximum(
                                  w[jnp.clip(start + pos, 0, n_edges - 1)],
                                  1e-30)), -jnp.inf)
-            g = jax.random.gumbel(k, (sample_size, max_deg))
-            pick = jnp.argmax(logw[None, :] + g, axis=1)
+            # ONE Gumbel perturbation per neighbor + top-k = weighted
+            # sampling WITHOUT replacement (Gumbel top-k trick) — the
+            # reference samples without replacement; per-slot independent
+            # draws (the r4 formulation) could return duplicate neighbors
+            # (ADVICE r4 item 1)
+            g = jax.random.gumbel(k, (max_deg,))
+            _, pick = jax.lax.top_k(logw + g, sample_size)
             neigh = rw[jnp.clip(start + pick, 0, n_edges - 1)]
             valid = jnp.arange(sample_size) < deg
             return (jnp.where(valid, neigh, -1),

@@ -180,10 +180,78 @@ def _ln_train_fwd(x, weight, bias, epsilon, use_pallas):
     from .flash_attention import _interpret
     affine = weight is not None
     if use_pallas and _use_pallas_ln(x):
-        out, mu, rstd = _ln_fwd_pallas(x, weight, bias, epsilon, affine,
-                                       interpret=_interpret())
+        d = x.shape[-1]
+        w_arr = weight if affine else jnp.ones((d,), x.dtype)
+        b_arr = bias if affine else jnp.zeros((d,), x.dtype)
+        out, mu, rstd = _ln_fwd_diffable(x, w_arr, b_arr, epsilon, affine,
+                                         _interpret())
         return out, (x, weight, mu, rstd)
     return layer_norm_ref(x, weight, bias, epsilon), (x, weight, None, None)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ln_fwd_diffable(x, weight, bias, epsilon, affine, interpret):
+    """The Pallas LN forward wrapped differentiable (see rms_norm's
+    _rms_fwd_diffable — the fwd rule's ops are differentiated in
+    grad-of-grad)."""
+    return _ln_fwd_pallas(x, weight, bias, epsilon, affine,
+                          interpret=interpret)
+
+
+def _ln_fwd_twin(x, weight, bias, epsilon, affine):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mu
+    rstd = jax.lax.rsqrt(jnp.mean(xc * xc, axis=-1, keepdims=True)
+                         + epsilon)
+    out = xc * rstd
+    if affine:
+        out = out * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return out.astype(x.dtype), mu.reshape(-1, 1), rstd.reshape(-1, 1)
+
+
+def _ln_fwd_diffable_fwd(x, weight, bias, epsilon, affine, interpret):
+    return (_ln_fwd_pallas(x, weight, bias, epsilon, affine,
+                           interpret=interpret), (x, weight, bias))
+
+
+def _ln_fwd_diffable_bwd(epsilon, affine, interpret, res, cots):
+    x, weight, bias = res
+    _, vjp = jax.vjp(
+        lambda x_, w_, b_: _ln_fwd_twin(x_, w_, b_, epsilon, affine),
+        x, weight, bias)
+    return vjp(cots)
+
+
+_ln_fwd_diffable.defvjp(_ln_fwd_diffable_fwd, _ln_fwd_diffable_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _ln_bwd_diffable(x, weight, mu, rstd, dy, eps, affine, interpret):
+    """Pallas LN backward wrapped DIFFERENTIABLE — double-grad/HVPs through
+    layer_norm_train previously hit the bare pallas_call (ADVICE r4
+    item 2); the second-order rule runs through the jnp twin (mu/rstd
+    are pure functions of x there, so their cotangents are zero)."""
+    return _ln_bwd_pallas(x, weight, mu, rstd, dy, affine,
+                          interpret=interpret)
+
+
+def _ln_bwd_diffable_fwd(x, weight, mu, rstd, dy, eps, affine, interpret):
+    return (_ln_bwd_pallas(x, weight, mu, rstd, dy, affine,
+                           interpret=interpret),
+            (x, weight, mu, rstd, dy))
+
+
+def _ln_bwd_diffable_bwd(eps, affine, interpret, res, cots):
+    x, weight, mu, rstd, dy = res
+    _, vjp = jax.vjp(
+        lambda x_, w_, dy_: _ln_ref_bwd(x_, w_, dy_, eps, affine),
+        x, weight, dy)
+    dx2, dw2, ddy = vjp(cots)
+    return dx2, dw2, jnp.zeros_like(mu), jnp.zeros_like(rstd), ddy
+
+
+_ln_bwd_diffable.defvjp(_ln_bwd_diffable_fwd, _ln_bwd_diffable_bwd)
 
 
 def _ln_train_bwd(epsilon, use_pallas, res, dy):
@@ -191,8 +259,9 @@ def _ln_train_bwd(epsilon, use_pallas, res, dy):
     x, weight, mu, rstd = res
     affine = weight is not None
     if mu is not None:
-        dx, dw, db = _ln_bwd_pallas(x, weight, mu, rstd, dy, affine,
-                                    interpret=_interpret())
+        w_arr = weight if affine else jnp.ones((x.shape[-1],), x.dtype)
+        dx, dw, db = _ln_bwd_diffable(x, w_arr, mu, rstd, dy, epsilon,
+                                      affine, _interpret())
     else:
         dx, dw, db = _ln_ref_bwd(x, weight, dy, epsilon, affine)
     if not affine:

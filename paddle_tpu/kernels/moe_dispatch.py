@@ -460,10 +460,19 @@ gather_scale_dot.defvjp(_gather_scale_dot_fwd, _gather_scale_dot_bwd)
 def combine_wsum(eout, idx_tk, w, inv_pos, use_pallas=True):
     """Fused MoE combine: y[b,t] = sum_j w[b,t,j] * eout[b, idx_tk[b,t,j]].
 
-    idx_tk [B, T, k]: PRE-CLIPPED slot id per (token, choice); w [B, T, k]
-    f32 gate probs with 0 at dropped choices. inv_pos [B, M] is the inverse
-    map (flat (t*k+j) position filling each slot, -1 = empty), consumed by
-    the backward only."""
+    CONTRACT (ADVICE r4 item 4 — the backward depends on it): callers
+    MUST pass idx_tk CLIPPED to valid range AND w PRE-ZEROED at dropped
+    choices, i.e. w = where(flat >= 0, probs, 0). The backward returns
+    d_w = 0 for empty/dropped slots, which is only correct under that
+    pre-zeroing — calling with RAW gate probs and clipped indices
+    silently produces wrong gate-prob gradients (the literal forward
+    would have d_w = dy·eout[0] there). Both moe_block branches honor
+    this; see w_tk construction in nlp/moe.py.
+
+    idx_tk [B, T, k]: pre-clipped slot id per (token, choice); w [B, T,
+    k] f32 gate probs with 0 at dropped choices. inv_pos [B, M] is the
+    inverse map (flat (t*k+j) position filling each slot, -1 = empty),
+    consumed by the backward only."""
     return gather_wsum(eout, idx_tk, w, use_pallas=use_pallas)
 
 

@@ -228,18 +228,77 @@ def rms_norm_train(x, weight, epsilon: float = 1e-6, use_pallas=True):
 def _rms_train_fwd(x, weight, epsilon, use_pallas):
     from .flash_attention import _interpret
     if use_pallas and _use_pallas_norm(x):
-        out, rstd = _rms_fwd_pallas(x, weight, epsilon,
-                                    interpret=_interpret())
+        out, rstd = _rms_fwd_diffable(x, weight, epsilon, _interpret())
         return out, (x, weight, rstd)
     return rms_norm_ref(x, weight, epsilon), (x, weight, None)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms_fwd_diffable(x, weight, epsilon, interpret):
+    """The Pallas forward wrapped differentiable: in grad-of-grad the
+    custom_vjp FWD RULE's ops land in the differentiated jaxpr, so the
+    bare pallas_call there also broke double-grad (ADVICE r4 item 2).
+    First-order still runs the fused kernel; differentiating through it
+    falls back to the jnp twin."""
+    return _rms_fwd_pallas(x, weight, epsilon, interpret=interpret)
+
+
+def _rms_fwd_twin(x, weight, epsilon):
+    xf = x.astype(jnp.float32)
+    rstd = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                         + epsilon)
+    out = (xf * rstd * weight.astype(jnp.float32)).astype(x.dtype)
+    return out, rstd.reshape(-1, 1)
+
+
+def _rms_fwd_diffable_fwd(x, weight, epsilon, interpret):
+    return (_rms_fwd_pallas(x, weight, epsilon, interpret=interpret),
+            (x, weight))
+
+
+def _rms_fwd_diffable_bwd(epsilon, interpret, res, cots):
+    x, weight = res
+    _, vjp = jax.vjp(lambda x_, w_: _rms_fwd_twin(x_, w_, epsilon),
+                     x, weight)
+    return vjp(cots)
+
+
+_rms_fwd_diffable.defvjp(_rms_fwd_diffable_fwd, _rms_fwd_diffable_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _rms_bwd_diffable(x, weight, rstd, dy, epsilon, interpret):
+    """The Pallas backward wrapped so it is itself differentiable:
+    double-grad/HVPs through the training stacks previously hit the bare
+    pallas_call (no transpose rule) and raised (ADVICE r4 item 2). The
+    second-order rule differentiates the jnp twin — rstd is a pure
+    function of x there, so its cotangent is zero by construction."""
+    return _rms_bwd_pallas(x, weight, rstd, dy, interpret=interpret)
+
+
+def _rms_bwd_diffable_fwd(x, weight, rstd, dy, epsilon, interpret):
+    return (_rms_bwd_pallas(x, weight, rstd, dy, interpret=interpret),
+            (x, weight, rstd, dy))
+
+
+def _rms_bwd_diffable_bwd(epsilon, interpret, res, cots):
+    x, weight, rstd, dy = res
+    _, vjp = jax.vjp(
+        lambda x_, w_, dy_: _rms_train_ref_bwd(x_, w_, dy_, epsilon),
+        x, weight, dy)
+    dx2, dw2, ddy = vjp(cots)
+    return dx2, dw2, jnp.zeros_like(rstd), ddy
+
+
+_rms_bwd_diffable.defvjp(_rms_bwd_diffable_fwd, _rms_bwd_diffable_bwd)
 
 
 def _rms_train_bwd(epsilon, use_pallas, res, dy):
     from .flash_attention import _interpret
     x, weight, rstd = res
     if rstd is not None:
-        dx, dw = _rms_bwd_pallas(x, weight, rstd, dy,
-                                 interpret=_interpret())
+        dx, dw = _rms_bwd_diffable(x, weight, rstd, dy, epsilon,
+                                   _interpret())
     else:
         dx, dw = _rms_train_ref_bwd(x, weight, dy, epsilon)
     return dx, dw

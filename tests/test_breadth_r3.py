@@ -575,7 +575,11 @@ class TestRound4OpTail:
         neigh, cnt = geometric.weighted_sample_neighbors(
             row, colptr, w, nodes, sample_size=2)
         assert int(cnt.numpy()[0]) == 2
-        assert (neigh.numpy() == 2).all()  # heavy edge dominates
+        # WITHOUT replacement (r5, ADVICE r4 item 1 — Gumbel top-k):
+        # both neighbors are returned exactly once, the heavy edge first
+        got = neigh.numpy()[0]
+        assert sorted(got.tolist()) == [1, 2]
+        assert got[0] == 2  # heavy edge wins the top slot
 
     def test_fused_gemm_epilogue_activations(self):
         import numpy as np

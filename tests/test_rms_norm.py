@@ -156,3 +156,61 @@ class TestRmsNormSharded:
         for a, b in zip(got_g, ref_g):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5)
+
+
+class TestNormDoubleGrad:
+    """ADVICE r4 item 2: double-grad/HVPs through the fused norm
+    backwards must not hit a bare pallas_call — the second-order rule
+    rides the jnp twin. Verified in interpret mode vs the pure-ref HVP."""
+
+    def test_rms_hvp_matches_ref(self):
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core import flags
+        from paddle_tpu.kernels.rms_norm import rms_norm_ref, rms_norm_train
+        x = jnp.asarray(np.random.RandomState(0).randn(8, 128), jnp.float32)
+        w = jnp.asarray(np.random.RandomState(1).rand(128), jnp.float32)
+        v = jnp.asarray(np.random.RandomState(2).randn(8, 128), jnp.float32)
+
+        def loss(fn, x_):
+            return jnp.sum(fn(x_, w) ** 2)
+
+        def hvp_of(fn):
+            # reverse-over-reverse (the tape's double-grad formulation)
+            g = jax.grad(lambda a: loss(fn, a))
+            return jax.grad(lambda a: jnp.vdot(g(a), v))(x)
+
+        flags.set_flags({"FLAGS_pallas_interpret": True})
+        try:
+            hvp = hvp_of(lambda p, q: rms_norm_train(p, q, 1e-6, True))
+        finally:
+            flags.set_flags({"FLAGS_pallas_interpret": False})
+        ref = hvp_of(lambda p, q: rms_norm_ref(p, q, 1e-6))
+        np.testing.assert_allclose(np.asarray(hvp), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_ln_hvp_matches_ref(self):
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core import flags
+        from paddle_tpu.kernels.layer_norm import (layer_norm_ref,
+                                                   layer_norm_train)
+        x = jnp.asarray(np.random.RandomState(3).randn(8, 128), jnp.float32)
+        w = jnp.asarray(np.random.RandomState(4).rand(128), jnp.float32)
+        b = jnp.asarray(np.random.RandomState(5).randn(128), jnp.float32)
+        v = jnp.asarray(np.random.RandomState(6).randn(8, 128), jnp.float32)
+
+        def hvp_of(fn):
+            g = jax.grad(lambda a: jnp.sum(fn(a, w, b) ** 2))
+            return jax.grad(lambda a: jnp.vdot(g(a), v))(x)
+
+        flags.set_flags({"FLAGS_pallas_interpret": True})
+        try:
+            hvp = hvp_of(layer_norm_train)
+        finally:
+            flags.set_flags({"FLAGS_pallas_interpret": False})
+        ref = hvp_of(layer_norm_ref)
+        np.testing.assert_allclose(np.asarray(hvp), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
