@@ -89,10 +89,14 @@ def xla_scale_options():
 
 def apply_xla_scale_flags():
     """Append the scale pins to XLA_FLAGS for processes that have not yet
-    initialized a backend (the launch CLI calls this before spawning
-    ranks). No-op for flags already present."""
+    initialized a backend (the launch CLI applies the same pins to its
+    children). No-op for flags already present, and SKIPPED entirely on
+    CPU-pinned processes — XLA:CPU's flag parser fatals on unknown
+    --xla_tpu_* flags."""
     import os
     cur = os.environ.get("XLA_FLAGS", "")
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        return cur
     for k, v in XLA_SCALE_FLAGS.items():
         if k not in cur:
             cur = f"{cur} --{k}={v}".strip()

@@ -68,3 +68,20 @@ def apply_rope_half(q, k, cos, sin, position_ids=None):
         return (x * c + rot_x * s).astype(x.dtype)
 
     return rot(q), rot(k)
+
+
+def apply_rope_half_bhsd(q, k, cos, sin):
+    """rotate_half over HEAD-MAJOR [B, H, S, D] tensors (the einsum-form
+    attention layout — r5; cos/sin broadcast over the head axis instead
+    of transposing activations into [B, S, H, D] and back)."""
+    def rot(x):
+        d = x.shape[-1]
+        c = jnp.concatenate([cos[: x.shape[2], : d // 2]] * 2,
+                            axis=-1)[None, None]
+        s = jnp.concatenate([sin[: x.shape[2], : d // 2]] * 2,
+                            axis=-1)[None, None]
+        half = d // 2
+        rx = jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+        return (x * c + rx * s).astype(x.dtype)
+
+    return rot(q), rot(k)
