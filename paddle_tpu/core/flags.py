@@ -87,19 +87,27 @@ def xla_scale_options():
     return dict(XLA_SCALE_FLAGS)
 
 
-def apply_xla_scale_flags():
-    """Append the scale pins to XLA_FLAGS for processes that have not yet
-    initialized a backend (the launch CLI applies the same pins to its
-    children). No-op for flags already present, and SKIPPED entirely on
-    CPU-pinned processes — XLA:CPU's flag parser fatals on unknown
-    --xla_tpu_* flags."""
-    import os
-    cur = os.environ.get("XLA_FLAGS", "")
-    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
-        return cur
+def merge_xla_scale_flags(xla_flags: str, jax_platforms: str) -> str:
+    """Merge the scale pins into an XLA_FLAGS string — ONLY when the
+    process explicitly targets TPU (JAX_PLATFORMS contains 'tpu').
+    XLA:CPU's flag parser FATALS on unknown --xla_tpu_* flags, and an
+    unset JAX_PLATFORMS may resolve to CPU on a TPU-less host, so the
+    pins require the explicit opt-in (multi-host TPU launchers set
+    JAX_PLATFORMS=tpu; jax.distributed environments generally do)."""
+    if "tpu" not in (jax_platforms or "").lower():
+        return xla_flags
     for k, v in XLA_SCALE_FLAGS.items():
-        if k not in cur:
-            cur = f"{cur} --{k}={v}".strip()
+        if k not in xla_flags:
+            xla_flags = f"{xla_flags} --{k}={v}".strip()
+    return xla_flags
+
+
+def apply_xla_scale_flags():
+    """Apply merge_xla_scale_flags to this process's environment (call
+    before any jax import/backend init)."""
+    import os
+    cur = merge_xla_scale_flags(os.environ.get("XLA_FLAGS", ""),
+                                os.environ.get("JAX_PLATFORMS", ""))
     os.environ["XLA_FLAGS"] = cur
     return cur
 define_flag("FLAGS_allocator_strategy", "xla", "allocator is owned by XLA/PJRT on TPU")

@@ -124,19 +124,12 @@ def _child_env(args, hb_file=None) -> dict:
     env = dict(os.environ)
     nnodes = int(str(args.nnodes).split(":")[0])
     # pin the latency-hiding/async-collective XLA behavior the sharding
-    # layouts assume at scale (core.flags.XLA_SCALE_FLAGS; the async-
-    # overlap HLO-golden asserts the resulting schedules). TPU-only:
-    # XLA:CPU parse_flags_from_env FATALS on unknown --xla_tpu_* flags,
-    # so CPU-pinned children (JAX_PLATFORMS=cpu — the local test rig)
-    # must not inherit them.
-    plats = env.get("JAX_PLATFORMS", "")
-    if "cpu" not in plats.lower():
-        from ...core.flags import XLA_SCALE_FLAGS
-        xf = env.get("XLA_FLAGS", "")
-        for k, v in XLA_SCALE_FLAGS.items():
-            if k not in xf:
-                xf = f"{xf} --{k}={v}".strip()
-        env["XLA_FLAGS"] = xf
+    # layouts assume at scale (core.flags.merge_xla_scale_flags — applied
+    # only when the child explicitly targets TPU; the async-overlap
+    # HLO-golden asserts the resulting schedules)
+    from ...core.flags import merge_xla_scale_flags
+    env["XLA_FLAGS"] = merge_xla_scale_flags(
+        env.get("XLA_FLAGS", ""), env.get("JAX_PLATFORMS", ""))
     env["PADDLE_TRAINERS_NUM"] = str(nnodes)
     env["PADDLE_TRAINER_ID"] = str(args.rank)
     if hb_file:

@@ -147,8 +147,8 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
                               name=None):
     """paddle.geometric.weighted_sample_neighbors: like sample_neighbors
     but each neighbor is drawn with probability proportional to its edge
-    weight (static-shape: WITH replacement via per-slot Gumbel draws over
-    the node's weighted neighbor window, -1 padding past the degree).
+    weight (static-shape: WITHOUT replacement via Gumbel top-k over the
+    node's weighted neighbor window, -1 padding past the degree).
     The Gumbel table is bounded by the graph's MAX DEGREE (computed from
     the concrete colptr before tracing), not the edge count — memory is
     O(nodes * sample_size * max_degree)."""
@@ -185,9 +185,15 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
             # draws (the r4 formulation) could return duplicate neighbors
             # (ADVICE r4 item 1)
             g = jax.random.gumbel(k, (max_deg,))
-            _, pick = jax.lax.top_k(logw + g, sample_size)
+            # top_k is capped at max_deg (k > axis size raises); slots
+            # past the cap pad with -1 like slots past the degree
+            kk = min(sample_size, max_deg)
+            _, pick = jax.lax.top_k(logw + g, kk)
+            pick = jnp.concatenate(
+                [pick, jnp.zeros((sample_size - kk,), pick.dtype)]) \
+                if kk < sample_size else pick
             neigh = rw[jnp.clip(start + pick, 0, n_edges - 1)]
-            valid = jnp.arange(sample_size) < deg
+            valid = jnp.arange(sample_size) < jnp.minimum(deg, kk)
             return (jnp.where(valid, neigh, -1),
                     jnp.minimum(deg, sample_size))
 

@@ -85,6 +85,7 @@ class LLMPredictor:
         self._config = config
         self._gen = dict(config._llm_gen or {})
         self._paged_stats = None
+        self._paged_alloc = None
         wo = getattr(config, "_llm_weight_only", None)
         if wo:
             # quantize at load (host arrays): Config.enable_weight_only —
@@ -163,8 +164,27 @@ class LLMPredictor:
                 import numpy as np
                 lengths = np.maximum(
                     (np.asarray(ids) != pad).cumsum(1).max(1), 1)
+                # ONE allocator persists across run() calls — later
+                # admissions reuse the blocks earlier batches freed
+                # (stats()["reused_blocks"] is the evidence). A batch
+                # larger than everything seen so far grows the pool.
+                B = ids.shape[0]
+                bs = pkw["block_size"]
+                need = B * -(-(int(lengths.max())
+                               + pkw["max_new_tokens"]) // bs)
+                alloc = self._paged_alloc
+                if alloc is None or alloc.num_blocks < need:
+                    cap = pkw["num_blocks"] or need
+                    if cap < need:
+                        raise ValueError(
+                            f"enable_paged_kv(num_blocks={cap}) too small "
+                            f"for this batch (needs {need} blocks)")
+                    alloc = self._paged_alloc = (
+                        paged_mod.BlockAllocator(cap))
                 out, alloc, owned = paged_mod.paged_generate(
-                    params, ids, lengths, self._cfg, key=key, **pkw)
+                    params, ids, lengths, self._cfg, key=key,
+                    allocator=alloc,
+                    **{k: v for k, v in pkw.items() if k != "num_blocks"})
                 self._paged_stats = alloc.stats()
                 for blocks in owned:   # request complete → blocks reusable
                     alloc.free(blocks)

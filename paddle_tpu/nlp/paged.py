@@ -71,7 +71,8 @@ class BlockAllocator:
         if n > len(self._free):
             raise RuntimeError(
                 f"pool exhausted: need {n} blocks, {len(self._free)} free")
-        blocks = [self._free.pop(0) for _ in range(n)]
+        blocks = self._free[:n]
+        del self._free[:n]
         self.reused_blocks += sum(1 for b in blocks if b in self._ever_used)
         self._ever_used.update(blocks)
         self.high_water = max(self.high_water,
@@ -180,7 +181,9 @@ def forward_paged(params, tokens, cache: PagedKVCache, positions, valid,
     (logits [B, P, V] f32, cache'). visible_len for decode = position+1
     (the just-written token included)."""
     cd = cfg.dtype
-    T_rope = cache.k.shape[1] * cache.k.shape[2]
+    # rope spans the per-request table width (max reachable position),
+    # NOT the whole pool — the pool is ~B x larger by construction
+    T_rope = cache.table.shape[1] * cache.k.shape[2]
     x = jnp.take(params["embed_tokens"], tokens, axis=0).astype(cd)
     cos, sin = rope_freqs(cfg.head_dim, T_rope, cfg.rope_theta, jnp.float32)
     visible_len = positions[:, -1] + 1
@@ -220,8 +223,9 @@ def paged_generate(params, tokens, lengths, cfg: llama.LlamaConfig,
 
     tokens [B, P_max] right-padded prompts; lengths [B] real prompt
     lengths (REQUESTS MAY DIFFER — the dense generate() cannot).
-    Returns ([B, max_new_tokens] generated ids, allocator) — the pool
-    blocks stay owned by the caller's allocator for free()/reuse.
+    Returns (ids [B, max_new_tokens], allocator, owned) — `owned` is the
+    per-request block lists; free them back to the allocator when each
+    request completes so later admissions reuse the pool.
     """
     import numpy as np
     B, P = tokens.shape

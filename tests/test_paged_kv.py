@@ -103,3 +103,8 @@ class TestPagedGenerate:
         out = pred.run([rows])[0]
         assert out.shape == (2, 4)
         assert pred._paged_stats["high_water_blocks"] > 0
+        # the allocator persists across run() calls: the second request
+        # batch reuses the blocks the first released
+        out2 = pred.run([rows])[0]
+        np.testing.assert_array_equal(out2, out)
+        assert pred._paged_stats["reused_blocks"] > 0
