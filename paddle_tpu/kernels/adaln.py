@@ -1,0 +1,246 @@
+"""Fused adaLN: affine-free LayerNorm + per-sample modulation in one pass.
+
+Reference analog: the DiT/adaLN-Zero modulation chains (PaddleMIX DiT —
+upstream-canonical, unverified, SURVEY.md §0) around phi's fused
+layer_norm. The r5 DiT xplane put ~100-130 ms/step into the f32 LN +
+modulate elementwise chains (README round-5 DiT accounting names this
+kernel as the next lever): XLA materializes the f32 normalized tensor
+between the norm and the [B, D]-broadcast modulate. This kernel computes
+
+    y = ((x - mu) * rsqrt(var + eps)) * (1 + scale_b) + shift_b
+
+in one VMEM pass (scale/shift are PER SAMPLE [B, D], broadcast over the
+token axis), saving (mu, rstd) as residuals, with a one-pass backward
+producing dx and the per-sample dscale/dshift accumulated across token
+blocks. Twice-differentiable via the jnp-twin pattern (see
+kernels/rms_norm.py — both the fwd and bwd pallas calls fall back to the
+twin when differentiated through).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def adaln_ref(x, shift, scale, epsilon: float = 1e-6):
+    """jnp reference: x [B, N, D]; shift/scale [B, D]."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    xhat = (xf - mu) * jax.lax.rsqrt(var + epsilon)
+    out = xhat * (1.0 + scale.astype(jnp.float32)[:, None]) \
+        + shift.astype(jnp.float32)[:, None]
+    return out.astype(x.dtype)
+
+
+def _blk_tokens(d: int) -> int:
+    return 128 if d >= 4096 else 256
+
+
+def _adaln_fwd_kernel(x_ref, sh_ref, sc_ref, o_ref, mu_ref, r_ref, *, eps):
+    x = x_ref[0].astype(jnp.float32)                      # [bn, D]
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    r = jax.lax.rsqrt(jnp.mean(xc * xc, axis=-1, keepdims=True) + eps)
+    w = 1.0 + sc_ref[0, 0].astype(jnp.float32)            # [D]
+    out = (xc * r) * w[None, :] + sh_ref[0, 0].astype(jnp.float32)[None, :]
+    o_ref[0] = out.astype(o_ref.dtype)
+    mu_ref[0] = mu
+    r_ref[0] = r
+
+
+def _adaln_bwd_kernel(x_ref, sc_ref, mu_ref, r_ref, dy_ref, dx_ref,
+                      dsh_ref, dsc_ref, *, d):
+    from jax.experimental import pallas as pl
+
+    x = x_ref[0].astype(jnp.float32)
+    dy = dy_ref[0].astype(jnp.float32)
+    mu = mu_ref[0]
+    r = r_ref[0]
+    xhat = (x - mu) * r
+    w = 1.0 + sc_ref[0, 0].astype(jnp.float32)
+    dyw = dy * w[None, :]
+    m1 = jnp.mean(dyw, axis=-1, keepdims=True)
+    m2 = jnp.mean(dyw * xhat, axis=-1, keepdims=True)
+    dx_ref[0] = (r * (dyw - m1 - xhat * m2)).astype(dx_ref.dtype)
+    dsc_part = jnp.sum(dy * xhat, axis=0, keepdims=True)[None]  # [1,1,D]
+    dsh_part = jnp.sum(dy, axis=0, keepdims=True)[None]
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        dsc_ref[...] = dsc_part
+        dsh_ref[...] = dsh_part
+
+    @pl.when(pl.program_id(1) != 0)
+    def _acc():
+        dsc_ref[...] += dsc_part
+        dsh_ref[...] += dsh_part
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def _adaln_fwd_pallas(x, shift, scale, eps, interpret=False):
+    from jax.experimental import pallas as pl
+
+    B, N, D = x.shape
+    bn = _blk_tokens(D)
+    while N % bn:
+        bn //= 2
+    grid = (B, N // bn)
+    with jax.enable_x64(False):
+        out, mu, rstd = pl.pallas_call(
+            functools.partial(_adaln_fwd_kernel, eps=eps),
+            grid=grid,
+            in_specs=[pl.BlockSpec((1, bn, D), lambda b, nb: (b, nb, 0)),
+                      pl.BlockSpec((1, 1, D), lambda b, nb: (b, 0, 0)),
+                      pl.BlockSpec((1, 1, D), lambda b, nb: (b, 0, 0))],
+            out_specs=[pl.BlockSpec((1, bn, D), lambda b, nb: (b, nb, 0)),
+                       pl.BlockSpec((1, bn, 1), lambda b, nb: (b, nb, 0)),
+                       pl.BlockSpec((1, bn, 1), lambda b, nb: (b, nb, 0))],
+            out_shape=[jax.ShapeDtypeStruct((B, N, D), x.dtype),
+                       jax.ShapeDtypeStruct((B, N, 1), jnp.float32),
+                       jax.ShapeDtypeStruct((B, N, 1), jnp.float32)],
+            interpret=interpret,
+        )(x, shift.reshape(B, 1, D), scale.reshape(B, 1, D))
+    return out, mu, rstd
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _adaln_bwd_pallas(x, scale, mu, rstd, dy, interpret=False):
+    from jax.experimental import pallas as pl
+
+    B, N, D = x.shape
+    bn = _blk_tokens(D)
+    while N % bn:
+        bn //= 2
+    grid = (B, N // bn)
+    with jax.enable_x64(False):
+        dx, dsh, dsc = pl.pallas_call(
+            functools.partial(_adaln_bwd_kernel, d=D),
+            grid=grid,
+            in_specs=[pl.BlockSpec((1, bn, D), lambda b, nb: (b, nb, 0)),
+                      pl.BlockSpec((1, 1, D), lambda b, nb: (b, 0, 0)),
+                      pl.BlockSpec((1, bn, 1), lambda b, nb: (b, nb, 0)),
+                      pl.BlockSpec((1, bn, 1), lambda b, nb: (b, nb, 0)),
+                      pl.BlockSpec((1, bn, D), lambda b, nb: (b, nb, 0))],
+            out_specs=[pl.BlockSpec((1, bn, D), lambda b, nb: (b, nb, 0)),
+                       pl.BlockSpec((1, 1, D), lambda b, nb: (b, 0, 0)),
+                       pl.BlockSpec((1, 1, D), lambda b, nb: (b, 0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((B, N, D), x.dtype),
+                       jax.ShapeDtypeStruct((B, 1, D), jnp.float32),
+                       jax.ShapeDtypeStruct((B, 1, D), jnp.float32)],
+            interpret=interpret,
+        )(x, scale.reshape(B, 1, D), mu, rstd, dy)
+    return dx, dsh.reshape(B, D), dsc.reshape(B, D)
+
+
+def _adaln_ref_bwd(x, scale, dy, eps):
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mu
+    r = jax.lax.rsqrt(jnp.mean(xc * xc, axis=-1, keepdims=True) + eps)
+    xhat = xc * r
+    w = 1.0 + scale.astype(jnp.float32)[:, None]
+    dyw = dyf * w
+    m1 = jnp.mean(dyw, axis=-1, keepdims=True)
+    m2 = jnp.mean(dyw * xhat, axis=-1, keepdims=True)
+    dx = (r * (dyw - m1 - xhat * m2)).astype(x.dtype)
+    dsc = jnp.sum(dyf * xhat, axis=1)
+    dsh = jnp.sum(dyf, axis=1)
+    return dx, dsh, dsc
+
+
+def _use_pallas_adaln(x):
+    from .flash_attention import _use_pallas
+    return _use_pallas(x) and x.shape[-1] % 128 == 0 and x.ndim == 3
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def adaln_modulate(x, shift, scale, epsilon: float = 1e-6):
+    """Fused LN+modulate: x [B, N, D]; shift/scale [B, D] (per sample).
+    Matches adaln_ref in value; Pallas on TPU, jnp elsewhere."""
+    from .flash_attention import _interpret
+    if _use_pallas_adaln(x):
+        return _adaln_fwd_pallas(x, shift, scale, epsilon,
+                                 interpret=_interpret())[0]
+    return adaln_ref(x, shift, scale, epsilon)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _adaln_fwd_diffable(x, shift, scale, epsilon, interpret):
+    return _adaln_fwd_pallas(x, shift, scale, epsilon, interpret=interpret)
+
+
+def _adaln_fwd_twin(x, shift, scale, epsilon):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mu
+    rstd = jax.lax.rsqrt(jnp.mean(xc * xc, axis=-1, keepdims=True)
+                         + epsilon)
+    out = (xc * rstd) * (1.0 + scale.astype(jnp.float32)[:, None]) \
+        + shift.astype(jnp.float32)[:, None]
+    return out.astype(x.dtype), mu, rstd
+
+
+def _adaln_fwd_diffable_fwd(x, shift, scale, epsilon, interpret):
+    return (_adaln_fwd_pallas(x, shift, scale, epsilon,
+                              interpret=interpret), (x, shift, scale))
+
+
+def _adaln_fwd_diffable_bwd(epsilon, interpret, res, cots):
+    x, shift, scale = res
+    _, vjp = jax.vjp(
+        lambda x_, sh_, sc_: _adaln_fwd_twin(x_, sh_, sc_, epsilon),
+        x, shift, scale)
+    return vjp(cots)
+
+
+_adaln_fwd_diffable.defvjp(_adaln_fwd_diffable_fwd, _adaln_fwd_diffable_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _adaln_bwd_diffable(x, scale, mu, rstd, dy, epsilon, interpret):
+    return _adaln_bwd_pallas(x, scale, mu, rstd, dy, interpret=interpret)
+
+
+def _adaln_bwd_diffable_fwd(x, scale, mu, rstd, dy, epsilon, interpret):
+    return (_adaln_bwd_pallas(x, scale, mu, rstd, dy, interpret=interpret),
+            (x, scale, mu, rstd, dy))
+
+
+def _adaln_bwd_diffable_bwd(epsilon, interpret, res, cots):
+    x, scale, mu, rstd, dy = res
+    _, vjp = jax.vjp(
+        lambda x_, sc_, dy_: _adaln_ref_bwd(x_, sc_, dy_, epsilon),
+        x, scale, dy)
+    dx2, dsc2, ddy = vjp(cots)
+    return dx2, dsc2, jnp.zeros_like(mu), jnp.zeros_like(rstd), ddy
+
+
+_adaln_bwd_diffable.defvjp(_adaln_bwd_diffable_fwd, _adaln_bwd_diffable_bwd)
+
+
+def _adaln_fwd(x, shift, scale, epsilon):
+    from .flash_attention import _interpret
+    if _use_pallas_adaln(x):
+        out, mu, rstd = _adaln_fwd_diffable(x, shift, scale, epsilon,
+                                            _interpret())
+        return out, (x, shift, scale, mu, rstd)
+    return adaln_ref(x, shift, scale, epsilon), (x, shift, scale, None,
+                                                 None)
+
+
+def _adaln_bwd(epsilon, res, dy):
+    from .flash_attention import _interpret
+    x, shift, scale, mu, rstd = res
+    if mu is not None:
+        dx, dsh, dsc = _adaln_bwd_diffable(x, scale, mu, rstd, dy,
+                                           epsilon, _interpret())
+    else:
+        dx, dsh, dsc = _adaln_ref_bwd(x, scale, dy, epsilon)
+    return dx, dsh.astype(shift.dtype), dsc.astype(scale.dtype)
+
+
+adaln_modulate.defvjp(_adaln_fwd, _adaln_bwd)
