@@ -29,6 +29,7 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, List, NamedTuple, Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -227,7 +228,6 @@ def paged_generate(params, tokens, lengths, cfg: llama.LlamaConfig,
     per-request block lists; free them back to the allocator when each
     request completes so later admissions reuse the pool.
     """
-    import numpy as np
     B, P = tokens.shape
     lengths_np = np.asarray(lengths)
     max_total = int(lengths_np.max()) + max_new_tokens
@@ -270,3 +270,153 @@ def paged_generate(params, tokens, lengths, cfg: llama.LlamaConfig,
     out = jnp.concatenate([first[:, None], rest.T.astype(jnp.int32)],
                           axis=1)
     return out, allocator, owned
+
+
+class ContinuousBatcher:
+    """Continuous batching over the shared block pool (reference analog:
+    PaddleNLP serving's in-flight batching over the block cache — pulled
+    forward from the VERDICT r4 next-8 'r6 follow-up').
+
+    Host-side scheduler over compiled device steps: a fixed set of B
+    batch slots decodes in lock-step chunks; when a request finishes
+    (eos or budget) its blocks return to the allocator and a queued
+    request is admitted into the free slot by a single-slot prefill —
+    decode of the other slots never re-pads or re-compiles (shapes are
+    static: the chunk step compiles once per (B, M)).
+
+    Usage:
+        cb = ContinuousBatcher(params, cfg, max_batch=2, block_size=16,
+                               max_total_len=256, max_new_tokens=16)
+        rid = cb.submit([tok, tok, ...])
+        cb.run()              # drain queue + in-flight
+        out = cb.outputs[rid] # list of generated ids
+    """
+
+    def __init__(self, params, cfg, max_batch: int, block_size: int,
+                 max_total_len: int, max_new_tokens: int,
+                 eos_token_id: Optional[int] = None,
+                 num_blocks: Optional[int] = None, chunk: int = 8):
+        self.params, self.cfg = params, cfg
+        self.B, self.bs = max_batch, block_size
+        self.M = -(-max_total_len // block_size)
+        self.max_new = max_new_tokens
+        self.eos = eos_token_id
+        self.chunk = chunk
+        nb = num_blocks or (max_batch * self.M)
+        self.alloc = BlockAllocator(nb)
+        kp, vp = init_pool(cfg, nb, block_size)
+        self.cache = PagedKVCache(
+            kp, vp, jnp.zeros((max_batch, self.M), jnp.int32),
+            jnp.zeros((max_batch,), jnp.int32))
+        self.active = [False] * max_batch
+        self.slot_req: List[Optional[int]] = [None] * max_batch
+        self.slot_blocks: List[Optional[List[int]]] = [None] * max_batch
+        self.budget = [0] * max_batch
+        self.cur_tok = jnp.zeros((max_batch,), jnp.int32)
+        self.queue: List = []
+        self.outputs: Dict[int, List[int]] = {}
+        self._next_rid = 0
+        self._chunk_fn = None
+
+    def submit(self, tokens) -> int:
+        rid = self._next_rid
+        self._next_rid += 1
+        self.queue.append((rid, list(map(int, tokens))))
+        self.outputs[rid] = []
+        return rid
+
+    # -- internals --------------------------------------------------------
+    def _admit_one(self, slot: int, rid: int, toks: List[int]) -> None:
+        P = len(toks)
+        need = -(-(P + self.max_new) // self.bs)
+        blocks = self.alloc.allocate(need) + [0] * (self.M - need)
+        table = self.cache.table.at[slot].set(
+            jnp.asarray(blocks, jnp.int32))
+        row = jnp.asarray(toks, jnp.int32)[None]
+        positions = jnp.arange(P)[None]
+        sub = PagedKVCache(self.cache.k, self.cache.v, table[slot:slot + 1],
+                           self.cache.lengths[slot:slot + 1])
+        logits, sub = forward_paged(
+            self.params, row, sub, positions, jnp.ones((1, P), bool),
+            self.cfg, is_prefill=True)
+        first = int(jnp.argmax(logits[0, P - 1]))
+        self.cache = PagedKVCache(
+            sub.k, sub.v, table,
+            self.cache.lengths.at[slot].set(P))
+        self.cur_tok = self.cur_tok.at[slot].set(first)
+        self.active[slot] = True
+        self.slot_req[slot] = rid
+        self.slot_blocks[slot] = blocks[:need]
+        self.budget[slot] = self.max_new - 1
+        self.outputs[rid].append(first)
+        if self.eos is not None and first == self.eos:
+            self._retire(slot)
+
+    def _retire(self, slot: int) -> None:
+        self.alloc.free(self.slot_blocks[slot])
+        self.active[slot] = False
+        self.slot_req[slot] = None
+        self.slot_blocks[slot] = None
+
+    def _admit(self) -> None:
+        for slot in range(self.B):
+            if not self.active[slot] and self.queue:
+                rid, toks = self.queue.pop(0)
+                self._admit_one(slot, rid, toks)
+
+    def _build_chunk(self):
+        cfg, chunk = self.cfg, self.chunk
+
+        def run_chunk(params, cache, tok, active, lengths):
+            def step(carry, _):
+                cache, tok, lengths = carry
+                pos = lengths[:, None]
+                logits, cache = forward_paged(
+                    params, tok[:, None], cache, pos, active[:, None],
+                    cfg, is_prefill=False)
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                nxt = jnp.where(active, nxt, tok)
+                lengths = lengths + active.astype(jnp.int32)
+                # inactive slots must not drift: pin lengths ourselves
+                cache = cache._replace(lengths=lengths)
+                return (cache, nxt, lengths), nxt
+
+            (cache, tok, lengths), toks = jax.lax.scan(
+                step, (cache, tok, lengths), None, length=chunk)
+            return cache, tok, lengths, toks.T     # [B, chunk]
+
+        return jax.jit(run_chunk)
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drain the queue and all in-flight requests (greedy decode)."""
+        if self._chunk_fn is None:
+            self._chunk_fn = self._build_chunk()
+        self._admit()
+        while any(self.active) or self.queue:
+            active = jnp.asarray(self.active)
+            self.cache, self.cur_tok, lengths, toks = self._chunk_fn(
+                self.params, self.cache, self.cur_tok, active,
+                self.cache.lengths)
+            self.cache = self.cache._replace(lengths=lengths)
+            toks = np.asarray(toks)
+            for slot in range(self.B):
+                if not self.active[slot]:
+                    continue
+                rid = self.slot_req[slot]
+                for j in range(self.chunk):
+                    if self.budget[slot] <= 0:
+                        break
+                    t = int(toks[slot, j])
+                    self.outputs[rid].append(t)
+                    self.budget[slot] -= 1
+                    if self.eos is not None and t == self.eos:
+                        break
+                done = (self.budget[slot] <= 0 or
+                        (self.eos is not None and
+                         self.outputs[rid] and
+                         self.outputs[rid][-1] == self.eos))
+                if done:
+                    self._retire(slot)
+            self._admit()
+        return self.outputs
+
