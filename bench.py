@@ -84,7 +84,7 @@ def run_config(cfg, batch, seq, timed_steps, state_quant=None,
             "params": llama.num_params(cfg)}
 
 
-def run_moe(batch=20, seq=2048, timed_steps=6):
+def run_moe(batch=20, seq=2048, timed_steps=10):
     """BASELINE config 4 (DeepSeekMoE/Qwen2-MoE-class EP workload) on one
     chip: a ~1.6B-total / ~0.5B-active DeepSeek-style MoE (16 experts
     top-2 + 1 shared, index-form GShard routing with the Pallas ragged
