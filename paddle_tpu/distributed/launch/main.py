@@ -53,6 +53,15 @@ def _parse(argv):
                         "(startup: imports + XLA compile routinely take "
                         "minutes); the steady-state timeout applies only "
                         "after the worker's first beat")
+    p.add_argument("--xla_scale_flags", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="pin the latency-hiding/async-collective XLA "
+                        "flags into the trainers' XLA_FLAGS "
+                        "(core.flags.XLA_SCALE_FLAGS). auto = only when "
+                        "JAX_PLATFORMS explicitly targets tpu (unset "
+                        "could resolve to CPU, whose flag parser fatals "
+                        "on --xla_tpu_*); on = always (TPU pods where "
+                        "JAX autodetects); off = never")
     p.add_argument("--job_id", type=str, default="default")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -124,12 +133,16 @@ def _child_env(args, hb_file=None) -> dict:
     env = dict(os.environ)
     nnodes = int(str(args.nnodes).split(":")[0])
     # pin the latency-hiding/async-collective XLA behavior the sharding
-    # layouts assume at scale (core.flags.merge_xla_scale_flags — applied
-    # only when the child explicitly targets TPU; the async-overlap
-    # HLO-golden asserts the resulting schedules)
-    from ...core.flags import merge_xla_scale_flags
-    env["XLA_FLAGS"] = merge_xla_scale_flags(
-        env.get("XLA_FLAGS", ""), env.get("JAX_PLATFORMS", ""))
+    # layouts assume at scale (core.flags.merge_xla_scale_flags; the
+    # async-overlap HLO-golden asserts the resulting schedules).
+    # --xla_scale_flags on forces the pins for TPU pods that rely on
+    # JAX autodetection (auto only trusts an explicit JAX_PLATFORMS=tpu)
+    mode = getattr(args, "xla_scale_flags", "auto")
+    if mode != "off":
+        from ...core.flags import merge_xla_scale_flags
+        env["XLA_FLAGS"] = merge_xla_scale_flags(
+            env.get("XLA_FLAGS", ""),
+            "tpu" if mode == "on" else env.get("JAX_PLATFORMS", ""))
     env["PADDLE_TRAINERS_NUM"] = str(nnodes)
     env["PADDLE_TRAINER_ID"] = str(args.rank)
     if hb_file:

@@ -361,29 +361,47 @@ class ContinuousBatcher:
     def _admit(self) -> None:
         for slot in range(self.B):
             if not self.active[slot] and self.queue:
+                need = -(-(len(self.queue[0][1]) + self.max_new) // self.bs)
+                if need > len(self.alloc._free):
+                    if not any(self.active):
+                        # nothing in flight will ever free blocks
+                        raise RuntimeError(
+                            f"request needs {need} blocks but the pool "
+                            f"holds only {self.alloc.num_blocks} — size "
+                            f"num_blocks for the largest single request")
+                    return          # defer until a request retires
                 rid, toks = self.queue.pop(0)
                 self._admit_one(slot, rid, toks)
 
     def _build_chunk(self):
         cfg, chunk = self.cfg, self.chunk
+        eos = -1 if self.eos is None else int(self.eos)
 
-        def run_chunk(params, cache, tok, active, lengths):
+        def run_chunk(params, cache, tok, active, lengths, budget):
             def step(carry, _):
-                cache, tok, lengths = carry
+                cache, tok, lengths, budget, act = carry
                 pos = lengths[:, None]
                 logits, cache = forward_paged(
-                    params, tok[:, None], cache, pos, active[:, None],
+                    params, tok[:, None], cache, pos, act[:, None],
                     cfg, is_prefill=False)
                 nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-                nxt = jnp.where(active, nxt, tok)
-                lengths = lengths + active.astype(jnp.int32)
+                nxt = jnp.where(act, nxt, tok)
+                lengths = lengths + act.astype(jnp.int32)
+                budget = budget - act.astype(jnp.int32)
+                # deactivate ON DEVICE the moment a slot's budget runs
+                # out or it emits eos — a fixed-size chunk must not keep
+                # writing past the slot's ALLOCATED blocks (the table
+                # row's padding points at block 0, i.e. someone else's
+                # cache)
+                act = act & (budget > 0) & (nxt != eos)
                 # inactive slots must not drift: pin lengths ourselves
                 cache = cache._replace(lengths=lengths)
-                return (cache, nxt, lengths), nxt
+                return (cache, nxt, lengths, budget, act), nxt
 
-            (cache, tok, lengths), toks = jax.lax.scan(
-                step, (cache, tok, lengths), None, length=chunk)
-            return cache, tok, lengths, toks.T     # [B, chunk]
+            (cache, tok, lengths, budget, act), toks = jax.lax.scan(
+                step, (cache, tok, lengths, budget, active), None,
+                length=chunk)
+            return cache, tok, lengths, budget, toks.T     # [B, chunk]
 
         return jax.jit(run_chunk)
 
@@ -394,9 +412,10 @@ class ContinuousBatcher:
         self._admit()
         while any(self.active) or self.queue:
             active = jnp.asarray(self.active)
-            self.cache, self.cur_tok, lengths, toks = self._chunk_fn(
+            budget = jnp.asarray(self.budget, jnp.int32)
+            self.cache, self.cur_tok, lengths, _, toks = self._chunk_fn(
                 self.params, self.cache, self.cur_tok, active,
-                self.cache.lengths)
+                self.cache.lengths, budget)
             self.cache = self.cache._replace(lengths=lengths)
             toks = np.asarray(toks)
             for slot in range(self.B):

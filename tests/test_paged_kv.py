@@ -152,3 +152,52 @@ class TestContinuousBatching:
         out = cb.run()
         assert out[r1] == [eos]          # stopped at eos immediately
         assert len(out[r2]) >= 1         # second request got the slot
+
+    def test_chunk_overrun_does_not_corrupt_neighbor(self, setup):
+        """A fixed-size chunk much larger than a request's budget must
+        deactivate the slot ON DEVICE — continuing to write would spill
+        through the table row's padding into block 0 (another request's
+        cache). Regression: the first-admitted request's output must
+        still match its dense run while sharing the pool."""
+        cfg, params = setup
+        rng = np.random.RandomState(9)
+        p0 = list(rng.randint(1, 200, 4))   # owns block 0
+        p1 = list(rng.randint(1, 200, 4))
+        max_new = 2
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=2, block_size=4, max_total_len=16,
+            max_new_tokens=max_new, chunk=8)   # chunk >> budget
+        r0, r1 = cb.submit(p0), cb.submit(p1)
+        out = cb.run()
+        for rid, p in ((r0, p0), (r1, p1)):
+            dense = generation.generate(
+                params, jnp.asarray([p], jnp.int32), cfg,
+                max_new_tokens=max_new, greedy=True)
+            np.testing.assert_array_equal(np.asarray(out[rid]),
+                                          np.asarray(dense[0]))
+
+    def test_admission_defers_when_pool_short(self, setup):
+        """A free batch slot without enough free blocks DEFERS admission
+        until a request retires (instead of aborting the run)."""
+        cfg, params = setup
+        rng = np.random.RandomState(10)
+        p = [list(rng.randint(1, 200, 4)) for _ in range(2)]
+        # 3 blocks per request; pool of 4: second must wait for the first
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=2, block_size=4, max_total_len=16,
+            max_new_tokens=4, chunk=2, num_blocks=4)
+        rids = [cb.submit(x) for x in p]
+        out = cb.run()
+        for rid, pr in zip(rids, p):
+            dense = generation.generate(
+                params, jnp.asarray([pr], jnp.int32), cfg,
+                max_new_tokens=4, greedy=True)
+            np.testing.assert_array_equal(np.asarray(out[rid]),
+                                          np.asarray(dense[0]))
+        # a single over-sized request still fails loudly
+        big = paged.ContinuousBatcher(
+            params, cfg, max_batch=1, block_size=4, max_total_len=64,
+            max_new_tokens=40, chunk=2, num_blocks=2)
+        big.submit(list(rng.randint(1, 200, 8)))
+        with pytest.raises(RuntimeError):
+            big.run()

@@ -564,3 +564,23 @@ class TestGqaNativeKernels:
         for got, want in zip((dq, dk, dv), vjp(g)):
             np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                        rtol=2e-3, atol=2e-3)
+
+
+class TestRopeBhsd:
+    def test_matches_bshd_on_transposed_inputs(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.kernels.rope import (rope_freqs, apply_rope_half,
+                                             apply_rope_half_bhsd)
+        rng = np.random.default_rng(0)
+        b, s, h, d = 2, 16, 4, 8
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        cos, sin = rope_freqs(d, 32)
+        rq, rk = apply_rope_half(q, k, cos, sin)
+        t = lambda x: x.transpose(0, 2, 1, 3)
+        bq, bk = apply_rope_half_bhsd(t(q), t(k), cos, sin)
+        np.testing.assert_allclose(np.asarray(bq), np.asarray(t(rq)),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(bk), np.asarray(t(rk)),
+                                   rtol=1e-6, atol=1e-6)
