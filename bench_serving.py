@@ -1,0 +1,83 @@
+"""Serving benchmark: offline throughput + latency percentiles through
+the ServingEngine on the CPU backend.
+
+Prints ONE JSON line (bench.py convention, landed alongside the
+BENCH_*.json records): generated tokens/s end-to-end through the full
+admission→batcher→channel path, plus TTFT and queue-wait percentiles —
+the serving-layer numbers the device-side decode benches in bench.py
+cannot see (queueing, scheduling, host fan-out overhead).
+
+Deliberately a tiny model on CPU: this measures the HOST serving layer's
+overhead and scheduling behavior deterministically; device-side decode
+throughput is bench.py's `decode_tok_s`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
+         block_size: int = 8, chunk: int = 4) -> dict:
+    import jax
+    from paddle_tpu.nlp import llama
+    from paddle_tpu import serving
+
+    cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    prompts = [list(map(int, rng.randint(1, 200, int(L))))
+               for L in rng.randint(4, 16, n_requests)]
+
+    eng = serving.ServingEngine(
+        params, cfg, max_batch=max_batch, block_size=block_size,
+        max_total_len=64, max_new_tokens=max_new, chunk=chunk,
+        max_queue_depth=n_requests, start=False)
+    # warmup: compile the chunk fn + prefill shapes outside the timing
+    eng.start()
+    eng.generate(prompts[0], timeout=600)
+    completed0 = eng.metrics.counter("requests_completed").value
+
+    t0 = time.perf_counter()
+    reqs = [eng.submit(p) for p in prompts]
+    if not eng.drain(timeout=600):
+        raise RuntimeError("drain timed out — benchmark invalid")
+    wall = time.perf_counter() - t0
+    eng.shutdown()
+
+    toks = sum(len(r.result()) for r in reqs)
+    ttft = np.asarray([r.first_token_time - r.submit_time for r in reqs])
+    wait = np.asarray([r.admit_time - r.submit_time for r in reqs])
+    snap = eng.snapshot()
+    pct = lambda a, q: round(float(np.percentile(a, q)), 4)
+    result = {
+        "metric": "serving_offline_tok_s",
+        "value": round(toks / wall, 1),
+        "unit": "tokens/s",
+        "n_requests": n_requests,
+        "max_batch": max_batch,
+        "max_new_tokens": max_new,
+        "wall_s": round(wall, 3),
+        "ttft_s_p50": pct(ttft, 50),
+        "ttft_s_p90": pct(ttft, 90),
+        "ttft_s_p99": pct(ttft, 99),
+        "queue_wait_s_p50": pct(wait, 50),
+        "queue_wait_s_p90": pct(wait, 90),
+        "queue_wait_s_p99": pct(wait, 99),
+        "step_s_p50": snap["histograms"]["serving.step_s"].get("p50"),
+        "per_token_s_p50": snap["histograms"]["per_token_s"].get("p50"),
+        "requests_completed": snap["counters"]["requests_completed"]
+        - completed0,
+        "kv_high_water_blocks": snap["allocator"]["high_water_blocks"],
+        "kv_reused_blocks": snap["allocator"]["reused_blocks"],
+    }
+    return result
+
+
+if __name__ == "__main__":
+    print(json.dumps(main()))

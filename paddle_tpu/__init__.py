@@ -90,6 +90,7 @@ from . import distribution  # noqa: F401
 from . import signal  # noqa: F401
 from . import geometric  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401
 from . import onnx  # noqa: F401
 from . import audio  # noqa: F401
 from . import jit  # noqa: F401

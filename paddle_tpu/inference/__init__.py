@@ -13,7 +13,20 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["Config", "Tensor", "Predictor", "create_predictor"]
+__all__ = ["Config", "Tensor", "Predictor", "create_predictor",
+           "ContinuousBatcher", "PagedKVCache", "ServingEngine",
+           "GenerationRequest"]
+
+
+def __getattr__(name: str):
+    # public serving surface without private module paths — delegated
+    # to paddle_tpu.serving, which resolves each name lazily so
+    # importing paddle_tpu.inference does not pull the nlp model stack
+    if name in ("ContinuousBatcher", "PagedKVCache", "ServingEngine",
+                "GenerationRequest"):
+        from .. import serving
+        return getattr(serving, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class Config:

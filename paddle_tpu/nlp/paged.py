@@ -83,6 +83,10 @@ class BlockAllocator:
     def free(self, blocks: List[int]) -> None:
         self._free.extend(blocks)
 
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
     def stats(self) -> Dict[str, int]:
         return {
             "capacity_blocks": self.num_blocks,
@@ -298,6 +302,7 @@ class ContinuousBatcher:
                  num_blocks: Optional[int] = None, chunk: int = 8):
         self.params, self.cfg = params, cfg
         self.B, self.bs = max_batch, block_size
+        self.max_total = max_total_len
         self.M = -(-max_total_len // block_size)
         self.max_new = max_new_tokens
         self.eos = eos_token_id
@@ -312,34 +317,111 @@ class ContinuousBatcher:
         self.slot_req: List[Optional[int]] = [None] * max_batch
         self.slot_blocks: List[Optional[List[int]]] = [None] * max_batch
         self.budget = [0] * max_batch
+        self.stop = [-1] * max_batch          # per-slot stop id (-1 = none)
         self.cur_tok = jnp.zeros((max_batch,), jnp.int32)
         self.queue: List = []
         self.outputs: Dict[int, List[int]] = {}
         self._next_rid = 0
         self._chunk_fn = None
+        self._delivered: Dict[int, int] = {}   # rid -> tokens handed out
+        self._just_finished: List[int] = []
 
-    def submit(self, tokens) -> int:
+    def submit(self, tokens, stop_token_id: Optional[int] = None,
+               max_new_tokens: Optional[int] = None) -> int:
+        """Queue a request. `stop_token_id` finishes THIS request early
+        when emitted (in addition to the batcher-wide eos); the slot's
+        blocks return to the pool on finish. `max_new_tokens` caps this
+        request's budget (must be <= the batcher-wide max — the block
+        table width is sized for it)."""
+        toks = list(map(int, tokens))
+        mn = self.validate(len(toks), max_new_tokens)
         rid = self._next_rid
         self._next_rid += 1
-        self.queue.append((rid, list(map(int, tokens))))
+        stop = -1 if stop_token_id is None else int(stop_token_id)
+        self.queue.append((rid, toks, stop, mn))
         self.outputs[rid] = []
+        self._delivered[rid] = 0
         return rid
 
+    def validate(self, prompt_len: int,
+                 max_new_tokens: Optional[int] = None) -> int:
+        """Check a request's shape against this batcher's static sizing;
+        returns the resolved max_new budget. The ONE place the sizing
+        rules live — submit() and the serving layer both use it."""
+        mn = self.max_new if max_new_tokens is None else int(max_new_tokens)
+        if not 1 <= mn <= self.max_new:
+            raise ValueError(
+                f"max_new_tokens {mn} out of range [1, {self.max_new}]")
+        if prompt_len + mn > self.max_total:
+            raise ValueError(
+                f"prompt of {prompt_len} + max_new {mn} exceeds "
+                f"max_total_len {self.max_total}")
+        return mn
+
+    def blocks_needed(self, prompt_len: int,
+                      max_new_tokens: Optional[int] = None) -> int:
+        """Pool blocks a request of this shape holds while in flight."""
+        mn = self.max_new if max_new_tokens is None else int(max_new_tokens)
+        return -(-(prompt_len + mn) // self.bs)
+
+    def release(self, rid: int) -> None:
+        """Drop a finished/aborted request's retained output list. The
+        long-lived serving engine calls this once tokens are delivered —
+        without it `outputs` grows with every request ever served.
+        (Standalone run() callers read outputs afterwards, so the
+        batcher never drops entries on its own.)"""
+        self.outputs.pop(rid, None)
+        self._delivered.pop(rid, None)
+
+    def free_slots(self) -> int:
+        """Batch slots available to new admissions (queued-but-not-yet-
+        prefilled requests count as taken)."""
+        return self.active.count(False) - len(self.queue)
+
+    def abort(self, rid: int) -> bool:
+        """Cancel a request: drop it from the queue, or retire its slot
+        mid-decode so its blocks return to the pool immediately. Already-
+        generated tokens stay in `outputs`. Returns False when rid is
+        unknown or already finished."""
+        for i, entry in enumerate(self.queue):
+            if entry[0] == rid:
+                del self.queue[i]
+                self._delivered.pop(rid, None)
+                return True
+        for slot in range(self.B):
+            if self.active[slot] and self.slot_req[slot] == rid:
+                self._retire(slot)
+                # an abort is the caller's bookkeeping, not a completion
+                self._just_finished.remove(rid)
+                self._delivered.pop(rid, None)
+                return True
+        return False
+
     # -- internals --------------------------------------------------------
-    def _admit_one(self, slot: int, rid: int, toks: List[int]) -> None:
+    def _admit_one(self, slot: int, rid: int, toks: List[int],
+                   stop: int = -1, max_new: Optional[int] = None) -> None:
         P = len(toks)
-        need = -(-(P + self.max_new) // self.bs)
-        blocks = self.alloc.allocate(need) + [0] * (self.M - need)
-        table = self.cache.table.at[slot].set(
-            jnp.asarray(blocks, jnp.int32))
-        row = jnp.asarray(toks, jnp.int32)[None]
-        positions = jnp.arange(P)[None]
-        sub = PagedKVCache(self.cache.k, self.cache.v, table[slot:slot + 1],
-                           self.cache.lengths[slot:slot + 1])
-        logits, sub = forward_paged(
-            self.params, row, sub, positions, jnp.ones((1, P), bool),
-            self.cfg, is_prefill=True)
-        first = int(jnp.argmax(logits[0, P - 1]))
+        mn = self.max_new if max_new is None else max_new
+        need = -(-(P + mn) // self.bs)
+        owned = self.alloc.allocate(need)
+        blocks = owned + [0] * (self.M - need)
+        try:
+            table = self.cache.table.at[slot].set(
+                jnp.asarray(blocks, jnp.int32))
+            row = jnp.asarray(toks, jnp.int32)[None]
+            positions = jnp.arange(P)[None]
+            sub = PagedKVCache(self.cache.k, self.cache.v,
+                               table[slot:slot + 1],
+                               self.cache.lengths[slot:slot + 1])
+            logits, sub = forward_paged(
+                self.params, row, sub, positions, jnp.ones((1, P), bool),
+                self.cfg, is_prefill=True)
+            first = int(jnp.argmax(logits[0, P - 1]))
+        except Exception:
+            # a failed prefill must not leak its blocks: the slot was
+            # never activated, so nothing else will ever free them
+            self.alloc.free(owned)
+            raise
         self.cache = PagedKVCache(
             sub.k, sub.v, table,
             self.cache.lengths.at[slot].set(P))
@@ -347,22 +429,27 @@ class ContinuousBatcher:
         self.active[slot] = True
         self.slot_req[slot] = rid
         self.slot_blocks[slot] = blocks[:need]
-        self.budget[slot] = self.max_new - 1
+        self.budget[slot] = mn - 1
+        self.stop[slot] = stop
         self.outputs[rid].append(first)
-        if self.eos is not None and first == self.eos:
+        if ((self.eos is not None and first == self.eos)
+                or first == stop or self.budget[slot] <= 0):
             self._retire(slot)
 
     def _retire(self, slot: int) -> None:
         self.alloc.free(self.slot_blocks[slot])
+        self._just_finished.append(self.slot_req[slot])
         self.active[slot] = False
         self.slot_req[slot] = None
         self.slot_blocks[slot] = None
+        self.stop[slot] = -1
 
     def _admit(self) -> None:
         for slot in range(self.B):
             if not self.active[slot] and self.queue:
-                need = -(-(len(self.queue[0][1]) + self.max_new) // self.bs)
-                if need > len(self.alloc._free):
+                _, toks0, _, mn0 = self.queue[0]
+                need = self.blocks_needed(len(toks0), mn0)
+                if need > self.alloc.free_blocks:
                     if not any(self.active):
                         # nothing in flight will ever free blocks
                         raise RuntimeError(
@@ -370,14 +457,14 @@ class ContinuousBatcher:
                             f"holds only {self.alloc.num_blocks} — size "
                             f"num_blocks for the largest single request")
                     return          # defer until a request retires
-                rid, toks = self.queue.pop(0)
-                self._admit_one(slot, rid, toks)
+                rid, toks, stop, mn = self.queue.pop(0)
+                self._admit_one(slot, rid, toks, stop, mn)
 
     def _build_chunk(self):
         cfg, chunk = self.cfg, self.chunk
         eos = -1 if self.eos is None else int(self.eos)
 
-        def run_chunk(params, cache, tok, active, lengths, budget):
+        def run_chunk(params, cache, tok, active, lengths, budget, stop):
             def step(carry, _):
                 cache, tok, lengths, budget, act = carry
                 pos = lengths[:, None]
@@ -389,11 +476,11 @@ class ContinuousBatcher:
                 lengths = lengths + act.astype(jnp.int32)
                 budget = budget - act.astype(jnp.int32)
                 # deactivate ON DEVICE the moment a slot's budget runs
-                # out or it emits eos — a fixed-size chunk must not keep
-                # writing past the slot's ALLOCATED blocks (the table
-                # row's padding points at block 0, i.e. someone else's
-                # cache)
-                act = act & (budget > 0) & (nxt != eos)
+                # out or it emits eos / its own stop id — a fixed-size
+                # chunk must not keep writing past the slot's ALLOCATED
+                # blocks (the table row's padding points at block 0,
+                # i.e. someone else's cache)
+                act = act & (budget > 0) & (nxt != eos) & (nxt != stop)
                 # inactive slots must not drift: pin lengths ourselves
                 cache = cache._replace(lengths=lengths)
                 return (cache, nxt, lengths, budget, act), nxt
@@ -405,17 +492,24 @@ class ContinuousBatcher:
 
         return jax.jit(run_chunk)
 
-    def run(self) -> Dict[int, List[int]]:
-        """Drain the queue and all in-flight requests (greedy decode)."""
+    def step(self):
+        """Admit what fits, then run ONE decode chunk.
+
+        The serving layer's granularity: returns (emitted, finished) —
+        `emitted` maps rid -> tokens newly generated since the last
+        step() (the prefill's first token included), `finished` lists
+        rids that completed this step (their blocks are already back in
+        the pool). A step with nothing in flight is a cheap no-op."""
         if self._chunk_fn is None:
             self._chunk_fn = self._build_chunk()
         self._admit()
-        while any(self.active) or self.queue:
+        if any(self.active):
             active = jnp.asarray(self.active)
             budget = jnp.asarray(self.budget, jnp.int32)
+            stop = jnp.asarray(self.stop, jnp.int32)
             self.cache, self.cur_tok, lengths, _, toks = self._chunk_fn(
                 self.params, self.cache, self.cur_tok, active,
-                self.cache.lengths, budget)
+                self.cache.lengths, budget, stop)
             self.cache = self.cache._replace(lengths=lengths)
             toks = np.asarray(toks)
             for slot in range(self.B):
@@ -428,14 +522,34 @@ class ContinuousBatcher:
                     t = int(toks[slot, j])
                     self.outputs[rid].append(t)
                     self.budget[slot] -= 1
-                    if self.eos is not None and t == self.eos:
+                    if ((self.eos is not None and t == self.eos)
+                            or t == self.stop[slot]):
                         break
+                out = self.outputs[rid]
                 done = (self.budget[slot] <= 0 or
-                        (self.eos is not None and
-                         self.outputs[rid] and
-                         self.outputs[rid][-1] == self.eos))
+                        (self.eos is not None and out and
+                         out[-1] == self.eos) or
+                        (self.stop[slot] >= 0 and out and
+                         out[-1] == self.stop[slot]))
                 if done:
                     self._retire(slot)
             self._admit()
+        emitted: Dict[int, List[int]] = {}
+        for rid, n in list(self._delivered.items()):
+            out = self.outputs.get(rid)
+            if out is not None and len(out) > n:
+                emitted[rid] = out[n:]
+                self._delivered[rid] = len(out)
+        finished, self._just_finished = self._just_finished, []
+        for rid in finished:
+            self._delivered.pop(rid, None)
+        return emitted, finished
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drain the queue and all in-flight requests (greedy decode)."""
+        while True:
+            self.step()
+            if not (any(self.active) or self.queue):
+                break
         return self.outputs
 

@@ -156,17 +156,27 @@ class Profiler:
 
 class RecordEvent:
     """RAII span recorded into the device/host trace
-    (reference: platform::RecordEvent; here jax.profiler.TraceAnnotation)."""
+    (reference: platform::RecordEvent; here jax.profiler.TraceAnnotation).
+
+    Reusable: one RecordEvent may go through many begin()/end() cycles
+    (the serving engine opens the same-named span every decode step), so
+    a fresh TraceAnnotation is created per begin."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
-        self._ann = jax.profiler.TraceAnnotation(name)
+        self._ann = None
 
     def begin(self):
+        if self._ann is not None:
+            raise RuntimeError(f"RecordEvent {self.name!r} already begun")
+        self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
 
     def end(self):
-        self._ann.__exit__(None, None, None)
+        if self._ann is None:
+            raise RuntimeError(f"RecordEvent {self.name!r} not begun")
+        ann, self._ann = self._ann, None
+        ann.__exit__(None, None, None)
 
     def __enter__(self):
         self.begin()

@@ -1,0 +1,57 @@
+"""paddle_tpu.serving — async request-serving engine over the paged-KV
+continuous batcher.
+
+The host-side serving layer the ROADMAP north star calls for: a
+thread-backed `ServingEngine` owns a `ContinuousBatcher`
+(`paddle_tpu.nlp.paged`) and keeps its in-flight batch saturated from a
+bounded priority queue, with per-request lifecycle (deadlines,
+cancellation, per-request stop tokens / budgets), streaming output
+channels, lock-safe metrics, and a step-level exception boundary that
+fails only the affected requests.
+
+    from paddle_tpu import serving
+
+    eng = serving.ServingEngine(params, cfg, max_batch=4,
+                                block_size=16, max_total_len=512,
+                                max_new_tokens=64)
+    out = eng.generate(prompt_ids)                   # blocking
+    for tok in eng.stream(prompt_ids):               # incremental
+        ...
+    req = eng.submit(prompt_ids, priority=1, timeout_s=30.0,
+                     stop_token_id=eos)              # async handle
+    print(eng.snapshot())                            # metrics + pool
+    eng.shutdown()                                   # graceful drain
+
+Modules: `engine` (ServingEngine loop), `request` (lifecycle/channels),
+`scheduler` (admission queue: priority + FIFO + aging + backpressure),
+`metrics` (counters/gauges/histograms + profiler-span timers).
+"""
+from __future__ import annotations
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
+from .request import (  # noqa: F401
+    GenerationRequest, RequestState, TERMINAL_STATES,
+    RequestError, RequestCancelled, RequestFailed, RequestTimedOut,
+)
+from .scheduler import AdmissionQueue, QueueFullError  # noqa: F401
+
+__all__ = [
+    "ServingEngine", "EngineStopped",
+    "GenerationRequest", "RequestState", "TERMINAL_STATES",
+    "RequestError", "RequestCancelled", "RequestFailed", "RequestTimedOut",
+    "AdmissionQueue", "QueueFullError",
+    "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "ContinuousBatcher", "PagedKVCache",
+]
+
+
+def __getattr__(name: str):
+    # ServingEngine pulls the nlp model stack — resolve lazily so plain
+    # `import paddle_tpu` (which imports this package) stays light
+    if name in ("ServingEngine", "EngineStopped"):
+        from . import engine
+        return getattr(engine, name)
+    if name in ("ContinuousBatcher", "PagedKVCache"):
+        from ..nlp import paged
+        return getattr(paged, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,433 @@
+"""paddle_tpu.serving — the async request-serving engine over the
+paged-KV continuous batcher.
+
+Deterministic CPU coverage: concurrent requests through ServingEngine
+match sequential `paged_generate` token-for-token (greedy), priority
+ordering, queue-full backpressure, deadline timeout, mid-decode
+cancellation returning KV blocks, per-request stop tokens, and the
+step-level exception boundary (one request's callback raises → the
+others complete and the engine stays alive).
+"""
+import threading
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nlp import llama, paged
+from paddle_tpu import serving
+from paddle_tpu.serving import AdmissionQueue, QueueFullError, \
+    MetricsRegistry, RequestState
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+_RNG = np.random.RandomState(42)
+PROMPT_A = list(map(int, _RNG.randint(1, 200, 5)))
+PROMPT_B = list(map(int, _RNG.randint(1, 200, 7)))
+PROMPT_A2 = list(map(int, _RNG.randint(1, 200, 5)))
+PROMPT_B2 = list(map(int, _RNG.randint(1, 200, 7)))
+MAX_NEW = 6
+
+
+def _paged_single(params, cfg, prompt, max_new=MAX_NEW):
+    """The sequential baseline: one request through paged_generate."""
+    out, _, _ = paged.paged_generate(
+        params, jnp.asarray([prompt], jnp.int32),
+        np.asarray([len(prompt)]), cfg, max_new_tokens=max_new,
+        block_size=4)
+    return [int(t) for t in np.asarray(out[0])]
+
+
+@pytest.fixture(scope="module")
+def baselines(setup):
+    cfg, params = setup
+    return {name: _paged_single(params, cfg, p) for name, p in [
+        ("A", PROMPT_A), ("B", PROMPT_B),
+        ("A2", PROMPT_A2), ("B2", PROMPT_B2)]}
+
+
+@pytest.fixture(scope="module")
+def engine(setup):
+    """Shared long-lived engine (stop-token / cancellation / fault tests
+    assert deltas or per-request outcomes, never absolute counters)."""
+    cfg, params = setup
+    eng = serving.ServingEngine(
+        params, cfg, max_batch=2, block_size=4, max_total_len=32,
+        max_new_tokens=20, chunk=3, max_queue_depth=16)
+    yield eng
+    eng.shutdown()
+
+
+class TestServingEngineE2E:
+    def test_concurrent_mixed_priorities_match_sequential(
+            self, setup, baselines):
+        """Acceptance: N=6 submissions (4 served at mixed priorities +
+        one cancellation + one deadline timeout) through one engine;
+        served outputs are token-identical to sequential paged_generate,
+        metrics are consistent, and the pool drains back to zero."""
+        cfg, params = setup
+        eng = serving.ServingEngine(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=MAX_NEW, chunk=3, max_queue_depth=16,
+            start=False)
+        r_lo = eng.submit(PROMPT_A, priority=2)
+        r_hi = eng.submit(PROMPT_B, priority=0)
+        r_mid = eng.submit(PROMPT_A2, priority=1)
+        # greedy decode ⇒ a shorter budget is a strict prefix of the
+        # longer run, so the per-request max_new needs no new baseline
+        r_short = eng.submit(PROMPT_B2, priority=2, max_new_tokens=4)
+        r_timeout = eng.submit(PROMPT_A, timeout_s=0.0)
+        r_cancel = eng.submit(PROMPT_B)
+        r_cancel.cancel()
+
+        eng.start()
+        eng.shutdown(drain=True, timeout=300)   # graceful drain
+
+        assert r_lo.result() == baselines["A"]
+        assert r_hi.result() == baselines["B"]
+        assert r_mid.result() == baselines["A2"]
+        assert r_short.result() == baselines["B2"][:4]
+        assert r_timeout.state is RequestState.TIMED_OUT
+        assert r_cancel.state is RequestState.CANCELLED
+        with pytest.raises(serving.RequestTimedOut):
+            r_timeout.result()
+        with pytest.raises(serving.RequestCancelled):
+            r_cancel.result()
+
+        snap = eng.snapshot()
+        c = snap["counters"]
+        assert c["requests_submitted"] == 6
+        assert c["requests_admitted"] == 4
+        assert c["requests_completed"] == 4
+        assert c["requests_cancelled"] == 1
+        assert c["requests_timed_out"] == 1
+        assert c["requests_rejected"] == 0
+        assert (c["requests_completed"] + c["requests_cancelled"]
+                + c["requests_timed_out"]) == c["requests_submitted"]
+        assert c["tokens_generated"] == 3 * MAX_NEW + 4
+        # latency surfaces populated
+        assert snap["histograms"]["ttft_s"]["count"] == 4
+        assert snap["histograms"]["queue_wait_s"]["count"] == 4
+        # drained: queue empty, nothing in flight, ALL KV blocks back
+        assert snap["gauges"]["queue_depth"] == 0
+        assert snap["gauges"]["requests_in_flight"] == 0
+        assert snap["gauges"]["kv_blocks_in_use"] == 0
+        assert snap["gauges"]["kv_block_utilization"] == 0.0
+        assert snap["allocator"]["blocks_in_use"] == 0
+        # served requests release their batcher-side output lists (no
+        # unbounded growth under a long-lived engine)
+        assert eng.batcher.outputs == {}
+
+    def test_priority_over_fifo(self, setup):
+        """With one batch slot, a priority-0 late arrival is admitted
+        before earlier priority-5 traffic; equal priorities stay FIFO."""
+        cfg, params = setup
+        eng = serving.ServingEngine(
+            params, cfg, max_batch=1, block_size=4, max_total_len=32,
+            max_new_tokens=2, chunk=2, aging_interval_s=100.0,
+            start=False)
+        a = eng.submit(PROMPT_A, priority=5)
+        b = eng.submit(PROMPT_B, priority=0)
+        c = eng.submit(PROMPT_A2, priority=5)
+        eng.start()
+        eng.shutdown(drain=True, timeout=300)
+        assert all(r.state is RequestState.FINISHED for r in (a, b, c))
+        assert b.admitted_index < a.admitted_index < c.admitted_index
+
+    def test_queue_full_rejection_and_validation(self, setup):
+        """Backpressure: a full queue REJECTS with QueueFullError; a
+        request that can never fit fails at submit. Neither runs the
+        model (the engine is never started)."""
+        cfg, params = setup
+        eng = serving.ServingEngine(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=MAX_NEW, max_queue_depth=2, start=False)
+        q1 = eng.submit(PROMPT_A)
+        q2 = eng.submit(PROMPT_B)
+        q3 = serving.GenerationRequest(PROMPT_A2)
+        with pytest.raises(QueueFullError):
+            eng.submit(q3)
+        # a backpressure-rejected request stays pristine → retryable
+        assert q3.submit_time is None and q3.max_new_tokens is None
+        with pytest.raises(ValueError):    # prompt + max_new > max_total
+            eng.submit(list(range(1, 41)))
+        with pytest.raises(ValueError):    # budget over engine-wide max
+            eng.submit(PROMPT_A, max_new_tokens=99)
+        # a pre-built request must not silently drop submit() kwargs
+        pre = serving.GenerationRequest(PROMPT_A, priority=5)
+        with pytest.raises(ValueError, match="not both"):
+            eng.submit(pre, timeout_s=5.0)
+        assert eng.shutdown() is True      # never started: queued → CANCELLED
+        assert q1.state is RequestState.CANCELLED
+        assert q2.state is RequestState.CANCELLED
+        with pytest.raises(ValueError, match="already submitted"):
+            eng.submit(q1)                 # a used request can't resubmit
+        with pytest.raises(serving.EngineStopped):
+            eng.submit(PROMPT_A)
+        c = eng.snapshot()["counters"]
+        assert c["requests_submitted"] == 2
+        assert c["requests_rejected"] == 3
+        assert c["requests_cancelled"] == 2
+
+
+class TestServingEngineShared:
+    def test_stop_token_finishes_early(self, engine, baselines):
+        """Per-request stop id (satellite: ContinuousBatcher per-slot
+        stop support) truncates at the stop token and frees the slot."""
+        stop = baselines["A"][1]
+        cut = baselines["A"].index(stop)  # first occurrence wins
+        out = engine.generate(PROMPT_A, max_new_tokens=MAX_NEW,
+                              stop_token_id=stop, timeout=300)
+        assert out == baselines["A"][:cut + 1]
+        assert out[-1] == stop
+        engine.drain(timeout=60)
+        assert engine.snapshot()["gauges"]["kv_blocks_in_use"] == 0
+
+    def test_cancel_mid_decode_frees_blocks(self, engine):
+        req = engine.submit(PROMPT_B, max_new_tokens=20)
+        it = req.stream()
+        first = next(it)                  # guarantees DECODING started
+        req.cancel()
+        assert req.wait(timeout=300)
+        assert req.state is RequestState.CANCELLED
+        rest = list(it)                   # cancelled stream ends cleanly
+        assert req.tokens == [first] + rest
+        assert len(req.tokens) < 20
+        with pytest.raises(serving.RequestCancelled):
+            req.result()
+        assert engine.drain(timeout=300)
+        assert engine.snapshot()["allocator"]["blocks_in_use"] == 0
+
+    def test_fault_injection_isolates_request(self, engine, baselines):
+        """One request's on_token callback raises → only that request
+        FAILS (its blocks freed); the co-batched request completes and
+        the engine keeps serving."""
+        failed_before = engine.metrics.counter("requests_failed").value
+        seen = []
+
+        def boom(tok):
+            seen.append(tok)
+            if len(seen) == 2:
+                raise RuntimeError("injected fault")
+
+        bad = engine.submit(PROMPT_A, max_new_tokens=MAX_NEW,
+                            on_token=boom)
+        good = engine.submit(PROMPT_B, max_new_tokens=MAX_NEW)
+        assert good.result(timeout=300) == baselines["B"]
+        assert bad.wait(timeout=300)
+        assert bad.state is RequestState.FAILED
+        assert isinstance(bad.error, RuntimeError)
+        assert len(bad.tokens) == 2
+        with pytest.raises(serving.RequestFailed):
+            bad.result()
+        m = engine.metrics.counter("requests_failed").value
+        assert m == failed_before + 1
+        # engine survived: serve another request end to end
+        again = engine.generate(PROMPT_A, max_new_tokens=MAX_NEW,
+                                timeout=300)
+        assert again == baselines["A"]
+        assert engine.drain(timeout=300)
+        assert engine.snapshot()["allocator"]["blocks_in_use"] == 0
+
+
+@pytest.mark.slow
+class TestServingStress:
+    def test_many_requests_saturate_and_drain(self, setup):
+        """Scale pass (excluded from tier-1): 12 mixed-priority requests
+        over 2 slots with interleaved cancellations; every invariant the
+        dashboard relies on must hold after the drain."""
+        cfg, params = setup
+        eng = serving.ServingEngine(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=8, chunk=3, max_queue_depth=32,
+            aging_interval_s=0.1, start=False)
+        rng = np.random.RandomState(3)
+        reqs = [eng.submit(list(rng.randint(1, 200, int(L))),
+                           priority=int(rng.randint(0, 3)))
+                for L in rng.randint(3, 12, 12)]
+        reqs[4].cancel()
+        reqs[9].cancel()
+        eng.start()
+        eng.shutdown(drain=True, timeout=600)
+        states = [r.state for r in reqs]
+        assert states.count(RequestState.CANCELLED) == 2
+        assert states.count(RequestState.FINISHED) == 10
+        assert all(len(r.tokens) == 8
+                   for r in reqs if r.state is RequestState.FINISHED)
+        snap = eng.snapshot()
+        c = snap["counters"]
+        assert c["requests_submitted"] == 12
+        assert (c["requests_completed"] + c["requests_cancelled"]) == 12
+        assert snap["allocator"]["blocks_in_use"] == 0
+        assert snap["gauges"]["queue_depth"] == 0
+        assert eng.batcher.outputs == {}
+
+
+class TestContinuousBatcherStop:
+    def test_per_request_stop_token(self, setup, baselines):
+        """Batcher-level satellite: a slot with stop_token_id finishes
+        the moment it emits that id — not only on global eos/budget —
+        and its blocks return to the pool while the OTHER slot keeps
+        decoding to its full budget."""
+        cfg, params = setup
+        stop = baselines["A"][1]
+        cut = baselines["A"].index(stop)  # first occurrence wins
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=MAX_NEW, chunk=3)
+        r_stop = cb.submit(PROMPT_A, stop_token_id=stop)
+        r_full = cb.submit(PROMPT_B)
+        out = cb.run()
+        assert out[r_stop] == baselines["A"][:cut + 1]
+        assert out[r_full] == baselines["B"]
+        assert cb.alloc.stats()["blocks_in_use"] == 0
+
+    def test_per_request_max_new(self, setup, baselines):
+        cfg, params = setup
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=MAX_NEW, chunk=3)
+        r = cb.submit(PROMPT_A, max_new_tokens=3)
+        out = cb.run()
+        assert out[r] == baselines["A"][:3]
+        with pytest.raises(ValueError):
+            cb.submit(PROMPT_A, max_new_tokens=MAX_NEW + 1)
+
+    def test_validate_caps_at_configured_total(self, setup):
+        """validate() enforces the CONFIGURED max_total_len, not the
+        block-rounded table capacity."""
+        cfg, params = setup
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=1, block_size=16, max_total_len=30,
+            max_new_tokens=4, chunk=2)
+        assert cb.validate(26, 4) == 4     # 30 fits exactly
+        with pytest.raises(ValueError, match="max_total_len 30"):
+            cb.validate(28, 4)             # 32 fits M*bs but not 30
+
+    def test_failed_prefill_does_not_leak_blocks(self, setup,
+                                                 monkeypatch):
+        """A prefill that raises must return its just-allocated blocks
+        to the pool (the engine's exception boundary relies on it)."""
+        cfg, params = setup
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=1, block_size=4, max_total_len=32,
+            max_new_tokens=4, chunk=2)
+        monkeypatch.setattr(
+            paged, "forward_paged",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+        cb.submit(PROMPT_A)
+        with pytest.raises(RuntimeError, match="boom"):
+            cb.run()
+        assert cb.alloc.stats()["blocks_in_use"] == 0
+
+
+class TestGenerationRequestUnit:
+    def test_stream_after_terminal_does_not_block(self):
+        req = serving.GenerationRequest([1, 2, 3])
+        req._deliver(10)
+        req._deliver(11)
+        req._finish(RequestState.FINISHED, "length")
+        assert list(req.stream()) == [10, 11]
+        assert list(req.stream()) == []    # second pass: no hang
+        assert req.result(0) == [10, 11]
+
+    def test_stream_raises_on_failure(self):
+        req = serving.GenerationRequest([1])
+        req._deliver(5)
+        req._finish(RequestState.FAILED, "boom",
+                    error=RuntimeError("boom"))
+        it = req.stream()
+        assert next(it) == 5
+        with pytest.raises(serving.RequestFailed):
+            next(it)
+
+
+class TestAdmissionQueue:
+    def test_priority_then_fifo(self):
+        q = AdmissionQueue(max_depth=8, aging_interval_s=100.0)
+        q.push("a5", priority=5)
+        q.push("b0", priority=0)
+        q.push("c0", priority=0)
+        q.push("d5", priority=5)
+        assert [q.pop() for _ in range(4)] == ["b0", "c0", "a5", "d5"]
+        assert q.pop() is None
+
+    def test_aging_prevents_starvation(self):
+        t = [0.0]
+        q = AdmissionQueue(max_depth=8, aging_interval_s=2.0,
+                           clock=lambda: t[0])
+        q.push("old9", priority=9)
+        t[0] = 19.0                       # aged by 9 levels → effective 0
+        q.push("new0", priority=0)
+        assert q.pop() == "old9"          # FIFO wins the tie at level 0
+        assert q.pop() == "new0"
+
+    def test_backpressure_and_defer(self):
+        q = AdmissionQueue(max_depth=2)
+        q.push("x")
+        q.push("y")
+        with pytest.raises(QueueFullError):
+            q.push("z")
+        # defer-on-no-blocks: the BEST item gates the whole queue
+        assert q.pop(fits=lambda i: False) is None
+        assert len(q) == 2
+        assert q.pop(fits=lambda i: True) == "x"
+
+    def test_reap(self):
+        q = AdmissionQueue(max_depth=8)
+        for i in range(4):
+            q.push(i)
+        assert q.reap(lambda i: i % 2 == 0) == [0, 2]
+        assert [q.pop(), q.pop()] == [1, 3]
+
+
+class TestMetricsRegistry:
+    def test_counters_gauges_histograms(self):
+        m = MetricsRegistry()
+        m.counter("c").inc()
+        m.counter("c").inc(2)
+        m.gauge("g").set(7.5)
+        h = m.histogram("h")
+        for v in range(1, 101):
+            h.observe(float(v))
+        snap = m.snapshot()
+        assert snap["counters"]["c"] == 3
+        assert snap["gauges"]["g"] == 7.5
+        hs = snap["histograms"]["h"]
+        assert hs["count"] == 100 and hs["min"] == 1.0 and hs["max"] == 100.0
+        assert abs(hs["p50"] - 50.0) <= 2.0
+        assert abs(hs["p99"] - 99.0) <= 2.0
+
+    def test_timer_observes_and_is_thread_safe(self):
+        m = MetricsRegistry()
+
+        def work():
+            for _ in range(50):
+                m.counter("n").inc()
+                with m.timer("t", record_event=False):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        snap = m.snapshot()
+        assert snap["counters"]["n"] == 200
+        assert snap["histograms"]["t"]["count"] == 200
+
+    def test_timer_emits_profiler_span(self):
+        # RecordEvent integration: reusable spans must not raise even
+        # when no trace is active
+        m = MetricsRegistry()
+        for _ in range(3):
+            with m.timer("serving.span_s"):
+                pass
+        assert m.snapshot()["histograms"]["serving.span_s"]["count"] == 3
