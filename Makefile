@@ -1,0 +1,19 @@
+# Developer loop targets. `make lint test` is the pre-push gate — the
+# same two jobs .github/workflows/ci.yml runs.
+
+PY ?= python
+
+.PHONY: lint test baseline lint-all
+
+lint:           ## ratcheted static analysis (fails on non-baselined findings)
+	$(PY) tools/ptlint.py --format json
+
+lint-all:       ## every finding, baseline ignored (burn-down worklist)
+	$(PY) tools/ptlint.py --no-baseline
+
+baseline:       ## rewrite tools/ptlint_baseline.json (should only shrink)
+	$(PY) tools/ptlint.py --update-baseline
+
+test:           ## tier-1 test suite (CPU)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider

@@ -1,0 +1,50 @@
+"""paddle_tpu.analysis — framework-aware static analysis (ptlint).
+
+A rule-engine over Python ASTs that knows what a TPU-native framework
+cannot tolerate: Python side effects under `jax.jit` tracing
+(TRACE001), implicit host↔device syncs in the serving decode hot path
+(SYNC001), lock-discipline violations in the threaded serving layer
+(LOCK001), broad `except Exception` that swallows device errors
+(EXC001), and undocumented public API re-exports (API001).
+
+Run it:
+
+    python -m paddle_tpu.analysis                # whole package, text
+    python tools/ptlint.py --format json         # CI (no jax import)
+    python -m paddle_tpu.analysis --list-rules
+
+Existing violations are frozen in tools/ptlint_baseline.json (the
+ratchet): new code must be clean, old findings burn down via
+`--update-baseline`. Inline escape hatch:
+`# ptlint: disable=RULE — one-line justification`.
+
+This package deliberately imports neither jax nor numpy, so the linter
+runs in seconds from CI (tools/ptlint.py loads it standalone).
+"""
+from __future__ import annotations
+
+from .baseline import BaselineResult, apply as apply_baseline, \
+    load as load_baseline, save as save_baseline  # noqa: F401
+from .core import (  # noqa: F401
+    FileContext, Finding, Project, Rule, load_project, run_rules,
+)
+from .rules import ALL_RULES, RULES_BY_ID  # noqa: F401
+from .runner import main  # noqa: F401
+
+__all__ = [
+    "Finding", "Rule", "FileContext", "Project",
+    "load_project", "run_rules", "analyze_source",
+    "ALL_RULES", "RULES_BY_ID", "main",
+    "BaselineResult", "apply_baseline", "load_baseline", "save_baseline",
+]
+
+
+def analyze_source(source: str, relpath: str = "snippet.py",
+                   rules=None):
+    """Check one in-memory source string (the unit-test entry point).
+
+    Returns the suppression-filtered findings from `rules`
+    (default: all shipped rules)."""
+    ctx = FileContext(path=relpath, source=source, relpath=relpath)
+    project = Project([ctx])
+    return run_rules(project, rules if rules is not None else ALL_RULES)

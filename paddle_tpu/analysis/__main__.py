@@ -1,0 +1,5 @@
+"""`python -m paddle_tpu.analysis` — run ptlint over the repo."""
+from .runner import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

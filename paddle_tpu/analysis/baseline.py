@@ -1,0 +1,79 @@
+"""paddle_tpu.analysis.baseline — the violation ratchet.
+
+The checked-in baseline (tools/ptlint_baseline.json) is the set of
+findings that existed when the checker landed: they are ALLOWED but
+frozen. New code must be clean — a finding whose fingerprint is not in
+the baseline fails the run — and old findings can only be burned down:
+fixing one leaves a stale baseline entry that `--update-baseline`
+removes (the file only ever shrinks, unless a human consciously commits
+a grown one in review).
+
+Fingerprints are `path::rule::stripped-source-line` with a count, NOT
+line numbers, so edits elsewhere in a file don't invalidate the
+baseline; two identical violations on identical lines share one
+fingerprint with count 2.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, NamedTuple
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = os.path.join("tools", "ptlint_baseline.json")
+
+
+class BaselineResult(NamedTuple):
+    """Outcome of applying the ratchet to one run's findings."""
+
+    new: List[Finding]           # findings not covered by the baseline
+    baselined: List[Finding]     # findings matched (and consumed) by it
+    stale: Dict[str, int]        # baseline entries no current finding uses
+
+
+def load(path: str) -> Dict[str, int]:
+    """Baseline fingerprints -> allowed count ({} when file is absent)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "fingerprints" not in data:
+        raise ValueError(f"{path}: not a ptlint baseline file")
+    return {str(k): int(v) for k, v in data["fingerprints"].items()}
+
+
+def save(path: str, findings: Iterable[Finding]) -> Dict[str, int]:
+    """Write the baseline covering exactly `findings`; returns the map."""
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+    payload = {
+        "version": BASELINE_VERSION,
+        "comment": ("ptlint violation ratchet — regenerate with "
+                    "`python -m paddle_tpu.analysis --update-baseline` "
+                    "(should only ever shrink)"),
+        "fingerprints": {k: counts[k] for k in sorted(counts)},
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return counts
+
+
+def apply(findings: List[Finding], baseline: Dict[str, int]) -> BaselineResult:
+    """Split findings into new vs baselined; count-aware (a baseline
+    entry with count N absorbs at most N identical findings)."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    matched: List[Finding] = []
+    for f in findings:
+        if budget.get(f.fingerprint, 0) > 0:
+            budget[f.fingerprint] -= 1
+            matched.append(f)
+        else:
+            new.append(f)
+    stale = {fp: n for fp, n in budget.items() if n > 0}
+    return BaselineResult(new=new, baselined=matched, stale=stale)

@@ -1,0 +1,313 @@
+"""paddle_tpu.analysis.core — file loading, alias resolution, findings.
+
+The engine half of the checker: `FileContext` wraps one parsed source
+file (AST + per-line `# ptlint: disable=RULE` suppressions), `Project`
+holds every file of a run with a module-name index so cross-file rules
+(API001 docstring resolution, LOCK001 lock-order aggregation) can see
+the whole package, and `ModuleAliases` resolves local names through the
+file's imports (`import jax.numpy as jnp` makes `jnp.asarray` resolve to
+`jax.numpy.asarray`) plus `self.<attr> = ClassName(...)` constructor
+assignments so rules can reason about attribute types.
+
+This module (and the whole analysis package) must stay importable
+WITHOUT jax/numpy: the linter runs in CI and pre-push hooks where
+pulling the framework would cost tens of seconds (`tools/ptlint.py`
+loads the package standalone for exactly that reason).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+SEVERITIES = ("error", "warning")
+
+# `# ptlint: disable=RULE1,RULE2 — justification` (inline: suppresses its
+# own line; standalone comment line: suppresses the next code line)
+_DISABLE_RE = re.compile(r"#\s*ptlint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*|all)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at path:line:col.
+
+    `snippet` is the stripped source line — the baseline fingerprints on
+    (path, rule, snippet) rather than the line number, so unrelated
+    edits that shift lines do not invalidate the baseline."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+    snippet: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.path}::{self.rule}::{self.snippet}"
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "col": self.col, "severity": self.severity,
+            "message": self.message, "snippet": self.snippet,
+        }
+
+
+class Rule:
+    """Base class: a rule sees the whole Project and yields Findings."""
+
+    id: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def run(self, project: "Project") -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def parse_suppressions(lines: List[str]) -> Dict[int, Set[str]]:
+    """Map 1-based line number -> rule ids disabled on that line."""
+    sup: Dict[int, Set[str]] = {}
+    pending: Optional[Set[str]] = None
+    for i, text in enumerate(lines, start=1):
+        stripped = text.strip()
+        m = _DISABLE_RE.search(text)
+        rules: Optional[Set[str]] = None
+        if m:
+            raw = m.group(1)
+            rules = ({"all"} if raw == "all"
+                     else {r.strip() for r in raw.split(",")})
+        if stripped.startswith("#") or not stripped:
+            # standalone comment: carries (and accumulates) past further
+            # comments AND blank lines to the next code line
+            if rules:
+                pending = (pending or set()) | rules
+            continue
+        here = set(rules or ())
+        if pending:
+            here |= pending
+            pending = None
+        if here:
+            sup[i] = here
+    return sup
+
+
+class FileContext:
+    """One parsed source file. `tree` is None when the file failed to
+    parse (the loader emits a PARSE finding instead of crashing)."""
+
+    def __init__(self, path: str, source: str, relpath: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.suppressions = parse_suppressions(self.lines)
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.parse_error = e
+        self.aliases = ModuleAliases(self)
+
+    @property
+    def module_name(self) -> str:
+        mod = self.relpath.replace("\\", "/")
+        if mod.endswith(".py"):
+            mod = mod[:-3]
+        if mod.endswith("/__init__"):
+            mod = mod[: -len("/__init__")]
+        return mod.replace("/", ".")
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and (rule in rules or "all" in rules)
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0) + 1
+        return Finding(rule=rule.id, path=self.relpath, line=line, col=col,
+                       message=message, severity=rule.severity,
+                       snippet=self.snippet(line))
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Raw dotted text of a Name/Attribute chain ('self.queue.push')."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ModuleAliases:
+    """Import-alias + `self.attr = Ctor()` resolution for one module."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.imports: Dict[str, str] = {}
+        # class name -> {attr -> resolved ctor dotted name}
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+        # class name -> {cond attr -> wrapped lock attr} (threading.Condition)
+        self.cond_wraps: Dict[str, Dict[str, str]] = {}
+        if ctx.tree is not None:
+            self._collect_imports(ctx.tree)
+            for node in ctx.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._collect_class(node)
+
+    def _collect_imports(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.imports[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        self.imports[head] = head
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:  # relative: resolve against this package
+                    anchor = self.ctx.module_name.split(".")
+                    if self.ctx.relpath.endswith("__init__.py"):
+                        anchor.append("")  # package itself is the anchor
+                    anchor = anchor[: len(anchor) - node.level]
+                    base = ".".join(anchor + ([base] if base else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = (
+                        f"{base}.{a.name}" if base else a.name)
+
+    def _collect_class(self, cls: ast.ClassDef) -> None:
+        types: Dict[str, str] = {}
+        wraps: Dict[str, str] = {}
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(meth):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                tgt = node.targets[0]
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                val = node.value
+                # `self.x = Ctor(...)` and `self.x = y or Ctor(...)`
+                if isinstance(val, ast.BoolOp) and val.values:
+                    val = val.values[-1]
+                if not isinstance(val, ast.Call):
+                    continue
+                ctor = self.resolve(val.func)
+                if ctor is None:
+                    continue
+                types.setdefault(tgt.attr, ctor)
+                if (ctor.endswith("Condition") and val.args
+                        and isinstance(val.args[0], ast.Attribute)
+                        and isinstance(val.args[0].value, ast.Name)
+                        and val.args[0].value.id == "self"):
+                    wraps[tgt.attr] = val.args[0].attr
+        self.attr_types[cls.name] = types
+        self.cond_wraps[cls.name] = wraps
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Expand a Name/Attribute chain through the import aliases."""
+        raw = dotted(node)
+        if raw is None:
+            return None
+        head, _, rest = raw.partition(".")
+        head = self.imports.get(head, head)
+        return f"{head}.{rest}" if rest else head
+
+
+class Project:
+    """All files of one analysis run, indexed by module name."""
+
+    def __init__(self, files: List[FileContext]):
+        self.files = files
+        self.by_module: Dict[str, FileContext] = {
+            f.module_name: f for f in files}
+
+    def module(self, name: str) -> Optional[FileContext]:
+        return self.by_module.get(name)
+
+
+class _ParseRule(Rule):
+    id = "PARSE"
+    severity = "error"
+    description = "file failed to parse"
+
+
+PARSE_RULE = _ParseRule()
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git"))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def load_project(paths: Iterable[str], root: str) -> Tuple[Project, List[Finding]]:
+    """Parse every .py under `paths`; returns the Project plus PARSE
+    findings for files whose AST could not be built."""
+    files: List[FileContext] = []
+    errors: List[Finding] = []
+    root = os.path.abspath(root)
+    for path in iter_py_files(paths):
+        apath = os.path.abspath(path)
+        rel = os.path.relpath(apath, root).replace(os.sep, "/")
+        try:
+            with open(apath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError) as e:
+            errors.append(Finding(rule=PARSE_RULE.id, path=rel, line=1,
+                                  col=1, message=f"unreadable: {e}"))
+            continue
+        ctx = FileContext(apath, source, rel)
+        if ctx.parse_error is not None:
+            e = ctx.parse_error
+            errors.append(Finding(
+                rule=PARSE_RULE.id, path=rel, line=e.lineno or 1,
+                col=(e.offset or 0) + 1, message=f"syntax error: {e.msg}",
+                snippet=ctx.snippet(e.lineno or 1)))
+        files.append(ctx)
+    return Project(files), errors
+
+
+def run_rules(project: Project, rules: Iterable[Rule]) -> List[Finding]:
+    """Run every rule, drop suppressed findings, sort by location."""
+    out: List[Finding] = []
+    by_path = {f.relpath: f for f in project.files}
+    for rule in rules:
+        for finding in rule.run(project):
+            ctx = by_path.get(finding.path)
+            if ctx is not None and ctx.suppressed(finding.line, finding.rule):
+                continue
+            out.append(finding)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out

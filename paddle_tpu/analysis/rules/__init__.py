@@ -1,0 +1,31 @@
+"""paddle_tpu.analysis.rules — the shipped rule pack.
+
+Adding a rule: subclass `core.Rule`, give it a unique `id`, implement
+`run(project) -> Iterator[Finding]`, add an instance to ALL_RULES, and
+cover it in tests/test_analysis.py with at least one true-positive and
+one true-negative fixture (the acceptance bar every shipped rule meets).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..core import Rule
+from .api import PublicDocstringRule
+from .broad_except import BroadExceptRule
+from .locks import LockDisciplineRule
+from .sync import HostSyncRule
+from .trace import TraceSideEffectRule
+
+ALL_RULES: List[Rule] = [
+    TraceSideEffectRule(),
+    HostSyncRule(),
+    LockDisciplineRule(),
+    BroadExceptRule(),
+    PublicDocstringRule(),
+]
+
+RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_ID", "TraceSideEffectRule",
+           "HostSyncRule", "LockDisciplineRule", "BroadExceptRule",
+           "PublicDocstringRule"]

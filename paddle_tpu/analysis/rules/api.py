@@ -1,0 +1,109 @@
+"""API001 — re-exported public symbols must carry docstrings.
+
+An `__init__.py` with an `__all__` is a public API statement: every
+name it exports is something users are invited to call. A def/class
+that reaches that surface without a docstring ships an undocumented
+contract. The rule resolves each `__all__` entry either to a definition
+in the `__init__.py` itself or through its `from .mod import Name`
+imports into the defining module (within the analyzed fileset; external
+re-exports are skipped), and checks `ast.get_docstring` at the
+definition. Packages without `__all__` are skipped — implicit surfaces
+are a different cleanup.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..core import FileContext, Finding, Project, Rule
+
+
+def _exported_names(tree: ast.Module) -> Optional[List[Tuple[str, ast.AST]]]:
+    """Names in __all__ (constant strings only), or None when absent."""
+    out: List[Tuple[str, ast.AST]] = []
+    found = False
+    for node in tree.body:
+        values: List[ast.expr] = []
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "__all__"
+                for t in node.targets):
+            found = True
+            if isinstance(node.value, (ast.List, ast.Tuple)):
+                values = node.value.elts
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id == "__all__" \
+                and isinstance(node.value, (ast.List, ast.Tuple)):
+            found = True
+            values = node.value.elts
+        for e in values:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append((e.value, e))
+    return out if found else None
+
+
+def _top_level_defs(tree: ast.Module) -> Dict[str, ast.AST]:
+    defs: Dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            defs[node.name] = node
+    return defs
+
+
+class PublicDocstringRule(Rule):
+    """API001: __all__-exported symbols must have docstrings at their
+    definition (resolved through the package's from-imports)."""
+
+    id = "API001"
+    severity = "warning"
+    description = ("public symbol in an __init__.py __all__ whose "
+                   "definition has no docstring")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for ctx in project.files:
+            if ctx.tree is None or not ctx.relpath.endswith("__init__.py"):
+                continue
+            exported = _exported_names(ctx.tree)
+            if not exported:
+                continue
+            local_defs = _top_level_defs(ctx.tree)
+            # exported name -> originating module (dotted) + original name
+            imports: Dict[str, Tuple[str, str]] = {}
+            for node in ctx.tree.body:
+                if isinstance(node, ast.ImportFrom):
+                    for a in node.names:
+                        if a.name == "*":
+                            continue
+                        local = a.asname or a.name
+                        target = ctx.aliases.imports.get(local)
+                        if target and "." in target:
+                            imports[local] = (
+                                target.rsplit(".", 1)[0], a.name)
+            for name, site in exported:
+                yield from self._check_symbol(
+                    ctx, project, name, site, local_defs, imports)
+
+    def _check_symbol(self, ctx: FileContext, project: Project, name: str,
+                      site: ast.AST, local_defs, imports
+                      ) -> Iterator[Finding]:
+        node = local_defs.get(name)
+        where = ctx.relpath
+        if node is None:
+            origin = imports.get(name)
+            if origin is None:
+                return                       # __getattr__/external: skip
+            mod, orig_name = origin
+            target_ctx = project.module(mod)
+            if target_ctx is None or target_ctx.tree is None:
+                return                       # outside the analyzed set
+            node = _top_level_defs(target_ctx.tree).get(orig_name)
+            if node is None:
+                return                       # assignment/alias: skip
+            where = target_ctx.relpath
+        if ast.get_docstring(node) is None:
+            yield ctx.finding(
+                self, site,
+                f"public symbol '{name}' (defined {where}:"
+                f"{node.lineno}) is exported via __all__ but has no "
+                f"docstring")

@@ -1,0 +1,251 @@
+"""LOCK001 — lock discipline for the threaded serving layer.
+
+PR 1's `ServingEngine` runs a background thread against consumer
+threads; the invariants this rule polices are the ones its design notes
+rely on:
+
+  * locks are held through `with` — a bare `.acquire()` leaks the lock
+    on any exception between acquire and release;
+  * nothing BLOCKS while holding a lock — `time.sleep`, `Thread.join`,
+    blocking `queue.Queue.get/put` under a lock stalls every other
+    thread contending for it (`Condition.wait` is exempt: it releases
+    the lock while waiting);
+  * lock ACQUISITION ORDER is globally consistent — if one code path
+    takes `ServingEngine._lock` then `AdmissionQueue._lock`, a path
+    taking them in the reverse order is a deadlock waiting for load.
+
+Lock identity: `self.<attr>` attributes assigned from
+`threading.Lock/RLock/Condition/Semaphore`, attributes whose name looks
+like a lock (`_lock`, `mutex`, ...), and module/local names likewise.
+`threading.Condition(self._lock)` aliases to the wrapped lock (the
+engine's `_work` IS `_lock`). Calling a method of another class that
+itself takes `with self._lock` (resolved through the constructor-
+assignment type map) counts as acquiring that class's lock, which is
+how the `ServingEngine._lock → AdmissionQueue._lock` edge is seen.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import FileContext, Finding, Project, Rule, dotted
+
+LOCK_NAME_RE = re.compile(r"(^|_)(lock|mutex|mtx)$", re.I)
+LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+BLOCKING_CALLS = {"time.sleep", "sleep"}
+QUEUE_CTORS = {"queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+               "queue.PriorityQueue"}
+THREAD_CTORS = {"threading.Thread"}
+
+
+def _call_is_nonblocking(call: ast.Call) -> bool:
+    """get/put with block=False or a bounded timeout never stalls —
+    `timeout=None` is NOT bounded (it blocks forever, same as none)."""
+    for kw in call.keywords:
+        if kw.arg == "timeout" and not (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is None):
+            return True
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    return False
+
+
+class _ClassLockIndex:
+    """Per-project view: which locks each class's methods acquire."""
+
+    def __init__(self, project: Project):
+        # class name -> FileContext (first definition wins)
+        self.class_files: Dict[str, Tuple[FileContext, ast.ClassDef]] = {}
+        # class name -> method name -> set of qualified lock ids
+        self.method_locks: Dict[str, Dict[str, Set[str]]] = {}
+        for ctx in project.files:
+            if ctx.tree is None:
+                continue
+            for node in ctx.tree.body:
+                if isinstance(node, ast.ClassDef) \
+                        and node.name not in self.class_files:
+                    self.class_files[node.name] = (ctx, node)
+        for cname, (ctx, cls) in self.class_files.items():
+            per_method: Dict[str, Set[str]] = {}
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                acquired: Set[str] = set()
+                for node in ast.walk(meth):
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            lock = qualify_lock(item.context_expr, ctx,
+                                                cname)
+                            if lock:
+                                acquired.add(lock)
+                if acquired:
+                    per_method[meth.name] = acquired
+            self.method_locks[cname] = per_method
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def qualify_lock(expr: ast.AST, ctx: FileContext,
+                 cls: Optional[str]) -> Optional[str]:
+    """Canonical id of the lock `expr` denotes, or None if not a lock.
+    `self._work` in ServingEngine (a Condition over `_lock`) qualifies
+    to 'ServingEngine._lock'."""
+    attr = _self_attr(expr)
+    aliases = ctx.aliases
+    if attr is not None and cls is not None:
+        attr = aliases.cond_wraps.get(cls, {}).get(attr, attr)
+        ctor = aliases.attr_types.get(cls, {}).get(attr)
+        if (ctor in LOCK_CTORS) or LOCK_NAME_RE.search(attr):
+            return f"{cls}.{attr}"
+        return None
+    if isinstance(expr, ast.Name) and LOCK_NAME_RE.search(expr.id):
+        return f"{ctx.module_name}.{expr.id}"
+    return None
+
+
+class LockDisciplineRule(Rule):
+    """LOCK001: bare acquire(), blocking calls under a held lock, and
+    globally inconsistent lock acquisition order (deadlock risk)."""
+
+    id = "LOCK001"
+    severity = "error"
+    description = ("lock discipline: bare acquire(), blocking call under "
+                   "a lock, or inconsistent lock acquisition order")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        index = _ClassLockIndex(project)
+        # (held_lock, taken_lock) -> list of (ctx, node, description)
+        order_sites: Dict[Tuple[str, str],
+                          List[Tuple[FileContext, ast.AST]]] = {}
+        for ctx in project.files:
+            if ctx.tree is None:
+                continue
+            yield from self._check_file(ctx, index, order_sites)
+        # lock-order aggregation: a pair seen in both directions is a
+        # deadlock — report every site of both directions
+        for (a, b), sites in sorted(order_sites.items()):
+            if (b, a) in order_sites and a < b:
+                for ctx, node in sites + order_sites[(b, a)]:
+                    yield ctx.finding(
+                        self, node,
+                        f"inconsistent lock order: '{a}' and '{b}' are "
+                        f"acquired in both orders across the codebase — "
+                        f"pick one global order (deadlock risk)")
+
+    # ---- per-file walk ---------------------------------------------------
+    def _check_file(self, ctx: FileContext, index: _ClassLockIndex,
+                    order_sites) -> Iterator[Finding]:
+        for top in ctx.tree.body:
+            if isinstance(top, ast.ClassDef):
+                for meth in top.body:
+                    if isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        yield from self._walk(ctx, meth, top.name, [],
+                                              index, order_sites)
+            elif isinstance(top, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._walk(ctx, top, None, [], index,
+                                      order_sites)
+
+    def _walk(self, ctx: FileContext, node: ast.AST, cls: Optional[str],
+              held: List[str], index: _ClassLockIndex,
+              order_sites) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.With):
+                locks: List[str] = []
+                for item in child.items:
+                    lock = qualify_lock(item.context_expr, ctx, cls)
+                    if lock:
+                        if lock in held:
+                            # re-entrant with on the same lock: RLock is
+                            # fine, don't record a self-edge
+                            continue
+                        for h in held + locks:
+                            if h != lock:
+                                order_sites.setdefault(
+                                    (h, lock), []).append((ctx, item.context_expr))
+                        locks.append(lock)
+                # recurse into the With node itself so a DIRECTLY nested
+                # `with` body statement hits the With branch again
+                yield from self._walk(ctx, child, cls, held + locks,
+                                      index, order_sites)
+                continue
+            if isinstance(child, ast.Call):
+                yield from self._check_call(ctx, child, cls, held, index,
+                                            order_sites)
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested defs execute later, outside the held region
+                yield from self._walk(ctx, child, cls, [], index,
+                                      order_sites)
+                continue
+            yield from self._walk(ctx, child, cls, held, index,
+                                  order_sites)
+
+    def _check_call(self, ctx: FileContext, call: ast.Call,
+                    cls: Optional[str], held: List[str],
+                    index: _ClassLockIndex,
+                    order_sites) -> Iterator[Finding]:
+        func = call.func
+        resolve = ctx.aliases.resolve
+        if held and resolve(func) in BLOCKING_CALLS:
+            yield ctx.finding(
+                self, call,
+                f"{dotted(func)}() sleeps while holding "
+                f"{', '.join(held)} — every contending thread stalls")
+            return
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            lock = qualify_lock(base, ctx, cls)
+            # 1) bare acquire()/release() outside `with`
+            if lock and func.attr == "acquire":
+                yield ctx.finding(
+                    self, call,
+                    f"bare {dotted(func)}() — use `with {dotted(base)}:` "
+                    f"so the lock is released on every exit path")
+                return
+            attr = _self_attr(base)
+            attr_type = (ctx.aliases.attr_types.get(cls, {}).get(attr)
+                         if cls and attr else None)
+            # ctor types resolve to dotted paths; the class index and the
+            # stdlib ctor sets key on the trailing class name
+            attr_cls = attr_type.rsplit(".", 1)[-1] if attr_type else None
+            if held:
+                # 2) blocking calls while holding a lock
+                is_cond = (lock is not None
+                           or attr_type == "threading.Condition")
+                if func.attr in ("wait", "notify", "notify_all") and is_cond:
+                    pass        # Condition.wait releases the lock: exempt
+                elif func.attr == "join" and attr_type in THREAD_CTORS:
+                    yield ctx.finding(
+                        self, call,
+                        f"{dotted(func)}() blocks while holding "
+                        f"{', '.join(held)} — join outside the lock")
+                elif func.attr in ("get", "put") \
+                        and attr_type in QUEUE_CTORS \
+                        and not _call_is_nonblocking(call):
+                    yield ctx.finding(
+                        self, call,
+                        f"blocking {dotted(func)}() while holding "
+                        f"{', '.join(held)} — use _nowait/timeout or move "
+                        f"outside the lock")
+                # 3) calling into another class that takes its own lock:
+                #    record the ordering edge held -> callee lock
+                elif attr_cls in index.method_locks:
+                    for callee_lock in sorted(
+                            index.method_locks[attr_cls].get(
+                                func.attr, ())):
+                        for h in held:
+                            if h != callee_lock:
+                                order_sites.setdefault(
+                                    (h, callee_lock), []).append((ctx, call))

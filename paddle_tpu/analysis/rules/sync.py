@@ -1,0 +1,136 @@
+"""SYNC001 — implicit host↔device synchronization in decode hot paths.
+
+On TPU a `float()` / `int()` / `bool()` / `.item()` / `np.asarray()` on
+a device value blocks the host until the device catches up; inside the
+serving decode loop that turns an async pipeline into lock-step
+ping-pong (the Ragged Paged Attention serving stack lives and dies by
+keeping the decode loop free of these). The rule polices
+
+  * the named hot paths — `step()`-shaped functions in
+    `paddle_tpu/nlp/paged.py` and `paddle_tpu/serving/engine.py` — where
+    a sync is a per-chunk cost paid on every scheduler tick, and
+  * every traced function (where `int(tracer)` is an outright error
+    that only surfaces at trace time).
+
+Flagged: `.item()`, `np.asarray`/`np.array`/`jax.device_get` calls,
+`int`/`float`/`bool` whose argument mentions a jax value, and per-step
+`jnp.asarray(self.<state>)` host→device re-uploads (cache a device
+mirror instead — see ContinuousBatcher's device-state mirrors).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Tuple
+
+from ..core import FileContext, Finding, Project, Rule, dotted
+from .trace import find_traced_functions
+
+# (relpath suffix, function-name regex) pairs that form the decode hot path
+HOT_PATHS: Tuple[Tuple[str, str], ...] = (
+    ("nlp/paged.py",
+     r"^(step|run|_paged_gqa_attention|forward_paged)$"),
+    ("serving/engine.py", r"^(_loop|_dispatch|step)$"),
+)
+
+HOST_COPY_CALLS = {
+    "numpy.asarray", "numpy.array", "np.asarray", "np.array",
+    "jax.device_get",
+}
+DEVICE_UPLOAD_CALLS = {"jax.numpy.asarray", "jax.numpy.array"}
+CAST_BUILTINS = {"int", "float", "bool"}
+
+
+def _mentions_jax(node: ast.AST, resolve) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Attribute, ast.Name)):
+            target = resolve(sub)
+            if target and (target == "jax" or target.startswith("jax.")):
+                return True
+    return False
+
+
+class HostSyncRule(Rule):
+    """SYNC001: flags host↔device syncs (.item(), np.asarray, casts on
+    jax values, per-step uploads) in decode hot paths and traced fns."""
+
+    id = "SYNC001"
+    severity = "error"
+    description = ("implicit host↔device sync (int()/float()/.item()/"
+                   "np.asarray) in a decode hot path or traced function")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for ctx in project.files:
+            if ctx.tree is None:
+                continue
+            hot = self._hot_functions(ctx)
+            classified = {id(fn) for fn, _ in hot}
+            for fn, where in hot:
+                yield from self._check_fn(ctx, fn, where, classified)
+
+    def _hot_functions(self, ctx: FileContext) -> List[Tuple[ast.AST, str]]:
+        hot: List[Tuple[ast.AST, str]] = []
+        seen = set()
+        patterns = [re.compile(rx) for suffix, rx in HOT_PATHS
+                    if ctx.relpath.endswith(suffix)]
+        if patterns:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and any(p.match(node.name) for p in patterns) \
+                        and id(node) not in seen:
+                    seen.add(id(node))
+                    hot.append((node, "decode hot path"))
+        for fn, why in find_traced_functions(ctx):
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                hot.append((fn, f"traced function ({why})"))
+        return hot
+
+    def _check_fn(self, ctx: FileContext, fn: ast.AST, where: str,
+                  classified) -> Iterator[Finding]:
+        name = getattr(fn, "name", "<fn>")
+        resolve = ctx.aliases.resolve
+        # walk the body, but don't descend into nested defs that are
+        # classified hot/traced themselves — they report their own
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        nodes: List[ast.AST] = []
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and id(node) in classified:
+                continue
+            nodes.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "item" \
+                    and not node.args:
+                yield ctx.finding(
+                    self, node,
+                    f".item() in '{name}' ({where}) blocks the host on "
+                    f"the device — hoist out of the hot loop")
+                continue
+            target = resolve(func)
+            if target in HOST_COPY_CALLS:
+                yield ctx.finding(
+                    self, node,
+                    f"{dotted(func)}() device→host copy in '{name}' "
+                    f"({where}) — sync once per chunk at most, outside "
+                    f"the per-token loop")
+            elif target in DEVICE_UPLOAD_CALLS and node.args and (
+                    isinstance(node.args[0], ast.Attribute)):
+                yield ctx.finding(
+                    self, node,
+                    f"{dotted(func)}({dotted(node.args[0])}) re-uploads "
+                    f"host state to device every call of '{name}' "
+                    f"({where}) — cache a device mirror, refresh on "
+                    f"change")
+            elif (isinstance(func, ast.Name)
+                  and func.id in CAST_BUILTINS and node.args
+                  and _mentions_jax(node.args[0], resolve)):
+                yield ctx.finding(
+                    self, node,
+                    f"{func.id}() on a jax value in '{name}' ({where}) "
+                    f"blocks the host — batch the readback instead")

@@ -1,0 +1,137 @@
+"""paddle_tpu.analysis.runner — CLI: load, check, ratchet, report.
+
+    python -m paddle_tpu.analysis [paths ...] [options]
+    python tools/ptlint.py       [paths ...] [options]   (no jax import)
+
+Exit codes: 0 clean (nothing beyond the baseline), 1 new findings,
+2 usage/internal error. `--format json` emits one machine-readable
+object (findings, baselined counts, stale entries) for CI.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from . import baseline as baseline_mod
+from .core import Finding, load_project, run_rules
+from .rules import ALL_RULES, RULES_BY_ID
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ptlint",
+        description=("paddle_tpu static analysis: trace-safety (TRACE001), "
+                     "host-sync (SYNC001), lock-discipline (LOCK001), "
+                     "broad-except (EXC001), API docstrings (API001)"))
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to check (default: paddle_tpu/)")
+    p.add_argument("--root", default=".",
+                   help="path findings are reported relative to "
+                        "(default: cwd; baseline fingerprints depend on it)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--select", default=None, metavar="RULES",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"baseline file (default: "
+                        f"{baseline_mod.DEFAULT_BASELINE} under --root)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignore the baseline")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to exactly the current "
+                        "findings (burn-down: should only shrink)")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def _select_rules(spec: Optional[str]):
+    if not spec:
+        return ALL_RULES
+    rules = []
+    for rid in spec.split(","):
+        rid = rid.strip()
+        if rid not in RULES_BY_ID:
+            raise SystemExit(
+                f"ptlint: unknown rule {rid!r} "
+                f"(known: {', '.join(sorted(RULES_BY_ID))})")
+        rules.append(RULES_BY_ID[rid])
+    return rules
+
+
+def _print_text(new: List[Finding], baselined: List[Finding],
+                stale, parse_errors: List[Finding], out) -> None:
+    for f in parse_errors + new:
+        print(f"{f.location}: {f.rule} [{f.severity}] {f.message}",
+              file=out)
+        if f.snippet:
+            print(f"    {f.snippet}", file=out)
+    bits = [f"{len(new) + len(parse_errors)} new finding(s)"]
+    if baselined:
+        bits.append(f"{len(baselined)} baselined (suppressed)")
+    if stale:
+        bits.append(f"{sum(stale.values())} stale baseline entr(ies) — "
+                    f"run --update-baseline to shrink the ratchet")
+    print("ptlint: " + ", ".join(bits), file=out)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI entry: parse args, run rules, apply the ratchet, report.
+    Returns the process exit code (0 clean / 1 findings / 2 usage)."""
+    args = build_arg_parser().parse_args(argv)
+    out = sys.stdout
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.id}  [{r.severity}]  {r.description}", file=out)
+        return 0
+    try:
+        rules = _select_rules(args.select)
+    except SystemExit as e:
+        print(e, file=sys.stderr)
+        return 2
+
+    root = os.path.abspath(args.root)
+    paths = list(args.paths) if args.paths else []
+    if not paths:
+        default = os.path.join(root, "paddle_tpu")
+        if not os.path.isdir(default):
+            print("ptlint: no paths given and no paddle_tpu/ under "
+                  f"{root}", file=sys.stderr)
+            return 2
+        paths = [default]
+
+    project, parse_errors = load_project(paths, root)
+    findings = run_rules(project, rules)
+
+    baseline_path = args.baseline or os.path.join(
+        root, baseline_mod.DEFAULT_BASELINE)
+    if args.update_baseline:
+        baseline_mod.save(baseline_path, findings)
+        print(f"ptlint: baseline written to {baseline_path} "
+              f"({len(findings)} finding(s))", file=out)
+        return 0
+    if args.no_baseline:
+        result = baseline_mod.apply(findings, {})
+    else:
+        try:
+            base = baseline_mod.load(baseline_path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"ptlint: bad baseline: {e}", file=sys.stderr)
+            return 2
+        result = baseline_mod.apply(findings, base)
+
+    failed = bool(result.new) or bool(parse_errors)
+    if args.format == "json":
+        json.dump({
+            "new": [f.to_dict() for f in parse_errors + result.new],
+            "baselined": len(result.baselined),
+            "stale_baseline": result.stale,
+            "checked_files": len(project.files),
+            "exit": 1 if failed else 0,
+        }, out, indent=2)
+        out.write("\n")
+    else:
+        _print_text(result.new, result.baselined, result.stale,
+                    parse_errors, out)
+    return 1 if failed else 0

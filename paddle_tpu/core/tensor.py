@@ -89,6 +89,9 @@ class Tensor:
         if devs is not None:
             try:
                 return Place(next(iter(self._data.devices())))
+            # ptlint: disable=EXC001 — devices() on tracers/committed
+            # arrays raises jax-version-dependent types; any failure
+            # means "no concrete placement", the default below
             except Exception:
                 pass
         return _default_place()

@@ -33,7 +33,7 @@ from ...parallel.topology import get_mesh
 def _mp_size() -> int:
     try:
         return get_mesh().shape["mp"]
-    except Exception:
+    except (KeyError, RuntimeError):   # no 'mp' axis / no device backend
         return 1
 
 

@@ -85,8 +85,8 @@ class PipelineLayer(Layer):
                 num_stages = (topology.get_dim("pipe") if topology
                               else get_hybrid_communicate_group()
                               .get_pipe_parallel_world_size())
-            except Exception:
-                num_stages = 1
+            except (ValueError, KeyError, AttributeError, RuntimeError):
+                num_stages = 1   # no pipe axis configured → single stage
         self._num_stages = max(int(num_stages), 1)
         self._descs = list(layers)
 

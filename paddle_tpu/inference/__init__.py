@@ -30,6 +30,9 @@ def __getattr__(name: str):
 
 
 class Config:
+    """paddle.inference.Config parity: points a Predictor at an exported
+    model prefix (params are baked into the exported module)."""
+
     def __init__(self, model_path: Optional[str] = None,
                  params_path: Optional[str] = None):
         # params are baked into the exported module; params_path kept for
@@ -130,6 +133,9 @@ class Tensor:
 
 
 class Predictor:
+    """paddle.inference.Predictor parity: feed/run/fetch over a loaded
+    inference program (see create_predictor)."""
+
     def __init__(self, config: Config):
         from ..static import load_inference_model, Executor
         if config._prefix is None:

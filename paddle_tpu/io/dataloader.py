@@ -70,6 +70,8 @@ def _picklable(obj) -> bool:
     try:
         pickle.dumps(obj)
         return True
+    # ptlint: disable=EXC001 — pickle raises whatever the object's
+    # __reduce__ raises; ANY failure means "not picklable", the answer
     except Exception:
         return False
 
@@ -104,8 +106,8 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn,
         from .shm_ring import ShmRing
         try:
             ring = ShmRing.attach(ring_name)
-        except Exception:
-            ring = None  # fall back to the queue transport
+        except (OSError, RuntimeError):
+            ring = None  # no native lib / shm gone → queue transport
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
 
@@ -119,6 +121,9 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn,
             try:
                 ring.send(job_id, (job_id, batch, err))
                 return
+            # ptlint: disable=EXC001 — shutdown race: the ring can die
+            # mid-send in arbitrary ways; the queue below ALWAYS carries
+            # the item so the main process can never hang on a lost batch
             except Exception:
                 pass  # ring stopped/raced at shutdown → last-resort queue
         data_queue.put((job_id, batch, err))
@@ -136,6 +141,8 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn,
             batch = collate_fn(samples) if collate_fn else samples
             batch = _to_numpy_tree(batch)
             emit(job_id, batch, None)
+        # ptlint: disable=EXC001 — worker boundary: the exception is
+        # shipped to the main process and re-raised there (not swallowed)
         except Exception as e:  # surface worker errors to the main process
             emit(job_id, None, e)
 
@@ -284,8 +291,8 @@ class _MultiProcessIter:
         for iq in self.index_queues:
             try:
                 iq.put(None)
-            except Exception:
-                pass
+            except (OSError, ValueError, AssertionError):
+                pass   # queue already closed/broken mid-shutdown
         if self.ring is not None:
             self.ring.stop()
         for w in self.workers:
@@ -314,6 +321,8 @@ class _PrefetchIter:
         try:
             for item in self.inner:
                 self.q.put(item)
+        # ptlint: disable=EXC001 — prefetch boundary: the exception is
+        # handed to the consuming thread and re-raised from __next__
         except Exception as e:
             self.q.put(e)
         self.q.put(self.done)

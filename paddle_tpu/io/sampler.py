@@ -125,8 +125,8 @@ class DistributedBatchSampler(BatchSampler):
                 from ..parallel.env import get_world_size, get_rank
                 num_replicas = num_replicas or get_world_size()
                 rank = rank if rank is not None else get_rank()
-            except Exception:
-                num_replicas, rank = 1, 0
+            except (ImportError, AttributeError, RuntimeError):
+                num_replicas, rank = 1, 0   # no distributed env → 1 replica
         self.nranks = num_replicas
         self.local_rank = rank
         self.epoch = 0

@@ -87,6 +87,9 @@ def get_lib():
         lib.ring_stop.argtypes = [ctypes.c_void_p]
         lib.ring_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
         _lib = lib
+    # ptlint: disable=EXC001 — the error is PRESERVED on _lib_error for
+    # native_available() diagnostics; any build/dlopen failure (no
+    # compiler, no /dev/shm) degrades to the python transport
     except Exception as e:  # no compiler / no /dev/shm → python fallback
         _lib_error = e
     return _lib
@@ -350,5 +353,7 @@ class ShmRing:
     def __del__(self):
         try:
             self.close()
+        # ptlint: disable=EXC001 — __del__ must never raise (interpreter
+        # teardown: modules/attrs may already be gone)
         except Exception:
             pass

@@ -90,6 +90,9 @@ class TranslatedLayer:
         self._layer = layer
 
         def fwd(state, args, kwargs, training):
+            # ptlint: disable=TRACE001 — training is a static argnum:
+            # each value retraces, so this trace-time write IS the
+            # mechanism that specializes the compiled forward
             layer.training = training
             out, new_state = functional_call(layer, state, *args, **kwargs)
             return out, new_state

@@ -841,6 +841,8 @@ def _use_pallas(x):
     # previously disabled the Pallas path in every jitted step.
     try:
         plat = next(iter(x.devices())).platform
+    # ptlint: disable=EXC001 — devices() on a tracer raises a jax-version-
+    # dependent type; tracing means "compile for the default backend"
     except Exception:
         plat = jax.default_backend()
     return plat not in ("cpu",)

@@ -258,6 +258,8 @@ def sep_attention(q, k, v, mesh: Mesh, impl: str = "ring",
         if ctx is not None and ctx.shape_tuple and any(
                 t == jax.sharding.AxisType.Manual for t in ctx.axis_types):
             mesh = ctx
+    # ptlint: disable=EXC001 — the abstract-mesh API differs across jax
+    # versions; probe failure means "no context mesh", keep the concrete one
     except Exception:
         pass
     spec = _sep_specs(mesh)

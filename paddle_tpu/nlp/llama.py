@@ -251,6 +251,9 @@ def in_manual_axis(*names) -> bool:
         try:
             jax.lax.axis_index(n)
             return True
+        # ptlint: disable=EXC001 — axis_index on an unbound axis raises a
+        # jax-version-dependent type (NameError today); unbound IS the
+        # probe result, not a failure
         except Exception:
             continue
     return False

@@ -318,6 +318,11 @@ class ContinuousBatcher:
         self.slot_blocks: List[Optional[List[int]]] = [None] * max_batch
         self.budget = [0] * max_batch
         self.stop = [-1] * max_batch          # per-slot stop id (-1 = none)
+        # device mirrors of (active, budget, stop): the decode chunk both
+        # consumes and RETURNS them, so steady-state decoding re-uploads
+        # nothing (SYNC001) — admission/retirement null the mirror and the
+        # next step refreshes it from the host lists
+        self._dev_state = None
         self.cur_tok = jnp.zeros((max_batch,), jnp.int32)
         self.queue: List = []
         self.outputs: Dict[int, List[int]] = {}
@@ -398,6 +403,14 @@ class ContinuousBatcher:
         return False
 
     # -- internals --------------------------------------------------------
+    def _upload_slot_state(self):
+        """Host slot lists → device arrays. Deliberately OUTSIDE step()'s
+        hot path: it runs only when admission/retirement invalidated the
+        mirror, so lock-step decode pays zero host→device uploads."""
+        return (jnp.asarray(self.active),
+                jnp.asarray(self.budget, jnp.int32),
+                jnp.asarray(self.stop, jnp.int32))
+
     def _admit_one(self, slot: int, rid: int, toks: List[int],
                    stop: int = -1, max_new: Optional[int] = None) -> None:
         P = len(toks)
@@ -431,6 +444,7 @@ class ContinuousBatcher:
         self.slot_blocks[slot] = blocks[:need]
         self.budget[slot] = mn - 1
         self.stop[slot] = stop
+        self._dev_state = None        # host slot state diverged from device
         self.outputs[rid].append(first)
         if ((self.eos is not None and first == self.eos)
                 or first == stop or self.budget[slot] <= 0):
@@ -443,6 +457,7 @@ class ContinuousBatcher:
         self.slot_req[slot] = None
         self.slot_blocks[slot] = None
         self.stop[slot] = -1
+        self._dev_state = None        # host slot state diverged from device
 
     def _admit(self) -> None:
         for slot in range(self.B):
@@ -488,7 +503,9 @@ class ContinuousBatcher:
             (cache, tok, lengths, budget, act), toks = jax.lax.scan(
                 step, (cache, tok, lengths, budget, active), None,
                 length=chunk)
-            return cache, tok, lengths, budget, toks.T     # [B, chunk]
+            # act/budget go back to the caller so the next chunk can feed
+            # them in again without a host round-trip
+            return cache, tok, lengths, budget, act, toks.T   # [B, chunk]
 
         return jax.jit(run_chunk)
 
@@ -504,14 +521,20 @@ class ContinuousBatcher:
             self._chunk_fn = self._build_chunk()
         self._admit()
         if any(self.active):
-            active = jnp.asarray(self.active)
-            budget = jnp.asarray(self.budget, jnp.int32)
-            stop = jnp.asarray(self.stop, jnp.int32)
-            self.cache, self.cur_tok, lengths, _, toks = self._chunk_fn(
+            if self._dev_state is None:
+                self._dev_state = self._upload_slot_state()
+            active, budget, stop = self._dev_state
+            (self.cache, self.cur_tok, lengths, budget, active,
+             toks) = self._chunk_fn(
                 self.params, self.cache, self.cur_tok, active,
                 self.cache.lengths, budget, stop)
             self.cache = self.cache._replace(lengths=lengths)
-            toks = np.asarray(toks)
+            # steady state: the chunk's own outputs are next chunk's
+            # inputs; _retire/_admit_one null this when the host diverges
+            self._dev_state = (active, budget, stop)
+            # one host sync per decode chunk — the per-token loop below
+            # reads this numpy copy, never the device
+            toks = np.asarray(toks)  # ptlint: disable=SYNC001 — single per-chunk sync, hoisted out of the per-token loop
             for slot in range(self.B):
                 if not self.active[slot]:
                     continue

@@ -87,6 +87,9 @@ def _opt_specs_like(opt_state_shape, params_shape, pspecs):
         try:
             if jax.tree.structure(node) == params_treedef:
                 return pspecs
+        # ptlint: disable=EXC001 — structure() on arbitrary optax state
+        # leaves raises type-dependent errors; "not param-shaped" is the
+        # answer, recursion below handles the node
         except Exception:
             pass
         if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
@@ -214,6 +217,9 @@ def make_train_step(cfg, tx, mesh: Optional[Mesh] = None,
             new_params, new_opt = tx.apply_fused(
                 grads, state.opt_state, state.params)
         else:
+            # ptlint: disable=TRACE001 — optax GradientTransformation.
+            # update is pure: it RETURNS (updates, new_state), mutating
+            # nothing (the name collides with dict.update)
             updates, new_opt = tx.update(grads, state.opt_state,
                                          state.params)
             new_params = optax.apply_updates(state.params, updates)

@@ -311,6 +311,8 @@ class ServingEngine:
             try:
                 with timer:
                     emitted, finished = self.batcher.step()
+            # ptlint: disable=EXC001 — step boundary: the error is attached
+            # to every in-flight request and re-raised in their result()
             except Exception as e:        # device-step boundary
                 self._fail_all_running(e)
                 continue
@@ -389,6 +391,9 @@ class ServingEngine:
                     self._c_tokens.inc()
                     if req.on_token is not None:
                         req.on_token(t)
+            # ptlint: disable=EXC001 — per-request boundary: the consumer
+            # callback's error fails ONLY this request; it is attached to
+            # the handle and re-raised in its result()/stream()
             except Exception as e:        # per-request boundary
                 self.batcher.abort(rid)
                 self.batcher.release(rid)

@@ -31,6 +31,9 @@ __all__ = [
 
 
 class RequestState(enum.Enum):
+    """Lifecycle states of a GenerationRequest (see module docstring
+    for the transition diagram)."""
+
     QUEUED = "QUEUED"
     PREFILL = "PREFILL"
     DECODING = "DECODING"
@@ -55,15 +58,16 @@ class RequestError(RuntimeError):
 
 
 class RequestCancelled(RequestError):
-    pass
+    """result()/stream() on a request that was cancel()ed."""
 
 
 class RequestTimedOut(RequestError):
-    pass
+    """result()/stream() on a request whose deadline expired."""
 
 
 class RequestFailed(RequestError):
-    pass
+    """result()/stream() on a request whose decode step or on_token
+    callback raised (the original error is on `.request.error`)."""
 
 
 _SENTINEL = object()      # channel close marker

@@ -1,0 +1,611 @@
+"""ptlint (paddle_tpu.analysis) — rule unit tests on purpose-built
+fixtures (a true positive AND a true negative per rule), suppression
+comments, the baseline ratchet, the CLI, and the whole-package gate:
+`paddle_tpu/` must be clean beyond the committed baseline.
+
+These tests exercise the AST engine only — no jax tracing happens, so
+the file is cheap even inside the tier-1 budget."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import (
+    ALL_RULES, RULES_BY_ID, analyze_source, apply_baseline,
+    load_baseline, load_project, run_rules, save_baseline,
+)
+from paddle_tpu.analysis.runner import main as ptlint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_src(src, rule=None, relpath="snippet.py"):
+    fs = analyze_source(textwrap.dedent(src), relpath=relpath)
+    return [f for f in fs if rule is None or f.rule == rule]
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# TRACE001
+# ---------------------------------------------------------------------------
+
+def test_trace_print_in_decorated_jit():
+    fs = run_src("""
+        import jax
+        @jax.jit
+        def f(x):
+            print("tracing!", x)
+            return x
+    """, "TRACE001")
+    assert len(fs) == 1 and "print()" in fs[0].message
+
+
+def test_trace_closure_append_in_wrapped_fn():
+    fs = run_src("""
+        import jax
+        log = []
+        def f(x):
+            log.append(x)
+            return x
+        g = jax.jit(f)
+    """, "TRACE001")
+    assert len(fs) == 1 and "log.append" in fs[0].message
+
+
+def test_trace_global_statement_and_attr_store():
+    fs = run_src("""
+        from jax import jit
+        state = {}
+        class Holder: pass
+        h = Holder()
+        @jit
+        def f(x):
+            global counter
+            counter = 1
+            h.field = x
+            return x
+    """, "TRACE001")
+    msgs = " | ".join(f.message for f in fs)
+    assert "global" in msgs and "attribute 'field'" in msgs
+
+
+def test_trace_scan_body_flagged():
+    fs = run_src("""
+        from jax import lax
+        def body(carry, x):
+            print(carry)
+            return carry, x
+        out = lax.scan(body, 0, None)
+    """, "TRACE001")
+    assert len(fs) == 1 and "body of jax.lax.scan" in fs[0].message
+
+
+def test_trace_fori_and_while_bodies_flagged():
+    # fori_loop's body is args[2], while_loop's cond/body are args[0:2]
+    fs = run_src("""
+        from jax import lax
+        def body(i, carry):
+            print(i)
+            return carry
+        out = lax.fori_loop(0, 10, body, 0)
+        def cond(c):
+            print(c)
+            return True
+        out2 = lax.while_loop(cond, lambda c: c, 0)
+    """, "TRACE001")
+    assert len(fs) == 2
+
+
+def test_trace_negative_eager_fn_and_local_mutation():
+    fs = run_src("""
+        import jax
+        def eager(x):
+            print(x)          # not traced: fine
+            return x
+        @jax.jit
+        def f(x):
+            acc = []
+            acc.append(x)     # local list: fine
+            return acc
+    """, "TRACE001")
+    assert fs == []
+
+
+def test_trace_same_name_method_not_confused_with_jitted_inner():
+    # LLMEngine.run regression: the HOST-side method shares the name of
+    # the nested traced fn; only the inner one is traced
+    fs = run_src("""
+        import jax
+        class Engine:
+            def run(self):
+                print("host side, fine")
+                def run(params):
+                    return params
+                return jax.jit(run)
+    """, "TRACE001")
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# SYNC001
+# ---------------------------------------------------------------------------
+
+def test_sync_hot_path_flags_syncs():
+    fs = run_src("""
+        import numpy as np
+        import jax.numpy as jnp
+        class Batcher:
+            def step(self):
+                active = jnp.asarray(self.active)     # re-upload
+                toks = np.asarray(self.toks)          # host copy
+                loss = self.metrics.item()            # blocking sync
+                return int(jnp.argmax(self.logits))   # blocking cast
+    """, "SYNC001", relpath="paddle_tpu/nlp/paged.py")
+    assert len(fs) == 4
+    msgs = " | ".join(f.message for f in fs)
+    assert "re-uploads" in msgs and ".item()" in msgs
+
+
+def test_sync_negative_cold_path_and_host_values():
+    # same code in a non-hot file: silent; host-only casts in a hot
+    # file: silent
+    assert run_src("""
+        import numpy as np
+        class Batcher:
+            def step(self):
+                return np.asarray(self.toks)
+    """, "SYNC001", relpath="paddle_tpu/other/module.py") == []
+    assert run_src("""
+        class Batcher:
+            def step(self):
+                n = int(len(self.queue))    # host int: fine
+                return n
+    """, "SYNC001", relpath="paddle_tpu/nlp/paged.py") == []
+
+
+def test_sync_item_in_traced_fn_any_file():
+    fs = run_src("""
+        import jax
+        @jax.jit
+        def f(x):
+            return x.item()
+    """, "SYNC001")
+    assert len(fs) == 1
+
+
+# ---------------------------------------------------------------------------
+# LOCK001
+# ---------------------------------------------------------------------------
+
+def test_lock_bare_acquire():
+    fs = run_src("""
+        import threading
+        _lock = threading.Lock()
+        def f():
+            _lock.acquire()
+            _lock.release()
+    """, "LOCK001")
+    assert len(fs) == 1 and "bare" in fs[0].message
+
+
+def test_lock_blocking_calls_under_lock():
+    fs = run_src("""
+        import queue
+        import threading
+        import time
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._chan = queue.Queue()
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(0.1)
+            def bad_get(self):
+                with self._lock:
+                    return self._chan.get()
+    """, "LOCK001")
+    msgs = " | ".join(f.message for f in fs)
+    assert len(fs) == 2 and "sleeps" in msgs and "blocking" in msgs
+
+
+def test_lock_timeout_none_still_blocking():
+    fs = run_src("""
+        import queue
+        import threading
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._chan = queue.Queue()
+            def bad(self):
+                with self._lock:
+                    return self._chan.get(timeout=None)   # blocks forever
+    """, "LOCK001")
+    assert len(fs) == 1
+
+
+def test_lock_negatives_with_condition_and_timeouts():
+    fs = run_src("""
+        import queue
+        import threading
+        import time
+        class Engine:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._work = threading.Condition(self._lock)
+                self._chan = queue.Queue()
+            def ok(self):
+                with self._work:
+                    self._work.wait()           # releases the lock
+                    self._chan.get(timeout=1)   # bounded
+                    self._chan.get_nowait()
+                time.sleep(0.1)                 # outside the lock
+    """, "LOCK001")
+    assert fs == []
+
+
+def test_lock_order_inconsistency_nested_with():
+    fs = run_src("""
+        import threading
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+        def f():
+            with a_lock:
+                with b_lock:
+                    pass
+        def g():
+            with b_lock:
+                with a_lock:
+                    pass
+    """, "LOCK001")
+    assert len(fs) == 2
+    assert all("inconsistent lock order" in f.message for f in fs)
+
+
+def test_lock_order_inconsistency_cross_class():
+    # the ServingEngine <-> AdmissionQueue shape: holding my lock while
+    # calling a method of a typed attribute that takes ITS lock
+    fs = run_src("""
+        import threading
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.b = B()
+            def m(self):
+                with self._lock:
+                    self.b.n()          # A._lock -> B._lock
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.a = A()
+            def n(self):
+                with self._lock:
+                    pass
+            def p(self):
+                with self._lock:
+                    self.a.m()          # B._lock -> A._lock: conflict
+    """, "LOCK001")
+    assert len(fs) == 2
+    assert all("inconsistent lock order" in f.message for f in fs)
+
+
+def test_lock_order_consistent_is_clean():
+    fs = run_src("""
+        import threading
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+        def f():
+            with a_lock:
+                with b_lock:
+                    pass
+        def g():
+            with a_lock:
+                with b_lock:
+                    pass
+    """, "LOCK001")
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# EXC001
+# ---------------------------------------------------------------------------
+
+def test_exc_broad_swallow_flagged():
+    fs = run_src("""
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+        def g():
+            try:
+                work()
+            except:
+                return None
+    """, "EXC001")
+    assert len(fs) == 2
+
+
+def test_exc_log_substring_names_do_not_count_as_logging():
+    # catalog/dialog contain 'log' but are NOT logging calls
+    fs = run_src("""
+        def f(self):
+            try:
+                work()
+            except Exception as e:
+                self.catalog.append(e)
+        def g(self):
+            try:
+                work()
+            except Exception:
+                self.dialog.close()
+    """, "EXC001")
+    assert len(fs) == 2
+
+
+def test_exc_negatives():
+    fs = run_src("""
+        import logging
+        import warnings
+        def a():
+            try:
+                work()
+            except ValueError:        # narrow: fine
+                pass
+        def b():
+            try:
+                work()
+            except Exception:
+                raise                 # re-raise: fine
+        def c():
+            try:
+                work()
+            except Exception as e:
+                logging.warning(e)    # logged: fine
+        def d():
+            try:
+                work()
+            except Exception as e:
+                warnings.warn(str(e))
+    """, "EXC001")
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# API001 (multi-file: needs a real project on disk)
+# ---------------------------------------------------------------------------
+
+_mini_count = [0]
+
+
+def _mini_project(tmp_path, init_src, mod_src):
+    _mini_count[0] += 1
+    pkg = tmp_path / f"pkg{_mini_count[0]}"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(textwrap.dedent(init_src))
+    (pkg / "mod.py").write_text(textwrap.dedent(mod_src))
+    project, errs = load_project([str(pkg)], str(tmp_path))
+    assert errs == []
+    return [f for f in run_rules(project, ALL_RULES) if f.rule == "API001"]
+
+
+def test_api_missing_docstring_across_modules(tmp_path):
+    fs = _mini_project(
+        tmp_path,
+        """
+        from .mod import documented, bare
+        __all__ = ["documented", "bare", "local_bare"]
+        def local_bare():
+            return 1
+        """,
+        '''
+        def documented():
+            """Has one."""
+        def bare():
+            return 2
+        ''')
+    names = sorted(f.message.split("'")[1] for f in fs)
+    assert names == ["bare", "local_bare"]
+
+
+def test_api_negative_all_documented_or_no_all(tmp_path):
+    assert _mini_project(
+        tmp_path,
+        """
+        from .mod import documented
+        __all__ = ["documented"]
+        """,
+        '''
+        def documented():
+            """Yes."""
+        ''') == []
+    # no __all__: implicit surface, skipped entirely
+    assert _mini_project(
+        tmp_path,
+        "from .mod import bare\n",
+        "def bare():\n    return 2\n") == []
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+# ---------------------------------------------------------------------------
+
+def test_suppression_inline_and_standalone():
+    clean = run_src("""
+        def f():
+            try:
+                work()
+            except Exception:  # ptlint: disable=EXC001 — justified here
+                pass
+        def g():
+            try:
+                work()
+            # ptlint: disable=EXC001 — two-line justification, the
+            # comment block carries to the handler line below
+            except Exception:
+                pass
+    """, "EXC001")
+    assert clean == []
+
+
+def test_suppression_survives_blank_line():
+    assert run_src("""
+        def f():
+            try:
+                work()
+            # ptlint: disable=EXC001 — justified
+
+            except Exception:
+                pass
+    """, "EXC001") == []
+
+
+def test_suppression_disable_all_and_wrong_rule():
+    assert run_src("""
+        def f():
+            try:
+                work()
+            except Exception:  # ptlint: disable=all
+                pass
+    """, "EXC001") == []
+    # disabling a DIFFERENT rule does not silence this one
+    fs = run_src("""
+        def f():
+            try:
+                work()
+            except Exception:  # ptlint: disable=SYNC001
+                pass
+    """, "EXC001")
+    assert len(fs) == 1
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet
+# ---------------------------------------------------------------------------
+
+_VIOLATION = ("def f():\n    try:\n        g()\n"
+              "    except Exception:\n        pass\n")
+
+
+def _write_pkg(tmp_path, n_violations):
+    src = "".join(_VIOLATION.replace("def f", f"def f{i}")
+                  for i in range(n_violations))
+    p = tmp_path / "code.py"
+    p.write_text(src or "x = 1\n")
+    return p
+
+
+def test_baseline_absorbs_then_ratchets(tmp_path):
+    p = _write_pkg(tmp_path, 1)
+    bl = tmp_path / "baseline.json"
+    args = [str(p), "--root", str(tmp_path), "--baseline", str(bl)]
+    assert ptlint_main(args + ["--update-baseline"]) == 0
+    assert ptlint_main(args) == 0                 # baselined: clean
+    # adding a NEW violation fails even though the old one is baselined
+    _write_pkg(tmp_path, 2)
+    assert ptlint_main(args) == 1
+
+
+def test_baseline_shrinks_cleanly(tmp_path, capsys):
+    p = _write_pkg(tmp_path, 2)
+    bl = tmp_path / "baseline.json"
+    args = [str(p), "--root", str(tmp_path), "--baseline", str(bl)]
+    assert ptlint_main(args + ["--update-baseline"]) == 0
+    # identical handler lines share one fingerprint with count 2
+    assert sum(load_baseline(str(bl)).values()) == 2
+    # burn one down: the run stays green and reports the stale entry
+    _write_pkg(tmp_path, 1)
+    capsys.readouterr()
+    assert ptlint_main(args) == 0
+    assert "stale" in capsys.readouterr().out
+    # --update-baseline shrinks the file to the surviving violation
+    assert ptlint_main(args + ["--update-baseline"]) == 0
+    assert sum(load_baseline(str(bl)).values()) == 1
+
+
+def test_baseline_apply_counts():
+    fs = analyze_source(_VIOLATION + _VIOLATION.replace("def f", "def h"))
+    assert len(fs) == 2
+    base = {fs[0].fingerprint: 1}
+    res = apply_baseline(fs, base)
+    assert len(res.new) == 1 and len(res.baselined) == 1 and not res.stale
+
+
+def test_baseline_save_load_roundtrip(tmp_path):
+    fs = analyze_source(_VIOLATION)
+    path = tmp_path / "b.json"
+    saved = save_baseline(str(path), fs)
+    assert load_baseline(str(path)) == saved
+    assert apply_baseline(fs, saved).new == []
+
+
+# ---------------------------------------------------------------------------
+# CLI / integration
+# ---------------------------------------------------------------------------
+
+def test_cli_json_format(tmp_path, capsys):
+    p = _write_pkg(tmp_path, 1)
+    rc = ptlint_main([str(p), "--root", str(tmp_path), "--no-baseline",
+                      "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["exit"] == 1
+    assert out["new"][0]["rule"] == "EXC001"
+    assert out["new"][0]["path"] == "code.py"
+
+
+def test_cli_select_and_list_rules(tmp_path, capsys):
+    p = _write_pkg(tmp_path, 1)
+    rc = ptlint_main([str(p), "--root", str(tmp_path), "--no-baseline",
+                      "--select", "SYNC001"])
+    assert rc == 0                                # EXC001 not selected
+    assert ptlint_main(["--list-rules"]) == 0
+    assert "TRACE001" in capsys.readouterr().out
+    assert ptlint_main([str(p), "--select", "NOPE"]) == 2
+
+
+def test_parse_error_reported_not_crash(tmp_path, capsys):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    rc = ptlint_main([str(p), "--root", str(tmp_path), "--no-baseline"])
+    assert rc == 1
+    assert "PARSE" in capsys.readouterr().out
+
+
+def test_ptlint_script_runs_standalone():
+    # the CI entry point: must work WITHOUT importing the framework
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ptlint.py"),
+         "--list-rules"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    for rid in RULES_BY_ID:
+        assert rid in out.stdout
+
+
+def test_repo_clean_beyond_committed_baseline():
+    """The acceptance gate: paddle_tpu/ has no findings beyond the
+    committed baseline, and the baseline has no stale entries."""
+    project, errs = load_project([os.path.join(REPO, "paddle_tpu")], REPO)
+    assert errs == []
+    findings = run_rules(project, ALL_RULES)
+    base = load_baseline(os.path.join(REPO, "tools",
+                                      "ptlint_baseline.json"))
+    res = apply_baseline(findings, base)
+    assert res.new == [], "\n".join(
+        f"{f.location} {f.rule} {f.message}" for f in res.new)
+    assert res.stale == {}, res.stale
+
+
+@pytest.mark.slow
+def test_module_entrypoint_exits_zero():
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "paddle_tpu/",
+         "--root", REPO],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout + out.stderr
