@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: lint test baseline lint-all
+.PHONY: lint test baseline lint-all bench-smoke
 
 lint:           ## ratcheted static analysis (fails on non-baselined findings)
 	$(PY) tools/ptlint.py --format json
@@ -17,3 +17,7 @@ baseline:       ## rewrite tools/ptlint_baseline.json (should only shrink)
 test:           ## tier-1 test suite (CPU)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+bench-smoke:    ## tiny prefix-share serving bench (non-blocking CI job)
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --prefix-share \
+		--n-requests 6 --max-new 4

@@ -7,12 +7,21 @@ admission→batcher→channel path, plus TTFT and queue-wait percentiles —
 the serving-layer numbers the device-side decode benches in bench.py
 cannot see (queueing, scheduling, host fan-out overhead).
 
+Workloads:
+  * `random` (default) — independent prompts of random lengths, the
+    original scheduling/overhead bench;
+  * `prefix-share` (`--prefix-share`) — N requests sharing one common
+    prompt prefix (the system-prompt / few-shot pattern), exercising the
+    `serving.cache` prefix cache: the JSON line gains
+    `prefix_cache_hit_rate` and `prefill_tokens_saved`.
+
 Deliberately a tiny model on CPU: this measures the HOST serving layer's
 overhead and scheduling behavior deterministically; device-side decode
 throughput is bench.py's `decode_tok_s`.
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import time
@@ -22,8 +31,20 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import numpy as np
 
 
+def _make_prompts(rng, n_requests: int, workload: str,
+                  prefix_len: int, suffix_len: int):
+    if workload == "prefix-share":
+        common = list(map(int, rng.randint(1, 200, prefix_len)))
+        return [common + list(map(int, rng.randint(1, 200, suffix_len)))
+                for _ in range(n_requests)]
+    return [list(map(int, rng.randint(1, 200, int(L))))
+            for L in rng.randint(4, 16, n_requests)]
+
+
 def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
-         block_size: int = 8, chunk: int = 4) -> dict:
+         block_size: int = 8, chunk: int = 4, workload: str = "random",
+         prefix_len: int = 24, suffix_len: int = 6,
+         prefix_cache: bool = True) -> dict:
     import jax
     from paddle_tpu.nlp import llama
     from paddle_tpu import serving
@@ -31,17 +52,21 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
     cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=2)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     rng = np.random.RandomState(0)
-    prompts = [list(map(int, rng.randint(1, 200, int(L))))
-               for L in rng.randint(4, 16, n_requests)]
+    prompts = _make_prompts(rng, n_requests, workload,
+                            prefix_len, suffix_len)
 
     eng = serving.ServingEngine(
         params, cfg, max_batch=max_batch, block_size=block_size,
         max_total_len=64, max_new_tokens=max_new, chunk=chunk,
-        max_queue_depth=n_requests, start=False)
+        max_queue_depth=n_requests, prefix_cache=prefix_cache,
+        start=False)
     # warmup: compile the chunk fn + prefill shapes outside the timing
+    # (for prefix-share it also PRIMES the cache — the steady-state view
+    # a shared system prompt actually serves under)
     eng.start()
     eng.generate(prompts[0], timeout=600)
     completed0 = eng.metrics.counter("requests_completed").value
+    pc0 = eng.snapshot()["prefix_cache"]
 
     t0 = time.perf_counter()
     reqs = [eng.submit(p) for p in prompts]
@@ -59,6 +84,7 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         "metric": "serving_offline_tok_s",
         "value": round(toks / wall, 1),
         "unit": "tokens/s",
+        "workload": workload,
         "n_requests": n_requests,
         "max_batch": max_batch,
         "max_new_tokens": max_new,
@@ -76,8 +102,46 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         "kv_high_water_blocks": snap["allocator"]["high_water_blocks"],
         "kv_reused_blocks": snap["allocator"]["reused_blocks"],
     }
+    pc = snap["prefix_cache"]
+    if pc.get("enabled"):
+        # deltas over the timed window (the warmup request primed the
+        # cache but must not count as a hit)
+        lookups = pc["prompt_tokens"] - pc0["prompt_tokens"]
+        saved = pc["hit_tokens"] - pc0["hit_tokens"]
+        result.update({
+            "prefix_cache_hit_rate": round(saved / lookups, 4)
+            if lookups else 0.0,
+            "prefill_tokens_saved": saved,
+            "prefix_cache_evictions": pc["evicted_blocks"],
+            "prefix_cache_cached_blocks": pc["cached_blocks"],
+        })
     return result
 
 
+def _cli() -> dict:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--prefix-share", action="store_true",
+                    help="N requests sharing a common prompt prefix "
+                         "(exercises the prefix cache)")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="serve with the prefix cache disabled")
+    ap.add_argument("--n-requests", type=int, default=16)
+    ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=4)
+    ap.add_argument("--prefix-len", type=int, default=24,
+                    help="shared prefix length for --prefix-share")
+    ap.add_argument("--suffix-len", type=int, default=6,
+                    help="per-request suffix length for --prefix-share")
+    a = ap.parse_args()
+    return main(n_requests=a.n_requests, max_new=a.max_new,
+                max_batch=a.max_batch, block_size=a.block_size,
+                chunk=a.chunk,
+                workload="prefix-share" if a.prefix_share else "random",
+                prefix_len=a.prefix_len, suffix_len=a.suffix_len,
+                prefix_cache=not a.no_prefix_cache)
+
+
 if __name__ == "__main__":
-    print(json.dumps(main()))
+    print(json.dumps(_cli()))

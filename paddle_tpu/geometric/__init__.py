@@ -51,18 +51,26 @@ def _segment(op_name, data, segment_ids, num_segments=None):
 
 
 def segment_sum(data, segment_ids, name=None):
+    """Sum rows of `data` that share a segment id (paddle.geometric
+    .segment_sum); segments are 0..max(segment_ids)."""
     return _segment("sum", data, segment_ids)
 
 
 def segment_mean(data, segment_ids, name=None):
+    """Mean of rows sharing a segment id; empty segments yield 0 (the
+    reference's fill), not NaN."""
     return _segment("mean", data, segment_ids)
 
 
 def segment_max(data, segment_ids, name=None):
+    """Per-segment max of rows sharing a segment id; empty segments
+    fill with 0 where jax would fill -inf (reference parity)."""
     return _segment("max", data, segment_ids)
 
 
 def segment_min(data, segment_ids, name=None):
+    """Per-segment min of rows sharing a segment id; empty segments
+    fill with 0 where jax would fill +inf (reference parity)."""
     return _segment("min", data, segment_ids)
 
 

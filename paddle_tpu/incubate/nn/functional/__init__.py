@@ -144,6 +144,10 @@ def variable_length_memory_efficient_attention(query, key, value,
 
 
 def fused_multi_head_attention(*args, **kwargs):
+    """Reference-signature stub: the monolithic fused MHA op does not
+    exist here — use nn.MultiHeadAttention (module) or
+    F.flash_attention (functional), which run the same Pallas kernel
+    the fused op would."""
     raise NotImplementedError(
         "fused_multi_head_attention: use nn.MultiHeadAttention or "
         "F.flash_attention (paddle_tpu/incubate/nn/functional/__init__.py)")
@@ -181,6 +185,10 @@ def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """x @ weight + bias (paddle.incubate.nn.functional.fused_linear):
+    the cublasLt-epilogue op of the reference; XLA fuses the bias add
+    natively, so this is fused_matmul_bias with the linear-layer
+    argument order."""
     return fused_matmul_bias(x, weight, bias, False, transpose_weight)
 
 
@@ -234,6 +242,8 @@ def block_multihead_attention(qkv, cache_k, cache_v, seq_lens, *,
 
 def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
                             activation="gelu", name=None):
+    """GEMM + bias + activation in one op (gelu/relu/none) — the
+    epilogue-fusion chain XLA folds into a single kernel on TPU."""
     out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
     from ....ops._registry import eager
     act = {"gelu": jax.nn.gelu, "relu": lambda a: jnp.maximum(a, 0),
@@ -264,6 +274,9 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
 
 
 def fused_bias_act(x, bias=None, act_method="gelu", name=None, **kw):
+    """bias-add + activation (gelu/relu/silu/swiglu) in one op; for
+    swiglu the input splits in half on the last axis after the bias
+    add (the reference's fused_bias_act generation epilogue)."""
     from ....ops._registry import eager
     act = {"gelu": jax.nn.gelu, "relu": lambda a: jnp.maximum(a, 0),
            "silu": jax.nn.silu, "swiglu": None}[act_method]

@@ -18,7 +18,11 @@ TPU-native design: everything on device is STATIC-shape —
     requires a common position);
   * block allocation/free is host-side (BlockAllocator below) — the
     reference does the same (its block tables are built by the serving
-    layer, not the kernel).
+    layer, not the kernel);
+  * the indirection makes KV sharing free: with prefix caching on
+    (RefcountingBlockAllocator + serving.cache.PrefixCacheIndex),
+    several requests' table rows name the same pool blocks for a shared
+    prompt prefix, and prefill runs only on each request's suffix.
 The attention here is the exact grouped-GQA formulation (generation.
 _gqa_cached_attention's paged twin); a Pallas block-gather kernel is the
 named follow-up once serving perf work starts (the dense decode bench
@@ -27,12 +31,17 @@ remains the perf path this round).
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, List, NamedTuple, Optional
+from collections import OrderedDict
+from typing import (TYPE_CHECKING, Any, Callable, Dict, List, NamedTuple,
+                    Optional, Sequence, Tuple)
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+if TYPE_CHECKING:  # annotation-only: the nlp -> serving edge stays lazy
+    from ..serving.cache import PrefixCacheIndex
 
 from ..kernels.rms_norm import rms_norm_ref
 from ..kernels.rope import rope_freqs, apply_rope_half
@@ -64,6 +73,7 @@ class BlockAllocator:
     def __init__(self, num_blocks: int):
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks))
+        self._free_set: set = set(self._free)
         self._ever_used: set = set()
         self.reused_blocks = 0
         self.high_water = 0
@@ -74,14 +84,43 @@ class BlockAllocator:
                 f"pool exhausted: need {n} blocks, {len(self._free)} free")
         blocks = self._free[:n]
         del self._free[:n]
+        self._free_set.difference_update(blocks)
+        self._note_allocated(blocks)
+        return blocks
+
+    def _note_allocated(self, blocks: List[int]) -> None:
         self.reused_blocks += sum(1 for b in blocks if b in self._ever_used)
         self._ever_used.update(blocks)
         self.high_water = max(self.high_water,
-                              self.num_blocks - len(self._free))
-        return blocks
+                              self.num_blocks - self.free_blocks)
+
+    def _check_returnable(self, b: int, seen: set, what: str) -> None:
+        """A returned block id must be in range and not already free —
+        a silent double free splices one block into the free list twice
+        and two later requests end up writing the same KV block."""
+        if not 0 <= b < self.num_blocks:
+            raise ValueError(
+                f"{what}: block id {b} out of range "
+                f"[0, {self.num_blocks})")
+        if b in self._free_set or b in seen:
+            raise ValueError(
+                f"{what}: block {b} is already free (double free)")
 
     def free(self, blocks: List[int]) -> None:
+        """Return blocks to the free list. Raises ValueError on
+        out-of-range or already-free ids (double-free detection) before
+        mutating anything."""
+        seen: set = set()
+        for b in blocks:
+            self._check_returnable(b, seen, "free()")
+            seen.add(b)
         self._free.extend(blocks)
+        self._free_set.update(blocks)
+
+    def release(self, blocks: List[int]) -> None:
+        """Alias of free() so callers can be allocator-agnostic — the
+        refcounting subclass gives release() decref semantics."""
+        self.free(blocks)
 
     @property
     def free_blocks(self) -> int:
@@ -93,6 +132,144 @@ class BlockAllocator:
             "blocks_in_use": self.num_blocks - len(self._free),
             "high_water_blocks": self.high_water,
             "reused_blocks": self.reused_blocks,
+        }
+
+
+class RefcountingBlockAllocator(BlockAllocator):
+    """Refcounted allocator for prefix-cache block sharing.
+
+    Three block states instead of two:
+
+      * free        — on the free list, contents dead;
+      * referenced  — refcount >= 1: held by one or more in-flight
+        requests' block tables (several tables may name the same id);
+      * cached      — refcount 0 but registered in the prefix index
+        (`mark_cached`): contents preserved on an LRU list, reclaimable
+        under pool pressure but revivable by `share()` until then.
+
+    `allocate` prefers truly-free blocks and evicts LRU cached blocks
+    only when it must (calling `on_evict(block)` so the prefix index
+    unlinks them); `release` decrefs with double-free detection and
+    parks cacheable blocks instead of freeing them; `share` bumps a
+    live block or revives a cached one. `free_blocks` counts free AND
+    cached — both are available to admission — which is exactly what
+    the batcher's defer-on-no-blocks logic should see."""
+
+    def __init__(self, num_blocks: int,
+                 on_evict: Optional[Callable[[int], None]] = None):
+        super().__init__(num_blocks)
+        self._refs: List[int] = [0] * num_blocks
+        self._cached: "OrderedDict[int, None]" = OrderedDict()  # LRU order
+        self._cacheable: set = set()
+        self._on_evict = on_evict
+        self.evicted_blocks = 0
+
+    def refcount(self, block: int) -> int:
+        """Current refcount of `block` (0 for free AND cached blocks —
+        check `is_cached` to tell them apart)."""
+        return self._refs[block]
+
+    def is_cached(self, block: int) -> bool:
+        """True when `block` sits on the refcount-0 LRU cached list."""
+        return block in self._cached
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free) + len(self._cached)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > self.free_blocks:
+            raise RuntimeError(
+                f"pool exhausted: need {n} blocks, {len(self._free)} "
+                f"free + {len(self._cached)} cached")
+        blocks: List[int] = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop(0)
+                self._free_set.discard(b)
+            else:
+                # reclaim the least-recently-parked cached block; the
+                # index must forget it before its contents are reused
+                b, _ = self._cached.popitem(last=False)
+                self._cacheable.discard(b)
+                self.evicted_blocks += 1
+                if self._on_evict is not None:
+                    self._on_evict(b)
+            self._refs[b] = 1
+            blocks.append(b)
+        self._note_allocated(blocks)
+        return blocks
+
+    def share(self, blocks: List[int]) -> None:
+        """Add one reference per block: bump a live block's refcount or
+        revive a cached one (pulling it off the eviction list). Raises
+        ValueError for a block that is neither — sharing a free block
+        would hand out dead contents. Validates the WHOLE list before
+        mutating anything (no half-applied bumps on error)."""
+        for b in blocks:
+            if not 0 <= b < self.num_blocks:
+                raise ValueError(
+                    f"share(): block id {b} out of range "
+                    f"[0, {self.num_blocks})")
+            if self._refs[b] <= 0 and b not in self._cached:
+                raise ValueError(
+                    f"share(): block {b} is neither referenced nor "
+                    f"cached — its contents are gone")
+        for b in blocks:
+            if self._refs[b] > 0:
+                self._refs[b] += 1
+            else:
+                del self._cached[b]
+                self._refs[b] = 1
+
+    def release(self, blocks: List[int]) -> None:
+        """Drop one reference per block. At refcount 0 a block parks on
+        the LRU cached list when the prefix index still names it
+        (`mark_cached`), else returns to the free list. Raises
+        ValueError on out-of-range ids and on releasing a block whose
+        refcount is already 0 (double free) — validated over the WHOLE
+        list (duplicates counted) before any refcount moves, so a
+        failed call never half-applies."""
+        pending: Dict[int, int] = {}
+        for b in blocks:
+            if not 0 <= b < self.num_blocks:
+                raise ValueError(
+                    f"release(): block id {b} out of range "
+                    f"[0, {self.num_blocks})")
+            pending[b] = pending.get(b, 0) + 1
+            if pending[b] > self._refs[b]:
+                raise ValueError(
+                    f"release(): block {b} has refcount "
+                    f"{self._refs[b]} (double free)")
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                if b in self._cacheable:
+                    self._cached[b] = None      # newest end of the LRU
+                else:
+                    self._free.append(b)
+                    self._free_set.add(b)
+
+    def free(self, blocks: List[int]) -> None:
+        """Refcount-aware: free() IS release() here, so allocator-
+        agnostic callers (the batcher's retire path) behave correctly
+        whichever allocator they hold."""
+        self.release(blocks)
+
+    def mark_cached(self, blocks: List[int]) -> None:
+        """Blocks the prefix index registered: when their refcount hits
+        0 they park on the cached LRU instead of the free list."""
+        self._cacheable.update(blocks)
+
+    def stats(self) -> Dict[str, int]:
+        in_use = self.num_blocks - len(self._free) - len(self._cached)
+        return {
+            "capacity_blocks": self.num_blocks,
+            "blocks_in_use": in_use,            # referenced only
+            "cached_blocks": len(self._cached),  # reclaimable, not dead
+            "high_water_blocks": self.high_water,
+            "reused_blocks": self.reused_blocks,
+            "evicted_blocks": self.evicted_blocks,
         }
 
 
@@ -131,10 +308,14 @@ def _write_pool(pool, table, positions, new, valid):
     return poolf.reshape(pool.shape)
 
 
-def _paged_gqa_attention(q, k_pool, v_pool, table, visible_len):
+def _paged_gqa_attention(q, k_pool, v_pool, table, positions):
     """q [B, P, H, hd] against pool blocks gathered through the table.
-    visible_len [B]: keys j < visible_len[b] are visible to every query
-    (decode) — prefill uses the in-batch causal path instead."""
+    positions [B, P]: query p sees pool keys at absolute positions
+    j <= positions[b, p] — per-query causal, so this one path serves
+    both single-token decode (P=1, position = current length) AND the
+    cached-prefix suffix prefill (P>1 suffix tokens attending to the
+    shared prefix blocks plus their own, never to their future).
+    Cold prefill uses the in-batch flash path instead."""
     B, P, H, hd = q.shape
     N, bs, KV, _ = k_pool.shape
     M = table.shape[1]
@@ -144,8 +325,9 @@ def _paged_gqa_attention(q, k_pool, v_pool, table, visible_len):
     qg = q.reshape(B, P, KV, rep, hd)
     s = jnp.einsum("bpkrd,btkd->bkrpt", qg, k,
                    preferred_element_type=jnp.float32) / math.sqrt(hd)
-    vis = (jnp.arange(M * bs)[None] < visible_len[:, None]
-           )[:, None, None, None, :]
+    # [B, P, T] key-visibility per query → broadcast over (KV, rep)
+    vis = (jnp.arange(M * bs)[None, None, :] <= positions[:, :, None]
+           )[:, None, None, :, :]
     s = jnp.where(vis, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bkrpt,btkd->bpkrd", p, v,
@@ -154,7 +336,7 @@ def _paged_gqa_attention(q, k_pool, v_pool, table, visible_len):
 
 
 def _attention_paged(x, lp, cfg, cos, sin, pk, pv, table, positions,
-                     valid, visible_len, is_prefill):
+                     valid, is_prefill):
     """One layer's attention. positions [B, P] per-request absolute
     positions of x's tokens; valid masks padded slots. Returns
     (out, pk', pv') with the new tokens written into the pool."""
@@ -176,7 +358,9 @@ def _attention_paged(x, lp, cfg, cos, sin, pk, pv, table, positions,
         from ..kernels import flash_attention as fa
         o = fa._flash_impl(q, k, v, True, None)
     else:
-        o = _paged_gqa_attention(q, pk, pv, table, visible_len)
+        # decode AND cached-prefix suffix prefill: gather through the
+        # table with per-query causal visibility (j <= position)
+        o = _paged_gqa_attention(q, pk, pv, table, positions)
     return (o.reshape(B, P, H * hd) @ _wq(lp, "o_proj", cd)), pk, pv
 
 
@@ -200,7 +384,7 @@ def forward_paged(params, tokens, cache: PagedKVCache, positions, valid,
         h = rms_norm_ref(x, lp["input_layernorm"], cfg.rms_norm_eps)
         a, pk, pv = _attention_paged(h, lp, cfg, cos, sin, pk, pv,
                                      cache.table, positions, valid,
-                                     visible_len, is_prefill)
+                                     is_prefill)
         pk_all = lax.dynamic_update_slice_in_dim(pk_all, pk[None], li, 0)
         pv_all = lax.dynamic_update_slice_in_dim(pv_all, pv[None], li, 0)
         x = x + a
@@ -299,7 +483,8 @@ class ContinuousBatcher:
     def __init__(self, params, cfg, max_batch: int, block_size: int,
                  max_total_len: int, max_new_tokens: int,
                  eos_token_id: Optional[int] = None,
-                 num_blocks: Optional[int] = None, chunk: int = 8):
+                 num_blocks: Optional[int] = None, chunk: int = 8,
+                 prefix_cache: bool = False):
         self.params, self.cfg = params, cfg
         self.B, self.bs = max_batch, block_size
         self.max_total = max_total_len
@@ -308,7 +493,23 @@ class ContinuousBatcher:
         self.eos = eos_token_id
         self.chunk = chunk
         nb = num_blocks or (max_batch * self.M)
-        self.alloc = BlockAllocator(nb)
+        if prefix_cache:
+            # vLLM-style automatic prefix caching: a trie over full-block
+            # token contents + a refcounted pool, so admissions sharing a
+            # prompt prefix reuse its KV blocks and prefill only their
+            # suffix (serving/cache.py has the subsystem overview).
+            # Imported here, not at module top: cache.py is dependency-
+            # free but lives in serving/, and this module must not pull
+            # the serving package eagerly (serving -> nlp is the lazy
+            # direction the engine already relies on)
+            from ..serving.cache import PrefixCacheIndex
+            self._pcache: "Optional[PrefixCacheIndex]" = \
+                PrefixCacheIndex(block_size)
+            self.alloc: BlockAllocator = RefcountingBlockAllocator(
+                nb, on_evict=self._pcache.evict)
+        else:
+            self._pcache = None
+            self.alloc = BlockAllocator(nb)
         kp, vp = init_pool(cfg, nb, block_size)
         self.cache = PagedKVCache(
             kp, vp, jnp.zeros((max_batch, self.M), jnp.int32),
@@ -316,6 +517,7 @@ class ContinuousBatcher:
         self.active = [False] * max_batch
         self.slot_req: List[Optional[int]] = [None] * max_batch
         self.slot_blocks: List[Optional[List[int]]] = [None] * max_batch
+        self.slot_tokens: List[Optional[List[int]]] = [None] * max_batch
         self.budget = [0] * max_batch
         self.stop = [-1] * max_batch          # per-slot stop id (-1 = none)
         # device mirrors of (active, budget, stop): the decode chunk both
@@ -364,10 +566,59 @@ class ContinuousBatcher:
         return mn
 
     def blocks_needed(self, prompt_len: int,
-                      max_new_tokens: Optional[int] = None) -> int:
-        """Pool blocks a request of this shape holds while in flight."""
+                      max_new_tokens: Optional[int] = None,
+                      tokens: Optional[Sequence[int]] = None) -> int:
+        """Pool blocks a request of this shape takes FROM the pool while
+        in flight. With `tokens` and prefix caching on, blocks the cache
+        already holds live (refcount >= 1, pinned by another in-flight
+        request) don't count — admission shares them instead of
+        allocating. Cached refcount-0 matches DO still count: reviving
+        one consumes a unit of `free_blocks` (free + cached) just like a
+        fresh allocation, so the defer logic's `needed <= free_blocks`
+        comparison stays exact either way."""
         mn = self.max_new if max_new_tokens is None else int(max_new_tokens)
-        return -(-(prompt_len + mn) // self.bs)
+        need = -(-(prompt_len + mn) // self.bs)
+        if tokens is not None and self._pcache is not None:
+            matched, _, _ = self._match_cached(list(tokens))
+            need -= sum(1 for b in matched if self.alloc.refcount(b) > 0)
+        return need
+
+    def _match_cached(self, toks: List[int]
+                      ) -> Tuple[List[int], int, Optional[int]]:
+        """Prefix-cache lookup for a prompt: (matched block chain,
+        cached token count, copy-on-write source block or None).
+
+        Full-block matches are shared as-is. When the match covers the
+        WHOLE prompt there is no suffix left to prefill, yet sampling
+        needs the last position's logits — so the final matched block is
+        demoted to a copy-on-write source: admission copies its KV into
+        a private block and recomputes only the prompt's last token
+        there (cached length P-1), instead of recomputing the whole
+        block. The partially-filled tail is thus never shared."""
+        if self._pcache is None:
+            return [], 0, None
+        matched = self._pcache.match(toks)
+        cached_len = len(matched) * self.bs
+        cow_src = None
+        if matched and cached_len == len(toks):
+            cow_src = matched[-1]
+            matched = matched[:-1]
+            cached_len = len(toks) - 1
+        return matched, cached_len, cow_src
+
+    def prefix_stats(self) -> Dict[str, Any]:
+        """Prefix-cache counters for the serving metrics surface:
+        hits/misses/hit_tokens/hit_rate from the index plus the
+        allocator's cached-block and eviction counts. `enabled` False
+        (and nothing else) when the batcher runs without the cache."""
+        if self._pcache is None:
+            return {"enabled": False}
+        d: Dict[str, Any] = {"enabled": True}
+        d.update(self._pcache.stats())
+        astats = self.alloc.stats()
+        d["cached_blocks"] = astats.get("cached_blocks", 0)
+        d["evictions"] = astats.get("evicted_blocks", 0)
+        return d
 
     def release(self, rid: int) -> None:
         """Drop a finished/aborted request's retained output list. The
@@ -416,25 +667,79 @@ class ContinuousBatcher:
         P = len(toks)
         mn = self.max_new if max_new is None else max_new
         need = -(-(P + mn) // self.bs)
-        owned = self.alloc.allocate(need)
+        # prefix cache: share the matched chain (bumping refcounts — and
+        # pinning the COW source so allocate() can't evict it before the
+        # copy), then allocate only what the cache didn't supply
+        matched, cached_len, cow_src = self._match_cached(toks)
+        if cow_src is not None and self.alloc.refcount(cow_src) == 0:
+            # a cached (refcount-0) COW source is transiently revived
+            # ALONGSIDE its fresh clone — one pool unit more than
+            # blocks_needed() promises the defer check. When the pool
+            # can't afford it, degrade to recomputing the final block
+            # cold instead of blowing up an admission that was told it
+            # fits (a live source costs nothing extra: sharing it takes
+            # no unit from the pool). Peak draw = fresh allocations +
+            # every refcount-0 match revived off the cached list + the
+            # transient source.
+            draw = (need - len(matched)
+                    + sum(1 for b in matched
+                          if self.alloc.refcount(b) == 0))
+            if self.alloc.free_blocks < draw + 1:
+                cow_src = None
+                cached_len = len(matched) * self.bs
+        pinned = matched + ([cow_src] if cow_src is not None else [])
+        if pinned:
+            self.alloc.share(pinned)
+        try:
+            fresh = self.alloc.allocate(need - len(matched))
+        except Exception:
+            if pinned:
+                self.alloc.release(pinned)
+            raise
+        owned = matched + fresh
         blocks = owned + [0] * (self.M - need)
         try:
+            k, v = self.cache.k, self.cache.v
+            if cow_src is not None:
+                # copy-on-write tail: the whole prompt hit the cache, so
+                # clone the final shared block and recompute only the
+                # last token into the private copy (fresh[0] sits at
+                # chain position len(matched) — exactly the clone's slot
+                # in the table row)
+                dst = fresh[0]
+                k = k.at[:, dst].set(k[:, cow_src])
+                v = v.at[:, dst].set(v[:, cow_src])
             table = self.cache.table.at[slot].set(
                 jnp.asarray(blocks, jnp.int32))
-            row = jnp.asarray(toks, jnp.int32)[None]
-            positions = jnp.arange(P)[None]
-            sub = PagedKVCache(self.cache.k, self.cache.v,
-                               table[slot:slot + 1],
+            S = P - cached_len            # suffix still to prefill (>= 1)
+            row = jnp.asarray(toks[cached_len:], jnp.int32)[None]
+            positions = jnp.arange(cached_len, P)[None]
+            sub = PagedKVCache(k, v, table[slot:slot + 1],
                                self.cache.lengths[slot:slot + 1])
+            # cold prompt: in-batch flash prefill; cached prefix: paged
+            # per-query-causal prefill of just the suffix
             logits, sub = forward_paged(
-                self.params, row, sub, positions, jnp.ones((1, P), bool),
-                self.cfg, is_prefill=True)
-            first = int(jnp.argmax(logits[0, P - 1]))
+                self.params, row, sub, positions, jnp.ones((1, S), bool),
+                self.cfg, is_prefill=(cached_len == 0))
+            first = int(jnp.argmax(logits[0, S - 1]))
         except Exception:
             # a failed prefill must not leak its blocks: the slot was
             # never activated, so nothing else will ever free them
-            self.alloc.free(owned)
+            self.alloc.release(fresh)
+            if pinned:
+                self.alloc.release(pinned)
             raise
+        if cow_src is not None:
+            self.alloc.release([cow_src])  # pinned only for the copy
+        if self._pcache is not None:
+            self._pcache.note_admission(P, cached_len)
+            # register the prompt's FULL blocks right away so requests
+            # queued behind this one share them while it is still in
+            # flight (the generated tail registers at retirement)
+            n_full = P // self.bs
+            if n_full:
+                self.alloc.mark_cached(self._pcache.insert(
+                    toks[:n_full * self.bs], owned[:n_full]))
         self.cache = PagedKVCache(
             sub.k, sub.v, table,
             self.cache.lengths.at[slot].set(P))
@@ -442,6 +747,7 @@ class ContinuousBatcher:
         self.active[slot] = True
         self.slot_req[slot] = rid
         self.slot_blocks[slot] = blocks[:need]
+        self.slot_tokens[slot] = list(toks)
         self.budget[slot] = mn - 1
         self.stop[slot] = stop
         self._dev_state = None        # host slot state diverged from device
@@ -451,11 +757,33 @@ class ContinuousBatcher:
             self._retire(slot)
 
     def _retire(self, slot: int) -> None:
-        self.alloc.free(self.slot_blocks[slot])
-        self._just_finished.append(self.slot_req[slot])
+        rid = self.slot_req[slot]
+        blocks = self.slot_blocks[slot]
+        if self._pcache is not None:
+            # register the finished sequence's FULL blocks (prompt +
+            # generated) before releasing: at refcount 0 they park on
+            # the cached LRU instead of dying, so the next request with
+            # this prefix skips their prefill. The last emitted token's
+            # KV was never written (decode writes token t's KV while
+            # producing t+1), so the written length is P + m - 1.
+            gen = self.outputs.get(rid, [])
+            prompt = self.slot_tokens[slot] or []
+            kv_len = len(prompt) + max(0, len(gen) - 1)
+            n_full = kv_len // self.bs
+            if n_full:
+                seq = (prompt + gen)[:n_full * self.bs]
+                self.alloc.mark_cached(
+                    self._pcache.insert(seq, blocks[:n_full]))
+            # leaf-first into the LRU: a chain's deep blocks are evicted
+            # before the prefix blocks other chains may still extend
+            self.alloc.release(list(reversed(blocks)))
+        else:
+            self.alloc.free(blocks)
+        self._just_finished.append(rid)
         self.active[slot] = False
         self.slot_req[slot] = None
         self.slot_blocks[slot] = None
+        self.slot_tokens[slot] = None
         self.stop[slot] = -1
         self._dev_state = None        # host slot state diverged from device
 
@@ -463,7 +791,11 @@ class ContinuousBatcher:
         for slot in range(self.B):
             if not self.active[slot] and self.queue:
                 _, toks0, _, mn0 = self.queue[0]
-                need = self.blocks_needed(len(toks0), mn0)
+                # cached-aware: blocks another in-flight request already
+                # pins for this prompt's prefix are shared, not drawn
+                # from the pool — and `free_blocks` already counts
+                # reclaimable cached blocks on the refcounting allocator
+                need = self.blocks_needed(len(toks0), mn0, tokens=toks0)
                 if need > self.alloc.free_blocks:
                     if not any(self.active):
                         # nothing in flight will ever free blocks

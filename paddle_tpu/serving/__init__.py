@@ -24,10 +24,14 @@ fails only the affected requests.
 
 Modules: `engine` (ServingEngine loop), `request` (lifecycle/channels),
 `scheduler` (admission queue: priority + FIFO + aging + backpressure),
-`metrics` (counters/gauges/histograms + profiler-span timers).
+`metrics` (counters/gauges/histograms + profiler-span timers),
+`cache` (automatic prefix cache: trie index over shared KV blocks,
+refcounted by `RefcountingBlockAllocator` — on by default; pass
+`prefix_cache=False` to serve cold).
 """
 from __future__ import annotations
 
+from .cache import PrefixCacheIndex  # noqa: F401
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
 from .request import (  # noqa: F401
     GenerationRequest, RequestState, TERMINAL_STATES,
@@ -41,6 +45,7 @@ __all__ = [
     "RequestError", "RequestCancelled", "RequestFailed", "RequestTimedOut",
     "AdmissionQueue", "QueueFullError",
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "PrefixCacheIndex", "RefcountingBlockAllocator",
     "ContinuousBatcher", "PagedKVCache",
 ]
 
@@ -51,7 +56,8 @@ def __getattr__(name: str):
     if name in ("ServingEngine", "EngineStopped"):
         from . import engine
         return getattr(engine, name)
-    if name in ("ContinuousBatcher", "PagedKVCache"):
+    if name in ("ContinuousBatcher", "PagedKVCache",
+                "RefcountingBlockAllocator"):
         from ..nlp import paged
         return getattr(paged, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

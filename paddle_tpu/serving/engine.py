@@ -69,13 +69,15 @@ class ServingEngine:
                  aging_interval_s: float = 2.0,
                  metrics: Optional[MetricsRegistry] = None,
                  start: bool = True, idle_poll_s: float = 0.05,
+                 prefix_cache: bool = True,
                  clock=time.monotonic):
         # lazy: keep `import paddle_tpu` from pulling the whole nlp tree
         from ..nlp.paged import ContinuousBatcher
         self.batcher = ContinuousBatcher(
             params, cfg, max_batch=max_batch, block_size=block_size,
             max_total_len=max_total_len, max_new_tokens=max_new_tokens,
-            eos_token_id=eos_token_id, num_blocks=num_blocks, chunk=chunk)
+            eos_token_id=eos_token_id, num_blocks=num_blocks, chunk=chunk,
+            prefix_cache=prefix_cache)
         self.metrics = metrics or MetricsRegistry()
         self._clock = clock
         self._idle_poll_s = idle_poll_s
@@ -90,6 +92,7 @@ class ServingEngine:
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         self._alloc_stats = self.batcher.alloc.stats()
+        self._prefix_stats = self.batcher.prefix_stats()
 
         m = self.metrics
         self._c_submitted = m.counter("requests_submitted")
@@ -107,6 +110,11 @@ class ServingEngine:
         self._h_ttft = m.histogram("ttft_s")
         self._h_wait = m.histogram("queue_wait_s")
         self._h_token = m.histogram("per_token_s")
+        # prefix-cache surface (flat-line zeros when the cache is off)
+        self._g_pc_hit_tokens = m.gauge("prefix_cache_hit_tokens")
+        self._g_pc_hit_rate = m.gauge("prefix_cache_hit_rate")
+        self._g_pc_evictions = m.gauge("prefix_cache_evictions")
+        self._g_pc_cached = m.gauge("prefix_cache_cached_blocks")
 
         if start:
             self.start()
@@ -280,6 +288,7 @@ class ServingEngine:
         with self._lock:
             snap = self.metrics.snapshot()
             snap["allocator"] = dict(self._alloc_stats)
+            snap["prefix_cache"] = dict(self._prefix_stats)
         return snap
 
     # ---- engine thread ---------------------------------------------------
@@ -344,10 +353,16 @@ class ServingEngine:
         free_slots = self.batcher.free_slots()
         free_blocks = self.batcher.alloc.free_blocks
         b = self.batcher
+        needed = {}          # id(req) -> blocks, computed once per pop
         while free_slots > 0:
             def fits(r):   # max_new_tokens was resolved by submit()
-                return b.blocks_needed(len(r.prompt),
-                                       r.max_new_tokens) <= free_blocks
+                # cached-aware: a prompt whose prefix is already pinned
+                # by an in-flight request needs fewer blocks of its own.
+                # The prefix-trie walk is memoized so the decrement
+                # below reuses it instead of walking again.
+                needed[id(r)] = n = b.blocks_needed(
+                    len(r.prompt), r.max_new_tokens, tokens=r.prompt)
+                return n <= free_blocks
             req = self.queue.pop(fits=fits)
             if req is None:
                 break                     # empty, or defer-on-no-blocks
@@ -369,7 +384,7 @@ class ServingEngine:
             self._c_admitted.inc()
             self._running[rid] = req
             free_slots -= 1
-            free_blocks -= b.blocks_needed(len(req.prompt), mn)
+            free_blocks -= needed.pop(id(req))
 
     def _dispatch(self, emitted: Dict[int, List[int]],
                   finished: List[int],
@@ -446,7 +461,14 @@ class ServingEngine:
     def _update_gauges_locked(self) -> None:
         stats = self.batcher.alloc.stats()
         self._alloc_stats = stats          # snapshot() reads this cache
+        pc = self.batcher.prefix_stats()
+        self._prefix_stats = pc
         self._g_queue.set(len(self.queue))
         self._g_running.set(len(self._running))
         self._g_blocks.set(stats["blocks_in_use"])
         self._g_util.set(stats["blocks_in_use"] / stats["capacity_blocks"])
+        if pc.get("enabled"):
+            self._g_pc_hit_tokens.set(pc["hit_tokens"])
+            self._g_pc_hit_rate.set(pc["hit_rate"])
+            self._g_pc_evictions.set(pc["evictions"])
+            self._g_pc_cached.set(pc["cached_blocks"])

@@ -193,14 +193,23 @@ _static_mode = False
 
 
 def default_main_program() -> Program:
+    """The Program op records are currently captured into (the
+    reference's global main ProgramDesc); swap it with program_guard."""
     return _main_program
 
 
 def default_startup_program() -> Program:
+    """The Program initializer records capture into. Here it is mostly
+    vestigial: Parameters are leaves whose initializers are pruned at
+    replay, which IS the 'startup program already ran' semantics."""
     return _startup_program
 
 
 class program_guard:
+    """Context manager swapping the default main (and optionally
+    startup) Program, so ops captured inside the block record into the
+    given graphs (reference paddle.static.program_guard)."""
+
     def __init__(self, main_program: Program,
                  startup_program: Optional[Program] = None):
         self.main = main_program
@@ -221,6 +230,8 @@ class program_guard:
 
 
 def in_static_mode() -> bool:
+    """True between enable_static() and disable_static() — i.e. while
+    the eager op layer records into a Program instead of executing."""
     return _static_mode
 
 
@@ -239,12 +250,17 @@ def _capture(raw, args, kwargs, name):
 
 
 def enable_static():
+    """Enter graph mode: eager ops stop executing and start recording
+    into default_main_program() (shape/dtype propagate via placeholder
+    evaluation, the InferMeta analog)."""
     global _static_mode
     _static_mode = True
     _registry._capture_hook = _capture
 
 
 def disable_static():
+    """Leave graph mode: the op layer executes eagerly again; captured
+    Programs stay replayable through Executor.run."""
     global _static_mode
     _static_mode = False
     _registry._capture_hook = None
@@ -314,10 +330,17 @@ _scope = _Scope()
 
 
 def global_scope():
+    """The reference's global variable Scope. Here a stub: variables
+    live on Tensors (leaves read at run time), so the scope has nothing
+    to resolve — kept for API-compatible callers that probe it."""
     return _scope
 
 
 class name_scope:
+    """No-op naming context (reference: prefixes op names in the
+    ProgramDesc). HLO keeps its own metadata, so this only preserves
+    the with-block API shape."""
+
     def __init__(self, prefix=""):
         self.prefix = prefix
 
@@ -394,6 +417,9 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
 
 
 def load_inference_model(path_prefix: str, executor):
+    """Load a save_inference_model artifact: deserializes the
+    jax.export blob (.pdmodel) + feed/fetch metadata (.pdmeta) and
+    returns (program, feed_names, fetch_names) like the reference."""
     from jax import export as jax_export
     with open(path_prefix + ".pdmodel", "rb") as f:
         exported = jax_export.deserialize(f.read())

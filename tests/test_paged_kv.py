@@ -110,6 +110,46 @@ class TestPagedGenerate:
         assert pred._paged_stats["reused_blocks"] > 0
 
 
+class TestBlockAllocatorFree:
+    """Regression: free() used to silently accept duplicate and
+    out-of-range block ids — a double free splices a block into the
+    free list twice, and two later requests then share (and corrupt)
+    one KV block."""
+
+    def test_double_free_raises(self):
+        alloc = paged.BlockAllocator(4)
+        blocks = alloc.allocate(2)
+        alloc.free(blocks)
+        with pytest.raises(ValueError, match="already free"):
+            alloc.free(blocks)                  # freed twice
+        assert alloc.free_blocks == 4           # first free stuck
+
+    def test_duplicate_within_one_call_raises(self):
+        alloc = paged.BlockAllocator(4)
+        b = alloc.allocate(1)
+        with pytest.raises(ValueError, match="already free"):
+            alloc.free([b[0], b[0]])
+        # the failed call must not have half-applied
+        assert alloc.free_blocks == 3
+        alloc.free(b)
+        assert alloc.free_blocks == 4
+
+    def test_out_of_range_raises(self):
+        alloc = paged.BlockAllocator(4)
+        with pytest.raises(ValueError, match="out of range"):
+            alloc.free([4])
+        with pytest.raises(ValueError, match="out of range"):
+            alloc.free([-1])
+
+    def test_free_list_never_grows_past_capacity(self):
+        alloc = paged.BlockAllocator(2)
+        blocks = alloc.allocate(2)
+        alloc.free(blocks)
+        with pytest.raises(ValueError):
+            alloc.free([0])
+        assert alloc.free_blocks == alloc.num_blocks
+
+
 class TestContinuousBatching:
     """Continuous batching over the block pool: more requests than batch
     slots, admission into freed slots mid-stream, outputs matching each

@@ -1,0 +1,332 @@
+"""paddle_tpu.serving.cache — refcounted prefix caching of KV blocks.
+
+Three layers of coverage, cheapest first:
+
+  * RefcountingBlockAllocator units — share/release refcount lifecycle,
+    double-free detection, cached-LRU parking/revival, eviction order
+    and callback;
+  * PrefixCacheIndex units — trie match/insert/evict semantics,
+    first-writer-wins dedup, orphaned-subtree eviction (no jax needed);
+  * ContinuousBatcher integration — warm admissions are token-identical
+    to cold ones (partial-prefix share, in-flight share, and the
+    copy-on-write full-hit), eviction under pool pressure stays
+    correct, and the cached-aware defer logic admits a request the
+    naive block count would refuse.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nlp import llama, paged
+from paddle_tpu.serving.cache import PrefixCacheIndex
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+class TestRefcountingAllocator:
+    def test_allocate_release_lifecycle(self):
+        alloc = paged.RefcountingBlockAllocator(4)
+        blocks = alloc.allocate(2)
+        assert all(alloc.refcount(b) == 1 for b in blocks)
+        assert alloc.free_blocks == 2
+        alloc.share(blocks)                      # second holder
+        assert all(alloc.refcount(b) == 2 for b in blocks)
+        alloc.release(blocks)                    # first holder gone
+        assert all(alloc.refcount(b) == 1 for b in blocks)
+        assert alloc.free_blocks == 2            # still referenced
+        alloc.release(blocks)                    # unmarked → plain free
+        assert alloc.free_blocks == 4
+        assert alloc.stats()["blocks_in_use"] == 0
+
+    def test_double_release_raises(self):
+        alloc = paged.RefcountingBlockAllocator(2)
+        b = alloc.allocate(1)
+        alloc.release(b)
+        with pytest.raises(ValueError, match="double free"):
+            alloc.release(b)
+        with pytest.raises(ValueError, match="out of range"):
+            alloc.release([9])
+        with pytest.raises(ValueError, match="double free"):
+            alloc.free(b)                        # free() is release()
+
+    def test_share_requires_live_or_cached(self):
+        alloc = paged.RefcountingBlockAllocator(2)
+        with pytest.raises(ValueError, match="neither"):
+            alloc.share([0])                     # free block: contents dead
+
+    def test_cached_parking_and_revival(self):
+        alloc = paged.RefcountingBlockAllocator(2)
+        b = alloc.allocate(1)
+        alloc.mark_cached(b)
+        alloc.release(b)
+        assert alloc.is_cached(b[0])
+        assert alloc.free_blocks == 2            # cached counts as free
+        assert alloc.stats()["blocks_in_use"] == 0
+        assert alloc.stats()["cached_blocks"] == 1
+        alloc.share(b)                           # revive: contents kept
+        assert alloc.refcount(b[0]) == 1
+        assert not alloc.is_cached(b[0])
+        alloc.release(b)
+        assert alloc.is_cached(b[0])             # still cacheable
+
+    def test_lru_eviction_order_and_callback(self):
+        evicted = []
+        alloc = paged.RefcountingBlockAllocator(3, on_evict=evicted.append)
+        blocks = alloc.allocate(3)
+        alloc.mark_cached(blocks)
+        for b in blocks:                         # park in order: LRU = first
+            alloc.release([b])
+        got = alloc.allocate(2)                  # must evict 2 LRU blocks
+        assert evicted == blocks[:2]
+        assert sorted(got) == sorted(blocks[:2])
+        assert alloc.evicted_blocks == 2
+        assert alloc.is_cached(blocks[2])        # newest survives
+
+    def test_allocate_prefers_free_over_cached(self):
+        alloc = paged.RefcountingBlockAllocator(3, on_evict=lambda b: None)
+        b = alloc.allocate(1)
+        alloc.mark_cached(b)
+        alloc.release(b)
+        alloc.allocate(2)                        # two truly-free blocks
+        assert alloc.is_cached(b[0])             # cache untouched
+        assert alloc.evicted_blocks == 0
+
+    def test_exhaustion_counts_cached(self):
+        alloc = paged.RefcountingBlockAllocator(2)
+        alloc.allocate(2)
+        with pytest.raises(RuntimeError, match="pool exhausted"):
+            alloc.allocate(1)
+
+    def test_release_never_half_applies(self):
+        """A bad id anywhere in the list must leave EVERY refcount
+        untouched — a half-applied release followed by a caller retry
+        would decref the good blocks twice."""
+        alloc = paged.RefcountingBlockAllocator(4)
+        good = alloc.allocate(2)
+        with pytest.raises(ValueError, match="out of range"):
+            alloc.release([good[0], 99])
+        with pytest.raises(ValueError, match="double free"):
+            alloc.release([good[0], good[0]])    # dup exceeds refcount 1
+        assert all(alloc.refcount(b) == 1 for b in good)
+        alloc.release(good)                      # clean retry succeeds
+        assert alloc.free_blocks == 4
+
+    def test_share_never_half_applies(self):
+        alloc = paged.RefcountingBlockAllocator(4)
+        good = alloc.allocate(1)
+        with pytest.raises(ValueError, match="neither"):
+            alloc.share([good[0], 2])            # 2 is free: dead contents
+        assert alloc.refcount(good[0]) == 1      # bump not applied
+
+
+class TestPrefixCacheIndex:
+    def test_match_insert_roundtrip(self):
+        idx = PrefixCacheIndex(4)
+        toks = list(range(100, 112))             # 3 full blocks
+        assert idx.match(toks) == []
+        assert idx.insert(toks, [7, 8, 9]) == [7, 8, 9]
+        assert idx.match(toks) == [7, 8, 9]
+        assert idx.match(toks[:8]) == [7, 8]     # prefix of the chain
+        assert idx.match(toks[:7]) == [7]        # partial block ignored
+        assert idx.match(toks[:3]) == []
+        # same first block, divergent second
+        other = toks[:4] + [1, 2, 3, 4]
+        assert idx.match(other) == [7]
+
+    def test_insert_first_writer_wins(self):
+        idx = PrefixCacheIndex(2)
+        assert idx.insert([1, 2], [0]) == [0]
+        assert idx.insert([1, 2, 3, 4], [5, 6]) == [6]   # block 5 dropped
+        assert idx.match([1, 2, 3, 4]) == [0, 6]         # incumbent kept
+
+    def test_insert_rejects_partial_blocks(self):
+        idx = PrefixCacheIndex(4)
+        with pytest.raises(ValueError, match="full blocks"):
+            idx.insert([1, 2, 3], [0])
+
+    def test_evict_unlinks_and_orphans_descendants(self):
+        idx = PrefixCacheIndex(2)
+        idx.insert([1, 2, 3, 4, 5, 6], [0, 1, 2])
+        idx.evict(1)                             # middle of the chain
+        assert idx.match([1, 2, 3, 4, 5, 6]) == [0]      # stops at hole
+        assert len(idx) == 2                     # 0 and orphaned 2 remain
+        idx.evict(2)                             # orphan still evictable
+        assert len(idx) == 1
+        idx.evict(2)                             # idempotent
+        assert idx.evicted_blocks == 2
+
+    def test_admission_stats(self):
+        idx = PrefixCacheIndex(4)
+        idx.note_admission(10, 8)
+        idx.note_admission(10, 0)
+        s = idx.stats()
+        assert (s["hits"], s["misses"]) == (1, 1)
+        assert s["hit_tokens"] == 8 and s["prompt_tokens"] == 20
+        assert idx.hit_rate == pytest.approx(0.4)
+
+
+def _cold_run(params, cfg, prompts, max_new=6, **kw):
+    cb = paged.ContinuousBatcher(
+        params, cfg, max_batch=2, block_size=4, max_total_len=32,
+        max_new_tokens=max_new, chunk=3, **kw)
+    rids = [cb.submit(p) for p in prompts]
+    out = cb.run()
+    return [out[r] for r in rids], cb
+
+
+class TestBatcherPrefixCache:
+    """Acceptance: prefix-cached generation is token-identical to
+    cold-cache generation, for partial shares, in-flight shares, and
+    the COW full-hit — and the stats prove blocks were actually
+    shared, not recomputed."""
+
+    def test_shared_prefix_matches_cold(self, setup):
+        cfg, params = setup
+        rng = np.random.RandomState(11)
+        common = list(map(int, rng.randint(1, 200, 8)))  # 2 full blocks
+        prompts = [common + list(map(int, rng.randint(1, 200, n)))
+                   for n in (3, 5, 2)]
+        cold, _ = _cold_run(params, cfg, prompts)
+        warm, cb = _cold_run(params, cfg, prompts, prefix_cache=True)
+        assert warm == cold
+        st = cb.prefix_stats()
+        assert st["hits"] >= 2 and st["hit_tokens"] >= 16
+        assert st["hit_rate"] > 0
+        # drained: nothing referenced, prefix blocks parked as cached
+        astats = cb.alloc.stats()
+        assert astats["blocks_in_use"] == 0
+        assert astats["cached_blocks"] > 0
+
+    def test_full_hit_cow_matches_cold(self, setup):
+        """A prompt that is ENTIRELY cached (length a multiple of
+        block_size, served before) goes down the copy-on-write path:
+        the final shared block is cloned and only the last token is
+        recomputed — output must still be token-identical."""
+        cfg, params = setup
+        rng = np.random.RandomState(12)
+        p = list(map(int, rng.randint(1, 200, 8)))       # exactly 2 blocks
+        cold, _ = _cold_run(params, cfg, [p])
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=1, block_size=4, max_total_len=32,
+            max_new_tokens=6, chunk=3, prefix_cache=True)
+        r1 = cb.submit(p)
+        cb.run()
+        hit0 = cb.prefix_stats()["hit_tokens"]
+        r2 = cb.submit(p)                                # full hit → COW
+        out = cb.run()
+        assert out[r1] == cold[0]
+        assert out[r2] == cold[0]
+        # COW caps the cached prefix at P-1 (last token recomputed)
+        assert cb.prefix_stats()["hit_tokens"] - hit0 == len(p) - 1
+        assert cb.alloc.stats()["blocks_in_use"] == 0
+
+    def test_generated_tokens_are_cached_too(self, setup):
+        """Retirement registers FULL blocks of prompt+generated KV: a
+        follow-up prompt equal to prompt+generated (the multi-turn
+        pattern) hits past the original prompt length."""
+        cfg, params = setup
+        rng = np.random.RandomState(13)
+        p = list(map(int, rng.randint(1, 200, 6)))
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=1, block_size=4, max_total_len=32,
+            max_new_tokens=6, chunk=3, prefix_cache=True)
+        r1 = cb.submit(p)
+        out1 = cb.run()[r1]
+        # turn 2: the conversation so far + a fresh user turn
+        p2 = p + out1 + list(map(int, rng.randint(1, 200, 3)))
+        hit0 = cb.prefix_stats()["hit_tokens"]
+        r2 = cb.submit(p2)
+        out2 = cb.run()[r2]
+        # written KV covered prompt + all-but-last generated token →
+        # (6 + 6 - 1) // 4 = 2 full blocks were registered
+        assert cb.prefix_stats()["hit_tokens"] - hit0 == 8
+        cold, _ = _cold_run(params, cfg, [p2])
+        assert out2 == cold[0]
+
+    def test_eviction_under_pool_pressure(self, setup):
+        """A pool too small to cache every retired request evicts LRU
+        cached blocks (never referenced ones) and keeps serving
+        correctly."""
+        cfg, params = setup
+        rng = np.random.RandomState(14)
+        prompts = [list(map(int, rng.randint(1, 200, 8)))
+                   for _ in range(4)]
+        # 3 blocks per request (8 prompt + 4 new @ bs=4); pool of 6
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=2, block_size=4, max_total_len=16,
+            max_new_tokens=4, chunk=2, num_blocks=6, prefix_cache=True)
+        rids = [cb.submit(p) for p in prompts]
+        out = cb.run()
+        assert cb.prefix_stats()["evictions"] > 0
+        cold, _ = _cold_run(params, cfg, prompts, max_new=4)
+        for r, c in zip(rids, cold):
+            assert out[r] == c
+        assert cb.alloc.stats()["blocks_in_use"] == 0
+
+    def test_cached_aware_defer_admits_on_shared_blocks(self, setup):
+        """blocks_needed(tokens=...) discounts blocks pinned by an
+        in-flight prefix sibling: two 11-token-prompt requests (5 blocks
+        each cold) sharing 2 full blocks fit TOGETHER in an 8-block pool
+        that could not hold two cold copies (2*5 > 8)."""
+        cfg, params = setup
+        rng = np.random.RandomState(15)
+        common = list(map(int, rng.randint(1, 200, 8)))
+        prompts = [common + list(map(int, rng.randint(1, 200, 3)))
+                   for _ in range(2)]
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=6, chunk=3, num_blocks=8, prefix_cache=True)
+        r1, r2 = [cb.submit(p) for p in prompts]
+        cb.step()                  # admits both (second shares 2 blocks)
+        assert cb.active == [True, True]
+        assert cb.alloc.stats()["blocks_in_use"] == 8    # 5 + 3 distinct
+        out = cb.run()
+        cold, _ = _cold_run(params, cfg, prompts)
+        assert [out[r1], out[r2]] == cold
+
+    def test_full_hit_cow_degrades_in_exactly_full_pool(self, setup):
+        """Regression: a whole-prompt hit whose COW source is cached
+        transiently needs one pool unit MORE than blocks_needed()
+        promises the defer check. In a pool sized exactly for one
+        request that must NOT raise 'pool exhausted' — admission
+        degrades to recomputing the final block and still serves
+        token-identically."""
+        cfg, params = setup
+        rng = np.random.RandomState(17)
+        p = list(map(int, rng.randint(1, 200, 8)))   # 2 full blocks
+        cold, _ = _cold_run(params, cfg, [p], max_new=4)
+        # 8 prompt + 4 new @ bs 4 → exactly 3 blocks, pool of 3
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=1, block_size=4, max_total_len=16,
+            max_new_tokens=4, chunk=2, num_blocks=3, prefix_cache=True)
+        r1 = cb.submit(p)
+        out1 = cb.run()[r1]
+        r2 = cb.submit(p)                            # full hit, no headroom
+        out2 = cb.run()[r2]
+        assert out1 == cold[0] and out2 == cold[0]
+        assert cb.alloc.stats()["blocks_in_use"] == 0
+
+    def test_mixed_lengths_still_batch(self, setup):
+        """Warm and cold slots co-decode in one chunk: one request with
+        a cached prefix, one without, both match their cold runs."""
+        cfg, params = setup
+        rng = np.random.RandomState(16)
+        shared = list(map(int, rng.randint(1, 200, 8)))
+        fresh = list(map(int, rng.randint(1, 200, 9)))
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=6, chunk=3, prefix_cache=True)
+        r0 = cb.submit(shared + [7, 8])
+        cb.run()
+        r1 = cb.submit(shared + [9, 10, 11])     # warm
+        r2 = cb.submit(fresh)                    # cold, co-batched
+        out = cb.run()
+        cold, _ = _cold_run(params, cfg,
+                            [shared + [7, 8], shared + [9, 10, 11], fresh])
+        assert [out[r0], out[r1], out[r2]] == cold

@@ -270,6 +270,78 @@ class TestServingStress:
         assert eng.batcher.outputs == {}
 
 
+class TestServingPrefixCache:
+    """serving.cache e2e: the engine's default prefix cache must be
+    invisible in outputs (token-identical to a cold engine) and visible
+    in metrics — including when one of two requests sharing blocks is
+    cancelled mid-decode."""
+
+    def _engine(self, setup, max_new=MAX_NEW, **kw):
+        cfg, params = setup
+        return serving.ServingEngine(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=max_new, chunk=3, max_queue_depth=16, **kw)
+
+    def test_warm_outputs_match_cold_engine(self, setup):
+        rng = np.random.RandomState(21)
+        common = list(map(int, rng.randint(1, 200, 8)))  # 2 full blocks
+        prompts = [common + [11, 12, 13], common + [14, 15], list(common)]
+        cold_eng = self._engine(setup, prefix_cache=False)
+        cold = [cold_eng.generate(p, timeout=300) for p in prompts]
+        cold_eng.shutdown()
+        assert cold_eng.snapshot()["prefix_cache"] == {"enabled": False}
+
+        warm_eng = self._engine(setup)                   # cache on by default
+        warm = [warm_eng.generate(p, timeout=300) for p in prompts]
+        # serve the shared-prefix set AGAIN: now every prompt hits
+        warm += [warm_eng.generate(p, timeout=300) for p in prompts]
+        snap = warm_eng.snapshot()
+        warm_eng.shutdown()
+        assert warm == cold + cold                       # token-identical
+        pc = snap["prefix_cache"]
+        assert pc["enabled"] and pc["hit_rate"] > 0
+        assert pc["hit_tokens"] >= 3 * 8                 # second pass ≥ fully warm
+        assert snap["gauges"]["prefix_cache_hit_rate"] == pc["hit_rate"]
+        assert snap["gauges"]["prefix_cache_hit_tokens"] == pc["hit_tokens"]
+        # drained: no block referenced, prefix blocks parked reclaimable
+        assert snap["allocator"]["blocks_in_use"] == 0
+        assert snap["allocator"]["cached_blocks"] > 0
+
+    def test_cancel_mid_decode_releases_shared_blocks(self, setup):
+        """Two in-flight requests share the common prefix's blocks
+        (refcount 2). Cancelling one mid-decode must decref — not
+        free — the shared blocks: the survivor keeps decoding on them
+        and still produces its cold-engine output."""
+        rng = np.random.RandomState(22)
+        common = list(map(int, rng.randint(1, 200, 8)))
+        p_cancel = common + [31, 32]
+        p_keep = common + [33, 34, 35]
+        cold_eng = self._engine(setup, prefix_cache=False)
+        keep_cold = cold_eng.generate(p_keep, timeout=300)
+        cold_eng.shutdown()
+
+        # the victim gets a 20-token budget so the cancel lands while it
+        # is still decoding; the keeper's budget matches the baseline
+        eng = self._engine(setup, max_new=20, start=False)
+        victim = eng.submit(p_cancel, max_new_tokens=20)
+        keeper = eng.submit(p_keep, max_new_tokens=MAX_NEW)
+        eng.start()                     # both admitted together: 2 slots
+        it = victim.stream()
+        next(it)                        # decode provably started
+        victim.cancel()
+        assert victim.wait(timeout=300)
+        assert victim.state is RequestState.CANCELLED
+        assert len(victim.tokens) < 20  # genuinely cut short
+        assert keeper.result(timeout=300) == keep_cold   # not corrupted
+        assert eng.drain(timeout=300)
+        snap = eng.snapshot()
+        eng.shutdown()
+        assert snap["prefix_cache"]["hit_tokens"] >= 8   # blocks were shared
+        assert snap["allocator"]["blocks_in_use"] == 0   # all refs dropped
+        # the shared prefix survives the cancel for future requests
+        assert snap["allocator"]["cached_blocks"] > 0
+
+
 class TestContinuousBatcherStop:
     def test_per_request_stop_token(self, setup, baselines):
         """Batcher-level satellite: a slot with stop_token_id finishes
