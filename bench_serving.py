@@ -13,7 +13,18 @@ Workloads:
   * `prefix-share` (`--prefix-share`) — N requests sharing one common
     prompt prefix (the system-prompt / few-shot pattern), exercising the
     `serving.cache` prefix cache: the JSON line gains
-    `prefix_cache_hit_rate` and `prefill_tokens_saved`.
+    `prefix_cache_hit_rate` and `prefill_tokens_saved`;
+  * `mixed` (`--bucketed`) — prompt lengths spread wide enough to span
+    every prefill bucket AND chunk past the largest one, exercising the
+    bucketed/chunked prefill path. Asserts ZERO prefill recompiles after
+    warmup (the TTFT story: admission dispatches to pre-compiled
+    shapes), so a recompile regression fails the bench.
+
+Warmup pre-compiles EVERY prefill bucket shape via `engine.warmup()`
+(AOT lowering — no device compute) plus one served request for the
+decode chunk fn; before it, the first timed request of each new prompt
+length ate a fresh XLA trace+compile and TTFT p99 measured the compiler,
+not the server.
 
 Deliberately a tiny model on CPU: this measures the HOST serving layer's
 overhead and scheduling behavior deterministically; device-side decode
@@ -37,6 +48,11 @@ def _make_prompts(rng, n_requests: int, workload: str,
         common = list(map(int, rng.randint(1, 200, prefix_len)))
         return [common + list(map(int, rng.randint(1, 200, suffix_len)))
                 for _ in range(n_requests)]
+    if workload == "mixed":
+        # lengths spanning the whole ladder, incl. past the largest
+        # bucket (chunked prefill) — every request a different length
+        return [list(map(int, rng.randint(1, 200, int(L))))
+                for L in rng.randint(3, 41, n_requests)]
     return [list(map(int, rng.randint(1, 200, int(L))))
             for L in rng.randint(4, 16, n_requests)]
 
@@ -44,7 +60,8 @@ def _make_prompts(rng, n_requests: int, workload: str,
 def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
          block_size: int = 8, chunk: int = 4, workload: str = "random",
          prefix_len: int = 24, suffix_len: int = 6,
-         prefix_cache: bool = True) -> dict:
+         prefix_cache: bool = True,
+         max_prefill_bucket: int = 512) -> dict:
     import jax
     from paddle_tpu.nlp import llama
     from paddle_tpu import serving
@@ -59,14 +76,20 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         params, cfg, max_batch=max_batch, block_size=block_size,
         max_total_len=64, max_new_tokens=max_new, chunk=chunk,
         max_queue_depth=n_requests, prefix_cache=prefix_cache,
-        start=False)
-    # warmup: compile the chunk fn + prefill shapes outside the timing
-    # (for prefix-share it also PRIMES the cache — the steady-state view
-    # a shared system prompt actually serves under)
+        max_prefill_bucket=max_prefill_bucket, start=False)
+    # warmup: AOT-compile EVERY prefill bucket shape (group ladder x
+    # bucket ladder x cold/cached) before the loop starts, then serve
+    # one request to compile the decode chunk fn (for prefix-share it
+    # also PRIMES the cache — the steady-state view a shared system
+    # prompt actually serves under)
+    t_w = time.perf_counter()
+    warmed = eng.warmup()
     eng.start()
     eng.generate(prompts[0], timeout=600)
+    warmup_s = time.perf_counter() - t_w
     completed0 = eng.metrics.counter("requests_completed").value
     pc0 = eng.snapshot()["prefix_cache"]
+    compiles_warm = eng.batcher.prefill_compile_count
 
     t0 = time.perf_counter()
     reqs = [eng.submit(p) for p in prompts]
@@ -79,6 +102,7 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
     ttft = np.asarray([r.first_token_time - r.submit_time for r in reqs])
     wait = np.asarray([r.admit_time - r.submit_time for r in reqs])
     snap = eng.snapshot()
+    recompiles = eng.batcher.prefill_compile_count - compiles_warm
     pct = lambda a, q: round(float(np.percentile(a, q)), 4)
     result = {
         "metric": "serving_offline_tok_s",
@@ -89,6 +113,7 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         "max_batch": max_batch,
         "max_new_tokens": max_new,
         "wall_s": round(wall, 3),
+        "warmup_s": round(warmup_s, 3),
         "ttft_s_p50": pct(ttft, 50),
         "ttft_s_p90": pct(ttft, 90),
         "ttft_s_p99": pct(ttft, 99),
@@ -101,6 +126,11 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         - completed0,
         "kv_high_water_blocks": snap["allocator"]["high_water_blocks"],
         "kv_reused_blocks": snap["allocator"]["reused_blocks"],
+        "prefill_buckets": list(eng.batcher.prefill_buckets),
+        "prefill_shapes_warmed": warmed,
+        "prefill_compile_count": eng.batcher.prefill_compile_count,
+        "prefill_recompiles_after_warmup": recompiles,
+        "prefill_pad_tokens": eng.batcher.prefill_pad_tokens,
     }
     pc = snap["prefix_cache"]
     if pc.get("enabled"):
@@ -115,6 +145,12 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
             "prefix_cache_evictions": pc["evicted_blocks"],
             "prefix_cache_cached_blocks": pc["cached_blocks"],
         })
+    if workload == "mixed" and recompiles:
+        raise RuntimeError(
+            f"bucketed workload recompiled {recompiles} prefill shapes "
+            f"after warmup — the bucket ladder no longer covers "
+            f"admission (warmed {warmed}, buckets "
+            f"{list(eng.batcher.prefill_buckets)})")
     return result
 
 
@@ -123,6 +159,9 @@ def _cli() -> dict:
     ap.add_argument("--prefix-share", action="store_true",
                     help="N requests sharing a common prompt prefix "
                          "(exercises the prefix cache)")
+    ap.add_argument("--bucketed", action="store_true",
+                    help="mixed-length workload spanning every prefill "
+                         "bucket; asserts zero recompiles after warmup")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="serve with the prefix cache disabled")
     ap.add_argument("--n-requests", type=int, default=16)
@@ -134,13 +173,25 @@ def _cli() -> dict:
                     help="shared prefix length for --prefix-share")
     ap.add_argument("--suffix-len", type=int, default=6,
                     help="per-request suffix length for --prefix-share")
+    ap.add_argument("--max-prefill-bucket", type=int, default=None,
+                    help="cap the prefill bucket ladder (default 512; "
+                         "16 for --bucketed so the workload chunks)")
     a = ap.parse_args()
+    if a.prefix_share and a.bucketed:
+        ap.error("--prefix-share and --bucketed are mutually exclusive")
+    workload = ("prefix-share" if a.prefix_share
+                else "mixed" if a.bucketed else "random")
+    bucket_cap = a.max_prefill_bucket
+    if bucket_cap is None:
+        # the mixed workload should also exercise CHUNKED prefill, so
+        # cap the ladder below its longest prompts by default
+        bucket_cap = 16 if a.bucketed else 512
     return main(n_requests=a.n_requests, max_new=a.max_new,
                 max_batch=a.max_batch, block_size=a.block_size,
-                chunk=a.chunk,
-                workload="prefix-share" if a.prefix_share else "random",
+                chunk=a.chunk, workload=workload,
                 prefix_len=a.prefix_len, suffix_len=a.suffix_len,
-                prefix_cache=not a.no_prefix_cache)
+                prefix_cache=not a.no_prefix_cache,
+                max_prefill_bucket=bucket_cap)
 
 
 if __name__ == "__main__":

@@ -273,6 +273,31 @@ class RefcountingBlockAllocator(BlockAllocator):
         }
 
 
+def _pow2_ceil(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+class _Admission(NamedTuple):
+    """One prepared-but-not-yet-activated admission: blocks are already
+    allocated/shared (and the COW clone applied to the pool), the prompt's
+    full blocks are registered in the prefix index so same-burst siblings
+    hit, but the slot is not active until `_commit` — `_rollback` can
+    still undo everything if the prefill fails."""
+    slot: int
+    rid: int
+    toks: List[int]
+    stop: int
+    mn: int
+    need: int
+    matched: List[int]
+    cached_len: int
+    cow_src: Optional[int]
+    fresh: List[int]
+    inserted: List[int]
+    chunks: List[Tuple[int, int, int]]   # (start, end, bucket) per chunk
+
+
 def init_pool(cfg: llama.LlamaConfig, num_blocks: int, block_size: int):
     L, KV, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
                  cfg.head_dim)
@@ -467,10 +492,20 @@ class ContinuousBatcher:
 
     Host-side scheduler over compiled device steps: a fixed set of B
     batch slots decodes in lock-step chunks; when a request finishes
-    (eos or budget) its blocks return to the allocator and a queued
-    request is admitted into the free slot by a single-slot prefill —
+    (eos or budget) its blocks return to the allocator and queued
+    requests are admitted into the free slots by a bucketed prefill —
     decode of the other slots never re-pads or re-compiles (shapes are
     static: the chunk step compiles once per (B, M)).
+
+    Prefill is bucketed, chunked, and batched: the suffix pads to a
+    power-of-two bucket ladder (masked through valid/positions), longer
+    suffixes split into sequential largest-bucket chunks through the
+    per-query-causal paged path, and same-bucket admissions in one burst
+    prefill in a single compiled call. Every shape comes from a finite
+    (group, bucket, phase) set memoized in `_prefill_exe`, so
+    steady-state admission NEVER recompiles (`prefill_compile_count`
+    goes flat after `warmup_prefill()`); `prefill_pad_tokens` counts the
+    padding overhead bucketing trades for that.
 
     Usage:
         cb = ContinuousBatcher(params, cfg, max_batch=2, block_size=16,
@@ -484,7 +519,9 @@ class ContinuousBatcher:
                  max_total_len: int, max_new_tokens: int,
                  eos_token_id: Optional[int] = None,
                  num_blocks: Optional[int] = None, chunk: int = 8,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 max_prefill_bucket: int = 512):
         self.params, self.cfg = params, cfg
         self.B, self.bs = max_batch, block_size
         self.max_total = max_total_len
@@ -492,6 +529,31 @@ class ContinuousBatcher:
         self.max_new = max_new_tokens
         self.eos = eos_token_id
         self.chunk = chunk
+        # prefill bucket ladder: suffixes pad to the smallest bucket that
+        # fits and longer ones split into largest-bucket chunks, so every
+        # admission hits one of a FIXED set of compiled shapes instead of
+        # tracing per prompt length. None = auto power-of-two ladder
+        # (8, 16, ... capped by max_prefill_bucket and the table span);
+        # an empty sequence disables bucketing (exact shapes — one
+        # compile per distinct suffix length, the pre-bucketing behavior)
+        if prefill_buckets is None:
+            # the top bucket never exceeds the table span — no suffix
+            # can be longer than max_total_len, so a bigger bucket would
+            # only buy pad tokens (the cap itself may be non-pow2)
+            cap = max(1, min(int(max_total_len), int(max_prefill_bucket)))
+            ladder, b = [], 8
+            while b < cap:
+                ladder.append(b)
+                b *= 2
+            ladder.append(cap)
+            self._buckets: Tuple[int, ...] = tuple(sorted(set(ladder)))
+        else:
+            self._buckets = tuple(sorted({int(x) for x in prefill_buckets}))
+            if any(x < 1 for x in self._buckets):
+                raise ValueError("prefill_buckets must be positive")
+        self._prefill_fns: Dict[bool, Any] = {}     # cold -> jitted fn
+        self._prefill_cache: Dict[Tuple[int, int, bool], Any] = {}
+        self.prefill_pad_tokens = 0
         nb = num_blocks or (max_batch * self.M)
         if prefix_cache:
             # vLLM-style automatic prefix caching: a trie over full-block
@@ -606,6 +668,27 @@ class ContinuousBatcher:
             cached_len = len(toks) - 1
         return matched, cached_len, cow_src
 
+    def prefix_cached_tokens(self, tokens: Sequence[int]) -> int:
+        """Prompt tokens the prefix cache can serve RIGHT NOW (0 with the
+        cache off). Cheap trie walk, no refcount moves — the scheduler's
+        cache-aware admission preference reads this."""
+        if self._pcache is None:
+            return 0
+        _, cached_len, _ = self._match_cached(list(tokens))
+        return cached_len
+
+    @property
+    def prefill_buckets(self) -> Tuple[int, ...]:
+        """The prefill bucket ladder (empty = bucketing disabled)."""
+        return self._buckets
+
+    @property
+    def prefill_compile_count(self) -> int:
+        """Distinct prefill shapes compiled so far — flat after warmup is
+        the whole point of bucketing (each (group, bucket, phase) combo
+        compiles exactly once for the batcher's lifetime)."""
+        return len(self._prefill_cache)
+
     def prefix_stats(self) -> Dict[str, Any]:
         """Prefix-cache counters for the serving metrics surface:
         hits/misses/hit_tokens/hit_rate from the index plus the
@@ -662,8 +745,100 @@ class ContinuousBatcher:
                 jnp.asarray(self.budget, jnp.int32),
                 jnp.asarray(self.stop, jnp.int32))
 
-    def _admit_one(self, slot: int, rid: int, toks: List[int],
-                   stop: int = -1, max_new: Optional[int] = None) -> None:
+    # -- bucketed / chunked / batched prefill -----------------------------
+    def _bucket_for(self, S: int) -> int:
+        """Smallest ladder bucket that fits a suffix of S tokens; with
+        bucketing disabled (empty ladder) the bucket IS the exact length."""
+        for b in self._buckets:
+            if b >= S:
+                return b
+        return S
+
+    def _suffix_chunks(self, cached_len: int,
+                       P: int) -> List[Tuple[int, int, int]]:
+        """Split the still-to-prefill suffix [cached_len, P) into
+        (start, end, bucket) chunks: largest-bucket-sized pieces first,
+        then one bucketed remainder — bounding per-chunk latency and
+        lifting the effective prompt length past one flash pass."""
+        out: List[Tuple[int, int, int]] = []
+        start = cached_len
+        cap = self._buckets[-1] if self._buckets else P - cached_len
+        while P - start > cap:
+            out.append((start, start + cap, cap))
+            start += cap
+        out.append((start, P, self._bucket_for(P - start)))
+        return out
+
+    def _group_pad(self, G: int) -> int:
+        """Pad an admission group to the next power of two (capped at the
+        batch width) so burst sizes draw from a fixed shape ladder."""
+        return min(_pow2_ceil(max(1, G)), self.B)
+
+    def _build_prefill(self, cold: bool):
+        """The one traced prefill: rows [G, Pb] at per-row absolute
+        positions against the shared pool. Pure — compile bookkeeping
+        lives host-side in `_prefill_exe` (TRACE001)."""
+        cfg = self.cfg
+
+        def prefill(params, rows, k, v, table, positions, valid, lengths):
+            sub = PagedKVCache(k, v, table, lengths)
+            logits, sub = forward_paged(params, rows, sub, positions,
+                                        valid, cfg, is_prefill=cold)
+            return logits, sub.k, sub.v
+
+        return jax.jit(prefill)
+
+    def _prefill_exe(self, G: int, Pb: int, cold: bool):
+        """Memoized COMPILED prefill per (group, bucket, phase) shape.
+        AOT-lowered from abstract avals, so `warmup_prefill` can populate
+        the whole ladder without running a single FLOP; steady-state
+        admission dispatches straight to a compiled executable and never
+        retraces."""
+        key = (G, Pb, cold)
+        exe = self._prefill_cache.get(key)
+        if exe is None:
+            fn = self._prefill_fns.get(cold)
+            if fn is None:
+                fn = self._build_prefill(cold)
+                self._prefill_fns[cold] = fn
+            sds, i32 = jax.ShapeDtypeStruct, jnp.int32
+            pstruct = jax.tree_util.tree_map(
+                lambda x: sds(jnp.shape(x), x.dtype), self.params)
+            exe = fn.lower(
+                pstruct, sds((G, Pb), i32),
+                sds(self.cache.k.shape, self.cache.k.dtype),
+                sds(self.cache.v.shape, self.cache.v.dtype),
+                sds((G, self.M), i32), sds((G, Pb), i32),
+                sds((G, Pb), jnp.bool_), sds((G,), i32)).compile()
+            self._prefill_cache[key] = exe
+        return exe
+
+    def warmup_prefill(self, buckets: Optional[Sequence[int]] = None,
+                       group_sizes: Optional[Sequence[int]] = None,
+                       modes: Sequence[bool] = (True, False)) -> int:
+        """Pre-compile every prefill shape admission can hit — each
+        ladder bucket x each power-of-two group size x {cold, cached} —
+        via AOT lowering (no device compute). After this, steady-state
+        admission never compiles. Returns the number of newly compiled
+        shapes. No-op for a bucketing-disabled batcher (exact shapes are
+        unbounded; there is nothing finite to warm)."""
+        ladder = self._buckets if buckets is None else tuple(buckets)
+        if group_sizes is None:
+            # exactly the shapes _group_pad can ever produce
+            group_sizes = {self._group_pad(g) for g in range(1, self.B + 1)}
+        n0 = len(self._prefill_cache)
+        for Pb in ladder:
+            for G in sorted(set(group_sizes)):
+                for cold in modes:
+                    self._prefill_exe(int(G), int(Pb), bool(cold))
+        return len(self._prefill_cache) - n0
+
+    def _prepare_admission(self, slot: int, rid: int, toks: List[int],
+                           stop: int, max_new: Optional[int]) -> _Admission:
+        """Blocks + prefix-cache bookkeeping for one admission, NO model
+        compute: share the matched chain, allocate the rest, apply the
+        COW clone, and register the prompt's full blocks so same-burst
+        siblings hit. The slot stays inactive until `_commit`."""
         P = len(toks)
         mn = self.max_new if max_new is None else max_new
         need = -(-(P + mn) // self.bs)
@@ -696,65 +871,192 @@ class ContinuousBatcher:
             if pinned:
                 self.alloc.release(pinned)
             raise
-        owned = matched + fresh
-        blocks = owned + [0] * (self.M - need)
-        try:
-            k, v = self.cache.k, self.cache.v
-            if cow_src is not None:
-                # copy-on-write tail: the whole prompt hit the cache, so
-                # clone the final shared block and recompute only the
-                # last token into the private copy (fresh[0] sits at
-                # chain position len(matched) — exactly the clone's slot
-                # in the table row)
-                dst = fresh[0]
-                k = k.at[:, dst].set(k[:, cow_src])
-                v = v.at[:, dst].set(v[:, cow_src])
-            table = self.cache.table.at[slot].set(
-                jnp.asarray(blocks, jnp.int32))
-            S = P - cached_len            # suffix still to prefill (>= 1)
-            row = jnp.asarray(toks[cached_len:], jnp.int32)[None]
-            positions = jnp.arange(cached_len, P)[None]
-            sub = PagedKVCache(k, v, table[slot:slot + 1],
-                               self.cache.lengths[slot:slot + 1])
-            # cold prompt: in-batch flash prefill; cached prefix: paged
-            # per-query-causal prefill of just the suffix
-            logits, sub = forward_paged(
-                self.params, row, sub, positions, jnp.ones((1, S), bool),
-                self.cfg, is_prefill=(cached_len == 0))
-            first = int(jnp.argmax(logits[0, S - 1]))
-        except Exception:
-            # a failed prefill must not leak its blocks: the slot was
-            # never activated, so nothing else will ever free them
-            self.alloc.release(fresh)
-            if pinned:
-                self.alloc.release(pinned)
-            raise
-        if cow_src is not None:
-            self.alloc.release([cow_src])  # pinned only for the copy
+        # NOTE: the copy-on-write clone (fresh[0] <- pool[cow_src]) is
+        # NOT applied here — a same-burst neighbor may have registered
+        # the source block moments ago with its prefill still pending,
+        # so the clone must wait until every earlier unit has written
+        # the pool (`_apply_cow` in `_admit_many`)
+        inserted: List[int] = []
         if self._pcache is not None:
-            self._pcache.note_admission(P, cached_len)
             # register the prompt's FULL blocks right away so requests
-            # queued behind this one share them while it is still in
-            # flight (the generated tail registers at retirement)
+            # queued behind this one (same burst included) share them
+            # while it is still in flight; `mark_cached` waits for
+            # `_commit` so a failed prefill can't park unwritten KV on
+            # the reclaimable list
             n_full = P // self.bs
             if n_full:
-                self.alloc.mark_cached(self._pcache.insert(
-                    toks[:n_full * self.bs], owned[:n_full]))
-        self.cache = PagedKVCache(
-            sub.k, sub.v, table,
-            self.cache.lengths.at[slot].set(P))
-        self.cur_tok = self.cur_tok.at[slot].set(first)
-        self.active[slot] = True
-        self.slot_req[slot] = rid
-        self.slot_blocks[slot] = blocks[:need]
-        self.slot_tokens[slot] = list(toks)
-        self.budget[slot] = mn - 1
-        self.stop[slot] = stop
+                owned = matched + fresh
+                inserted = self._pcache.insert(toks[:n_full * self.bs],
+                                               owned[:n_full])
+        return _Admission(slot, rid, list(toks), stop, mn, need, matched,
+                          cached_len, cow_src, fresh, inserted,
+                          self._suffix_chunks(cached_len, P))
+
+    def _rollback(self, recs: Sequence[_Admission]) -> None:
+        """Undo prepared-but-uncommitted admissions after a failed
+        prefill: unlink their index registrations (nothing may match KV
+        that was never written), then return their blocks. Never touches
+        committed slots."""
+        for rec in recs:
+            if self._pcache is not None:
+                for b in rec.inserted:
+                    self._pcache.unlink(b)
+            self.alloc.release(rec.fresh)
+            pinned = rec.matched + ([rec.cow_src]
+                                    if rec.cow_src is not None else [])
+            if pinned:
+                self.alloc.release(pinned)
+
+    def _prefill_call(self, items: Sequence[Tuple[_Admission, int, int]],
+                      Pb: int, cold: bool):
+        """Run ONE compiled prefill over a group of (record, start, end)
+        chunks: rows pad to the bucket, the group pads to its power-of-
+        two size, padding masks through `valid` (writes drop) and clamped
+        positions (gathers stay in range). Returns logits [Gp, Pb, V]."""
+        G = len(items)
+        Gp = self._group_pad(G)
+        rows = np.zeros((Gp, Pb), np.int32)
+        pos = np.zeros((Gp, Pb), np.int32)
+        val = np.zeros((Gp, Pb), np.bool_)
+        tab = np.zeros((Gp, self.M), np.int32)
+        real = 0
+        maxpos = self.M * self.bs - 1
+        for g, (rec, start, end) in enumerate(items):
+            S = end - start
+            real += S
+            rows[g, :S] = rec.toks[start:end]
+            pos[g] = np.minimum(np.arange(start, start + Pb), maxpos)
+            val[g, :S] = True
+            tab[g, :rec.need] = rec.matched + rec.fresh
+        self.prefill_pad_tokens += Gp * Pb - real
+        exe = self._prefill_exe(Gp, Pb, cold)
+        logits, k, v = exe(self.params, jnp.asarray(rows), self.cache.k,
+                           self.cache.v, jnp.asarray(tab),
+                           jnp.asarray(pos), jnp.asarray(val),
+                           jnp.zeros((Gp,), jnp.int32))
+        self.cache = self.cache._replace(k=k, v=v)
+        return logits
+
+    def _units(self,
+               recs: Sequence[_Admission]) -> List[List[_Admission]]:
+        """Partition a burst into execution units IN ORDER (a later
+        request may share blocks a former one just registered, so units
+        never reorder): consecutive single-chunk records with the same
+        (bucket, phase) batch into one prefill call; a chunked record
+        runs alone (its chunks are sequential by construction)."""
+        units: List[List[_Admission]] = []
+        cur: List[_Admission] = []
+        cur_inserted: set = set()
+        key = None
+        for rec in recs:
+            if len(rec.chunks) > 1:
+                if cur:
+                    units.append(cur)
+                    cur, cur_inserted, key = [], set(), None
+                units.append([rec])
+                continue
+            s, _, b = rec.chunks[0]
+            k = (b, s == 0)
+            # a COW record must not share a unit with the record that
+            # registered its source block: the clone reads the POOL
+            # (outside the compiled call), so the source's prefill has
+            # to complete in an earlier unit first. Matched (non-COW)
+            # blocks are safe in-unit — the gather sees the layer's
+            # writes inside the computation.
+            cow_conflict = (rec.cow_src is not None
+                            and rec.cow_src in cur_inserted)
+            if cur and k == key and len(cur) < self.B \
+                    and not cow_conflict:
+                cur.append(rec)
+            else:
+                if cur:
+                    units.append(cur)
+                cur, cur_inserted, key = [rec], set(), k
+            cur_inserted.update(rec.inserted)
+        if cur:
+            units.append(cur)
+        return units
+
+    def _apply_cow(self, unit: Sequence[_Admission]) -> None:
+        """Apply a unit's copy-on-write clones right before its prefill:
+        every earlier unit has written the pool by now, so the clone
+        captures the source block's real KV (fresh[0] sits at chain
+        position len(matched) — exactly the clone's slot in the table
+        row)."""
+        for rec in unit:
+            if rec.cow_src is not None:
+                dst = rec.fresh[0]
+                self.cache = self.cache._replace(
+                    k=self.cache.k.at[:, dst].set(
+                        self.cache.k[:, rec.cow_src]),
+                    v=self.cache.v.at[:, dst].set(
+                        self.cache.v[:, rec.cow_src]))
+
+    def _commit(self, rec: _Admission, first: int) -> None:
+        """Activate a successfully prefilled admission in its slot."""
+        if rec.cow_src is not None:
+            self.alloc.release([rec.cow_src])  # pinned only for the copy
+        P = len(rec.toks)
+        if self._pcache is not None:
+            self._pcache.note_admission(P, rec.cached_len)
+            if rec.inserted:
+                self.alloc.mark_cached(rec.inserted)
+        owned = rec.matched + rec.fresh
+        blocks = owned + [0] * (self.M - rec.need)
+        self.cache = self.cache._replace(
+            table=self.cache.table.at[rec.slot].set(
+                jnp.asarray(blocks, jnp.int32)),
+            lengths=self.cache.lengths.at[rec.slot].set(P))
+        self.cur_tok = self.cur_tok.at[rec.slot].set(first)
+        self.active[rec.slot] = True
+        self.slot_req[rec.slot] = rec.rid
+        self.slot_blocks[rec.slot] = owned
+        self.slot_tokens[rec.slot] = list(rec.toks)
+        self.budget[rec.slot] = rec.mn - 1
+        self.stop[rec.slot] = rec.stop
         self._dev_state = None        # host slot state diverged from device
-        self.outputs[rid].append(first)
+        self.outputs[rec.rid].append(first)
         if ((self.eos is not None and first == self.eos)
-                or first == stop or self.budget[slot] <= 0):
-            self._retire(slot)
+                or first == rec.stop or self.budget[rec.slot] <= 0):
+            self._retire(rec.slot)
+
+    def _admit_many(self, recs: List[_Admission]) -> None:
+        """Prefill + activate a prepared burst: same-bucket single-chunk
+        records amortize one compiled call; longer suffixes stream
+        through sequential bucket-sized chunks (chunk i's KV is in the
+        pool before chunk i+1 attends through the table). One host sync
+        per unit reads every first token at once."""
+        pending = list(recs)
+        try:
+            for unit in self._units(recs):
+                self._apply_cow(unit)
+                if len(unit) == 1 and len(unit[0].chunks) > 1:
+                    rec = unit[0]
+                    for start, end, bucket in rec.chunks:
+                        logits = self._prefill_call(
+                            [(rec, start, end)], bucket, cold=(start == 0))
+                    items = [(rec, rec.chunks[-1][0], rec.chunks[-1][1])]
+                else:
+                    items = [(r, r.chunks[0][0], r.chunks[0][1])
+                             for r in unit]
+                    _, _, bucket = unit[0].chunks[0]
+                    logits = self._prefill_call(
+                        items, bucket, cold=(items[0][1] == 0))
+                # ragged last-token logits per row, ONE readback per unit
+                li = np.asarray([end - start - 1
+                                 for _, start, end in items])
+                last = jnp.argmax(
+                    logits[jnp.asarray(np.arange(len(items))),
+                           jnp.asarray(li)], axis=-1)
+                firsts = np.asarray(last)
+                for rec, first in zip(unit, firsts):
+                    self._commit(rec, int(first))
+                    pending.remove(rec)
+        except Exception:
+            # a failed prefill must not leak its blocks: the slots were
+            # never activated, so nothing else will ever free them
+            self._rollback(pending)
+            raise
 
     def _retire(self, slot: int) -> None:
         rid = self.slot_req[slot]
@@ -788,24 +1090,35 @@ class ContinuousBatcher:
         self._dev_state = None        # host slot state diverged from device
 
     def _admit(self) -> None:
-        for slot in range(self.B):
-            if not self.active[slot] and self.queue:
+        free = [s for s in range(self.B) if not self.active[s]]
+        recs: List[_Admission] = []
+        try:
+            while free and self.queue:
                 _, toks0, _, mn0 = self.queue[0]
                 # cached-aware: blocks another in-flight request already
                 # pins for this prompt's prefix are shared, not drawn
                 # from the pool — and `free_blocks` already counts
-                # reclaimable cached blocks on the refcounting allocator
+                # reclaimable cached blocks on the refcounting allocator.
+                # Earlier records in this burst already hold their blocks
+                # (and registered their prompts), so the head-of-line
+                # check and the trie walk both see them.
                 need = self.blocks_needed(len(toks0), mn0, tokens=toks0)
                 if need > self.alloc.free_blocks:
-                    if not any(self.active):
+                    if not any(self.active) and not recs:
                         # nothing in flight will ever free blocks
                         raise RuntimeError(
                             f"request needs {need} blocks but the pool "
                             f"holds only {self.alloc.num_blocks} — size "
                             f"num_blocks for the largest single request")
-                    return          # defer until a request retires
+                    break           # defer until a request retires
                 rid, toks, stop, mn = self.queue.pop(0)
-                self._admit_one(slot, rid, toks, stop, mn)
+                recs.append(self._prepare_admission(
+                    free.pop(0), rid, toks, stop, mn))
+        except Exception:
+            self._rollback(recs)
+            raise
+        if recs:
+            self._admit_many(recs)
 
     def _build_chunk(self):
         cfg, chunk = self.cfg, self.chunk

@@ -131,12 +131,21 @@ class PrefixCacheIndex:
         at the hole — but stay registered in the block map so their own
         eviction (they are older in the allocator's LRU or still live)
         cleans them up; memory stays bounded by the pool size."""
+        if self.unlink(block):
+            self.evicted_blocks += 1
+
+    def unlink(self, block: int) -> bool:
+        """Remove `block` from the index WITHOUT counting an eviction —
+        the admission-rollback path undoes registrations whose KV was
+        never written, which is not pool pressure and must not show up
+        as `evicted_blocks` on the metrics surface. Returns True when
+        the block was indexed."""
         node = self._by_block.pop(block, None)
         if node is None:
-            return
+            return False
         if node.parent.get(node.key) is node:
             del node.parent[node.key]
-        self.evicted_blocks += 1
+        return True
 
     def note_admission(self, prompt_len: int, cached_tokens: int) -> None:
         """Record one admission's hit accounting (called by the batcher

@@ -70,6 +70,8 @@ class ServingEngine:
                  metrics: Optional[MetricsRegistry] = None,
                  start: bool = True, idle_poll_s: float = 0.05,
                  prefix_cache: bool = True,
+                 prefill_buckets=None, max_prefill_bucket: int = 512,
+                 warmup: bool = False,
                  clock=time.monotonic):
         # lazy: keep `import paddle_tpu` from pulling the whole nlp tree
         from ..nlp.paged import ContinuousBatcher
@@ -77,7 +79,8 @@ class ServingEngine:
             params, cfg, max_batch=max_batch, block_size=block_size,
             max_total_len=max_total_len, max_new_tokens=max_new_tokens,
             eos_token_id=eos_token_id, num_blocks=num_blocks, chunk=chunk,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache, prefill_buckets=prefill_buckets,
+            max_prefill_bucket=max_prefill_bucket)
         self.metrics = metrics or MetricsRegistry()
         self._clock = clock
         self._idle_poll_s = idle_poll_s
@@ -115,11 +118,32 @@ class ServingEngine:
         self._g_pc_hit_rate = m.gauge("prefix_cache_hit_rate")
         self._g_pc_evictions = m.gauge("prefix_cache_evictions")
         self._g_pc_cached = m.gauge("prefix_cache_cached_blocks")
+        # bucketed-prefill surface: compile count flat after warmup is
+        # the TTFT story; pad tokens is the overhead bucketing costs
+        self._g_prefill_compiles = m.gauge("prefill_compile_count")
+        self._g_prefill_pad = m.gauge("prefill_pad_tokens")
 
+        if warmup:
+            self.warmup()
         if start:
             self.start()
 
     # ---- public API ------------------------------------------------------
+    def warmup(self) -> int:
+        """Pre-compile every prefill shape (bucket ladder x admission
+        group size x cold/cached) via AOT lowering, so no serving-path
+        request ever pays a prefill compile. Only valid BEFORE start():
+        once the loop runs, the batcher belongs to the engine thread.
+        Returns the number of shapes compiled."""
+        with self._work:
+            if self._thread is not None:
+                raise RuntimeError(
+                    "warmup() must run before start() — the engine "
+                    "thread owns the batcher once the loop is live")
+            n = self.batcher.warmup_prefill()
+            self._update_gauges_locked()
+            return n
+
     def start(self) -> "ServingEngine":
         with self._work:
             if self._stop:
@@ -354,6 +378,22 @@ class ServingEngine:
         free_blocks = self.batcher.alloc.free_blocks
         b = self.batcher
         needed = {}          # id(req) -> blocks, computed once per pop
+        # cache-aware ordering: at EQUAL effective priority, prefer the
+        # request whose prefix is cached right now — serving it before
+        # eviction recycles those blocks converts reclaimable KV into
+        # skipped prefill (pure trie walk, no refcount moves). Memoized
+        # per admission round: pop() evaluates prefer on EVERY queued
+        # item, and one walk per request is enough — the slight
+        # staleness across this round's pops is harmless (same tolerance
+        # as `needed` below).
+        prefer = None
+        if b.prefix_stats().get("enabled") is True:
+            warm = {}        # id(req) -> bool, one trie walk per request
+
+            def prefer(r):
+                if id(r) not in warm:
+                    warm[id(r)] = b.prefix_cached_tokens(r.prompt) > 0
+                return warm[id(r)]
         while free_slots > 0:
             def fits(r):   # max_new_tokens was resolved by submit()
                 # cached-aware: a prompt whose prefix is already pinned
@@ -363,7 +403,7 @@ class ServingEngine:
                 needed[id(r)] = n = b.blocks_needed(
                     len(r.prompt), r.max_new_tokens, tokens=r.prompt)
                 return n <= free_blocks
-            req = self.queue.pop(fits=fits)
+            req = self.queue.pop(fits=fits, prefer=prefer)
             if req is None:
                 break                     # empty, or defer-on-no-blocks
             now = self._clock()
@@ -467,6 +507,8 @@ class ServingEngine:
         self._g_running.set(len(self._running))
         self._g_blocks.set(stats["blocks_in_use"])
         self._g_util.set(stats["blocks_in_use"] / stats["capacity_blocks"])
+        self._g_prefill_compiles.set(self.batcher.prefill_compile_count)
+        self._g_prefill_pad.set(self.batcher.prefill_pad_tokens)
         if pc.get("enabled"):
             self._g_pc_hit_tokens.set(pc["hit_tokens"])
             self._g_pc_hit_rate.set(pc["hit_rate"])

@@ -69,23 +69,34 @@ class AdmissionQueue:
                 _Entry(int(priority), self._seq, self._clock(), item))
             self._seq += 1
 
-    def _key(self, e: _Entry, now: float):
+    def _key(self, e: _Entry, now: float,
+             prefer: Optional[Callable[[object], bool]] = None):
         aged = int((now - e.enq_time) / self.aging_interval_s) \
             if self.aging_interval_s > 0 else 0
-        return (e.priority - aged, e.seq)
+        if prefer is None:
+            return (e.priority - aged, e.seq)
+        # preference is a TIE-BREAK within an effective-priority level:
+        # it can reorder equals (cache-aware admission) but never jump
+        # a lower-priority request over a higher one
+        return (e.priority - aged, 0 if prefer(e.item) else 1, e.seq)
 
-    def pop(self, fits: Optional[Callable[[object], bool]] = None):
+    def pop(self, fits: Optional[Callable[[object], bool]] = None,
+            prefer: Optional[Callable[[object], bool]] = None):
         """Remove and return the best (aged-priority, FIFO) item.
 
         With `fits`, the best item is returned only when fits(item) is
         True; otherwise the queue DEFERS as a whole (returns None) —
-        the batcher's defer-on-no-blocks semantics. Returns None when
-        empty."""
+        the batcher's defer-on-no-blocks semantics. With `prefer`, items
+        for which prefer(item) is True win ties WITHIN an effective
+        priority level (the engine passes cached-prefix preference, so
+        reclaimable KV is reused before eviction recycles it); FIFO
+        still breaks remaining ties. Returns None when empty."""
         with self._lock:
             if not self._items:
                 return None
             now = self._clock()
-            best = min(self._items, key=lambda e: self._key(e, now))
+            best = min(self._items,
+                       key=lambda e: self._key(e, now, prefer))
             if fits is not None and not fits(best.item):
                 return None
             self._items.remove(best)

@@ -140,6 +140,8 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True) -> SparseCsrTensor:
+    """Build a CSR sparse tensor from (crows, cols, values) index
+    arrays and a dense shape (jax BCSR-backed)."""
     indptr = jnp.asarray(crows._data if isinstance(crows, Tensor) else crows,
                          jnp.int32)
     indices = jnp.asarray(cols._data if isinstance(cols, Tensor) else cols,
@@ -153,6 +155,7 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
 
 
 def is_sparse(x) -> bool:
+    """True when `x` is a sparse (COO or CSR) tensor."""
     return isinstance(x, (SparseCooTensor, SparseCsrTensor))
 
 
@@ -180,34 +183,42 @@ def _unary(x, fn) -> SparseCooTensor:
 
 
 def relu(x):
+    """Elementwise max(x, 0) on the stored values (zeros preserved)."""
     return _unary(x, lambda v: jnp.maximum(v, 0))
 
 
 def abs(x):
+    """Elementwise absolute value on the stored values."""
     return _unary(x, jnp.abs)
 
 
 def sin(x):
+    """Elementwise sine on the stored values (zeros preserved)."""
     return _unary(x, jnp.sin)
 
 
 def tanh(x):
+    """Elementwise tanh on the stored values (zeros preserved)."""
     return _unary(x, jnp.tanh)
 
 
 def sqrt(x):
+    """Elementwise square root on the stored values."""
     return _unary(x, jnp.sqrt)
 
 
 def neg(x):
+    """Elementwise negation on the stored values."""
     return _unary(x, jnp.negative)
 
 
 def pow(x, factor):
+    """Elementwise power x**factor on the stored values."""
     return _unary(x, lambda v: jnp.power(v, factor))
 
 
 def cast(x, index_dtype=None, value_dtype=None):
+    """Cast a COO tensor's index and/or value dtypes."""
     b = _coo(x)
     data = b.data if value_dtype is None else b.data.astype(value_dtype)
     idx = b.indices if index_dtype is None else b.indices.astype(index_dtype)
@@ -216,11 +227,14 @@ def cast(x, index_dtype=None, value_dtype=None):
 
 
 def transpose(x, perm):
+    """Permute a sparse tensor's dimensions by `perm`."""
     b = _coo(x)
     return SparseCooTensor(b.transpose(tuple(perm)), x.stop_gradient)
 
 
 def sum(x, axis=None, dtype=None, keepdim=False):
+    """Sum a sparse tensor's values (all or along `axis`) into a
+    dense Tensor."""
     b = _coo(x)
     out = b.sum() if axis is None else b.sum(axis)
     out = getattr(out, "todense", lambda: out)()
@@ -243,6 +257,8 @@ def _binary_densify(x, y, fn):
 
 
 def add(x, y):
+    """Elementwise sum: sparse+sparse stays sparse (indices merged);
+    any dense operand densifies."""
     if is_sparse(x) and is_sparse(y):
         bx, by = _coo(x), _coo(y)
         merged = jsparse.BCOO(
@@ -254,16 +270,19 @@ def add(x, y):
 
 
 def subtract(x, y):
+    """Elementwise difference (sparse-sparse stays sparse)."""
     if is_sparse(x) and is_sparse(y):
         return add(x, neg(y))
     return Tensor(_dense(x) - _dense(y))
 
 
 def multiply(x, y):
+    """Elementwise product (densified, re-sparsified from nonzeros)."""
     return _binary_densify(x, y, jnp.multiply)
 
 
 def divide(x, y):
+    """Elementwise quotient; 0/0 and x/0 artifacts drop to zeros."""
     return _binary_densify(x, y, jnp.divide)
 
 

@@ -1,0 +1,274 @@
+"""Bucketed / chunked / jit-cached prefill in the continuous batcher.
+
+Three layers, cheapest first:
+
+  * ladder/chunking units — bucket selection, chunk splitting, group
+    padding (pure host logic on a built batcher, no model compute);
+  * token-level parity — bucketed == unbucketed and chunked == whole,
+    incl. the prefix-cache interplay (suffix chunking after a cached
+    chain, the COW full-hit whose padded bucket crosses a block
+    boundary) and co-batched neighbors staying uncorrupted;
+  * compile-count accounting — admissions draw from a FIXED shape set:
+    repeat lengths in the same bucket add zero compiles, warmup_prefill
+    pre-compiles the whole ladder so serving never traces, and a
+    same-bucket burst prefills in ONE batched call.
+"""
+import numpy as np
+import pytest
+import jax
+
+from paddle_tpu.nlp import llama, paged
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _batcher(params, cfg, max_new=6, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_total_len", 32)
+    kw.setdefault("chunk", 3)
+    return paged.ContinuousBatcher(params, cfg, max_new_tokens=max_new,
+                                   **kw)
+
+
+def _run(params, cfg, prompts, max_new=6, **kw):
+    cb = _batcher(params, cfg, max_new=max_new, **kw)
+    rids = [cb.submit(p) for p in prompts]
+    out = cb.run()
+    return [out[r] for r in rids], cb
+
+
+def _prompts(seed, lengths):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(1, 200, n))) for n in lengths]
+
+
+class TestBucketLadder:
+    def test_auto_ladder_and_bucket_for(self, setup):
+        cfg, params = setup
+        cb = _batcher(params, cfg)                    # max_total_len=32
+        assert cb.prefill_buckets == (8, 16, 32)
+        assert cb._bucket_for(1) == 8
+        assert cb._bucket_for(8) == 8
+        assert cb._bucket_for(9) == 16
+        assert cb._bucket_for(32) == 32
+
+    def test_explicit_and_disabled_ladder(self, setup):
+        cfg, params = setup
+        cb = _batcher(params, cfg, prefill_buckets=(4, 12))
+        assert cb.prefill_buckets == (4, 12)
+        off = _batcher(params, cfg, prefill_buckets=())
+        assert off.prefill_buckets == ()
+        assert off._bucket_for(13) == 13              # exact shapes
+        with pytest.raises(ValueError, match="positive"):
+            _batcher(params, cfg, prefill_buckets=(0, 4))
+
+    def test_ladder_caps_at_max_prefill_bucket(self, setup):
+        cfg, params = setup
+        cb = _batcher(params, cfg, max_prefill_bucket=16)
+        assert cb.prefill_buckets == (8, 16)
+        # non-pow2 table span: the top bucket is the span itself, never
+        # a power of two PAST it (33..47-token suffixes would only buy
+        # pad tokens from a 64 bucket)
+        cb = _batcher(params, cfg, max_total_len=48, block_size=8)
+        assert cb.prefill_buckets == (8, 16, 32, 48)
+
+    def test_suffix_chunking_rule(self, setup):
+        cfg, params = setup
+        cb = _batcher(params, cfg, max_prefill_bucket=8)   # ladder (8,)
+        # 20-token cold suffix → two full 8-chunks + a bucketed tail
+        assert cb._suffix_chunks(0, 20) == [(0, 8, 8), (8, 16, 8),
+                                            (16, 20, 8)]
+        # warm suffix starts at the cached length
+        assert cb._suffix_chunks(8, 13) == [(8, 13, 8)]
+        # disabled bucketing: one exact-shape pass, never chunks
+        off = _batcher(params, cfg, prefill_buckets=())
+        assert off._suffix_chunks(0, 20) == [(0, 20, 20)]
+
+    def test_group_padding_ladder(self, setup):
+        cfg, params = setup
+        cb = _batcher(params, cfg, max_batch=4)
+        assert [cb._group_pad(g) for g in (1, 2, 3, 4)] == [1, 2, 4, 4]
+
+
+class TestPrefillParity:
+    """Acceptance: the bucketed/chunked pipeline is token-identical to
+    the exact-shape path — padding and chunk seams must be invisible."""
+
+    def test_bucketed_matches_unbucketed(self, setup):
+        cfg, params = setup
+        prompts = _prompts(31, (3, 5, 9, 13))         # two buckets
+        base, _ = _run(params, cfg, prompts, prefill_buckets=())
+        buck, cb = _run(params, cfg, prompts)
+        assert buck == base
+        assert cb.prefill_pad_tokens > 0              # padding happened
+
+    def test_chunked_matches_whole(self, setup):
+        cfg, params = setup
+        prompts = _prompts(32, (18, 21))              # > largest bucket 4
+        base, _ = _run(params, cfg, prompts, prefill_buckets=())
+        chunked, cb = _run(params, cfg, prompts, prefill_buckets=(4,))
+        assert chunked == base
+        # 18 cold tokens = 4 full chunks + a 2-token tail → ≥ 5 calls
+        assert cb.prefill_compile_count >= 1
+
+    def test_padded_bucket_crossing_block_boundary(self, setup):
+        """A 5-token prompt pads to bucket 8 with block_size 4: the pad
+        region spans the first block's tail AND the whole second block.
+        The dropped pad writes must not corrupt either the request's own
+        later decode or its co-batched neighbor."""
+        cfg, params = setup
+        prompts = _prompts(33, (5, 11))
+        base, _ = _run(params, cfg, prompts, prefill_buckets=())
+        buck, _ = _run(params, cfg, prompts, prefill_buckets=(8, 16))
+        assert buck == base
+
+    def test_chunked_suffix_after_cached_prefix(self, setup):
+        """Warm path x chunking: a prompt whose prefix chain is cached
+        (including blocks a COW admission produced) and whose LONG
+        suffix chunks through the paged per-query-causal path."""
+        cfg, params = setup
+        rng = np.random.RandomState(34)
+        head = list(map(int, rng.randint(1, 200, 8)))   # 2 full blocks
+        long_tail = list(map(int, rng.randint(1, 200, 14)))
+        cold, _ = _run(params, cfg, [head + long_tail],
+                       prefill_buckets=())
+        cb = _batcher(params, cfg, max_batch=1, prefill_buckets=(4,),
+                      prefix_cache=True)
+        r0 = cb.submit(head)          # seeds the cache with head's blocks
+        cb.run()
+        r1 = cb.submit(head)          # full hit → COW tail clone
+        cb.run()
+        r2 = cb.submit(head + long_tail)   # cached prefix + chunked tail
+        out = cb.run()
+        assert out[r2] == cold[0]
+        st = cb.prefix_stats()
+        assert st["hit_tokens"] >= 7 + 8       # r1 COW (P-1) + r2 chain
+        cold_head, _ = _run(params, cfg, [head], prefill_buckets=())
+        assert out[r0] == cold_head[0] and out[r1] == cold_head[0]
+
+    def test_cow_full_hit_padded_across_block_boundary(self, setup):
+        """The COW full-hit recomputes ONE token at position P-1
+        (mid-block); its bucket pads past the block boundary into the
+        next block. Output must match cold, and the pool must drain."""
+        cfg, params = setup
+        rng = np.random.RandomState(35)
+        p = list(map(int, rng.randint(1, 200, 8)))    # exactly 2 blocks
+        cold, _ = _run(params, cfg, [p], prefill_buckets=())
+        cb = _batcher(params, cfg, max_batch=1, prefill_buckets=(4, 8),
+                      prefix_cache=True)
+        r1 = cb.submit(p)
+        cb.run()
+        r2 = cb.submit(p)                             # full hit → COW
+        out = cb.run()
+        assert out[r1] == cold[0] and out[r2] == cold[0]
+        assert cb.alloc.stats()["blocks_in_use"] == 0
+
+    def test_same_burst_cow_on_inflight_sibling(self, setup):
+        """Regression: two IDENTICAL prompts admitted in ONE burst — the
+        second full-hits on blocks the first registered moments earlier
+        with its prefill still pending. The COW clone must wait until
+        the source's unit has written the pool (it once cloned zeros and
+        corrupted the second request's decode context)."""
+        cfg, params = setup
+        for seed in (52, 53, 56, 63):     # seeds that caught the bug
+            rng = np.random.RandomState(seed)
+            p = list(map(int, rng.randint(1, 200, 12)))  # 3 full blocks
+            cold, _ = _run(params, cfg, [p], max_batch=1)
+            cb = _batcher(params, cfg, prefix_cache=True)
+            ra, rb = cb.submit(p), cb.submit(p)
+            cb.step()                     # one burst admits both
+            out = cb.run()
+            assert out[ra] == cold[0]
+            assert out[rb] == cold[0], f"seed {seed}: COW read stale KV"
+            assert cb.alloc.stats()["blocks_in_use"] == 0
+
+    def test_failed_prefill_rolls_back_whole_burst(self, setup,
+                                                   monkeypatch):
+        """A prefill failure mid-burst must return EVERY prepared
+        request's blocks (none of the slots activated) — the engine's
+        exception boundary relies on it."""
+        cfg, params = setup
+        cb = _batcher(params, cfg, prefix_cache=True)
+        monkeypatch.setattr(
+            paged, "forward_paged",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+        for p in _prompts(36, (5, 7)):
+            cb.submit(p)
+        with pytest.raises(RuntimeError, match="boom"):
+            cb.run()
+        assert cb.alloc.stats()["blocks_in_use"] == 0
+        assert cb.active == [False, False]
+        # undoing never-written registrations is NOT pool pressure:
+        # neither the index's eviction counter nor the allocator's moves
+        assert cb.prefix_stats()["evicted_blocks"] == 0
+        assert cb.prefix_stats()["evictions"] == 0
+
+
+class TestCompileAccounting:
+    def test_same_bucket_lengths_share_one_compile(self, setup):
+        cfg, params = setup
+        cb = _batcher(params, cfg, max_batch=1)       # ladder (8, 16, 32)
+        for p in _prompts(41, (3,)):
+            cb.submit(p)
+        cb.run()
+        assert cb.prefill_compile_count == 1          # (G=1, 8, cold)
+        for p in _prompts(42, (5, 7, 8)):             # same bucket
+            cb.submit(p)
+            cb.run()
+        assert cb.prefill_compile_count == 1          # zero recompiles
+        cb.submit(_prompts(43, (9,))[0])              # next bucket
+        cb.run()
+        assert cb.prefill_compile_count == 2
+
+    def test_warmup_prefill_covers_all_admission_shapes(self, setup):
+        cfg, params = setup
+        cb = _batcher(params, cfg, max_batch=2, prefix_cache=True)
+        warmed = cb.warmup_prefill()
+        # ladder (8,16,32) x groups {1,2} x {cold, cached}
+        assert warmed == 3 * 2 * 2
+        c0 = cb.prefill_compile_count
+        for p in _prompts(44, (3, 9, 17, 4, 10, 3)):  # span the ladder
+            cb.submit(p)
+        cb.run()
+        for p in _prompts(44, (3, 9, 17)):            # warm repeats (hits)
+            cb.submit(p)
+        cb.run()
+        assert cb.prefill_compile_count == c0         # NEVER recompiled
+
+    def test_unbucketed_compiles_per_length(self, setup):
+        """The pre-bucketing behavior, kept reachable for comparison:
+        every distinct suffix length is its own compiled shape."""
+        cfg, params = setup
+        cb = _batcher(params, cfg, max_batch=1, prefill_buckets=())
+        for p in _prompts(45, (3, 5, 7)):
+            cb.submit(p)
+            cb.run()
+        assert cb.prefill_compile_count == 3
+
+    def test_same_bucket_burst_prefills_in_one_call(self, setup):
+        """Batched admission: a burst landing in one bucket runs ONE
+        compiled prefill (group-padded), and outputs match solo runs."""
+        cfg, params = setup
+        prompts = _prompts(46, (5, 6, 7))
+        solo = [_run(params, cfg, [p], max_batch=1)[0][0]
+                for p in prompts]
+        cb = _batcher(params, cfg, max_batch=3)
+        rids = [cb.submit(p) for p in prompts]
+        cb.step()                                     # one admission burst
+        assert cb.active == [True, True, True]
+        assert cb.prefill_compile_count == 1          # (G=3→3, 8, cold)
+        out = cb.run()
+        assert [out[r] for r in rids] == solo
+
+    def test_pad_tokens_accounting(self, setup):
+        cfg, params = setup
+        cb = _batcher(params, cfg, max_batch=1)
+        cb.submit(_prompts(47, (5,))[0])              # 5 → bucket 8
+        cb.run()
+        assert cb.prefill_pad_tokens == 3

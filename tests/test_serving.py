@@ -307,6 +307,46 @@ class TestServingPrefixCache:
         assert snap["allocator"]["blocks_in_use"] == 0
         assert snap["allocator"]["cached_blocks"] > 0
 
+    def test_cache_aware_admission_prefers_cached_prefix(self, setup):
+        """At equal priority the engine admits the request whose prefix
+        is cached BEFORE earlier-queued cold traffic (scheduler `prefer`
+        tie-break), so reclaimable blocks turn into skipped prefill
+        before eviction can recycle them."""
+        rng = np.random.RandomState(23)
+        common = list(map(int, rng.randint(1, 200, 8)))  # 2 full blocks
+        cold_p = list(map(int, rng.randint(1, 200, 9)))
+        eng = self._engine(setup, start=False, aging_interval_s=100.0)
+        # prime the cache while the loop is parked (the batcher is ours
+        # until start()) and hand the outputs back
+        rid = eng.batcher.submit(common + [41, 42])
+        eng.batcher.run()
+        eng.batcher.release(rid)
+        cold = eng.submit(cold_p)                 # queued FIRST
+        warm = eng.submit(common + [43])          # cached prefix, later
+        eng.start()
+        eng.shutdown(drain=True, timeout=300)
+        assert warm.state is RequestState.FINISHED
+        assert cold.state is RequestState.FINISHED
+        assert warm.admitted_index < cold.admitted_index
+        snap = eng.snapshot()
+        assert snap["prefix_cache"]["hit_tokens"] >= 8
+        # bucketed-prefill gauges ride the same snapshot
+        assert snap["gauges"]["prefill_compile_count"] >= 1
+        assert snap["gauges"]["prefill_pad_tokens"] > 0
+
+    def test_warmup_precompiles_and_refuses_after_start(self, setup):
+        eng = self._engine(setup, start=False)
+        warmed = eng.warmup()
+        assert warmed == eng.batcher.prefill_compile_count > 0
+        eng.start()
+        with pytest.raises(RuntimeError, match="before start"):
+            eng.warmup()
+        out = eng.generate(PROMPT_A, timeout=300)
+        assert eng.batcher.prefill_compile_count == warmed  # no retrace
+        eng.shutdown()
+        cfg, params = setup
+        assert out == _paged_single(params, cfg, PROMPT_A)
+
     def test_cancel_mid_decode_releases_shared_blocks(self, setup):
         """Two in-flight requests share the common prefix's blocks
         (refcount 2). Cancelling one mid-decode must decref — not
@@ -458,6 +498,26 @@ class TestAdmissionQueue:
             q.push(i)
         assert q.reap(lambda i: i % 2 == 0) == [0, 2]
         assert [q.pop(), q.pop()] == [1, 3]
+
+    def test_prefer_breaks_ties_within_priority(self):
+        """Cache-aware ordering: at EQUAL effective priority a preferred
+        (cached-prefix) item pops before earlier FIFO traffic, but never
+        jumps a strictly better priority level."""
+        q = AdmissionQueue(max_depth=8, aging_interval_s=100.0)
+        q.push("cold_a", priority=1)
+        q.push("warm", priority=1)
+        q.push("cold_b", priority=1)
+        prefer = lambda item: item == "warm"
+        assert q.pop(prefer=prefer) == "warm"          # tie-break wins
+        assert q.pop(prefer=prefer) == "cold_a"        # then FIFO
+        # a higher-priority cold item still beats a preferred one
+        q.push("hot", priority=0)
+        q.push("warm2", priority=1)
+        assert q.pop(prefer=lambda i: i == "warm2") == "hot"
+        # prefer composes with fits-deferral: the PREFERRED head gates
+        assert q.pop(fits=lambda i: i != "warm2",
+                     prefer=lambda i: i == "warm2") is None
+        assert q.pop() == "cold_b"
 
 
 class TestMetricsRegistry:
