@@ -18,10 +18,15 @@ test:           ## tier-1 test suite (CPU)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
 
-# bench-smoke: prefix-share hit rate + mixed-length bucketed run; the
-# bucketed leg FAILS on any prefill recompile after warmup
+# bench-smoke: prefix-share hit rate + mixed-length bucketed run + the
+# fused-vs-unfused comparison; the bucketed leg FAILS on any prefill
+# recompile after warmup, and the fused leg FAILS unless piggybacked
+# admission stalls decode strictly less than the standalone baseline
+# (both deterministic schedule/shape accounting, not timing)
 bench-smoke:    ## tiny serving benches (non-blocking CI job)
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --prefix-share \
 		--n-requests 6 --max-new 4
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --bucketed \
 		--n-requests 8 --max-new 4
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --fused \
+		--n-requests 8 --max-new 6

@@ -3,9 +3,10 @@ the ServingEngine on the CPU backend.
 
 Prints ONE JSON line (bench.py convention, landed alongside the
 BENCH_*.json records): generated tokens/s end-to-end through the full
-admission→batcher→channel path, plus TTFT and queue-wait percentiles —
-the serving-layer numbers the device-side decode benches in bench.py
-cannot see (queueing, scheduling, host fan-out overhead).
+admission→batcher→channel path, plus TTFT, queue-wait and inter-token
+latency percentiles — the serving-layer numbers the device-side decode
+benches in bench.py cannot see (queueing, scheduling, host fan-out
+overhead).
 
 Workloads:
   * `random` (default) — independent prompts of random lengths, the
@@ -18,13 +19,23 @@ Workloads:
     every prefill bucket AND chunk past the largest one, exercising the
     bucketed/chunked prefill path. Asserts ZERO prefill recompiles after
     warmup (the TTFT story: admission dispatches to pre-compiled
-    shapes), so a recompile regression fails the bench.
+    shapes), so a recompile regression fails the bench;
+  * `fused` (`--fused`) — the mixed admission-during-decode workload run
+    TWICE, fusion on then off: admissions land while other slots decode
+    (n_requests >> max_batch), so the unfused run pays a standalone
+    prefill stall per admission and the fused run piggybacks the same
+    chunk on the decode call. Asserts `decode_stall_steps` strictly
+    below the unfused baseline AND zero prefill recompiles after warmup
+    — both shape/schedule accounting, deterministic on CPU. The JSON
+    line carries `decode_stall_steps` / `fused_steps` / `itl_ms_p99`
+    for the fused run and the `*_unfused` baselines next to them.
 
-Warmup pre-compiles EVERY prefill bucket shape via `engine.warmup()`
-(AOT lowering — no device compute) plus one served request for the
-decode chunk fn; before it, the first timed request of each new prompt
-length ate a fresh XLA trace+compile and TTFT p99 measured the compiler,
-not the server.
+Warmup pre-compiles EVERY prefill shape via `engine.warmup()` (AOT
+lowering — no device compute): the standalone ladder AND, with fusion
+on, the fused decode+prefill variants; plus one served request for the
+decode chunk fn. Before it, the first timed request of each new prompt
+length ate a fresh XLA trace+compile and TTFT p99 measured the
+compiler, not the server.
 
 Deliberately a tiny model on CPU: this measures the HOST serving layer's
 overhead and scheduling behavior deterministically; device-side decode
@@ -48,13 +59,83 @@ def _make_prompts(rng, n_requests: int, workload: str,
         common = list(map(int, rng.randint(1, 200, prefix_len)))
         return [common + list(map(int, rng.randint(1, 200, suffix_len)))
                 for _ in range(n_requests)]
-    if workload == "mixed":
+    if workload in ("mixed", "fused"):
         # lengths spanning the whole ladder, incl. past the largest
         # bucket (chunked prefill) — every request a different length
         return [list(map(int, rng.randint(1, 200, int(L))))
                 for L in rng.randint(3, 41, n_requests)]
     return [list(map(int, rng.randint(1, 200, int(L))))
             for L in rng.randint(4, 16, n_requests)]
+
+
+def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
+           block_size: int, chunk: int, prefix_cache: bool,
+           max_prefill_bucket: int, fused_prefill: bool,
+           budgets=None) -> dict:
+    """One engine lifecycle over `prompts`: warmup (AOT ladder + one
+    served request), timed serve, drain. Returns the raw numbers the
+    workload-specific JSON assembly picks from."""
+    from paddle_tpu import serving
+
+    eng = serving.ServingEngine(
+        params, cfg, max_batch=max_batch, block_size=block_size,
+        max_total_len=64, max_new_tokens=max_new, chunk=chunk,
+        max_queue_depth=len(prompts), prefix_cache=prefix_cache,
+        max_prefill_bucket=max_prefill_bucket,
+        fused_prefill=fused_prefill, start=False)
+    # warmup: AOT-compile EVERY prefill shape (group ladder x bucket
+    # ladder x cold/cached, + the fused variants) before the loop
+    # starts, then serve one request to compile the decode chunk fn
+    # (for prefix-share it also PRIMES the cache — the steady-state
+    # view a shared system prompt actually serves under)
+    t_w = time.perf_counter()
+    warmed = eng.warmup()
+    eng.start()
+    eng.generate(prompts[0], timeout=600)
+    warmup_s = time.perf_counter() - t_w
+    completed0 = eng.metrics.counter("requests_completed").value
+    pc0 = eng.snapshot()["prefix_cache"]
+    compiles_warm = eng.batcher.prefill_compile_count
+    itl = eng.metrics.histogram("itl_s")
+    # the warmup request's gaps include the decode chunk fn's XLA
+    # compile — rank only samples observed inside the timed window
+    itl0 = itl.summary().get("count", 0)
+
+    t0 = time.perf_counter()
+    budgets = budgets or [None] * len(prompts)
+    reqs = [eng.submit(p, max_new_tokens=mn)
+            for p, mn in zip(prompts, budgets)]
+    if not eng.drain(timeout=600):
+        raise RuntimeError("drain timed out — benchmark invalid")
+    wall = time.perf_counter() - t0
+    eng.shutdown()
+
+    toks = sum(len(r.result()) for r in reqs)
+    b = eng.batcher
+    return {
+        "snap": eng.snapshot(),
+        "pc0": pc0,
+        "reqs": reqs,
+        "wall_s": wall,
+        "warmup_s": warmup_s,
+        "warmed": warmed,
+        "completed0": completed0,
+        "tok_s": toks / wall,
+        "recompiles": b.prefill_compile_count - compiles_warm,
+        "compile_count": b.prefill_compile_count,
+        "pad_tokens": b.prefill_pad_tokens,
+        "buckets": list(b.prefill_buckets),
+        "suffix_hist": {str(k): v
+                        for k, v in sorted(b.prefill_suffix_hist.items())},
+        "fused_steps": b.fused_steps,
+        "decode_stall_steps": b.decode_stall_steps,
+        "itl_ms_p50": _ms(itl.percentile(0.50, since=itl0)),
+        "itl_ms_p99": _ms(itl.percentile(0.99, since=itl0)),
+    }
+
+
+def _ms(v):
+    return None if v is None else round(v * 1000.0, 3)
 
 
 def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
@@ -64,80 +145,71 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
          max_prefill_bucket: int = 512) -> dict:
     import jax
     from paddle_tpu.nlp import llama
-    from paddle_tpu import serving
 
     cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=2)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     rng = np.random.RandomState(0)
     prompts = _make_prompts(rng, n_requests, workload,
                             prefix_len, suffix_len)
+    kw = dict(max_new=max_new, max_batch=max_batch,
+              block_size=block_size, chunk=chunk,
+              prefix_cache=prefix_cache,
+              max_prefill_bucket=max_prefill_bucket)
 
-    eng = serving.ServingEngine(
-        params, cfg, max_batch=max_batch, block_size=block_size,
-        max_total_len=64, max_new_tokens=max_new, chunk=chunk,
-        max_queue_depth=n_requests, prefix_cache=prefix_cache,
-        max_prefill_bucket=max_prefill_bucket, start=False)
-    # warmup: AOT-compile EVERY prefill bucket shape (group ladder x
-    # bucket ladder x cold/cached) before the loop starts, then serve
-    # one request to compile the decode chunk fn (for prefix-share it
-    # also PRIMES the cache — the steady-state view a shared system
-    # prompt actually serves under)
-    t_w = time.perf_counter()
-    warmed = eng.warmup()
-    eng.start()
-    eng.generate(prompts[0], timeout=600)
-    warmup_s = time.perf_counter() - t_w
-    completed0 = eng.metrics.counter("requests_completed").value
-    pc0 = eng.snapshot()["prefix_cache"]
-    compiles_warm = eng.batcher.prefill_compile_count
+    base = None
+    if workload == "fused":
+        # staggered per-request budgets so slots retire at DIFFERENT
+        # steps — equal budgets would march the whole batch in lockstep
+        # waves and no admission would ever land mid-decode
+        kw["budgets"] = [1 + (i % max_new) for i in range(len(prompts))]
+        # unfused first: the SAME prompts through the PR4 path give the
+        # decode_stall_steps / ITL baseline the fused run must beat
+        base = _serve(params, cfg, prompts, fused_prefill=False, **kw)
+    r = _serve(params, cfg, prompts, fused_prefill=True, **kw)
 
-    t0 = time.perf_counter()
-    reqs = [eng.submit(p) for p in prompts]
-    if not eng.drain(timeout=600):
-        raise RuntimeError("drain timed out — benchmark invalid")
-    wall = time.perf_counter() - t0
-    eng.shutdown()
-
-    toks = sum(len(r.result()) for r in reqs)
-    ttft = np.asarray([r.first_token_time - r.submit_time for r in reqs])
-    wait = np.asarray([r.admit_time - r.submit_time for r in reqs])
-    snap = eng.snapshot()
-    recompiles = eng.batcher.prefill_compile_count - compiles_warm
+    reqs, snap = r["reqs"], r["snap"]
+    ttft = np.asarray([q.first_token_time - q.submit_time for q in reqs])
+    wait = np.asarray([q.admit_time - q.submit_time for q in reqs])
     pct = lambda a, q: round(float(np.percentile(a, q)), 4)
     result = {
         "metric": "serving_offline_tok_s",
-        "value": round(toks / wall, 1),
+        "value": round(r["tok_s"], 1),
         "unit": "tokens/s",
         "workload": workload,
         "n_requests": n_requests,
         "max_batch": max_batch,
         "max_new_tokens": max_new,
-        "wall_s": round(wall, 3),
-        "warmup_s": round(warmup_s, 3),
+        "wall_s": round(r["wall_s"], 3),
+        "warmup_s": round(r["warmup_s"], 3),
         "ttft_s_p50": pct(ttft, 50),
         "ttft_s_p90": pct(ttft, 90),
         "ttft_s_p99": pct(ttft, 99),
         "queue_wait_s_p50": pct(wait, 50),
         "queue_wait_s_p90": pct(wait, 90),
         "queue_wait_s_p99": pct(wait, 99),
+        "itl_ms_p50": r["itl_ms_p50"],
+        "itl_ms_p99": r["itl_ms_p99"],
         "step_s_p50": snap["histograms"]["serving.step_s"].get("p50"),
         "per_token_s_p50": snap["histograms"]["per_token_s"].get("p50"),
         "requests_completed": snap["counters"]["requests_completed"]
-        - completed0,
+        - r["completed0"],
         "kv_high_water_blocks": snap["allocator"]["high_water_blocks"],
         "kv_reused_blocks": snap["allocator"]["reused_blocks"],
-        "prefill_buckets": list(eng.batcher.prefill_buckets),
-        "prefill_shapes_warmed": warmed,
-        "prefill_compile_count": eng.batcher.prefill_compile_count,
-        "prefill_recompiles_after_warmup": recompiles,
-        "prefill_pad_tokens": eng.batcher.prefill_pad_tokens,
+        "prefill_buckets": r["buckets"],
+        "prefill_shapes_warmed": r["warmed"],
+        "prefill_compile_count": r["compile_count"],
+        "prefill_recompiles_after_warmup": r["recompiles"],
+        "prefill_pad_tokens": r["pad_tokens"],
+        "prefill_suffix_hist": r["suffix_hist"],
+        "fused_steps": r["fused_steps"],
+        "decode_stall_steps": r["decode_stall_steps"],
     }
     pc = snap["prefix_cache"]
     if pc.get("enabled"):
         # deltas over the timed window (the warmup request primed the
         # cache but must not count as a hit)
-        lookups = pc["prompt_tokens"] - pc0["prompt_tokens"]
-        saved = pc["hit_tokens"] - pc0["hit_tokens"]
+        lookups = pc["prompt_tokens"] - r["pc0"]["prompt_tokens"]
+        saved = pc["hit_tokens"] - r["pc0"]["hit_tokens"]
         result.update({
             "prefix_cache_hit_rate": round(saved / lookups, 4)
             if lookups else 0.0,
@@ -145,12 +217,30 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
             "prefix_cache_evictions": pc["evicted_blocks"],
             "prefix_cache_cached_blocks": pc["cached_blocks"],
         })
-    if workload == "mixed" and recompiles:
+    if base is not None:
+        result.update({
+            "tok_s_unfused": round(base["tok_s"], 1),
+            "decode_stall_steps_unfused": base["decode_stall_steps"],
+            "itl_ms_p50_unfused": base["itl_ms_p50"],
+            "itl_ms_p99_unfused": base["itl_ms_p99"],
+        })
+        if base["decode_stall_steps"] == 0:
+            raise RuntimeError(
+                "unfused baseline recorded ZERO decode stalls — the "
+                "workload produced no admission-during-decode overlap "
+                "(raise n_requests vs max_batch, or lower chunk), so "
+                "the fused-vs-unfused comparison is vacuous")
+        if not (r["decode_stall_steps"] < base["decode_stall_steps"]):
+            raise RuntimeError(
+                f"fused run stalled decode {r['decode_stall_steps']} "
+                f"times vs {base['decode_stall_steps']} unfused — "
+                f"piggybacked admission is not overlapping prefill "
+                f"with in-flight decode")
+    if workload in ("mixed", "fused") and r["recompiles"]:
         raise RuntimeError(
-            f"bucketed workload recompiled {recompiles} prefill shapes "
-            f"after warmup — the bucket ladder no longer covers "
-            f"admission (warmed {warmed}, buckets "
-            f"{list(eng.batcher.prefill_buckets)})")
+            f"bucketed workload recompiled {r['recompiles']} prefill "
+            f"shapes after warmup — the bucket ladder no longer covers "
+            f"admission (warmed {r['warmed']}, buckets {r['buckets']})")
     return result
 
 
@@ -162,33 +252,45 @@ def _cli() -> dict:
     ap.add_argument("--bucketed", action="store_true",
                     help="mixed-length workload spanning every prefill "
                          "bucket; asserts zero recompiles after warmup")
+    ap.add_argument("--fused", action="store_true",
+                    help="admission-during-decode workload run fused "
+                         "AND unfused; asserts the fused run stalls "
+                         "decode less and never recompiles")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="serve with the prefix cache disabled")
     ap.add_argument("--n-requests", type=int, default=16)
     ap.add_argument("--max-new", type=int, default=8)
     ap.add_argument("--max-batch", type=int, default=4)
     ap.add_argument("--block-size", type=int, default=8)
-    ap.add_argument("--chunk", type=int, default=4)
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="decode chunk length (default 4; 2 for "
+                         "--fused so staggered budgets desync the "
+                         "batch and admissions land mid-decode)")
     ap.add_argument("--prefix-len", type=int, default=24,
                     help="shared prefix length for --prefix-share")
     ap.add_argument("--suffix-len", type=int, default=6,
                     help="per-request suffix length for --prefix-share")
     ap.add_argument("--max-prefill-bucket", type=int, default=None,
                     help="cap the prefill bucket ladder (default 512; "
-                         "16 for --bucketed so the workload chunks)")
+                         "16 for --bucketed/--fused so the workload "
+                         "chunks)")
     a = ap.parse_args()
-    if a.prefix_share and a.bucketed:
-        ap.error("--prefix-share and --bucketed are mutually exclusive")
+    if sum((a.prefix_share, a.bucketed, a.fused)) > 1:
+        ap.error("--prefix-share, --bucketed and --fused are mutually "
+                 "exclusive")
     workload = ("prefix-share" if a.prefix_share
-                else "mixed" if a.bucketed else "random")
+                else "mixed" if a.bucketed
+                else "fused" if a.fused else "random")
     bucket_cap = a.max_prefill_bucket
     if bucket_cap is None:
-        # the mixed workload should also exercise CHUNKED prefill, so
-        # cap the ladder below its longest prompts by default
-        bucket_cap = 16 if a.bucketed else 512
+        # the mixed/fused workloads should also exercise CHUNKED
+        # prefill, so cap the ladder below their longest prompts
+        bucket_cap = 16 if workload in ("mixed", "fused") else 512
+    chunk = (a.chunk if a.chunk is not None
+             else 2 if workload == "fused" else 4)
     return main(n_requests=a.n_requests, max_new=a.max_new,
                 max_batch=a.max_batch, block_size=a.block_size,
-                chunk=a.chunk, workload=workload,
+                chunk=chunk, workload=workload,
                 prefix_len=a.prefix_len, suffix_len=a.suffix_len,
                 prefix_cache=not a.no_prefix_cache,
                 max_prefill_bucket=bucket_cap)

@@ -29,7 +29,8 @@ from .trace import find_traced_functions
 # (relpath suffix, function-name regex) pairs that form the decode hot path
 HOT_PATHS: Tuple[Tuple[str, str], ...] = (
     ("nlp/paged.py",
-     r"^(step|run|_paged_gqa_attention|forward_paged)$"),
+     r"^(step|run|_step_fused|_prefill_pending|_run_standalone_unit"
+     r"|_paged_gqa_attention|forward_paged)$"),
     ("serving/engine.py", r"^(_loop|_dispatch|step)$"),
 )
 

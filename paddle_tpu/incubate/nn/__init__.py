@@ -16,6 +16,10 @@ from ...nn import initializer as I
 
 
 class FusedRMSNorm(Layer):
+    """RMS normalization layer over the last axis with a learned gain,
+    lowered through the fused `functional.fused_rms_norm` kernel (one
+    pass instead of separate mean/scale ops)."""
+
     def __init__(self, hidden_size, epsilon=1e-6, name=None):
         super().__init__()
         import paddle_tpu as paddle
@@ -28,6 +32,10 @@ class FusedRMSNorm(Layer):
 
 
 class FusedLayerNorm(Layer):
+    """LayerNorm with learned gain and bias computed by the fused
+    `functional.fused_layer_norm` kernel — numerically the standard
+    nn.LayerNorm, minus the intermediate materializations."""
+
     def __init__(self, hidden_size, epsilon=1e-5, name=None):
         super().__init__()
         self.weight = self.create_parameter(
@@ -42,6 +50,11 @@ class FusedLayerNorm(Layer):
 
 
 class FusedLinear(Layer):
+    """Linear layer whose matmul + bias-add run as one fused
+    `functional.fused_linear` call; `transpose_weight` stores the
+    weight pre-transposed for layouts that prefer it. `bias_attr=False`
+    drops the bias term entirely."""
+
     def __init__(self, in_features, out_features, weight_attr=None,
                  bias_attr=None, transpose_weight=False, name=None):
         super().__init__()
@@ -55,6 +68,11 @@ class FusedLinear(Layer):
 
 
 class FusedDropoutAdd(Layer):
+    """dropout(x) + y in one fused kernel — the transformer residual
+    pattern. `mode` follows paddle dropout semantics
+    ("upscale_in_train" rescales at train time, "downscale_in_infer"
+    rescales at inference)."""
+
     def __init__(self, p=0.5, mode="upscale_in_train", name=None):
         super().__init__()
         self._p = p
@@ -66,6 +84,10 @@ class FusedDropoutAdd(Layer):
 
 
 class FusedBiasDropoutResidualLayerNorm(Layer):
+    """The attention-output epilogue fused end to end:
+    layer_norm(dropout(x + linear_bias) + residual) with learned LN
+    scale/bias — one call instead of four kernels."""
+
     def __init__(self, embed_dim, dropout_rate=0.5, epsilon=1e-5,
                  name=None, **kw):
         super().__init__()
@@ -116,6 +138,10 @@ class FusedMultiHeadAttention(Layer):
 
 
 class FusedFeedForward(Layer):
+    """Transformer FFN block (linear → activation → linear) with the
+    residual dropout-add fused and pre-/post-LN selected by
+    `normalize_before` — mirrors paddle.incubate.nn.FusedFeedForward."""
+
     def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
                  epsilon=1e-5, activation="relu", act_dropout_rate=None,
                  normalize_before=False, name=None, **kw):
@@ -141,6 +167,10 @@ class FusedFeedForward(Layer):
 
 
 class FusedTransformerEncoderLayer(Layer):
+    """One encoder layer built from the fused attention and FFN blocks
+    above — drop-in for nn.TransformerEncoderLayer where the fused
+    epilogues matter."""
+
     def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
                  activation="relu", attn_dropout_rate=None,
                  act_dropout_rate=None, normalize_before=False, name=None,

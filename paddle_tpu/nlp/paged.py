@@ -507,6 +507,21 @@ class ContinuousBatcher:
     goes flat after `warmup_prefill()`); `prefill_pad_tokens` counts the
     padding overhead bucketing trades for that.
 
+    Prefill is FUSED with decode (`fused_prefill=True`): when an
+    admission lands while slots are decoding, one compiled call carries
+    `max_batch` decode rows PLUS up to one bucket-sized chunk of prefill
+    rows — the Ragged Paged Attention mixed-mode batch — so in-flight
+    decoding advances by its chunk in the same device program that
+    prefills the admission, instead of stalling while a standalone
+    prefill monopolizes the device. Prepared admissions wait in a
+    pending pipeline; `step()` decides each tick whether to piggyback
+    the next prefill unit on the decode chunk (fused), run it standalone
+    (nothing decoding — nothing to stall), or decode only. Chunked long
+    prompts stream ONE fused chunk per step. `fused_steps` counts
+    piggybacked calls, `decode_stall_steps` counts standalone prefill
+    calls that ran while slots were decoding (the unfused cost), and
+    fused shapes are memoized/AOT-warmed exactly like standalone ones.
+
     Usage:
         cb = ContinuousBatcher(params, cfg, max_batch=2, block_size=16,
                                max_total_len=256, max_new_tokens=16)
@@ -521,7 +536,8 @@ class ContinuousBatcher:
                  num_blocks: Optional[int] = None, chunk: int = 8,
                  prefix_cache: bool = False,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 max_prefill_bucket: int = 512):
+                 max_prefill_bucket: int = 512,
+                 fused_prefill: bool = True):
         self.params, self.cfg = params, cfg
         self.B, self.bs = max_batch, block_size
         self.max_total = max_total_len
@@ -554,6 +570,21 @@ class ContinuousBatcher:
         self._prefill_fns: Dict[bool, Any] = {}     # cold -> jitted fn
         self._prefill_cache: Dict[Tuple[int, int, bool], Any] = {}
         self.prefill_pad_tokens = 0
+        # fused prefill+decode: admissions landing mid-decode piggyback
+        # one prefill chunk on the decode chunk call instead of stalling
+        # every in-flight slot behind a standalone prefill
+        self._fused = bool(fused_prefill)
+        self._fused_fn = None
+        self._fused_cache: Dict[Tuple[int, int], Any] = {}
+        # prepared-but-not-fully-prefilled admissions: [record, chunks
+        # done] — the record's slot and blocks are reserved for the
+        # whole mid-stream prefill (free_slots counts them taken)
+        self._pending: List[List] = []
+        self.fused_steps = 0          # piggybacked prefill calls
+        self.decode_stall_steps = 0   # standalone prefills that stalled
+        # observed real chunk lengths (len -> count): the data a
+        # workload-specific bucket ladder is fitted from (bucket_tuner)
+        self.prefill_suffix_hist: Dict[int, int] = {}
         nb = num_blocks or (max_batch * self.M)
         if prefix_cache:
             # vLLM-style automatic prefix caching: a trie over full-block
@@ -684,10 +715,11 @@ class ContinuousBatcher:
 
     @property
     def prefill_compile_count(self) -> int:
-        """Distinct prefill shapes compiled so far — flat after warmup is
-        the whole point of bucketing (each (group, bucket, phase) combo
-        compiles exactly once for the batcher's lifetime)."""
-        return len(self._prefill_cache)
+        """Distinct prefill shapes compiled so far — standalone (group,
+        bucket, phase) AND fused (group, bucket) executables. Flat after
+        warmup is the whole point of bucketing: each shape compiles
+        exactly once for the batcher's lifetime."""
+        return len(self._prefill_cache) + len(self._fused_cache)
 
     def prefix_stats(self) -> Dict[str, Any]:
         """Prefix-cache counters for the serving metrics surface:
@@ -713,9 +745,16 @@ class ContinuousBatcher:
         self._delivered.pop(rid, None)
 
     def free_slots(self) -> int:
-        """Batch slots available to new admissions (queued-but-not-yet-
-        prefilled requests count as taken)."""
-        return self.active.count(False) - len(self.queue)
+        """Batch slots available to new admissions. Queued-but-not-yet-
+        prefilled requests count as taken, and so do slots reserved by
+        a prepared admission whose (possibly multi-chunk, mid-stream)
+        prefill has not committed yet — without the pending term a
+        fused admission landing during a chunked prefill could
+        oversubscribe max_batch. Never negative: callers may queue past
+        capacity directly via submit(), but a slot deficit still means
+        zero slots for anyone new."""
+        return max(0, self.active.count(False) - len(self.queue)
+                   - len(self._pending))
 
     def abort(self, rid: int) -> bool:
         """Cancel a request: drop it from the queue, or retire its slot
@@ -727,6 +766,17 @@ class ContinuousBatcher:
                 del self.queue[i]
                 self._delivered.pop(rid, None)
                 return True
+        for i, (rec, _done) in enumerate(self._pending):
+            if rec.rid == rid:
+                # prepared (possibly mid-stream chunked prefill): undo
+                # like a failed prefill — unlink index registrations and
+                # return the blocks; any KV already written there is
+                # dead content in freed blocks
+                self._rollback([rec])
+                del self._pending[i]
+                self._delivered.pop(rid, None)
+                self._requeue_poisoned(rec)
+                return True
         for slot in range(self.B):
             if self.active[slot] and self.slot_req[slot] == rid:
                 self._retire(slot)
@@ -735,6 +785,35 @@ class ContinuousBatcher:
                 self._delivered.pop(rid, None)
                 return True
         return False
+
+    def _requeue_poisoned(self, rec: "_Admission") -> None:
+        """Aborting the pending `rec` unlinked and freed `rec.inserted`
+        before anyone wrote their KV; a co-pending record whose matched
+        chain (or COW source) leans on those blocks would skip
+        prefilling a prefix NO ONE will ever compute — silent garbage
+        tokens. Roll back the pending tail from the first such record
+        and push the requests back onto the queue front (original
+        order), so the next drain re-prepares them against the real
+        index state. Requeueing the whole tail keeps admission order
+        and absorbs cascades (a rolled-back record's own insertions
+        poison later matches too). Safe to fully undo: only the head
+        record can be mid-stream, and the head was prepared before
+        `rec`, so every tail record's prefill has not started."""
+        poisoned = set(rec.inserted)
+        cut = None
+        for i, (sib, _done) in enumerate(self._pending):
+            refs = set(sib.matched)
+            if sib.cow_src is not None:
+                refs.add(sib.cow_src)
+            if refs & poisoned:
+                cut = i
+                break
+        if cut is None:
+            return
+        victims = [e[0] for e in self._pending[cut:]]
+        self._rollback(victims)
+        del self._pending[cut:]
+        self.queue[:0] = [(v.rid, v.toks, v.stop, v.mn) for v in victims]
 
     # -- internals --------------------------------------------------------
     def _upload_slot_state(self):
@@ -815,23 +894,30 @@ class ContinuousBatcher:
 
     def warmup_prefill(self, buckets: Optional[Sequence[int]] = None,
                        group_sizes: Optional[Sequence[int]] = None,
-                       modes: Sequence[bool] = (True, False)) -> int:
+                       modes: Sequence[bool] = (True, False),
+                       fused: Optional[bool] = None) -> int:
         """Pre-compile every prefill shape admission can hit — each
-        ladder bucket x each power-of-two group size x {cold, cached} —
-        via AOT lowering (no device compute). After this, steady-state
-        admission never compiles. Returns the number of newly compiled
-        shapes. No-op for a bucketing-disabled batcher (exact shapes are
-        unbounded; there is nothing finite to warm)."""
+        ladder bucket x each power-of-two group size x {cold, cached},
+        plus (with fusion on) the fused decode+prefill variant per
+        (group, bucket) — via AOT lowering (no device compute). After
+        this, steady-state admission never compiles. Returns the number
+        of newly compiled shapes. No-op for a bucketing-disabled batcher
+        (exact shapes are unbounded; there is nothing finite to warm)."""
         ladder = self._buckets if buckets is None else tuple(buckets)
         if group_sizes is None:
             # exactly the shapes _group_pad can ever produce
             group_sizes = {self._group_pad(g) for g in range(1, self.B + 1)}
-        n0 = len(self._prefill_cache)
+        n0 = self.prefill_compile_count
         for Pb in ladder:
             for G in sorted(set(group_sizes)):
                 for cold in modes:
                     self._prefill_exe(int(G), int(Pb), bool(cold))
-        return len(self._prefill_cache) - n0
+        warm_fused = self._fused if fused is None else fused
+        if warm_fused:
+            for Pb in ladder:
+                for G in sorted(set(group_sizes)):
+                    self._fused_exe(int(G), int(Pb))
+        return self.prefill_compile_count - n0
 
     def _prepare_admission(self, slot: int, rid: int, toks: List[int],
                            stop: int, max_new: Optional[int]) -> _Admission:
@@ -875,7 +961,8 @@ class ContinuousBatcher:
         # NOT applied here — a same-burst neighbor may have registered
         # the source block moments ago with its prefill still pending,
         # so the clone must wait until every earlier unit has written
-        # the pool (`_apply_cow` in `_admit_many`)
+        # the pool (`_apply_cow` in `_run_standalone_unit` /
+        # `_step_fused`)
         inserted: List[int] = []
         if self._pcache is not None:
             # register the prompt's FULL blocks right away so requests
@@ -907,18 +994,20 @@ class ContinuousBatcher:
             if pinned:
                 self.alloc.release(pinned)
 
-    def _prefill_call(self, items: Sequence[Tuple[_Admission, int, int]],
-                      Pb: int, cold: bool):
-        """Run ONE compiled prefill over a group of (record, start, end)
-        chunks: rows pad to the bucket, the group pads to its power-of-
-        two size, padding masks through `valid` (writes drop) and clamped
-        positions (gathers stay in range). Returns logits [Gp, Pb, V]."""
-        G = len(items)
-        Gp = self._group_pad(G)
+    def _pack_prefill_rows(self, items: Sequence[Tuple[_Admission, int,
+                                                       int]],
+                           Pb: int, Gp: int):
+        """Pack a unit's (record, start, end) chunks into the [Gp, Pb]
+        prefill-row arrays one compiled call consumes: rows pad to the
+        bucket, the group pads to its power-of-two size, padding masks
+        through `valid` (writes drop) and clamped positions (gathers
+        stay in range). Returns (rows, pos, valid, table, last_idx) and
+        accounts the pad overhead."""
         rows = np.zeros((Gp, Pb), np.int32)
         pos = np.zeros((Gp, Pb), np.int32)
         val = np.zeros((Gp, Pb), np.bool_)
         tab = np.zeros((Gp, self.M), np.int32)
+        li = np.zeros((Gp,), np.int32)
         real = 0
         maxpos = self.M * self.bs - 1
         for g, (rec, start, end) in enumerate(items):
@@ -928,14 +1017,23 @@ class ContinuousBatcher:
             pos[g] = np.minimum(np.arange(start, start + Pb), maxpos)
             val[g, :S] = True
             tab[g, :rec.need] = rec.matched + rec.fresh
+            li[g] = S - 1
         self.prefill_pad_tokens += Gp * Pb - real
+        return rows, pos, val, tab, li
+
+    def _prefill_call(self, items: Sequence[Tuple[_Admission, int, int]],
+                      Pb: int, cold: bool):
+        """Run ONE compiled standalone prefill over a unit's rows.
+        Returns (logits [Gp, Pb, V], last real index per row [Gp])."""
+        Gp = self._group_pad(len(items))
+        rows, pos, val, tab, li = self._pack_prefill_rows(items, Pb, Gp)
         exe = self._prefill_exe(Gp, Pb, cold)
         logits, k, v = exe(self.params, jnp.asarray(rows), self.cache.k,
                            self.cache.v, jnp.asarray(tab),
                            jnp.asarray(pos), jnp.asarray(val),
                            jnp.zeros((Gp,), jnp.int32))
         self.cache = self.cache._replace(k=k, v=v)
-        return logits
+        return logits, li
 
     def _units(self,
                recs: Sequence[_Admission]) -> List[List[_Admission]]:
@@ -994,6 +1092,13 @@ class ContinuousBatcher:
 
     def _commit(self, rec: _Admission, first: int) -> None:
         """Activate a successfully prefilled admission in its slot."""
+        for start, end, _b in rec.chunks:
+            # real (pre-padding) chunk lengths, the distribution a
+            # workload-specific ladder is fitted from (bucket_tuner).
+            # Recorded at commit, not prepare: rolled-back and aborted
+            # admissions must not feed phantom chunks to the fit.
+            self.prefill_suffix_hist[end - start] = \
+                self.prefill_suffix_hist.get(end - start, 0) + 1
         if rec.cow_src is not None:
             self.alloc.release([rec.cow_src])  # pinned only for the copy
         P = len(rec.toks)
@@ -1020,43 +1125,124 @@ class ContinuousBatcher:
                 or first == rec.stop or self.budget[rec.slot] <= 0):
             self._retire(rec.slot)
 
-    def _admit_many(self, recs: List[_Admission]) -> None:
-        """Prefill + activate a prepared burst: same-bucket single-chunk
-        records amortize one compiled call; longer suffixes stream
-        through sequential bucket-sized chunks (chunk i's KV is in the
-        pool before chunk i+1 attends through the table). One host sync
-        per unit reads every first token at once."""
-        pending = list(recs)
+    def _pop_unit(self):
+        """The next prefill execution unit off the pending pipeline, in
+        order (a later record may share blocks an earlier one
+        registered): ([pipeline entries], [(rec, start, end) rows],
+        bucket, cold, final). `final` is False for a non-last chunk of a
+        chunked record — the entry stays pending with its progress
+        bumped; True means every record in the unit commits when the
+        call lands."""
+        unit = self._units([e[0] for e in self._pending])[0]
+        if len(unit[0].chunks) > 1:
+            entry = self._pending[0]
+            rec, done = entry
+            start, end, bucket = rec.chunks[done]
+            return ([entry], [(rec, start, end)], bucket, start == 0,
+                    done == len(rec.chunks) - 1)
+        entries = self._pending[:len(unit)]
+        items = [(r, r.chunks[0][0], r.chunks[0][1]) for r in unit]
+        _, _, bucket = unit[0].chunks[0]
+        return entries, items, bucket, items[0][1] == 0, True
+
+    def _finish_unit(self, entries, firsts) -> None:
+        """Commit a unit whose FINAL chunk just computed: one readback
+        of every first token at once, then activate each record."""
+        firsts = np.asarray(firsts)
+        for entry, first in zip(entries, firsts):
+            self._commit(entry[0], int(first))
+            self._pending.remove(entry)
+
+    def _run_standalone_unit(self) -> None:
+        """Run ONE standalone prefill call for the head pending unit —
+        the PR4 path: nothing decodes while it runs, so it only ever
+        executes when the decode set is empty (nothing to stall) or
+        fusion is off (`decode_stall_steps` then counts the cost)."""
+        entries, items, bucket, cold, final = self._pop_unit()
+        self._apply_cow([e[0] for e in entries if e[1] == 0])
+        logits, li = self._prefill_call(items, bucket, cold)
+        if final:
+            # ragged last-token logits per row, ONE readback per unit
+            # (inside _finish_unit) — li came packed with the rows
+            g = len(items)
+            last = jnp.argmax(
+                logits[jnp.arange(g), jnp.asarray(li[:g])], axis=-1)
+            self._finish_unit(entries, last)
+        else:
+            entries[0][1] += 1
+
+    def _fail_pending(self) -> None:
+        """A failed prefill/fused call must not leak blocks: every
+        still-pending record rolls back (the slots were never activated,
+        so nothing else would ever free them). All-or-nothing on
+        purpose — later records may lean on the failed unit's registered
+        blocks, so partial survival would strand never-written KV."""
+        self._rollback([e[0] for e in self._pending])
+        self._pending.clear()
+
+    def _prefill_pending(self) -> None:
+        """Drain the pending pipeline with standalone prefill calls
+        (chunked records stream their remaining chunks back to back).
+        With fusion ON the drain stops the moment a commit activates a
+        decode slot — running the rest standalone would stall that
+        fresh decoder exactly the way fusion exists to avoid, so the
+        remaining units piggyback on the following fused steps instead.
+        With fusion off everything drains (the PR4 path) and each call
+        made while slots decode counts a stall. A failed call must not
+        leak blocks: every still-pending record rolls back — the slots
+        were never activated, so nothing else would ever free them."""
         try:
-            for unit in self._units(recs):
-                self._apply_cow(unit)
-                if len(unit) == 1 and len(unit[0].chunks) > 1:
-                    rec = unit[0]
-                    for start, end, bucket in rec.chunks:
-                        logits = self._prefill_call(
-                            [(rec, start, end)], bucket, cold=(start == 0))
-                    items = [(rec, rec.chunks[-1][0], rec.chunks[-1][1])]
-                else:
-                    items = [(r, r.chunks[0][0], r.chunks[0][1])
-                             for r in unit]
-                    _, _, bucket = unit[0].chunks[0]
-                    logits = self._prefill_call(
-                        items, bucket, cold=(items[0][1] == 0))
-                # ragged last-token logits per row, ONE readback per unit
-                li = np.asarray([end - start - 1
-                                 for _, start, end in items])
-                last = jnp.argmax(
-                    logits[jnp.asarray(np.arange(len(items))),
-                           jnp.asarray(li)], axis=-1)
-                firsts = np.asarray(last)
-                for rec, first in zip(unit, firsts):
-                    self._commit(rec, int(first))
-                    pending.remove(rec)
+            while self._pending:
+                if any(self.active):
+                    if self._fused:
+                        break          # the fused step takes it from here
+                    # every in-flight slot stalls behind this call — the
+                    # cost fusion exists to remove
+                    self.decode_stall_steps += 1
+                self._run_standalone_unit()
         except Exception:
-            # a failed prefill must not leak its blocks: the slots were
-            # never activated, so nothing else will ever free them
-            self._rollback(pending)
+            self._fail_pending()
             raise
+
+    def _step_fused(self):
+        """Piggyback the head pending prefill unit on this step's decode
+        chunk: ONE compiled call advances every active slot by its chunk
+        AND prefills up to one bucket-sized admission chunk. Returns the
+        decode chunk's tokens [B, chunk] (host copy)."""
+        try:
+            entries, items, bucket, _cold, final = self._pop_unit()
+            self._apply_cow([e[0] for e in entries if e[1] == 0])
+            Gp = self._group_pad(len(items))
+            rows, pos, val, tab, li = self._pack_prefill_rows(
+                items, bucket, Gp)
+            exe = self._fused_exe(Gp, bucket)
+            if self._dev_state is None:
+                self._dev_state = self._upload_slot_state()
+            active, budget, stop = self._dev_state
+            (k, v, lengths, tok, budget, active, toks, pfirst) = exe(
+                self.params, self.cache.k, self.cache.v,
+                self.cache.table, self.cache.lengths, self.cur_tok,
+                active, budget, stop, jnp.asarray(rows),
+                jnp.asarray(pos), jnp.asarray(val), jnp.asarray(tab),
+                jnp.asarray(li))
+            # one host sync serves BOTH the decode chunk's tokens and
+            # the prefill rows' first tokens — and, dispatch being
+            # async, surfaces any device-side failure HERE, before the
+            # batcher state commits below
+            toks, pfirst = jax.device_get((toks, pfirst))  # ptlint: disable=SYNC001 — single per-step sync, decode + prefill readbacks coalesced
+        except Exception:
+            # decode state untouched (the assignments below never ran)
+            self._fail_pending()
+            raise
+        self.cache = self.cache._replace(k=k, v=v, lengths=lengths)
+        self.cur_tok = tok
+        self._dev_state = (active, budget, stop)
+        self.fused_steps += 1
+        if final:
+            self._finish_unit(entries, pfirst)
+        else:
+            entries[0][1] += 1
+        return toks
 
     def _retire(self, slot: int) -> None:
         rid = self.slot_req[slot]
@@ -1089,8 +1275,14 @@ class ContinuousBatcher:
         self.stop[slot] = -1
         self._dev_state = None        # host slot state diverged from device
 
-    def _admit(self) -> None:
-        free = [s for s in range(self.B) if not self.active[s]]
+    def _drain_queue(self) -> None:
+        """Prepare queued requests into the pending-prefill pipeline
+        while a batch slot AND the KV blocks fit. Slots reserved by
+        still-pending admissions are NOT handed out again (a mid-stream
+        chunked prefill keeps its slot across steps)."""
+        reserved = {e[0].slot for e in self._pending}
+        free = [s for s in range(self.B)
+                if not self.active[s] and s not in reserved]
         recs: List[_Admission] = []
         try:
             while free and self.queue:
@@ -1104,7 +1296,8 @@ class ContinuousBatcher:
                 # check and the trie walk both see them.
                 need = self.blocks_needed(len(toks0), mn0, tokens=toks0)
                 if need > self.alloc.free_blocks:
-                    if not any(self.active) and not recs:
+                    if (not any(self.active) and not recs
+                            and not self._pending):
                         # nothing in flight will ever free blocks
                         raise RuntimeError(
                             f"request needs {need} blocks but the pool "
@@ -1117,34 +1310,68 @@ class ContinuousBatcher:
         except Exception:
             self._rollback(recs)
             raise
-        if recs:
-            self._admit_many(recs)
+        for rec in recs:
+            self._pending.append([rec, 0])
+
+    def _fuse_now(self) -> bool:
+        """This step's scheduling decision: piggyback the next pending
+        prefill unit on the decode chunk exactly when there IS pending
+        prefill work, slots are decoding (someone to stall), and fusion
+        is enabled. Everything else runs standalone."""
+        return bool(self._fused and self._pending and any(self.active))
+
+    def _admit(self) -> None:
+        """Pull queued requests into the pending pipeline, then prefill
+        standalone unless the next chunk will piggyback them: the decode
+        set is empty (nothing to stall) or fusion is off (the PR4 path,
+        stalls counted). Runs before AND after the device chunk so a
+        retire frees slots for the same step's queue."""
+        self._drain_queue()
+        if self._pending and not self._fuse_now():
+            self._prefill_pending()
+
+    def _emit_one(self, logits_row, tok, act, lengths, budget, stop):
+        """Greedy-emit one token per decode row and advance the row's
+        state — THE stopping rule, shared by the decode scan body and
+        the fused chunk's first token so the two cannot diverge (token
+        parity between them is by construction)."""
+        eos = -1 if self.eos is None else int(self.eos)
+        nxt = jnp.argmax(logits_row, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(act, nxt, tok)
+        lengths = lengths + act.astype(jnp.int32)
+        budget = budget - act.astype(jnp.int32)
+        # deactivate ON DEVICE the moment a slot's budget runs
+        # out or it emits eos / its own stop id — a fixed-size
+        # chunk must not keep writing past the slot's ALLOCATED
+        # blocks (the table row's padding points at block 0,
+        # i.e. someone else's cache)
+        act = act & (budget > 0) & (nxt != eos) & (nxt != stop)
+        return nxt, lengths, budget, act
+
+    def _decode_step_body(self, params, stop):
+        """The one traced single-token decode step, shared by the plain
+        decode chunk AND the fused chunk's post-first-token scan."""
+        cfg = self.cfg
+
+        def step(carry, _):
+            cache, tok, lengths, budget, act = carry
+            pos = lengths[:, None]
+            logits, cache = forward_paged(
+                params, tok[:, None], cache, pos, act[:, None],
+                cfg, is_prefill=False)
+            nxt, lengths, budget, act = self._emit_one(
+                logits[:, 0], tok, act, lengths, budget, stop)
+            # inactive slots must not drift: pin lengths ourselves
+            cache = cache._replace(lengths=lengths)
+            return (cache, nxt, lengths, budget, act), nxt
+
+        return step
 
     def _build_chunk(self):
-        cfg, chunk = self.cfg, self.chunk
-        eos = -1 if self.eos is None else int(self.eos)
+        chunk = self.chunk
 
         def run_chunk(params, cache, tok, active, lengths, budget, stop):
-            def step(carry, _):
-                cache, tok, lengths, budget, act = carry
-                pos = lengths[:, None]
-                logits, cache = forward_paged(
-                    params, tok[:, None], cache, pos, act[:, None],
-                    cfg, is_prefill=False)
-                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-                nxt = jnp.where(act, nxt, tok)
-                lengths = lengths + act.astype(jnp.int32)
-                budget = budget - act.astype(jnp.int32)
-                # deactivate ON DEVICE the moment a slot's budget runs
-                # out or it emits eos / its own stop id — a fixed-size
-                # chunk must not keep writing past the slot's ALLOCATED
-                # blocks (the table row's padding points at block 0,
-                # i.e. someone else's cache)
-                act = act & (budget > 0) & (nxt != eos) & (nxt != stop)
-                # inactive slots must not drift: pin lengths ourselves
-                cache = cache._replace(lengths=lengths)
-                return (cache, nxt, lengths, budget, act), nxt
-
+            step = self._decode_step_body(params, stop)
             (cache, tok, lengths, budget, act), toks = jax.lax.scan(
                 step, (cache, tok, lengths, budget, active), None,
                 length=chunk)
@@ -1154,8 +1381,88 @@ class ContinuousBatcher:
 
         return jax.jit(run_chunk)
 
+    def _build_fused(self):
+        """The fused prefill+decode chunk: ONE compiled call over a
+        mixed batch of `max_batch` decode rows plus `Pb` prefill-chunk
+        rows (the Ragged Paged Attention mixed-mode shape). The first
+        decode token and the whole prefill chunk compute in one
+        forward_paged pass — decode rows are [.., Pb]-padded with only
+        column 0 valid, prefill rows mask padding through valid /
+        clamped positions exactly like the standalone path. Every row
+        in the mixed batch takes the per-query-causal paged kernel,
+        COLD prefill rows included (standalone cold prefill uses the
+        flash path): the two compute the same softmax attention and
+        greedy-token parity with the unfused path is asserted in
+        tests/test_fused_step.py, but logits are not bit-for-bit.
+        The remaining chunk-1 decode tokens scan the shared decode
+        step body."""
+        cfg, chunk, B = self.cfg, self.chunk, self.B
+        maxpos = self.M * self.bs - 1
+
+        def run_fused(params, k, v, table, lengths, tok, active, budget,
+                      stop, prows, ppos, pval, ptab, plast):
+            Gp, Pb = prows.shape
+            # decode rows ride the prefill chunk's width: token in
+            # column 0 at the slot's current position, the rest padding
+            # (writes drop; per-query attention keeps columns
+            # independent, so column 0 is the P=1 decode computation)
+            dtok = jnp.zeros((B, Pb), jnp.int32).at[:, 0].set(tok)
+            dpos = jnp.minimum(
+                lengths[:, None] + jnp.arange(Pb)[None, :], maxpos)
+            dval = jnp.zeros((B, Pb), jnp.bool_).at[:, 0].set(active)
+            sub = PagedKVCache(
+                k, v, jnp.concatenate([table, ptab], 0),
+                jnp.zeros((B + Gp,), jnp.int32))
+            logits, sub = forward_paged(
+                params, jnp.concatenate([dtok, prows], 0), sub,
+                jnp.concatenate([dpos, ppos], 0),
+                jnp.concatenate([dval, pval], 0), cfg, is_prefill=False)
+            # ragged last-token logits per prefill row → first tokens
+            pfirst = jnp.argmax(logits[B:][jnp.arange(Gp), plast],
+                                axis=-1).astype(jnp.int32)
+            nxt, lengths, budget, active = self._emit_one(
+                logits[:B, 0], tok, active, lengths, budget, stop)
+            cache = PagedKVCache(sub.k, sub.v, table, lengths)
+            step = self._decode_step_body(params, stop)
+            (cache, tok, lengths, budget, active), toks = jax.lax.scan(
+                step, (cache, nxt, lengths, budget, active), None,
+                length=chunk - 1)
+            toks = jnp.concatenate([nxt[None], toks], 0)
+            return (cache.k, cache.v, lengths, tok, budget, active,
+                    toks.T, pfirst)                       # toks [B, chunk]
+
+        return jax.jit(run_fused)
+
+    def _fused_exe(self, Gp: int, Pb: int):
+        """Memoized COMPILED fused chunk per (group, bucket) shape,
+        AOT-lowered from abstract avals like `_prefill_exe` — warmup
+        covers the whole fused ladder so steady-state piggybacked
+        admission never retraces."""
+        key = (Gp, Pb)
+        exe = self._fused_cache.get(key)
+        if exe is None:
+            if self._fused_fn is None:
+                self._fused_fn = self._build_fused()
+            sds, i32 = jax.ShapeDtypeStruct, jnp.int32
+            pstruct = jax.tree_util.tree_map(
+                lambda x: sds(jnp.shape(x), x.dtype), self.params)
+            B = self.B
+            exe = self._fused_fn.lower(
+                pstruct,
+                sds(self.cache.k.shape, self.cache.k.dtype),
+                sds(self.cache.v.shape, self.cache.v.dtype),
+                sds((B, self.M), i32), sds((B,), i32), sds((B,), i32),
+                sds((B,), jnp.bool_), sds((B,), i32), sds((B,), i32),
+                sds((Gp, Pb), i32), sds((Gp, Pb), i32),
+                sds((Gp, Pb), jnp.bool_), sds((Gp, self.M), i32),
+                sds((Gp,), i32)).compile()
+            self._fused_cache[key] = exe
+        return exe
+
     def step(self):
-        """Admit what fits, then run ONE decode chunk.
+        """Admit what fits, then run ONE device chunk — fused with up to
+        one admission-prefill unit when slots are decoding, plain decode
+        otherwise.
 
         The serving layer's granularity: returns (emitted, finished) —
         `emitted` maps rid -> tokens newly generated since the last
@@ -1166,23 +1473,28 @@ class ContinuousBatcher:
             self._chunk_fn = self._build_chunk()
         self._admit()
         if any(self.active):
-            if self._dev_state is None:
-                self._dev_state = self._upload_slot_state()
-            active, budget, stop = self._dev_state
-            (self.cache, self.cur_tok, lengths, budget, active,
-             toks) = self._chunk_fn(
-                self.params, self.cache, self.cur_tok, active,
-                self.cache.lengths, budget, stop)
-            self.cache = self.cache._replace(lengths=lengths)
-            # steady state: the chunk's own outputs are next chunk's
-            # inputs; _retire/_admit_one null this when the host diverges
-            self._dev_state = (active, budget, stop)
-            # one host sync per decode chunk — the per-token loop below
-            # reads this numpy copy, never the device
-            toks = np.asarray(toks)  # ptlint: disable=SYNC001 — single per-chunk sync, hoisted out of the per-token loop
-            for slot in range(self.B):
-                if not self.active[slot]:
-                    continue
+            # slots committed by a fused admission AFTER the device call
+            # must not read this chunk's token rows — they were inactive
+            # (masked) rows during the call itself
+            decoding = [s for s in range(self.B) if self.active[s]]
+            if self._fuse_now():
+                toks = self._step_fused()
+            else:
+                if self._dev_state is None:
+                    self._dev_state = self._upload_slot_state()
+                active, budget, stop = self._dev_state
+                (self.cache, self.cur_tok, lengths, budget, active,
+                 toks) = self._chunk_fn(
+                    self.params, self.cache, self.cur_tok, active,
+                    self.cache.lengths, budget, stop)
+                self.cache = self.cache._replace(lengths=lengths)
+                # steady state: the chunk's own outputs are next chunk's
+                # inputs; _retire/_commit null this when the host diverges
+                self._dev_state = (active, budget, stop)
+                # one host sync per decode chunk — the per-token loop
+                # below reads this numpy copy, never the device
+                toks = np.asarray(toks)  # ptlint: disable=SYNC001 — single per-chunk sync, hoisted out of the per-token loop
+            for slot in decoding:
                 rid = self.slot_req[slot]
                 for j in range(self.chunk):
                     if self.budget[slot] <= 0:
@@ -1217,7 +1529,7 @@ class ContinuousBatcher:
         """Drain the queue and all in-flight requests (greedy decode)."""
         while True:
             self.step()
-            if not (any(self.active) or self.queue):
+            if not (any(self.active) or self.queue or self._pending):
                 break
         return self.outputs
 

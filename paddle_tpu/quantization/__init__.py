@@ -38,6 +38,11 @@ def quant_dequant(x, scale, bit_length=8):
 
 
 class BaseObserver:
+    """Base class for calibration observers: watch tensors flowing
+    through `__call__` (identity pass-through), accumulate statistics
+    in `observe`, and expose quantization `scales()` once calibrated.
+    Subclasses implement `observe`."""
+
     def __init__(self, quant_bits=8):
         self.quant_bits = quant_bits
         self._scale: Optional[np.ndarray] = None
@@ -62,6 +67,9 @@ class AbsmaxObserver(BaseObserver):
 
 
 class MinMaxObserver(BaseObserver):
+    """Running min/max observer: the scale covers the widest value range
+    seen during calibration (symmetric, max(|min|, |max|))."""
+
     def __init__(self, quant_bits=8):
         super().__init__(quant_bits)
         self._min = None
@@ -167,6 +175,12 @@ def _qdq_weight(w, quanter, scale_shape=None):
 
 
 class QuantedLinear(nn.Layer):
+    """Linear layer wrapped for quantization-aware execution: the
+    activation quanter fake-quantizes the input, the weight quanter
+    fake-quantizes the weight per output channel, then the ORIGINAL
+    layer's bias/semantics apply — produced by QAT/PTQ conversion, not
+    constructed directly."""
+
     def __init__(self, layer: nn.Linear, act_q, w_q):
         super().__init__()
         self.inner = layer
@@ -181,6 +195,10 @@ class QuantedLinear(nn.Layer):
 
 
 class QuantedConv2D(nn.Layer):
+    """Conv2D twin of QuantedLinear: fake-quantized activations and
+    per-output-channel fake-quantized weights around the wrapped
+    layer's convolution."""
+
     def __init__(self, layer: nn.Conv2D, act_q, w_q):
         super().__init__()
         self.inner = layer

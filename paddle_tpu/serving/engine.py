@@ -71,6 +71,7 @@ class ServingEngine:
                  start: bool = True, idle_poll_s: float = 0.05,
                  prefix_cache: bool = True,
                  prefill_buckets=None, max_prefill_bucket: int = 512,
+                 fused_prefill: bool = True,
                  warmup: bool = False,
                  clock=time.monotonic):
         # lazy: keep `import paddle_tpu` from pulling the whole nlp tree
@@ -80,7 +81,8 @@ class ServingEngine:
             max_total_len=max_total_len, max_new_tokens=max_new_tokens,
             eos_token_id=eos_token_id, num_blocks=num_blocks, chunk=chunk,
             prefix_cache=prefix_cache, prefill_buckets=prefill_buckets,
-            max_prefill_bucket=max_prefill_bucket)
+            max_prefill_bucket=max_prefill_bucket,
+            fused_prefill=fused_prefill)
         self.metrics = metrics or MetricsRegistry()
         self._clock = clock
         self._idle_poll_s = idle_poll_s
@@ -113,6 +115,12 @@ class ServingEngine:
         self._h_ttft = m.histogram("ttft_s")
         self._h_wait = m.histogram("queue_wait_s")
         self._h_token = m.histogram("per_token_s")
+        # inter-token latency per request: the gap between consecutive
+        # step dispatches that delivered this request tokens — its p99
+        # is where admission-during-decode stalls show up (and what the
+        # fused prefill+decode step exists to flatten)
+        self._h_itl = m.histogram("itl_s")
+        self._last_emit: Dict[int, float] = {}    # rid -> last dispatch
         # prefix-cache surface (flat-line zeros when the cache is off)
         self._g_pc_hit_tokens = m.gauge("prefix_cache_hit_tokens")
         self._g_pc_hit_rate = m.gauge("prefix_cache_hit_rate")
@@ -122,6 +130,11 @@ class ServingEngine:
         # the TTFT story; pad tokens is the overhead bucketing costs
         self._g_prefill_compiles = m.gauge("prefill_compile_count")
         self._g_prefill_pad = m.gauge("prefill_pad_tokens")
+        # fused prefill+decode surface: fused_steps counts piggybacked
+        # admission chunks, decode_stall_steps counts standalone
+        # prefills that ran while slots were decoding (the ITL cost)
+        self._g_fused_steps = m.gauge("fused_steps")
+        self._g_decode_stalls = m.gauge("decode_stall_steps")
 
         if warmup:
             self.warmup()
@@ -374,18 +387,18 @@ class ServingEngine:
         return req.deadline is not None and now > req.deadline
 
     def _admit_locked(self) -> None:
-        free_slots = self.batcher.free_slots()
-        free_blocks = self.batcher.alloc.free_blocks
         b = self.batcher
-        needed = {}          # id(req) -> blocks, computed once per pop
+        free_slots = b.free_slots()
+        if free_slots <= 0:
+            return
         # cache-aware ordering: at EQUAL effective priority, prefer the
         # request whose prefix is cached right now — serving it before
         # eviction recycles those blocks converts reclaimable KV into
         # skipped prefill (pure trie walk, no refcount moves). Memoized
-        # per admission round: pop() evaluates prefer on EVERY queued
-        # item, and one walk per request is enough — the slight
-        # staleness across this round's pops is harmless (same tolerance
-        # as `needed` below).
+        # per admission round: pop_many() evaluates prefer on EVERY
+        # queued item, and one walk per request is enough — the slight
+        # staleness across this round is harmless (same tolerance as
+        # the block budget below).
         prefer = None
         if b.prefix_stats().get("enabled") is True:
             warm = {}        # id(req) -> bool, one trie walk per request
@@ -394,27 +407,38 @@ class ServingEngine:
                 if id(r) not in warm:
                     warm[id(r)] = b.prefix_cached_tokens(r.prompt) > 0
                 return warm[id(r)]
-        while free_slots > 0:
-            def fits(r):   # max_new_tokens was resolved by submit()
-                # cached-aware: a prompt whose prefix is already pinned
-                # by an in-flight request needs fewer blocks of its own.
-                # The prefix-trie walk is memoized so the decrement
-                # below reuses it instead of walking again.
-                needed[id(r)] = n = b.blocks_needed(
-                    len(r.prompt), r.max_new_tokens, tokens=r.prompt)
-                return n <= free_blocks
-            req = self.queue.pop(fits=fits, prefer=prefer)
-            if req is None:
-                break                     # empty, or defer-on-no-blocks
-            now = self._clock()
+        budget = {"blocks": b.alloc.free_blocks}
+
+        def fits(r):   # max_new_tokens was resolved by submit()
+            # cached-aware: a prompt whose prefix is already pinned by
+            # an in-flight request needs fewer blocks of its own.
+            # pop_many calls fits once per ACCEPTED item, so the block
+            # budget is debited right here.
+            n = b.blocks_needed(len(r.prompt), r.max_new_tokens,
+                                tokens=r.prompt)
+            if n > budget["blocks"]:
+                return False
+            budget["blocks"] -= n
+            return True
+
+        # one lock acquisition and one consistent priority view for the
+        # whole admission round; the burst lands in the batcher's queue
+        # together, so same-bucket requests prefill in one compiled
+        # call. A request cancelled in the microseconds since
+        # _reap_queued_locked still consumes its slot + block budget
+        # for THIS round (reaped below instead of admitted) — the next
+        # loop tick re-admits at full budget, a deliberate trade for
+        # the single-round queue view.
+        now = self._clock()
+        for req in self.queue.pop_many(free_slots, fits=fits,
+                                       prefer=prefer):
             if req.cancel_requested or self._expired(req, now):
                 state = (RequestState.CANCELLED if req.cancel_requested
                          else RequestState.TIMED_OUT)
                 self._finish_locked(req, state, "reaped_at_admission")
                 continue
-            mn = req.max_new_tokens
             rid = b.submit(req.prompt, stop_token_id=req.stop_token_id,
-                           max_new_tokens=mn)
+                           max_new_tokens=req.max_new_tokens)
             req.request_id = rid
             req.state = RequestState.PREFILL
             req.admit_time = now
@@ -423,8 +447,6 @@ class ServingEngine:
             self._h_wait.observe(now - req.submit_time)
             self._c_admitted.inc()
             self._running[rid] = req
-            free_slots -= 1
-            free_blocks -= needed.pop(id(req))
 
     def _dispatch(self, emitted: Dict[int, List[int]],
                   finished: List[int],
@@ -437,6 +459,10 @@ class ServingEngine:
             req = self._running.get(rid)
             if req is None:
                 continue                  # aborted in between
+            last = self._last_emit.get(rid)
+            if last is not None:
+                self._h_itl.observe(now - last)
+            self._last_emit[rid] = now
             try:
                 for t in toks:
                     if req.first_token_time is None:
@@ -485,6 +511,7 @@ class ServingEngine:
         }[state]
         if not req.done:
             counter.inc()
+        self._last_emit.pop(req.request_id, None)
         req._finish(state, reason, error=error, now=self._clock())
         self._work.notify_all()
 
@@ -509,6 +536,8 @@ class ServingEngine:
         self._g_util.set(stats["blocks_in_use"] / stats["capacity_blocks"])
         self._g_prefill_compiles.set(self.batcher.prefill_compile_count)
         self._g_prefill_pad.set(self.batcher.prefill_pad_tokens)
+        self._g_fused_steps.set(self.batcher.fused_steps)
+        self._g_decode_stalls.set(self.batcher.decode_stall_steps)
         if pc.get("enabled"):
             self._g_pc_hit_tokens.set(pc["hit_tokens"])
             self._g_pc_hit_rate.set(pc["hit_rate"])

@@ -106,6 +106,31 @@ class Histogram:
                   max(0, int(round(q * (len(sorted_vals) - 1)))))
         return sorted_vals[idx]
 
+    def percentile(self, q: float, since: int = 0) -> Optional[float]:
+        """Nearest-rank percentile (q in [0, 1]) over the recent
+        observation window, or None before the first observation —
+        benchmark emitters read arbitrary quantiles (itl_ms_p99 & co)
+        without re-implementing the windowing. `since` drops the first
+        `since` lifetime observations (as counted by summary()["count"])
+        from the window first, so a bench can rank only the samples
+        recorded inside its timed region (e.g. skip the warmup request's
+        compile-tainted inter-token gaps); observations that already
+        fell off the ring are skipped implicitly."""
+        with self._lock:
+            if not self._ring:
+                return None
+            vals = self._ring
+            if since > 0:
+                if self._count <= self._cap:
+                    ordered = vals
+                else:
+                    start = self._count % self._cap
+                    ordered = vals[start:] + vals[:start]
+                vals = ordered[max(0, since - (self._count - len(ordered))):]
+                if not vals:
+                    return None
+            return self._percentile(sorted(vals), q)
+
     def summary(self) -> Dict[str, float]:
         with self._lock:
             if not self._count:
@@ -119,6 +144,7 @@ class Histogram:
                 "max": self._max,
                 "p50": self._percentile(vals, 0.50),
                 "p90": self._percentile(vals, 0.90),
+                "p95": self._percentile(vals, 0.95),
                 "p99": self._percentile(vals, 0.99),
             }
 

@@ -91,16 +91,33 @@ class AdmissionQueue:
         priority level (the engine passes cached-prefix preference, so
         reclaimable KV is reused before eviction recycles it); FIFO
         still breaks remaining ties. Returns None when empty."""
+        got = self.pop_many(1, fits=fits, prefer=prefer)
+        return got[0] if got else None
+
+    def pop_many(self, k: int,
+                 fits: Optional[Callable[[object], bool]] = None,
+                 prefer: Optional[Callable[[object], bool]] = None
+                 ) -> List[object]:
+        """Pop up to `k` best items under ONE lock acquisition and one
+        consistent clock reading — the engine's admission round takes a
+        whole burst at once instead of re-locking per request (the burst
+        then prefills in one compiled call batcher-side). Same
+        semantics as `pop` applied repeatedly: head-of-line deferral
+        (the best REMAINING item failing `fits` stops the round),
+        `prefer` tie-breaks within an effective-priority level. `fits`
+        is called once per accepted item, so callers may account
+        resources (KV blocks) inside it."""
+        out: List[object] = []
         with self._lock:
-            if not self._items:
-                return None
             now = self._clock()
-            best = min(self._items,
-                       key=lambda e: self._key(e, now, prefer))
-            if fits is not None and not fits(best.item):
-                return None
-            self._items.remove(best)
-            return best.item
+            while len(out) < k and self._items:
+                best = min(self._items,
+                           key=lambda e: self._key(e, now, prefer))
+                if fits is not None and not fits(best.item):
+                    break
+                self._items.remove(best)
+                out.append(best.item)
+        return out
 
     def peek(self):
         """The item pop() would consider next (no removal)."""

@@ -1,0 +1,323 @@
+"""Fused prefill+decode steps (PR 5): admission chunks piggyback on the
+decode chunk call instead of stalling in-flight slots.
+
+Three layers:
+
+  * scheduling — step() fuses exactly when pending prefill work and
+    active decode coexist (fused_steps vs decode_stall_steps), the
+    fusion-off flag restores the PR4 standalone path, and a mid-stream
+    chunked prefill keeps its slot reserved (free_slots / max_batch
+    oversubscription regression);
+  * token parity — fused schedules are token-identical to the unfused
+    path on mixed admission-during-decode workloads, incl. prefix-cache
+    COW admissions and chunked long prompts streaming one fused chunk
+    per step;
+  * accounting — fused shapes are AOT-warmed with the ladder (zero
+    compiles after warmup), and failure/abort paths return every
+    pending block.
+"""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+import jax
+
+from paddle_tpu.nlp import llama, paged
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "bucket_tuner", os.path.join(_REPO, "tools", "bucket_tuner.py"))
+bucket_tuner = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bucket_tuner)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _batcher(params, cfg, max_new=8, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_total_len", 32)
+    kw.setdefault("chunk", 3)
+    return paged.ContinuousBatcher(params, cfg, max_new_tokens=max_new,
+                                   **kw)
+
+
+def _prompts(seed, lengths):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(1, 200, n))) for n in lengths]
+
+
+def _mid_decode_schedule(cb, first, rest):
+    """Admit `first`, step until it decodes, then land `rest` one step
+    apart — every later admission arrives while a slot is decoding."""
+    rids = [cb.submit(first)]
+    cb.step()
+    for p in rest:
+        rids.append(cb.submit(p))
+        cb.step()
+    out = cb.run()
+    return [out[r] for r in rids]
+
+
+class TestFusedScheduling:
+    def test_fuses_only_mid_decode(self, setup):
+        """Admissions landing while slots decode piggyback (fused_steps)
+        and never stall; the same schedule with fusion off pays one
+        standalone stall per admission burst."""
+        cfg, params = setup
+        a, b, c = _prompts(71, (5, 7, 6))
+        for fused in (True, False):
+            cb = _batcher(params, cfg, max_batch=3,
+                          prefill_buckets=(8,), fused_prefill=fused)
+            _mid_decode_schedule(cb, a, [b, c])
+            if fused:
+                assert cb.fused_steps >= 2       # b and c piggybacked
+                assert cb.decode_stall_steps == 0
+            else:
+                assert cb.fused_steps == 0       # escape hatch: PR4 path
+                assert cb.decode_stall_steps >= 2
+            assert cb.alloc.stats()["blocks_in_use"] == 0
+
+    def test_standalone_prefill_when_decode_idle(self, setup):
+        """An admission with NOTHING decoding runs standalone (no one to
+        stall) — neither a fused step nor a stall."""
+        cfg, params = setup
+        cb = _batcher(params, cfg, fused_prefill=True)
+        cb.submit(_prompts(72, (6,))[0])
+        cb.run()
+        assert cb.fused_steps == 0
+        assert cb.decode_stall_steps == 0
+
+    def test_chunked_prefill_reserves_slot_across_steps(self, setup):
+        """Oversubscription regression: a long prompt streaming one
+        fused chunk per step holds its slot the whole time — free_slots
+        counts it taken, admissions never exceed max_batch, and the
+        batcher refuses to hand the reserved slot to later traffic."""
+        cfg, params = setup
+        long_p = _prompts(73, (22,))[0]      # 6 chunks on a (4,) ladder
+        a, d = _prompts(74, (6, 7))
+        cb = _batcher(params, cfg, max_batch=2, prefill_buckets=(4,),
+                      fused_prefill=True)
+        ra = cb.submit(a)
+        cb.step()                            # a decoding in slot 0
+        rl = cb.submit(long_p)               # multi-chunk, mid-decode
+        rd = cb.submit(d)                    # must WAIT for a slot
+        cb.step()                            # long prefill now mid-stream
+        # slot 0 decoding + slot 1 reserved by the pending prefill + d
+        # queued: nothing left for new admissions
+        assert cb._pending and cb.free_slots() == 0
+        seen_active = []
+        while cb._pending or cb.queue:
+            cb.step()
+            seen_active.append(cb.active.count(True))
+            assert cb.active.count(True) <= 2
+        out = cb.run()
+        assert max(seen_active) <= 2
+        # everyone completed despite the contention
+        assert all(len(out[r]) == 8 for r in (ra, rl, rd))
+        assert cb.alloc.stats()["blocks_in_use"] == 0
+
+    def test_abort_pending_midstream_prefill_frees_blocks(self, setup):
+        """Aborting a request whose chunked prefill is mid-stream (some
+        chunks written, not committed) rolls back its blocks and index
+        registrations — nothing else would ever free them."""
+        cfg, params = setup
+        cb = _batcher(params, cfg, max_batch=2, prefill_buckets=(4,),
+                      prefix_cache=True, fused_prefill=True)
+        ra = cb.submit(_prompts(75, (6,))[0])
+        cb.step()
+        rl = cb.submit(_prompts(76, (20,))[0])
+        cb.step()                            # first fused chunk ran
+        assert cb._pending and cb._pending[0][1] >= 1   # mid-stream
+        assert cb.abort(rl) is True
+        assert not cb._pending
+        cb.run()
+        assert cb.alloc.stats()["blocks_in_use"] == 0
+        assert ra in cb.outputs and len(cb.outputs[ra]) == 8
+
+    def test_abort_pending_requeues_poisoned_prefix_siblings(self, setup):
+        """Aborting a PENDING admission must not strand a co-pending
+        sibling that matched the abortee's registered prompt blocks in
+        the prefix index: those blocks' KV will now never be written, so
+        the sibling is rolled back and re-prepared from the queue — and
+        still produces the exact tokens of a clean run (regression:
+        silent garbage from a never-computed 'cached' prefix)."""
+        cfg, params = setup
+        w = _prompts(79, (5,))[0]
+        long_p = _prompts(80, (20,))[0]      # multi-chunk pipeline head
+        shared = _prompts(81, (8,))[0]       # 2 full blocks on bs=4
+        pa, pb = shared + [3, 5], shared + [7, 11, 13]
+
+        clean = _batcher(params, cfg, max_batch=4, prefill_buckets=(4,),
+                         prefix_cache=True, fused_prefill=True)
+        rb = clean.submit(pb)
+        expect = clean.run()[rb]
+
+        cb = _batcher(params, cfg, max_batch=4, prefill_buckets=(4,),
+                      prefix_cache=True, fused_prefill=True)
+        cb.submit(w)
+        cb.step()                            # w decoding in slot 0
+        cb.submit(long_p)                    # holds the pending head
+        ra, rb = cb.submit(pa), cb.submit(pb)
+        cb.step()                            # long_p mid-stream; a + b
+        pending = {r.rid for r, _ in cb._pending}
+        assert ra in pending and rb in pending
+        assert cb.abort(ra) is True          # b's matched chain poisoned
+        out = cb.run()
+        assert out[rb] == expect             # token-identical to clean
+        assert cb.alloc.stats()["blocks_in_use"] == 0
+
+    def test_failed_fused_call_rolls_back_pending(self, setup,
+                                                  monkeypatch):
+        """A fused-call failure returns every pending record's blocks
+        (the slots were never activated) — the engine's step boundary
+        relies on it, exactly like the standalone path."""
+        cfg, params = setup
+        cb = _batcher(params, cfg, prefill_buckets=(8,),
+                      fused_prefill=True)
+        cb.submit(_prompts(77, (5,))[0])
+        cb.step()                            # healthy admission decodes
+        in_use = cb.alloc.stats()["blocks_in_use"]
+        monkeypatch.setattr(
+            cb, "_fused_exe",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+        cb.submit(_prompts(78, (6,))[0])
+        with pytest.raises(RuntimeError, match="boom"):
+            cb.step()
+        # pending rolled back; the in-flight request's blocks untouched
+        assert not cb._pending
+        assert cb.alloc.stats()["blocks_in_use"] == in_use
+
+
+class TestFusedParity:
+    """Acceptance: fused steps produce bit-identical tokens to the
+    unfused PR4 path on mixed admission-during-decode schedules."""
+
+    def _both(self, params, cfg, schedule, **kw):
+        outs = []
+        for fused in (False, True):
+            cb = _batcher(params, cfg, fused_prefill=fused, **kw)
+            outs.append(schedule(cb))
+            assert cb.alloc.stats()["blocks_in_use"] == 0
+        assert cb.fused_steps > 0            # the fused run really fused
+        return outs
+
+    def test_mid_decode_admissions_match_unfused(self, setup):
+        cfg, params = setup
+        a, b, c, d = _prompts(81, (5, 9, 13, 3))
+        base, fused = self._both(
+            params, cfg,
+            lambda cb: _mid_decode_schedule(cb, a, [b, c, d]),
+            max_batch=2)
+        assert fused == base
+
+    def test_chunked_long_prompt_mid_decode_matches(self, setup):
+        """A prompt past the largest bucket streams one FUSED chunk per
+        step while the neighbor keeps decoding — token-identical to the
+        stall-the-world unfused chunking."""
+        cfg, params = setup
+        a, long_p = _prompts(82, (6, 21))
+        base, fused = self._both(
+            params, cfg,
+            lambda cb: _mid_decode_schedule(cb, a, [long_p]),
+            max_batch=2, prefill_buckets=(4,))
+        assert fused == base
+
+    def test_cow_prefix_admission_mid_decode_matches(self, setup):
+        """Prefix-cache interplay: a full-hit COW admission and a
+        cached-prefix + long-suffix admission both land mid-decode and
+        fuse; outputs match the unfused path token for token."""
+        cfg, params = setup
+        rng = np.random.RandomState(83)
+        head = list(map(int, rng.randint(1, 200, 8)))    # 2 full blocks
+        tail = list(map(int, rng.randint(1, 200, 10)))
+        filler = list(map(int, rng.randint(1, 200, 5)))
+
+        def schedule(cb):
+            r0 = cb.submit(head)             # seeds the cache
+            cb.run()
+            r1 = cb.submit(filler)
+            cb.step()                        # filler decoding
+            r2 = cb.submit(head)             # full hit -> COW, mid-decode
+            cb.step()
+            r3 = cb.submit(head + tail)      # cached prefix + chunked tail
+            out = cb.run()
+            return [out[r] for r in (r0, r1, r2, r3)]
+
+        base, fused = self._both(params, cfg, schedule, max_batch=2,
+                                 prefill_buckets=(4,), prefix_cache=True)
+        assert fused == base
+        assert base[0] == base[2]            # COW really replayed the hit
+
+
+class TestBucketTuner:
+    """tools/bucket_tuner.py: the pad-minimizing ladder fit over the
+    batcher's `prefill_suffix_hist` accounting (pure host DP — no
+    model)."""
+
+    def test_pad_cost_matches_bucket_rule(self):
+        hist = {3: 2, 5: 1, 9: 4}
+        # ladder (4, 16): 3->4 (x2), 5->16, 9->16 (x4)
+        assert bucket_tuner.pad_cost(hist, [4, 16]) == \
+            2 * 1 + 11 + 4 * 7
+
+    def test_fit_is_optimal_and_covers_max(self):
+        hist = {3: 10, 4: 10, 16: 1}
+        ladder, pad = bucket_tuner.fit_ladder(hist, 2)
+        # one bucket at 4 (pad 10), one at 16 — beats (3,16): pad 130
+        assert ladder == [4, 16] and pad == 10
+        # k >= distinct lengths: zero pad, buckets ON the lengths
+        ladder, pad = bucket_tuner.fit_ladder(hist, 5)
+        assert ladder == [3, 4, 16] and pad == 0
+        # one bucket: everything pads to the max length
+        ladder, pad = bucket_tuner.fit_ladder(hist, 1)
+        assert ladder == [16] == [max(hist)]
+        assert pad == bucket_tuner.pad_cost(hist, ladder)
+
+    def test_tune_reads_bench_record(self):
+        rec = {"prefill_suffix_hist": {"3": 4, "6": 2, "14": 1},
+               "prefill_buckets": [8, 16]}
+        r = bucket_tuner.tune(rec)          # same 2-bucket budget
+        assert r["observed_ladder"] == [8, 16]
+        assert len(r["recommended_ladder"]) <= 2
+        assert (r["pad_tokens_recommended"]
+                <= r["pad_tokens_current_ladder"])
+        dense = bucket_tuner.tune(rec, max_buckets=3)
+        assert dense["pad_tokens_recommended"] == 0   # one per length
+
+    def test_batcher_records_real_chunk_lengths(self, setup):
+        """The histogram feeding the tuner holds PRE-padding lengths:
+        a 5-token prompt on an (8,) ladder records 5, not 8; a chunked
+        prompt records each chunk."""
+        cfg, params = setup
+        cb = _batcher(params, cfg, prefill_buckets=(4,))
+        cb.submit(_prompts(90, (3,))[0])
+        cb.submit(_prompts(90, (9,))[0])    # chunks 4 + 4 + 1
+        cb.run()
+        assert cb.prefill_suffix_hist == {3: 1, 4: 2, 1: 1}
+
+
+class TestFusedCompileAccounting:
+    def test_no_compiles_after_warmup_with_fusion(self, setup):
+        """warmup_prefill covers the fused (group, bucket) ladder too:
+        a mixed admission-during-decode run — groups, COW, chunked long
+        prompts — never compiles a new shape afterwards."""
+        cfg, params = setup
+        cb = _batcher(params, cfg, max_batch=2, prefill_buckets=(4, 8),
+                      prefix_cache=True, fused_prefill=True)
+        warmed = cb.warmup_prefill()
+        # standalone ladder x groups {1,2} x {cold,cached} + fused
+        assert warmed == 2 * 2 * 2 + 2 * 2
+        c0 = cb.prefill_compile_count
+        a, b, long_p = _prompts(84, (5, 7, 19))
+        _mid_decode_schedule(cb, a, [b, long_p])
+        cb.submit(a)                          # warm repeat (cache hit)
+        cb.run()
+        assert cb.fused_steps > 0
+        assert cb.prefill_compile_count == c0  # NEVER recompiled

@@ -230,8 +230,13 @@ class TestCompileAccounting:
         cfg, params = setup
         cb = _batcher(params, cfg, max_batch=2, prefix_cache=True)
         warmed = cb.warmup_prefill()
-        # ladder (8,16,32) x groups {1,2} x {cold, cached}
-        assert warmed == 3 * 2 * 2
+        # standalone: ladder (8,16,32) x groups {1,2} x {cold, cached};
+        # fused decode+prefill: ladder x groups (phase-free — prefill
+        # rows always ride the per-query-causal paged path)
+        assert warmed == 3 * 2 * 2 + 3 * 2
+        # fusion off: only the standalone ladder is warmed
+        off = _batcher(params, cfg, max_batch=2, fused_prefill=False)
+        assert off.warmup_prefill() == 3 * 2 * 2
         c0 = cb.prefill_compile_count
         for p in _prompts(44, (3, 9, 17, 4, 10, 3)):  # span the ladder
             cb.submit(p)

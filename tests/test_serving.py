@@ -382,6 +382,53 @@ class TestServingPrefixCache:
         assert snap["allocator"]["cached_blocks"] > 0
 
 
+class TestFusedServing:
+    """Fused prefill+decode through the full engine: admissions landing
+    while another request decodes piggyback on the decode chunk (the
+    fused_steps gauge proves it) and stay token-identical to the
+    sequential baselines; the fusion-off engine is the escape hatch."""
+
+    def _engine(self, setup, **kw):
+        cfg, params = setup
+        return serving.ServingEngine(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=20, chunk=3, max_queue_depth=16, **kw)
+
+    def _serve_overlapped(self, eng, baselines):
+        long_req = eng.submit(PROMPT_A, max_new_tokens=20)
+        it = long_req.stream()
+        first = next(it)                  # decode provably started
+        # lands mid-decode: with fusion on this admission piggybacks
+        out_b = eng.generate(PROMPT_B, max_new_tokens=MAX_NEW,
+                             timeout=300)
+        assert out_b == baselines["B"]
+        rest = list(it)
+        # greedy ⇒ the 6-token baseline is a strict prefix of 20 tokens
+        assert ([first] + rest)[:MAX_NEW] == baselines["A"]
+        assert eng.drain(timeout=300)
+
+    def test_fused_engine_parity_and_metrics(self, setup, baselines):
+        eng = self._engine(setup)         # fused_prefill on by default
+        self._serve_overlapped(eng, baselines)
+        snap = eng.snapshot()
+        eng.shutdown()
+        assert snap["gauges"]["fused_steps"] >= 1
+        assert snap["gauges"]["decode_stall_steps"] == 0
+        # inter-token latency surfaced (multi-step requests ⇒ gaps)
+        assert snap["histograms"]["itl_s"]["count"] >= 1
+        assert "p95" in snap["histograms"]["itl_s"]
+        assert snap["allocator"]["blocks_in_use"] == 0
+
+    def test_fusion_off_escape_hatch(self, setup, baselines):
+        eng = self._engine(setup, fused_prefill=False)
+        self._serve_overlapped(eng, baselines)
+        snap = eng.snapshot()
+        eng.shutdown()
+        assert snap["gauges"]["fused_steps"] == 0
+        assert snap["gauges"]["decode_stall_steps"] >= 1
+        assert snap["allocator"]["blocks_in_use"] == 0
+
+
 class TestContinuousBatcherStop:
     def test_per_request_stop_token(self, setup, baselines):
         """Batcher-level satellite: a slot with stop_token_id finishes
@@ -499,6 +546,30 @@ class TestAdmissionQueue:
         assert q.reap(lambda i: i % 2 == 0) == [0, 2]
         assert [q.pop(), q.pop()] == [1, 3]
 
+    def test_pop_many_batch_defer_and_prefer(self):
+        """One admission round under one lock: best-first order, the
+        head-of-line item failing `fits` stops the round, `fits` runs
+        once per ACCEPTED item (callers debit resources inside it), and
+        `prefer` tie-breaks within the round."""
+        q = AdmissionQueue(max_depth=8, aging_interval_s=100.0)
+        q.push("a1", priority=1)
+        q.push("b0", priority=0)
+        q.push("c1", priority=1)
+        assert q.pop_many(2) == ["b0", "a1"]
+        assert q.pop_many(5) == ["c1"]
+        assert q.pop_many(3) == []
+        q.push("big", priority=0)
+        q.push("small", priority=1)
+        assert q.pop_many(2, fits=lambda i: i != "big") == []
+        assert len(q) == 2                 # defer leaves the queue intact
+        calls = []
+        got = q.pop_many(2, fits=lambda i: calls.append(i) or True)
+        assert got == ["big", "small"] and calls == got
+        q.push("cold", priority=1)
+        q.push("warm", priority=1)
+        assert q.pop_many(2, prefer=lambda i: i == "warm") \
+            == ["warm", "cold"]
+
     def test_prefer_breaks_ties_within_priority(self):
         """Cache-aware ordering: at EQUAL effective priority a preferred
         (cached-prefix) item pops before earlier FIFO traffic, but never
@@ -536,6 +607,27 @@ class TestMetricsRegistry:
         assert hs["count"] == 100 and hs["min"] == 1.0 and hs["max"] == 100.0
         assert abs(hs["p50"] - 50.0) <= 2.0
         assert abs(hs["p99"] - 99.0) <= 2.0
+
+    def test_percentile_since_skips_warmup_samples(self):
+        # bench emitters rank only the timed window: `since` drops the
+        # first N lifetime observations (e.g. a warmup request's
+        # compile-tainted gaps)
+        m = MetricsRegistry()
+        h = m.histogram("h")
+        h.observe(1000.0)          # warmup outlier
+        for v in range(1, 11):
+            h.observe(float(v))
+        assert h.percentile(0.99) == 1000.0
+        assert h.percentile(0.99, since=1) == 10.0
+        assert h.percentile(0.50, since=1) == 5.0
+        assert h.percentile(0.99, since=11) is None
+        # wrapped ring: samples that already fell off are skipped
+        hw = m.histogram("hw")
+        hw._cap = 8
+        for v in range(16):
+            hw.observe(float(v))
+        assert hw.percentile(1.0, since=4) == 15.0
+        assert hw.percentile(0.0, since=4) == 8.0   # 4..7 fell off
 
     def test_timer_observes_and_is_thread_safe(self):
         m = MetricsRegistry()
