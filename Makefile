@@ -22,11 +22,16 @@ test:           ## tier-1 test suite (CPU)
 # fused-vs-unfused comparison; the bucketed leg FAILS on any prefill
 # recompile after warmup, and the fused leg FAILS unless piggybacked
 # admission stalls decode strictly less than the standalone baseline
-# (both deterministic schedule/shape accounting, not timing)
+# (both deterministic schedule/shape accounting, not timing). The last
+# leg forces the Pallas ragged kernel through the served path in
+# interpret mode (the CPU parity configuration — tests/
+# test_ragged_attention.py is the full parity suite, run by `make test`)
 bench-smoke:    ## tiny serving benches (non-blocking CI job)
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --prefix-share \
 		--n-requests 6 --max-new 4
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --bucketed \
 		--n-requests 8 --max-new 4
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --fused \
-		--n-requests 8 --max-new 6
+		--n-requests 8 --max-new 6 --fused-units 2
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py \
+		--attention-impl pallas --n-requests 4 --max-new 4

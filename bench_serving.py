@@ -37,6 +37,15 @@ decode chunk fn. Before it, the first timed request of each new prompt
 length ate a fresh XLA trace+compile and TTFT p99 measured the
 compiler, not the server.
 
+`--attention-impl {auto,xla,pallas}` selects the paged-attention
+backend (nlp/ragged_attention.py); the JSON line records the RESOLVED
+impl plus `decode_tok_s` — generated tokens over time spent inside
+batcher.step(), the number the attention backend actually moves. On
+CPU pallas runs in Pallas interpret mode: a correctness/parity
+configuration, not a speed one (the kernel's win is HBM traffic on
+TPU). `--fused-units N` lets one fused step carry up to N pending
+prefill units (admission bursts drain faster under sustained decode).
+
 Deliberately a tiny model on CPU: this measures the HOST serving layer's
 overhead and scheduling behavior deterministically; device-side decode
 throughput is bench.py's `decode_tok_s`.
@@ -71,6 +80,7 @@ def _make_prompts(rng, n_requests: int, workload: str,
 def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
            block_size: int, chunk: int, prefix_cache: bool,
            max_prefill_bucket: int, fused_prefill: bool,
+           attention_impl: str = "auto", fused_units: int = 1,
            budgets=None) -> dict:
     """One engine lifecycle over `prompts`: warmup (AOT ladder + one
     served request), timed serve, drain. Returns the raw numbers the
@@ -82,7 +92,8 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
         max_total_len=64, max_new_tokens=max_new, chunk=chunk,
         max_queue_depth=len(prompts), prefix_cache=prefix_cache,
         max_prefill_bucket=max_prefill_bucket,
-        fused_prefill=fused_prefill, start=False)
+        fused_prefill=fused_prefill, fused_units=fused_units,
+        attention_impl=attention_impl, start=False)
     # warmup: AOT-compile EVERY prefill shape (group ladder x bucket
     # ladder x cold/cached, + the fused variants) before the loop
     # starts, then serve one request to compile the decode chunk fn
@@ -95,11 +106,15 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
     warmup_s = time.perf_counter() - t_w
     completed0 = eng.metrics.counter("requests_completed").value
     pc0 = eng.snapshot()["prefix_cache"]
-    compiles_warm = eng.batcher.prefill_compile_count
+    # compile_count covers EVERY device-step shape (prefill/fused
+    # ladder + the plain decode chunk) — the zero-post-warmup gate
+    compiles_warm = eng.batcher.compile_count
     itl = eng.metrics.histogram("itl_s")
     # the warmup request's gaps include the decode chunk fn's XLA
     # compile — rank only samples observed inside the timed window
     itl0 = itl.summary().get("count", 0)
+    step_h = eng.metrics.histogram("serving.step_s")
+    step_s0 = step_h.summary().get("sum", 0.0)
 
     t0 = time.perf_counter()
     budgets = budgets or [None] * len(prompts)
@@ -112,6 +127,10 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
 
     toks = sum(len(r.result()) for r in reqs)
     b = eng.batcher
+    # device-step throughput of the timed window: generated tokens over
+    # time spent INSIDE batcher.step() — queueing/host fan-out excluded,
+    # so this is the number the attention backend actually moves
+    step_s = step_h.summary().get("sum", 0.0) - step_s0
     return {
         "snap": eng.snapshot(),
         "pc0": pc0,
@@ -121,8 +140,12 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
         "warmed": warmed,
         "completed0": completed0,
         "tok_s": toks / wall,
-        "recompiles": b.prefill_compile_count - compiles_warm,
+        "decode_tok_s": toks / step_s if step_s else None,
+        "attention_impl": eng.attention_impl,
+        "recompiles": b.compile_count - compiles_warm,
         "compile_count": b.prefill_compile_count,
+        "compile_count_total": b.compile_count,
+        "fused_unit_count": b.fused_unit_count,
         "pad_tokens": b.prefill_pad_tokens,
         "buckets": list(b.prefill_buckets),
         "suffix_hist": {str(k): v
@@ -142,7 +165,8 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
          block_size: int = 8, chunk: int = 4, workload: str = "random",
          prefix_len: int = 24, suffix_len: int = 6,
          prefix_cache: bool = True,
-         max_prefill_bucket: int = 512) -> dict:
+         max_prefill_bucket: int = 512,
+         attention_impl: str = "auto", fused_units: int = 1) -> dict:
     import jax
     from paddle_tpu.nlp import llama
 
@@ -154,7 +178,8 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
     kw = dict(max_new=max_new, max_batch=max_batch,
               block_size=block_size, chunk=chunk,
               prefix_cache=prefix_cache,
-              max_prefill_bucket=max_prefill_bucket)
+              max_prefill_bucket=max_prefill_bucket,
+              attention_impl=attention_impl, fused_units=fused_units)
 
     base = None
     if workload == "fused":
@@ -176,6 +201,11 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         "value": round(r["tok_s"], 1),
         "unit": "tokens/s",
         "workload": workload,
+        "attention_impl": r["attention_impl"],
+        "decode_tok_s": (round(r["decode_tok_s"], 1)
+                         if r["decode_tok_s"] else None),
+        "fused_units": fused_units,
+        "fused_unit_count": r["fused_unit_count"],
         "n_requests": n_requests,
         "max_batch": max_batch,
         "max_new_tokens": max_new,
@@ -198,6 +228,7 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         "prefill_buckets": r["buckets"],
         "prefill_shapes_warmed": r["warmed"],
         "prefill_compile_count": r["compile_count"],
+        "compile_count": r["compile_count_total"],
         "prefill_recompiles_after_warmup": r["recompiles"],
         "prefill_pad_tokens": r["pad_tokens"],
         "prefill_suffix_hist": r["suffix_hist"],
@@ -258,6 +289,17 @@ def _cli() -> dict:
                          "decode less and never recompiles")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="serve with the prefix cache disabled")
+    ap.add_argument("--attention-impl", default="auto",
+                    choices=("auto", "xla", "pallas"),
+                    help="paged-attention backend: xla reference "
+                         "gather, pallas ragged kernel (interpret mode "
+                         "off-TPU — parity, not speed), or auto "
+                         "(pallas on TPU, xla elsewhere); the JSON "
+                         "line records the RESOLVED impl")
+    ap.add_argument("--fused-units", type=int, default=1,
+                    help="max pending prefill units one fused step "
+                         "carries (PR 5 follow-on: >1 drains "
+                         "admission bursts faster under decode load)")
     ap.add_argument("--n-requests", type=int, default=16)
     ap.add_argument("--max-new", type=int, default=8)
     ap.add_argument("--max-batch", type=int, default=4)
@@ -293,7 +335,9 @@ def _cli() -> dict:
                 chunk=chunk, workload=workload,
                 prefix_len=a.prefix_len, suffix_len=a.suffix_len,
                 prefix_cache=not a.no_prefix_cache,
-                max_prefill_bucket=bucket_cap)
+                max_prefill_bucket=bucket_cap,
+                attention_impl=a.attention_impl,
+                fused_units=a.fused_units)
 
 
 if __name__ == "__main__":

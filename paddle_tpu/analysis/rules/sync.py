@@ -31,6 +31,8 @@ HOT_PATHS: Tuple[Tuple[str, str], ...] = (
     ("nlp/paged.py",
      r"^(step|run|_step_fused|_prefill_pending|_run_standalone_unit"
      r"|_paged_gqa_attention|forward_paged)$"),
+    ("nlp/ragged_attention.py",
+     r"^(ragged_paged_attention|_rpa_kernel|resolve_attention_impl)$"),
     ("serving/engine.py", r"^(_loop|_dispatch|step)$"),
 )
 

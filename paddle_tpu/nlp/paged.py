@@ -24,9 +24,12 @@ TPU-native design: everything on device is STATIC-shape —
     several requests' table rows name the same pool blocks for a shared
     prompt prefix, and prefill runs only on each request's suffix.
 The attention here is the exact grouped-GQA formulation (generation.
-_gqa_cached_attention's paged twin); a Pallas block-gather kernel is the
-named follow-up once serving perf work starts (the dense decode bench
-remains the perf path this round).
+_gqa_cached_attention's paged twin) with TWO interchangeable backends:
+the XLA gather path below (reference — gathers the full table width,
+bit-stable, the CPU default) and the Pallas ragged paged-attention
+kernel (ragged_attention.py — walks only each request's LIVE block
+chain, the TPU default; `attention_impl=` selects, "auto" resolves per
+backend).
 """
 from __future__ import annotations
 
@@ -47,6 +50,7 @@ from ..kernels.rms_norm import rms_norm_ref
 from ..kernels.rope import rope_freqs, apply_rope_half
 from . import llama
 from .generation import _wq, _mlp_cached, _final_head_cached, _sample
+from .ragged_attention import resolve_attention_impl
 
 
 class PagedKVCache(NamedTuple):
@@ -333,14 +337,26 @@ def _write_pool(pool, table, positions, new, valid):
     return poolf.reshape(pool.shape)
 
 
-def _paged_gqa_attention(q, k_pool, v_pool, table, positions):
+def _paged_gqa_attention(q, k_pool, v_pool, table, positions, valid=None,
+                         impl: str = "xla"):
     """q [B, P, H, hd] against pool blocks gathered through the table.
     positions [B, P]: query p sees pool keys at absolute positions
     j <= positions[b, p] — per-query causal, so this one path serves
     both single-token decode (P=1, position = current length) AND the
     cached-prefix suffix prefill (P>1 suffix tokens attending to the
     shared prefix blocks plus their own, never to their future).
-    Cold prefill uses the in-batch flash path instead."""
+    Cold prefill uses the in-batch flash path instead.
+
+    impl="xla" (default) is THE reference: full-table-width gather,
+    unchanged bit-for-bit from before the backend switch existed (it
+    ignores `valid` — padded rows compute never-read garbage).
+    impl="pallas" dispatches to the ragged Pallas kernel, which walks
+    only each request's live block chain and zeroes invalid rows;
+    parity is tight-tolerance, not bitwise (online softmax)."""
+    if impl == "pallas":
+        from .ragged_attention import ragged_paged_attention
+        return ragged_paged_attention(q, k_pool, v_pool, table, positions,
+                                      valid)
     B, P, H, hd = q.shape
     N, bs, KV, _ = k_pool.shape
     M = table.shape[1]
@@ -361,7 +377,7 @@ def _paged_gqa_attention(q, k_pool, v_pool, table, positions):
 
 
 def _attention_paged(x, lp, cfg, cos, sin, pk, pv, table, positions,
-                     valid, is_prefill):
+                     valid, is_prefill, attention_impl: str = "xla"):
     """One layer's attention. positions [B, P] per-request absolute
     positions of x's tokens; valid masks padded slots. Returns
     (out, pk', pv') with the new tokens written into the pool."""
@@ -385,15 +401,18 @@ def _attention_paged(x, lp, cfg, cos, sin, pk, pv, table, positions,
     else:
         # decode AND cached-prefix suffix prefill: gather through the
         # table with per-query causal visibility (j <= position)
-        o = _paged_gqa_attention(q, pk, pv, table, positions)
+        o = _paged_gqa_attention(q, pk, pv, table, positions, valid,
+                                 impl=attention_impl)
     return (o.reshape(B, P, H * hd) @ _wq(lp, "o_proj", cd)), pk, pv
 
 
 def forward_paged(params, tokens, cache: PagedKVCache, positions, valid,
-                  cfg, is_prefill: bool):
+                  cfg, is_prefill: bool, attention_impl: str = "xla"):
     """tokens [B, P] at per-request absolute `positions` [B, P] →
     (logits [B, P, V] f32, cache'). visible_len for decode = position+1
-    (the just-written token included)."""
+    (the just-written token included). `attention_impl` selects the
+    paged-attention backend ("xla" reference gather | "pallas" ragged
+    kernel) for the non-prefill path; cold prefill keeps flash."""
     cd = cfg.dtype
     # rope spans the per-request table width (max reachable position),
     # NOT the whole pool — the pool is ~B x larger by construction
@@ -409,7 +428,7 @@ def forward_paged(params, tokens, cache: PagedKVCache, positions, valid,
         h = rms_norm_ref(x, lp["input_layernorm"], cfg.rms_norm_eps)
         a, pk, pv = _attention_paged(h, lp, cfg, cos, sin, pk, pv,
                                      cache.table, positions, valid,
-                                     is_prefill)
+                                     is_prefill, attention_impl)
         pk_all = lax.dynamic_update_slice_in_dim(pk_all, pk[None], li, 0)
         pv_all = lax.dynamic_update_slice_in_dim(pv_all, pv[None], li, 0)
         x = x + a
@@ -432,7 +451,8 @@ def paged_generate(params, tokens, lengths, cfg: llama.LlamaConfig,
                    temperature: float = 1.0, top_k: int = 0,
                    top_p: float = 1.0, greedy: bool = True,
                    pad_token_id: int = 0,
-                   key: Optional[jax.Array] = None):
+                   key: Optional[jax.Array] = None,
+                   attention_impl: str = "auto"):
     """Ragged batched generation over one shared block pool.
 
     tokens [B, P_max] right-padded prompts; lengths [B] real prompt
@@ -440,7 +460,10 @@ def paged_generate(params, tokens, lengths, cfg: llama.LlamaConfig,
     Returns (ids [B, max_new_tokens], allocator, owned) — `owned` is the
     per-request block lists; free them back to the allocator when each
     request completes so later admissions reuse the pool.
+    `attention_impl` picks the decode attention backend ("xla"
+    reference | "pallas" ragged kernel | "auto" per backend).
     """
+    attention_impl = resolve_attention_impl(attention_impl)
     B, P = tokens.shape
     lengths_np = np.asarray(lengths)
     max_total = int(lengths_np.max()) + max_new_tokens
@@ -473,7 +496,8 @@ def paged_generate(params, tokens, lengths, cfg: llama.LlamaConfig,
         pos = cache.lengths[:, None]                       # [B, 1]
         logits, cache = forward_paged(
             params, tok[:, None], cache, pos,
-            jnp.ones_like(pos, bool), cfg, is_prefill=False)
+            jnp.ones_like(pos, bool), cfg, is_prefill=False,
+            attention_impl=attention_impl)
         key, sub = jax.random.split(key)
         nxt = _sample(logits[:, 0], sub, temperature, top_k, top_p, greedy)
         return (nxt, cache, key), nxt
@@ -521,6 +545,20 @@ class ContinuousBatcher:
     piggybacked calls, `decode_stall_steps` counts standalone prefill
     calls that ran while slots were decoding (the unfused cost), and
     fused shapes are memoized/AOT-warmed exactly like standalone ones.
+    A fused step carries up to `fused_units` CONSECUTIVE pending units
+    when they share this step's chunk bucket and no cross-unit block
+    dependency forces ordering — admission bursts and co-pending
+    chunked long prompts drain up to `fused_units` x faster under
+    sustained decode load, with shapes still drawn from the finite
+    warmed ladder (total prefill rows = units x group pad).
+
+    Attention backend (`attention_impl=`): "xla" is the reference
+    full-table-width gather (bit-stable, the CPU default); "pallas" is
+    the ragged paged-attention kernel (ragged_attention.py) that walks
+    only each request's LIVE block chain (the TPU default — decode HBM
+    traffic tracks live pool bytes, not table width); "auto" resolves
+    per backend at construction. Every compiled-shape memo keys on the
+    resolved impl.
 
     Usage:
         cb = ContinuousBatcher(params, cfg, max_batch=2, block_size=16,
@@ -537,9 +575,13 @@ class ContinuousBatcher:
                  prefix_cache: bool = False,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  max_prefill_bucket: int = 512,
-                 fused_prefill: bool = True):
+                 fused_prefill: bool = True, fused_units: int = 1,
+                 attention_impl: str = "auto"):
         self.params, self.cfg = params, cfg
         self.B, self.bs = max_batch, block_size
+        # resolved once: every traced fn closes over the concrete
+        # backend and every compiled-shape memo keys on it
+        self.attention_impl = resolve_attention_impl(attention_impl)
         self.max_total = max_total_len
         self.M = -(-max_total_len // block_size)
         self.max_new = max_new_tokens
@@ -568,19 +610,28 @@ class ContinuousBatcher:
             if any(x < 1 for x in self._buckets):
                 raise ValueError("prefill_buckets must be positive")
         self._prefill_fns: Dict[bool, Any] = {}     # cold -> jitted fn
-        self._prefill_cache: Dict[Tuple[int, int, bool], Any] = {}
+        self._prefill_cache: Dict[Tuple[int, int, bool, str], Any] = {}
         self.prefill_pad_tokens = 0
         # fused prefill+decode: admissions landing mid-decode piggyback
-        # one prefill chunk on the decode chunk call instead of stalling
-        # every in-flight slot behind a standalone prefill
+        # up to `fused_units` prefill units on the decode chunk call
+        # instead of stalling every in-flight slot behind a standalone
+        # prefill
         self._fused = bool(fused_prefill)
+        if int(fused_units) < 1:
+            raise ValueError("fused_units must be >= 1")
+        self.fused_units = int(fused_units)
         self._fused_fn = None
-        self._fused_cache: Dict[Tuple[int, int], Any] = {}
+        self._fused_cache: Dict[Tuple[int, int, str], Any] = {}
+        # the plain decode chunk, AOT-compiled like the prefill shapes
+        # (warmup covers it, so a decode-only stretch after a fused
+        # stretch never pays a first-call compile)
+        self._chunk_cache: Dict[Tuple[int, str], Any] = {}
         # prepared-but-not-fully-prefilled admissions: [record, chunks
         # done] — the record's slot and blocks are reserved for the
         # whole mid-stream prefill (free_slots counts them taken)
         self._pending: List[List] = []
         self.fused_steps = 0          # piggybacked prefill calls
+        self.fused_unit_count = 0     # prefill units those calls carried
         self.decode_stall_steps = 0   # standalone prefills that stalled
         # observed real chunk lengths (len -> count): the data a
         # workload-specific bucket ladder is fitted from (bucket_tuner)
@@ -716,10 +767,20 @@ class ContinuousBatcher:
     @property
     def prefill_compile_count(self) -> int:
         """Distinct prefill shapes compiled so far — standalone (group,
-        bucket, phase) AND fused (group, bucket) executables. Flat after
+        bucket, phase) AND fused (rows, bucket) executables. Flat after
         warmup is the whole point of bucketing: each shape compiles
         exactly once for the batcher's lifetime."""
         return len(self._prefill_cache) + len(self._fused_cache)
+
+    @property
+    def compile_count(self) -> int:
+        """EVERY compiled device-step shape: the prefill/fused ladder
+        plus the plain decode chunk executable. The zero-post-warmup-
+        recompiles gate reads this one — a decode-only stretch after a
+        fused stretch must not compile either (the chunk fn used to
+        slip through `prefill_compile_count`, compiling lazily on the
+        first standalone-decode step)."""
+        return self.prefill_compile_count + len(self._chunk_cache)
 
     def prefix_stats(self) -> Dict[str, Any]:
         """Prefix-cache counters for the serving metrics surface:
@@ -857,12 +918,13 @@ class ContinuousBatcher:
         """The one traced prefill: rows [G, Pb] at per-row absolute
         positions against the shared pool. Pure — compile bookkeeping
         lives host-side in `_prefill_exe` (TRACE001)."""
-        cfg = self.cfg
+        cfg, impl = self.cfg, self.attention_impl
 
         def prefill(params, rows, k, v, table, positions, valid, lengths):
             sub = PagedKVCache(k, v, table, lengths)
             logits, sub = forward_paged(params, rows, sub, positions,
-                                        valid, cfg, is_prefill=cold)
+                                        valid, cfg, is_prefill=cold,
+                                        attention_impl=impl)
             return logits, sub.k, sub.v
 
         return jax.jit(prefill)
@@ -873,7 +935,7 @@ class ContinuousBatcher:
         the whole ladder without running a single FLOP; steady-state
         admission dispatches straight to a compiled executable and never
         retraces."""
-        key = (G, Pb, cold)
+        key = (G, Pb, cold, self.attention_impl)
         exe = self._prefill_cache.get(key)
         if exe is None:
             fn = self._prefill_fns.get(cold)
@@ -896,28 +958,52 @@ class ContinuousBatcher:
                        group_sizes: Optional[Sequence[int]] = None,
                        modes: Sequence[bool] = (True, False),
                        fused: Optional[bool] = None) -> int:
-        """Pre-compile every prefill shape admission can hit — each
+        """Pre-compile every device-step shape serving can hit — each
         ladder bucket x each power-of-two group size x {cold, cached},
         plus (with fusion on) the fused decode+prefill variant per
-        (group, bucket) — via AOT lowering (no device compute). After
-        this, steady-state admission never compiles. Returns the number
-        of newly compiled shapes. No-op for a bucketing-disabled batcher
-        (exact shapes are unbounded; there is nothing finite to warm)."""
+        reachable prefill-row count (units x group pad, units up to
+        `fused_units`), plus EVERY reachable decode chunk executable
+        (today: the one configured standalone-decode chunk) — via AOT
+        lowering (no device compute). After this, steady state never
+        compiles: not admission, not a fused stretch, and not the first
+        decode-only step after one. Returns the number of newly
+        compiled shapes. With bucketing disabled only the decode chunk
+        warms (exact prefill shapes are unbounded; there is nothing
+        finite to ladder)."""
         ladder = self._buckets if buckets is None else tuple(buckets)
         if group_sizes is None:
             # exactly the shapes _group_pad can ever produce
             group_sizes = {self._group_pad(g) for g in range(1, self.B + 1)}
-        n0 = self.prefill_compile_count
+        n0 = self.compile_count
         for Pb in ladder:
             for G in sorted(set(group_sizes)):
                 for cold in modes:
                     self._prefill_exe(int(G), int(Pb), bool(cold))
         warm_fused = self._fused if fused is None else fused
         if warm_fused:
+            # total prefill rows a fused call can carry: U consecutive
+            # same-bucket units, each padded to the SAME power-of-two
+            # group size — the memo normalizes (units, group) to the
+            # row count U*G, so coinciding shapes compile once. Only
+            # REACHABLE shapes warm: every pending record holds a slot
+            # and a fused step needs >= 1 ACTIVE decode slot besides,
+            # so a call whose widest unit pads to G (> G//2 records)
+            # riding with u-1 more units (>= 1 record each) exists only
+            # when that minimum record count fits in max_batch - 1
+            rows = set()
+            for G in sorted(set(int(g) for g in group_sizes)):
+                need_widest = G // 2 + 1 if G > 1 else 1
+                for u in range(1, self.fused_units + 1):
+                    if need_widest + (u - 1) <= self.B - 1:
+                        rows.add(u * G)
             for Pb in ladder:
-                for G in sorted(set(group_sizes)):
-                    self._fused_exe(int(G), int(Pb))
-        return self.prefill_compile_count - n0
+                for Gt in sorted(rows):
+                    self._fused_exe(Gt, int(Pb))
+        # the standalone-decode chunk is reachable from ANY workload
+        # (incl. a decode-only stretch after a fused stretch) — warm it
+        # regardless of ladder/fusion configuration
+        self._chunk_exe()
+        return self.compile_count - n0
 
     def _prepare_admission(self, slot: int, rid: int, toks: List[int],
                            stop: int, max_new: Optional[int]) -> _Admission:
@@ -1125,25 +1211,29 @@ class ContinuousBatcher:
                 or first == rec.stop or self.budget[rec.slot] <= 0):
             self._retire(rec.slot)
 
-    def _pop_unit(self):
-        """The next prefill execution unit off the pending pipeline, in
-        order (a later record may share blocks an earlier one
-        registered): ([pipeline entries], [(rec, start, end) rows],
-        bucket, cold, final). `final` is False for a non-last chunk of a
-        chunked record — the entry stays pending with its progress
-        bumped; True means every record in the unit commits when the
-        call lands."""
-        unit = self._units([e[0] for e in self._pending])[0]
+    def _unit_view(self, unit, entries):
+        """One pending unit as an execution view — the unit-shape logic
+        shared by the standalone and fused poppers: ([pipeline entries],
+        [(rec, start, end) rows], bucket, cold, final). A chunked record
+        runs its CURRENT chunk (progress lives in its entry); `final` is
+        False for a non-last chunk — the entry stays pending with its
+        progress bumped — and True means every record in the unit
+        commits when the call lands."""
         if len(unit[0].chunks) > 1:
-            entry = self._pending[0]
-            rec, done = entry
+            rec, done = entries[0]
             start, end, bucket = rec.chunks[done]
-            return ([entry], [(rec, start, end)], bucket, start == 0,
+            return (entries[:1], [(rec, start, end)], bucket, start == 0,
                     done == len(rec.chunks) - 1)
-        entries = self._pending[:len(unit)]
         items = [(r, r.chunks[0][0], r.chunks[0][1]) for r in unit]
         _, _, bucket = unit[0].chunks[0]
         return entries, items, bucket, items[0][1] == 0, True
+
+    def _pop_unit(self):
+        """The next prefill execution unit off the pending pipeline, in
+        order (a later record may share blocks an earlier one
+        registered)."""
+        unit = self._units([e[0] for e in self._pending])[0]
+        return self._unit_view(unit, self._pending[:len(unit)])
 
     def _finish_unit(self, entries, firsts) -> None:
         """Commit a unit whose FINAL chunk just computed: one readback
@@ -1204,18 +1294,75 @@ class ContinuousBatcher:
             self._fail_pending()
             raise
 
+    def _pop_fused_units(self):
+        """Select the units ONE fused call carries, in pending order:
+        the head unit always rides; up to `fused_units - 1` more
+        CONSECUTIVE units join when each (a) prefills this step at the
+        head unit's bucket (one compiled shape), and (b) holds no block
+        reference — matched chain or COW source — that an earlier
+        SELECTED unit registered but will not have fully written.
+        In-call pool writes ARE visible to the gather (each layer
+        writes every row's KV before gathering), so a later unit may
+        chain onto blocks a completing co-selected unit writes this
+        very call; but a chunked unit advancing a NON-final chunk
+        leaves its later blocks unwritten, and the host-side COW clone
+        copies the pool BEFORE the call — both force the dependent unit
+        to wait for a later step. Returns (groups, bucket): groups is a
+        list of (pipeline entries, (rec, start, end) items, final) per
+        selected unit."""
+        units = self._units([e[0] for e in self._pending])
+        groups: List[Tuple[List, List, bool]] = []
+        bucket0 = None
+        consumed = 0
+        inserted_sel: set = set()    # registered by any selected unit
+        unwritten: set = set()       # ... that this call won't write
+        for unit in units:
+            if len(groups) >= self.fused_units:
+                break
+            entries, items, bucket, _cold, final = self._unit_view(
+                unit, self._pending[consumed:consumed + len(unit)])
+            if bucket0 is None:
+                bucket0 = bucket
+            elif bucket != bucket0:
+                break
+            refs = set()
+            cow_refs = set()
+            for rec in unit:
+                refs.update(rec.matched)
+                if rec.cow_src is not None:
+                    cow_refs.add(rec.cow_src)
+            if (refs | cow_refs) & unwritten or cow_refs & inserted_sel:
+                break
+            groups.append((entries, items, final))
+            consumed += len(unit)
+            for rec in unit:
+                inserted_sel.update(rec.inserted)
+                if not final:
+                    # mid-stream: blocks past this chunk stay unwritten
+                    unwritten.update(rec.inserted)
+        return groups, bucket0
+
     def _step_fused(self):
-        """Piggyback the head pending prefill unit on this step's decode
-        chunk: ONE compiled call advances every active slot by its chunk
-        AND prefills up to one bucket-sized admission chunk. Returns the
-        decode chunk's tokens [B, chunk] (host copy)."""
+        """Piggyback up to `fused_units` pending prefill units on this
+        step's decode chunk: ONE compiled call advances every active
+        slot by its chunk AND prefills the selected same-bucket
+        admission chunks. Returns the decode chunk's tokens [B, chunk]
+        (host copy)."""
         try:
-            entries, items, bucket, _cold, final = self._pop_unit()
-            self._apply_cow([e[0] for e in entries if e[1] == 0])
-            Gp = self._group_pad(len(items))
-            rows, pos, val, tab, li = self._pack_prefill_rows(
-                items, bucket, Gp)
-            exe = self._fused_exe(Gp, bucket)
+            groups, bucket = self._pop_fused_units()
+            self._apply_cow([e[0] for entries, _, _ in groups
+                             for e in entries if e[1] == 0])
+            # every selected unit pads to the SAME group size so the
+            # call's shape is (units x Gp, bucket) — drawn from the
+            # finite warmed ladder whatever mix of units rides
+            Gp = max(self._group_pad(len(items))
+                     for _, items, _ in groups)
+            packs = [self._pack_prefill_rows(items, bucket, Gp)
+                     for _, items, _ in groups]
+            rows, pos, val, tab, li = (
+                np.concatenate([p[i] for p in packs], axis=0)
+                for i in range(5))
+            exe = self._fused_exe(len(groups) * Gp, bucket)
             if self._dev_state is None:
                 self._dev_state = self._upload_slot_state()
             active, budget, stop = self._dev_state
@@ -1238,10 +1385,15 @@ class ContinuousBatcher:
         self.cur_tok = tok
         self._dev_state = (active, budget, stop)
         self.fused_steps += 1
-        if final:
-            self._finish_unit(entries, pfirst)
-        else:
-            entries[0][1] += 1
+        self.fused_unit_count += len(groups)
+        # commit IN ORDER: group g's real rows sit at [g*Gp, g*Gp+|items|)
+        # of the concatenated prefill batch, so pfirst slices per group
+        for g, (entries, items, final) in enumerate(groups):
+            if final:
+                self._finish_unit(entries,
+                                  pfirst[g * Gp:g * Gp + len(items)])
+            else:
+                entries[0][1] += 1
         return toks
 
     def _retire(self, slot: int) -> None:
@@ -1351,14 +1503,14 @@ class ContinuousBatcher:
     def _decode_step_body(self, params, stop):
         """The one traced single-token decode step, shared by the plain
         decode chunk AND the fused chunk's post-first-token scan."""
-        cfg = self.cfg
+        cfg, impl = self.cfg, self.attention_impl
 
         def step(carry, _):
             cache, tok, lengths, budget, act = carry
             pos = lengths[:, None]
             logits, cache = forward_paged(
                 params, tok[:, None], cache, pos, act[:, None],
-                cfg, is_prefill=False)
+                cfg, is_prefill=False, attention_impl=impl)
             nxt, lengths, budget, act = self._emit_one(
                 logits[:, 0], tok, act, lengths, budget, stop)
             # inactive slots must not drift: pin lengths ourselves
@@ -1381,6 +1533,29 @@ class ContinuousBatcher:
 
         return jax.jit(run_chunk)
 
+    def _chunk_exe(self):
+        """Memoized COMPILED plain decode chunk, AOT-lowered like the
+        prefill shapes so `warmup_prefill` covers it — before this, the
+        chunk fn compiled lazily on the first standalone-decode step,
+        and a decode-only stretch AFTER a fused stretch (whose steps
+        all ran `_fused_exe`) paid a post-warmup compile."""
+        key = (self.chunk, self.attention_impl)
+        exe = self._chunk_cache.get(key)
+        if exe is None:
+            if self._chunk_fn is None:
+                self._chunk_fn = self._build_chunk()
+            sds, i32 = jax.ShapeDtypeStruct, jnp.int32
+            pstruct = jax.tree_util.tree_map(
+                lambda x: sds(jnp.shape(x), x.dtype), self.params)
+            cstruct = jax.tree_util.tree_map(
+                lambda x: sds(jnp.shape(x), x.dtype), self.cache)
+            B = self.B
+            exe = self._chunk_fn.lower(
+                pstruct, cstruct, sds((B,), i32), sds((B,), jnp.bool_),
+                sds((B,), i32), sds((B,), i32), sds((B,), i32)).compile()
+            self._chunk_cache[key] = exe
+        return exe
+
     def _build_fused(self):
         """The fused prefill+decode chunk: ONE compiled call over a
         mixed batch of `max_batch` decode rows plus `Pb` prefill-chunk
@@ -1397,6 +1572,7 @@ class ContinuousBatcher:
         The remaining chunk-1 decode tokens scan the shared decode
         step body."""
         cfg, chunk, B = self.cfg, self.chunk, self.B
+        impl = self.attention_impl
         maxpos = self.M * self.bs - 1
 
         def run_fused(params, k, v, table, lengths, tok, active, budget,
@@ -1416,7 +1592,8 @@ class ContinuousBatcher:
             logits, sub = forward_paged(
                 params, jnp.concatenate([dtok, prows], 0), sub,
                 jnp.concatenate([dpos, ppos], 0),
-                jnp.concatenate([dval, pval], 0), cfg, is_prefill=False)
+                jnp.concatenate([dval, pval], 0), cfg, is_prefill=False,
+                attention_impl=impl)
             # ragged last-token logits per prefill row → first tokens
             pfirst = jnp.argmax(logits[B:][jnp.arange(Gp), plast],
                                 axis=-1).astype(jnp.int32)
@@ -1434,11 +1611,14 @@ class ContinuousBatcher:
         return jax.jit(run_fused)
 
     def _fused_exe(self, Gp: int, Pb: int):
-        """Memoized COMPILED fused chunk per (group, bucket) shape,
-        AOT-lowered from abstract avals like `_prefill_exe` — warmup
-        covers the whole fused ladder so steady-state piggybacked
-        admission never retraces."""
-        key = (Gp, Pb)
+        """Memoized COMPILED fused chunk per (prefill rows, bucket)
+        shape, AOT-lowered from abstract avals like `_prefill_exe` —
+        warmup covers the whole fused ladder so steady-state
+        piggybacked admission never retraces. `Gp` is the TOTAL prefill
+        row count of the call: units x per-unit group pad for a
+        multi-unit step, so (units, group) pairs with the same product
+        share one executable."""
+        key = (Gp, Pb, self.attention_impl)
         exe = self._fused_cache.get(key)
         if exe is None:
             if self._fused_fn is None:
@@ -1469,8 +1649,6 @@ class ContinuousBatcher:
         step() (the prefill's first token included), `finished` lists
         rids that completed this step (their blocks are already back in
         the pool). A step with nothing in flight is a cheap no-op."""
-        if self._chunk_fn is None:
-            self._chunk_fn = self._build_chunk()
         self._admit()
         if any(self.active):
             # slots committed by a fused admission AFTER the device call
@@ -1484,7 +1662,7 @@ class ContinuousBatcher:
                     self._dev_state = self._upload_slot_state()
                 active, budget, stop = self._dev_state
                 (self.cache, self.cur_tok, lengths, budget, active,
-                 toks) = self._chunk_fn(
+                 toks) = self._chunk_exe()(
                     self.params, self.cache, self.cur_tok, active,
                     self.cache.lengths, budget, stop)
                 self.cache = self.cache._replace(lengths=lengths)

@@ -1,0 +1,236 @@
+"""paddle_tpu.nlp.ragged_attention — Pallas ragged paged-attention.
+
+The serving decode path is gather/HBM-bound: `_paged_gqa_attention`
+(nlp/paged.py) gathers the FULL block-table width per step in XLA —
+every request pays `M * block_size` keys of HBM traffic no matter how
+short its live sequence is, and BENCH shows decode ~25x below prefill
+throughput because of it. This module is the kernel half of the fix
+(design: "Ragged Paged Attention: A High-Performance and Flexible LLM
+Inference Kernel for TPU", PAPERS.md, arxiv 2604.15464):
+
+  * grid over (request row, query tile, KV-block-chunk) with the block
+    table and per-(row, tile) LIVE chain lengths fed as scalar
+    prefetch — the BlockSpec index map resolves each grid step's pool
+    block id from the table before the kernel body runs, so the KV
+    gather IS the pipeline's DMA (no XLA gather materializing
+    [B, M*bs, KV, hd] in HBM); the query tile (`q_tile`, default 128)
+    bounds VMEM residency so wide prefill buckets fit a core;
+  * dead chunks (past a request's live chain, or all of a padded /
+    inactive row) clamp their index map to the previous live block —
+    Pallas skips the re-fetch of an unchanged block, so a request's HBM
+    traffic tracks ceil(len/block_size) blocks, not the table width;
+  * a flash-style online softmax (running max / sum / accumulator in
+    VMEM scratch, carried across the block-chunk grid dimension)
+    finalizes each row at its LAST live chunk;
+  * per-query causal masking (`key position j <= positions[row, p]`)
+    matches the XLA path exactly, so the one kernel serves single-token
+    decode rows, bucketed/chunked cached-prefix prefill rows, AND the
+    mixed decode+prefill batch of the fused step — the Ragged Paged
+    Attention mixed-mode shape. Invalid (padded) query rows produce
+    zeros instead of the XLA path's never-read garbage.
+
+The XLA gather path stays the reference implementation: CPU runs it by
+default (`resolve_attention_impl("auto")`), and the parity suite
+(tests/test_ragged_attention.py) pins pallas==xla on decode, prefill,
+fused and prefix-cache-COW batches — on CPU via `interpret=True`, which
+this wrapper selects automatically off-TPU.
+
+Follow-on recorded in ROADMAP direction 4: int8 paged-KV blocks with
+per-block scales dequantized INSIDE this kernel's block loop — the
+gather-fused structure makes the dequant free.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ragged_paged_attention", "resolve_attention_impl"]
+
+_NEG_INF = -1e30
+
+
+def resolve_attention_impl(impl: str) -> str:
+    """Resolve an `attention_impl` choice to a concrete backend.
+
+    "auto" picks "pallas" on TPU and "xla" everywhere else (the XLA
+    gather path is the reference/fallback implementation and the only
+    compiled path on CPU — pallas off-TPU runs in interpret mode, which
+    is for parity testing, not speed). "pallas" and "xla" pass through;
+    anything else raises ValueError.
+    """
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl not in ("pallas", "xla"):
+        raise ValueError(
+            f"attention_impl must be 'auto', 'pallas' or 'xla', "
+            f"got {impl!r}")
+    return impl
+
+
+def _rpa_kernel(tab_ref, live_ref, pos_ref, val_ref, q_ref, k_ref, v_ref,
+                o_ref, acc_ref, m_ref, l_ref, *, bs: int, scale: float):
+    """One (row, query-tile, block-chunk) grid step of the ragged kernel.
+
+    Refs (per BlockSpec):
+      pos_ref/val_ref [1, Pt] int32 — this tile's query positions /
+      validity; q_ref [1, Pt, H, hd]; k_ref/v_ref [1, bs, KV, hd] — THE
+      pool block this chunk's index map resolved from the prefetched
+      table; o_ref [1, Pt, H, hd]; scratch acc [Pt, H, hd] f32,
+      m/l [Pt, H] f32. `live_ref` is per (row, tile): a tile's chain
+      walk stops at ITS OWN last visible block, not the row's.
+    """
+    import jax.experimental.pallas as pl
+
+    r, t, c = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nlive = live_ref[r, t]
+
+    @pl.when(c == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(c < nlive)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale          # [P, H, hd]
+        k = k_ref[0].astype(jnp.float32)                  # [bs, KV, hd]
+        v = v_ref[0].astype(jnp.float32)
+        P, H, hd = q.shape
+        KV = k.shape[1]
+        rep = H // KV
+        # grouped-GQA scores against this ONE pool block: query head
+        # h = kv*rep + r_h reads kv head kv — the same head grouping as
+        # q.reshape(B, P, KV, rep, hd) in the XLA path
+        qg = q.reshape(P, KV, rep, hd)
+        s = jnp.einsum("pkrd,tkd->pkrt", qg, k,
+                       preferred_element_type=jnp.float32)
+        s = s.reshape(P, H, bs)
+        # per-query causal visibility at ABSOLUTE key position
+        # j = c*bs + t (chain position, not pool position), masked by
+        # query validity so padded rows accumulate nothing
+        kpos = c * bs + jax.lax.broadcasted_iota(jnp.int32, (P, bs), 1)
+        vis = (kpos <= pos_ref[0][:, None]) & \
+              (val_ref[0] != 0)[:, None]                  # [P, bs]
+        s = jnp.where(vis[:, None, :], s, _NEG_INF)
+        m_prev = m_ref[...]                               # [P, H]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        # exp(s - m) alone is 1.0 for fully-masked rows (s == m ==
+        # _NEG_INF) — the explicit vis multiply keeps them at zero
+        p = jnp.exp(s - m_new[:, :, None])
+        p = jnp.where(vis[:, None, :], p, 0.0)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("pkrt,tkd->pkrd", p.reshape(P, KV, rep, bs), v,
+                        preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha[:, :, None] \
+            + pv.reshape(P, H, hd)
+        m_ref[...] = m_new
+
+    # finalize at the row's last LIVE chunk (c == 0 for an all-padded
+    # row: init just zeroed the accumulators, so the row emits zeros)
+    @pl.when(c == jnp.maximum(nlive - 1, 0))
+    def _finalize():
+        l = l_ref[...]
+        o = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)[:, :, None]
+        o_ref[0] = o.astype(o_ref.dtype)
+
+
+def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
+                           *, q_tile: int = 128, interpret=None):
+    """Paged GQA attention walking only each request's live block chain.
+
+    Drop-in twin of the XLA `_paged_gqa_attention` gather path
+    (nlp/paged.py) with the same per-query-causal semantics:
+
+      q [R, P, H, hd]; k_pool/v_pool [N, bs, KV, hd]; table [R, M] int32
+      pool block ids per row; positions [R, P] int32 absolute query
+      positions (query p sees chain keys j <= positions[r, p]);
+      valid [R, P] bool query mask (None = all valid). Returns
+      [R, P, H, hd] in q's dtype; INVALID queries return zeros (the XLA
+      path leaves never-read garbage there).
+
+    The query dimension tiles at the largest divisor of P that is
+    <= `q_tile` rows per grid step (q_tile itself for the serving
+    path's power-of-two buckets; worst case 1 for a prime P, which
+    trades grid overhead for the VMEM bound), bounding VMEM residency
+    — scratch + q/o blocks scale with the TILE, not the full prefill
+    bucket width, so a 512-wide bucket at production head counts still
+    fits a core's VMEM. Per (row, tile) live chain lengths —
+    ceil((max valid position in the tile + 1) / bs) — ride scalar
+    prefetch next to the table, so the kernel's grid work and HBM
+    traffic track the tokens actually cached, not the table width: a
+    tile with no valid query (padded slot, inactive decode row of the
+    fused batch, all-pad bucket tail) touches no blocks at all, and an
+    early tile of a long suffix stops at its own last visible block.
+
+    `interpret=None` auto-selects Pallas interpret mode off-TPU — the
+    CPU CI parity path. Tolerance vs XLA is tight-but-not-bitwise: the
+    online softmax reassociates the reduction.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    R, P, H, hd = q.shape
+    N, bs, KV, _ = k_pool.shape
+    M = table.shape[1]
+    if valid is None:
+        valid = jnp.ones((R, P), bool)
+    val = valid.astype(jnp.int32)
+    positions = positions.astype(jnp.int32)
+    table = table.astype(jnp.int32)
+    # largest divisor of P that fits the tile budget: bucketed widths
+    # are powers of two, so this is q_tile itself for every P > 128 the
+    # serving path produces; an awkward P (non-pow2 bucket caps, exact
+    # unbucketed shapes) still tiles at its largest fitting divisor
+    # rather than silently reverting to a VMEM-unbounded whole-row tile
+    q_tile = max(1, min(q_tile, P))
+    Pt = max(d for d in range(1, q_tile + 1) if P % d == 0)
+    T = P // Pt
+    # live chain blocks per (row, tile): valid query p needs chain keys
+    # up to position positions[r, p], all written before this call — so
+    # a tile's walk stops at ceil((its max valid position + 1) / bs)
+    live_tok = jnp.max(
+        jnp.where(valid, positions + 1, 0).reshape(R, T, Pt), axis=2)
+    live = ((live_tok + bs - 1) // bs).astype(jnp.int32)
+
+    def _tile_map(r, t, c, tab, live):
+        return (r, t)
+
+    def _tile3_map(r, t, c, tab, live):
+        return (r, t, 0, 0)
+
+    def _kv_map(r, t, c, tab, live):
+        # chunk c of (row r, tile t) reads pool block table[r, c]; DEAD
+        # chunks (c >= live[r, t]) re-resolve to the last live block —
+        # an unchanged index, so the pipeline skips the fetch
+        j = jnp.minimum(c, jnp.maximum(live[r, t] - 1, 0))
+        return (jnp.maximum(tab[r, j], 0), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(R, T, M),
+        in_specs=[
+            pl.BlockSpec((1, Pt), _tile_map),
+            pl.BlockSpec((1, Pt), _tile_map),
+            pl.BlockSpec((1, Pt, H, hd), _tile3_map),
+            pl.BlockSpec((1, bs, KV, hd), _kv_map),
+            pl.BlockSpec((1, bs, KV, hd), _kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, Pt, H, hd), _tile3_map),
+        scratch_shapes=[
+            pltpu.VMEM((Pt, H, hd), jnp.float32),
+            pltpu.VMEM((Pt, H), jnp.float32),
+            pltpu.VMEM((Pt, H), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_rpa_kernel, bs=bs, scale=1.0 / math.sqrt(hd)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, P, H, hd), q.dtype),
+        interpret=interpret,
+    )(table, live, positions, val, q, k_pool, v_pool)

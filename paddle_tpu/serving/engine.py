@@ -71,7 +71,8 @@ class ServingEngine:
                  start: bool = True, idle_poll_s: float = 0.05,
                  prefix_cache: bool = True,
                  prefill_buckets=None, max_prefill_bucket: int = 512,
-                 fused_prefill: bool = True,
+                 fused_prefill: bool = True, fused_units: int = 1,
+                 attention_impl: str = "auto",
                  warmup: bool = False,
                  clock=time.monotonic):
         # lazy: keep `import paddle_tpu` from pulling the whole nlp tree
@@ -82,7 +83,11 @@ class ServingEngine:
             eos_token_id=eos_token_id, num_blocks=num_blocks, chunk=chunk,
             prefix_cache=prefix_cache, prefill_buckets=prefill_buckets,
             max_prefill_bucket=max_prefill_bucket,
-            fused_prefill=fused_prefill)
+            fused_prefill=fused_prefill, fused_units=fused_units,
+            attention_impl=attention_impl)
+        # the RESOLVED backend ("auto" already collapsed to the concrete
+        # choice at batcher construction) — bench/snapshot surface
+        self.attention_impl = self.batcher.attention_impl
         self.metrics = metrics or MetricsRegistry()
         self._clock = clock
         self._idle_poll_s = idle_poll_s
@@ -134,7 +139,11 @@ class ServingEngine:
         # admission chunks, decode_stall_steps counts standalone
         # prefills that ran while slots were decoding (the ITL cost)
         self._g_fused_steps = m.gauge("fused_steps")
+        self._g_fused_units = m.gauge("fused_unit_count")
         self._g_decode_stalls = m.gauge("decode_stall_steps")
+        # EVERY compiled device-step shape (prefill/fused ladder + the
+        # plain decode chunk) — the zero-post-warmup-recompiles gate
+        self._g_compiles = m.gauge("compile_count")
 
         if warmup:
             self.warmup()
@@ -326,6 +335,7 @@ class ServingEngine:
             snap = self.metrics.snapshot()
             snap["allocator"] = dict(self._alloc_stats)
             snap["prefix_cache"] = dict(self._prefix_stats)
+            snap["attention_impl"] = self.attention_impl
         return snap
 
     # ---- engine thread ---------------------------------------------------
@@ -535,8 +545,10 @@ class ServingEngine:
         self._g_blocks.set(stats["blocks_in_use"])
         self._g_util.set(stats["blocks_in_use"] / stats["capacity_blocks"])
         self._g_prefill_compiles.set(self.batcher.prefill_compile_count)
+        self._g_compiles.set(self.batcher.compile_count)
         self._g_prefill_pad.set(self.batcher.prefill_pad_tokens)
         self._g_fused_steps.set(self.batcher.fused_steps)
+        self._g_fused_units.set(self.batcher.fused_unit_count)
         self._g_decode_stalls.set(self.batcher.decode_stall_steps)
         if pc.get("enabled"):
             self._g_pc_hit_tokens.set(pc["hit_tokens"])

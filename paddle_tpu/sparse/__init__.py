@@ -340,50 +340,62 @@ class _SparseNN:
 # ---------------------------------------------------------------------------
 
 def asin(x):
+    """Elementwise arcsine over the stored values (paddle.sparse.asin)."""
     return _unary(x, jnp.arcsin)
 
 
 def atan(x):
+    """Elementwise arctangent over the stored values (paddle.sparse.atan)."""
     return _unary(x, jnp.arctan)
 
 
 def asinh(x):
+    """Elementwise inverse hyperbolic sine over the stored values."""
     return _unary(x, jnp.arcsinh)
 
 
 def atanh(x):
+    """Elementwise inverse hyperbolic tangent over the stored values."""
     return _unary(x, jnp.arctanh)
 
 
 def sinh(x):
+    """Elementwise hyperbolic sine over the stored values."""
     return _unary(x, jnp.sinh)
 
 
 def expm1(x):
+    """Elementwise exp(x)-1 over the stored values (paddle.sparse.expm1)."""
     return _unary(x, jnp.expm1)
 
 
 def log1p(x):
+    """Elementwise log(1+x) over the stored values (paddle.sparse.log1p)."""
     return _unary(x, jnp.log1p)
 
 
 def square(x):
+    """Elementwise square over the stored values (paddle.sparse.square)."""
     return _unary(x, jnp.square)
 
 
 def deg2rad(x):
+    """Degrees-to-radians over the stored values (paddle.sparse.deg2rad)."""
     return _unary(x, jnp.deg2rad)
 
 
 def rad2deg(x):
+    """Radians-to-degrees over the stored values (paddle.sparse.rad2deg)."""
     return _unary(x, jnp.rad2deg)
 
 
 def coalesce(x):
+    """Sum duplicate indices into one entry per coordinate (COO canonical form)."""
     return x.coalesce()
 
 
 def is_same_shape(x, y):
+    """True when x and y have identical dense shapes (paddle.sparse.is_same_shape)."""
     return list(x.shape) == list(y.shape)
 
 
@@ -435,18 +447,22 @@ def pca_lowrank(*a, **k):
 
 
 def add_coo_coo(x, y):
+    """COO + COO elementwise add — alias of `add` kept for the paddle kernel-named surface."""
     return add(x, y)
 
 
 def add_coo_dense(x, y):
+    """COO + dense elementwise add — alias of `add` kept for the paddle kernel-named surface."""
     return add(x, y)
 
 
 def matmul_coo_dense(x, y):
+    """COO x dense matmul — alias of `matmul` kept for the paddle kernel-named surface."""
     return matmul(x, y)
 
 
 def matmul_csr_dense(x, y):
+    """CSR x dense matmul — alias of `matmul` kept for the paddle kernel-named surface."""
     return matmul(x, y)
 
 

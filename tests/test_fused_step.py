@@ -313,11 +313,76 @@ class TestFusedCompileAccounting:
                       prefix_cache=True, fused_prefill=True)
         warmed = cb.warmup_prefill()
         # standalone ladder x groups {1,2} x {cold,cached} + fused
-        assert warmed == 2 * 2 * 2 + 2 * 2
-        c0 = cb.prefill_compile_count
+        # row-counts x ladder + the standalone-decode chunk. Fused
+        # rows: only REACHABLE counts warm — at max_batch=2 a fused
+        # step needs 1 active slot, leaving 1 for pending records, so
+        # only the single-record unit shape (rows=1) can ever run
+        assert warmed == 2 * 2 * 2 + 2 * 1 + 1
+        c0 = cb.compile_count
         a, b, long_p = _prompts(84, (5, 7, 19))
         _mid_decode_schedule(cb, a, [b, long_p])
         cb.submit(a)                          # warm repeat (cache hit)
         cb.run()
         assert cb.fused_steps > 0
-        assert cb.prefill_compile_count == c0  # NEVER recompiled
+        assert cb.compile_count == c0          # NEVER recompiled
+
+    def test_decode_only_stretch_after_fused_is_warm(self, setup):
+        """The warmup bugfix: the plain decode chunk is AOT-warmed with
+        the ladder, so a decode-only stretch AFTER a fused stretch (all
+        of whose steps ran the fused executable) compiles nothing. The
+        flatness gate is `compile_count` — `prefill_compile_count`
+        never saw the chunk fn, which is exactly how the lazy compile
+        used to slip through."""
+        cfg, params = setup
+        cb = _batcher(params, cfg, max_batch=2, prefill_buckets=(8,),
+                      fused_prefill=True)
+        cb.warmup_prefill()
+        c0 = cb.compile_count
+        assert len(cb._chunk_cache) == 1      # the chunk warmed too
+        a, b = _prompts(85, (5, 7))
+        # fused stretch: b lands while a decodes -> every device call so
+        # far is either a standalone prefill or the FUSED executable
+        cb.submit(a)
+        cb.step()
+        cb.submit(b)
+        cb.step()
+        assert cb.fused_steps >= 1
+        # decode-only stretch: nothing pending, plain chunk steps
+        while any(cb.active):
+            cb.step()
+        assert cb.compile_count == c0
+
+    def test_multi_unit_piggyback_drains_burst(self, setup):
+        """fused_units=2: one fused call carries TWO pending units — a
+        chunked long prompt's current chunk AND the short admission
+        behind it (same bucket; consecutive single-chunk records merge
+        into one group unit, so a chunked record is what makes two
+        units co-pend) — with fused_unit_count > fused_steps and tokens
+        identical to the single-unit schedule."""
+        cfg, params = setup
+        first, b, c = _prompts(86, (5, 19, 6))
+
+        outs = []
+        for units in (1, 2):
+            cb = _batcher(params, cfg, max_batch=3, prefill_buckets=(8,),
+                          fused_prefill=True, fused_units=units)
+            cb.warmup_prefill()
+            c0 = cb.compile_count
+            rids = [cb.submit(first)]
+            cb.step()
+            # burst of two admissions while `first` decodes
+            rids += [cb.submit(b), cb.submit(c)]
+            out = cb.run()
+            assert cb.compile_count == c0      # multi-unit shapes warmed
+            assert cb.alloc.stats()["blocks_in_use"] == 0
+            if units == 2:
+                assert cb.fused_unit_count > cb.fused_steps
+            else:
+                assert cb.fused_unit_count == cb.fused_steps
+            outs.append([out[r] for r in rids])
+        assert outs[0] == outs[1]
+
+    def test_fused_units_validation(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError):
+            _batcher(params, cfg, fused_units=0)

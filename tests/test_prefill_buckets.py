@@ -231,20 +231,23 @@ class TestCompileAccounting:
         cb = _batcher(params, cfg, max_batch=2, prefix_cache=True)
         warmed = cb.warmup_prefill()
         # standalone: ladder (8,16,32) x groups {1,2} x {cold, cached};
-        # fused decode+prefill: ladder x groups (phase-free — prefill
-        # rows always ride the per-query-causal paged path)
-        assert warmed == 3 * 2 * 2 + 3 * 2
-        # fusion off: only the standalone ladder is warmed
+        # fused decode+prefill: ladder x REACHABLE row counts (phase-
+        # free — prefill rows always ride the per-query-causal paged
+        # path): at max_batch=2 a fused step needs 1 active slot,
+        # leaving 1 for pending records, so only rows=1 can ever run;
+        # plus the standalone-decode chunk executable
+        assert warmed == 3 * 2 * 2 + 3 * 1 + 1
+        # fusion off: the standalone ladder + the decode chunk
         off = _batcher(params, cfg, max_batch=2, fused_prefill=False)
-        assert off.warmup_prefill() == 3 * 2 * 2
-        c0 = cb.prefill_compile_count
+        assert off.warmup_prefill() == 3 * 2 * 2 + 1
+        c0 = cb.compile_count
         for p in _prompts(44, (3, 9, 17, 4, 10, 3)):  # span the ladder
             cb.submit(p)
         cb.run()
         for p in _prompts(44, (3, 9, 17)):            # warm repeats (hits)
             cb.submit(p)
         cb.run()
-        assert cb.prefill_compile_count == c0         # NEVER recompiled
+        assert cb.compile_count == c0                 # NEVER recompiled
 
     def test_unbucketed_compiles_per_length(self, setup):
         """The pre-bucketing behavior, kept reachable for comparison:

@@ -1,0 +1,338 @@
+"""Pallas ragged paged-attention (PR 6): the kernel that walks only
+each request's LIVE block chain, pinned against the XLA gather path.
+
+Two layers:
+
+  * kernel parity — `ragged_paged_attention` matches
+    `_paged_gqa_attention` (the XLA reference) on every ragged shape
+    the serving path produces: single-token decode rows, bucketed
+    cached-prefix prefill rows, the fused mixed decode+prefill batch,
+    and the edge cases (exactly-one-block chains, length == block_size
+    boundaries, single-slot batches, fully padded batches, chains
+    sharing prefix blocks with a COW-cloned tail). CPU runs the kernel
+    in Pallas interpret mode — the CI parity path.
+  * end-to-end parity — `ContinuousBatcher(attention_impl="pallas")`
+    emits token-identical greedy output to the XLA backend across
+    decode, chunked prefill, fused admission-during-decode, and
+    prefix-cache COW-hit schedules, and `attention_impl="xla"` IS the
+    pre-switch code path (the reference stays the fallback).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nlp import llama, paged
+from paddle_tpu.nlp.ragged_attention import (ragged_paged_attention,
+                                             resolve_attention_impl)
+
+
+def _pools(seed, N, bs, KV, hd):
+    rng = np.random.RandomState(seed)
+    kp = jnp.asarray(rng.randn(N, bs, KV, hd), jnp.float32)
+    vp = jnp.asarray(rng.randn(N, bs, KV, hd), jnp.float32)
+    return rng, kp, vp
+
+
+def _chains(rng, lengths, M, bs, N):
+    """Distinct live block chains per row, padded table rows -> 0."""
+    table = np.zeros((len(lengths), M), np.int32)
+    free = list(rng.permutation(np.arange(1, N)))
+    for r, L in enumerate(lengths):
+        need = -(-L // bs) if L else 0
+        for j in range(need):
+            table[r, j] = free.pop()
+    return jnp.asarray(table)
+
+
+def _suffix_qpv(rng, lengths, P, M, bs):
+    """Suffix-prefill style positions/valid: row r's P queries end at
+    position lengths[r]-1 (rows shorter than P left-pad as invalid)."""
+    R = len(lengths)
+    pos = np.zeros((R, P), np.int32)
+    val = np.zeros((R, P), np.bool_)
+    maxpos = M * bs - 1
+    for r, L in enumerate(lengths):
+        for p in range(P):
+            j = L - P + p
+            pos[r, p] = min(max(j, 0), maxpos)
+            val[r, p] = 0 <= j
+    return jnp.asarray(pos), jnp.asarray(val)
+
+
+def _assert_parity(q, kp, vp, table, pos, val, tol=2e-5):
+    """pallas == xla on valid rows; pallas == 0 on padded rows."""
+    ref = paged._paged_gqa_attention(q, kp, vp, table, pos)
+    ref = np.where(np.asarray(val)[:, :, None, None], np.asarray(ref), 0.0)
+    out = np.asarray(ragged_paged_attention(q, kp, vp, table, pos, val))
+    np.testing.assert_allclose(out, ref, atol=tol, rtol=tol)
+
+
+class TestKernelParity:
+    N, bs, KV, hd, H, M = 12, 4, 2, 8, 4, 5
+
+    def _q(self, rng, R, P):
+        return jnp.asarray(rng.randn(R, P, self.H, self.hd), jnp.float32)
+
+    def test_decode_rows(self):
+        """P=1 decode rows at heterogeneous live lengths — the shape
+        every steady-state decode step produces."""
+        rng, kp, vp = _pools(0, self.N, self.bs, self.KV, self.hd)
+        lengths = [1, 6, 17, 9]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        pos, val = _suffix_qpv(rng, lengths, 1, self.M, self.bs)
+        _assert_parity(self._q(rng, 4, 1), kp, vp, table, pos, val)
+
+    def test_bucketed_prefill_rows(self):
+        """P=8 bucket-padded suffix rows (cached-prefix prefill): the
+        invalid left-pad must not contaminate the real queries."""
+        rng, kp, vp = _pools(1, self.N, self.bs, self.KV, self.hd)
+        lengths = [3, 11, 19]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        pos, val = _suffix_qpv(rng, lengths, 8, self.M, self.bs)
+        _assert_parity(self._q(rng, 3, 8), kp, vp, table, pos, val)
+
+    def test_fused_mixed_batch(self):
+        """The PR 5 fused shape: B decode rows (column 0 valid at the
+        slot's position, inactive rows fully masked) stacked on top of
+        bucket-width prefill rows — one kernel call serves both."""
+        rng, kp, vp = _pools(2, self.N, self.bs, self.KV, self.hd)
+        P = 4
+        dlen, plen = [7, 13, 0], [P, 2 * P + 1]     # slot 2 inactive
+        table = _chains(rng, dlen + plen, self.M, self.bs, self.N)
+        dpos = np.zeros((3, P), np.int32)
+        dval = np.zeros((3, P), np.bool_)
+        maxpos = self.M * self.bs - 1
+        for r, L in enumerate(dlen):
+            dpos[r] = np.minimum(np.arange(L, L + P), maxpos)
+            dval[r, 0] = L > 0
+        ppos, pval = _suffix_qpv(rng, plen, P, self.M, self.bs)
+        pos = jnp.concatenate([jnp.asarray(dpos), ppos], 0)
+        val = jnp.concatenate([jnp.asarray(dval), pval], 0)
+        _assert_parity(self._q(rng, 5, P), kp, vp, table, pos, val)
+
+    def test_exactly_one_block(self):
+        """A request whose whole live chain is ONE pool block."""
+        rng, kp, vp = _pools(3, self.N, self.bs, self.KV, self.hd)
+        lengths = [2, self.bs - 1]                   # both within block 0
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        pos, val = _suffix_qpv(rng, lengths, 2, self.M, self.bs)
+        _assert_parity(self._q(rng, 2, 2), kp, vp, table, pos, val)
+
+    def test_block_size_boundary(self):
+        """length == block_size exactly: the chain walk must include
+        the boundary block's last key and must NOT step into the next
+        (garbage) table entry."""
+        rng, kp, vp = _pools(4, self.N, self.bs, self.KV, self.hd)
+        lengths = [self.bs, 2 * self.bs, self.bs + 1]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        pos, val = _suffix_qpv(rng, lengths, 1, self.M, self.bs)
+        _assert_parity(self._q(rng, 3, 1), kp, vp, table, pos, val)
+
+    def test_single_slot_batch(self):
+        """R=1 — the one-request grid still initializes, accumulates
+        and finalizes correctly."""
+        rng, kp, vp = _pools(5, self.N, self.bs, self.KV, self.hd)
+        lengths = [10]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        pos, val = _suffix_qpv(rng, lengths, 3, self.M, self.bs)
+        _assert_parity(self._q(rng, 1, 3), kp, vp, table, pos, val)
+
+    def test_all_padded_batch(self):
+        """Every query invalid (empty batch of padded slots): the
+        kernel emits exact zeros and touches no live chain at all."""
+        rng, kp, vp = _pools(6, self.N, self.bs, self.KV, self.hd)
+        R, P = 3, 2
+        q = self._q(rng, R, P)
+        table = jnp.zeros((R, self.M), jnp.int32)
+        pos = jnp.zeros((R, P), jnp.int32)
+        val = jnp.zeros((R, P), bool)
+        out = np.asarray(ragged_paged_attention(q, kp, vp, table, pos, val))
+        assert (out == 0.0).all()
+
+    def test_cow_cloned_chain(self):
+        """Two chains share prefix blocks; the second's tail block is a
+        COW clone (identical KV content under a different block id) —
+        the prefix-cache hit shape. Rows must agree with the reference
+        AND with each other where their visible keys coincide."""
+        rng, kp, vp = _pools(7, self.N, self.bs, self.KV, self.hd)
+        L = 2 * self.bs + 2
+        table = np.zeros((2, self.M), np.int32)
+        table[0, :3] = [3, 7, 5]
+        table[1, :3] = [3, 7, 9]                     # 9 := clone of 5
+        kp = kp.at[9].set(kp[5])
+        vp = vp.at[9].set(vp[5])
+        pos, val = _suffix_qpv(rng, [L, L], 2, self.M, self.bs)
+        q = self._q(rng, 1, 2)
+        q = jnp.concatenate([q, q], 0)               # identical queries
+        _assert_parity(q, kp, vp, jnp.asarray(table), pos, val)
+        out = np.asarray(ragged_paged_attention(
+            q, kp, vp, jnp.asarray(table), pos, val))
+        np.testing.assert_allclose(out[0], out[1], atol=2e-6)
+
+    def test_query_tiling_parity(self):
+        """q_tile < P: the grid grows a query-tile dimension (VMEM
+        bound for wide prefill buckets) and each tile walks only ITS
+        OWN visible chain prefix — output identical to untiled."""
+        rng, kp, vp = _pools(9, self.N, self.bs, self.KV, self.hd)
+        lengths = [3, 11, 19]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        pos, val = _suffix_qpv(rng, lengths, 8, self.M, self.bs)
+        q = self._q(rng, 3, 8)
+        ref = paged._paged_gqa_attention(q, kp, vp, table, pos)
+        ref = np.where(np.asarray(val)[:, :, None, None],
+                       np.asarray(ref), 0.0)
+        for tile in (2, 4):                          # 4 and 2 tiles
+            out = np.asarray(ragged_paged_attention(
+                q, kp, vp, table, pos, val, q_tile=tile))
+            np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_query_tiling_indivisible_falls_back(self):
+        """P % q_tile != 0 (exact unbucketed shapes): the largest
+        divisor of P that fits becomes the tile — here Pt=1, the
+        worst case (P=5 prime, q_tile=3) — same result."""
+        rng, kp, vp = _pools(10, self.N, self.bs, self.KV, self.hd)
+        lengths = [9, 14]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        pos, val = _suffix_qpv(rng, lengths, 5, self.M, self.bs)
+        q = self._q(rng, 2, 5)
+        out = np.asarray(ragged_paged_attention(
+            q, kp, vp, table, pos, val, q_tile=3))
+        ref = np.asarray(ragged_paged_attention(q, kp, vp, table, pos, val))
+        np.testing.assert_allclose(out, ref, atol=2e-6)
+
+    def test_query_dtype_roundtrip(self):
+        """Output lands in q's dtype (the pool may be wider)."""
+        rng, kp, vp = _pools(8, self.N, self.bs, self.KV, self.hd)
+        lengths = [5]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        pos, val = _suffix_qpv(rng, lengths, 1, self.M, self.bs)
+        q = self._q(rng, 1, 1).astype(jnp.bfloat16)
+        out = ragged_paged_attention(q, kp, vp, table, pos, val)
+        assert out.dtype == jnp.bfloat16
+
+
+class TestResolveImpl:
+    def test_auto_resolves_off_tpu(self):
+        """CPU CI: auto means the XLA reference (pallas off-TPU is
+        interpret mode — a testing path, not a serving path)."""
+        expect = "pallas" if jax.default_backend() == "tpu" else "xla"
+        assert resolve_attention_impl("auto") == expect
+
+    def test_passthrough_and_reject(self):
+        assert resolve_attention_impl("pallas") == "pallas"
+        assert resolve_attention_impl("xla") == "xla"
+        with pytest.raises(ValueError):
+            resolve_attention_impl("cuda")
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.LlamaConfig.tiny(use_flash=False, num_hidden_layers=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _batcher(params, cfg, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_total_len", 32)
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("chunk", 2)
+    return paged.ContinuousBatcher(params, cfg, **kw)
+
+
+def _prompts(seed, lengths):
+    rng = np.random.RandomState(seed)
+    return [list(map(int, rng.randint(1, 200, n))) for n in lengths]
+
+
+def _run_both(params, cfg, schedule, **kw):
+    outs = []
+    for impl in ("xla", "pallas"):
+        cb = _batcher(params, cfg, attention_impl=impl, **kw)
+        outs.append(schedule(cb))
+    return outs
+
+
+class TestBatcherParity:
+    """pallas == xla greedy tokens through the real serving paths."""
+
+    def test_decode_parity(self, setup):
+        cfg, params = setup
+        prompts = _prompts(11, (5, 9, 3))
+
+        def schedule(cb):
+            rids = [cb.submit(p) for p in prompts]
+            out = cb.run()
+            return [out[r] for r in rids]
+
+        a, b = _run_both(params, cfg, schedule, prefill_buckets=(8,))
+        assert a == b
+
+    def test_fused_mid_decode_parity(self, setup):
+        """Admissions landing mid-decode take the fused mixed batch —
+        the kernel's hardest shape — with identical tokens."""
+        cfg, params = setup
+        first, late = _prompts(12, (6, 7))
+
+        def schedule(cb):
+            rids = [cb.submit(first)]
+            cb.step()
+            rids.append(cb.submit(late))
+            out = cb.run()
+            assert cb.fused_steps >= 1
+            return [out[r] for r in rids]
+
+        a, b = _run_both(params, cfg, schedule, prefill_buckets=(8,))
+        assert a == b
+
+    def test_chunked_prefill_parity(self, setup):
+        """A prompt past the largest bucket streams bucket-sized chunks
+        through the ragged path."""
+        cfg, params = setup
+        (long,) = _prompts(13, (19,))
+
+        def schedule(cb):
+            rid = cb.submit(long)
+            return cb.run()[rid]
+
+        a, b = _run_both(params, cfg, schedule, prefill_buckets=(8,))
+        assert a == b
+
+    def test_cow_prefix_hit_parity(self, setup):
+        """Same prompt twice with the prefix cache on: the second
+        admission COW-clones the cached tail block — chains built from
+        shared + cloned blocks must decode identically."""
+        cfg, params = setup
+        (p,) = _prompts(14, (9,))
+
+        def schedule(cb):
+            r1 = cb.submit(p)
+            cb.run()
+            r2 = cb.submit(list(p))
+            out = cb.run()
+            stats = cb.prefix_stats()
+            assert stats["hits"] >= 1
+            return out[r2]
+
+        a, b = _run_both(params, cfg, schedule, prefix_cache=True,
+                         prefill_buckets=(8,))
+        assert a == b
+
+    def test_xla_is_default_off_tpu(self, setup):
+        cfg, params = setup
+        cb = _batcher(params, cfg)           # attention_impl="auto"
+        if jax.default_backend() != "tpu":
+            assert cb.attention_impl == "xla"
+
+    def test_compile_memo_keys_on_impl(self, setup):
+        """Every compiled-shape memo keys on the resolved impl, so a
+        pallas batcher never aliases an xla executable."""
+        cfg, params = setup
+        cb = _batcher(params, cfg, attention_impl="pallas",
+                      prefill_buckets=(8,))
+        cb.warmup_prefill()
+        keys = (list(cb._prefill_cache) + list(cb._fused_cache)
+                + list(cb._chunk_cache))
+        assert keys and all(k[-1] == "pallas" for k in keys)

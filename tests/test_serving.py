@@ -337,12 +337,12 @@ class TestServingPrefixCache:
     def test_warmup_precompiles_and_refuses_after_start(self, setup):
         eng = self._engine(setup, start=False)
         warmed = eng.warmup()
-        assert warmed == eng.batcher.prefill_compile_count > 0
+        assert warmed == eng.batcher.compile_count > 0
         eng.start()
         with pytest.raises(RuntimeError, match="before start"):
             eng.warmup()
         out = eng.generate(PROMPT_A, timeout=300)
-        assert eng.batcher.prefill_compile_count == warmed  # no retrace
+        assert eng.batcher.compile_count == warmed  # no retrace
         eng.shutdown()
         cfg, params = setup
         assert out == _paged_single(params, cfg, PROMPT_A)
