@@ -22,16 +22,24 @@ test:           ## tier-1 test suite (CPU)
 # fused-vs-unfused comparison; the bucketed leg FAILS on any prefill
 # recompile after warmup, and the fused leg FAILS unless piggybacked
 # admission stalls decode strictly less than the standalone baseline
-# (both deterministic schedule/shape accounting, not timing). The last
-# leg forces the Pallas ragged kernel through the served path in
+# (both deterministic schedule/shape accounting, not timing). The
+# pallas leg forces the ragged kernel through the served path in
 # interpret mode (the CPU parity configuration — tests/
-# test_ragged_attention.py is the full parity suite, run by `make test`)
+# test_ragged_attention.py is the full parity suite, run by `make test`).
+# Observability legs: the prefix-share run writes its per-request trace
+# timelines to /tmp/paddle_tpu_trace.json (Perfetto-loadable;
+# trace_report.py summarizes it as a non-blocking artifact), and the
+# tracing-overhead leg FAILS unless traced tok/s >= 0.97x untraced with
+# zero post-warmup recompiles (the always-on-cheap gate).
 bench-smoke:    ## tiny serving benches (non-blocking CI job)
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --prefix-share \
-		--n-requests 6 --max-new 4
+		--n-requests 6 --max-new 4 --trace /tmp/paddle_tpu_trace.json
+	$(PY) tools/trace_report.py /tmp/paddle_tpu_trace.json
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --bucketed \
 		--n-requests 8 --max-new 4
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --fused \
 		--n-requests 8 --max-new 6 --fused-units 2
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py \
 		--attention-impl pallas --n-requests 4 --max-new 4
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --trace-overhead \
+		--n-requests 8 --max-new 6

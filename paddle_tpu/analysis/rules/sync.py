@@ -30,10 +30,16 @@ from .trace import find_traced_functions
 HOT_PATHS: Tuple[Tuple[str, str], ...] = (
     ("nlp/paged.py",
      r"^(step|run|_step_fused|_prefill_pending|_run_standalone_unit"
-     r"|_paged_gqa_attention|forward_paged)$"),
+     r"|_paged_gqa_attention|forward_paged"
+     r"|_trace_emit|_trace_chunks|_record_tick)$"),
     ("nlp/ragged_attention.py",
      r"^(ragged_paged_attention|_rpa_kernel|resolve_attention_impl)$"),
     ("serving/engine.py", r"^(_loop|_dispatch|step)$"),
+    # trace emission helpers run once per scheduler tick / dispatched
+    # token batch with tracing always on — a device sync hiding in an
+    # event attr would tax EVERY step, so they are hot paths too
+    ("serving/trace.py",
+     r"^(emit|finish|start|alias|span|now|record)$"),
 )
 
 HOST_COPY_CALLS = {

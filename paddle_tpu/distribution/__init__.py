@@ -70,6 +70,12 @@ def _shape(sample_shape, batch_shape, event_shape=()):
 
 
 class Distribution:
+    """Base class of the distribution zoo (paddle.distribution.
+    Distribution parity): carries batch/event shapes and the
+    sample/rsample/log_prob/entropy/kl contract the subclasses fill
+    in; densities route through the eager op dispatch so Tensor
+    parameters stay on the autograd tape."""
+
     def __init__(self, batch_shape=(), event_shape=()):
         self._batch_shape = tuple(int(s) for s in batch_shape)
         self._event_shape = tuple(int(s) for s in event_shape)
@@ -110,6 +116,10 @@ class Distribution:
 
 
 class Normal(Distribution):
+    """Gaussian N(loc, scale): reparameterized rsample (pathwise
+    gradients for policy-gradient / VAE training), closed-form
+    log_prob/entropy/kl vs another Normal."""
+
     def __init__(self, loc, scale, name=None):
         self.loc = _param(loc)
         self.scale = _param(scale)
@@ -155,6 +165,10 @@ class Normal(Distribution):
 
 
 class Uniform(Distribution):
+    """Continuous uniform on [low, high): affine-reparameterized
+    sampling, log_prob -inf outside the support, closed-form
+    entropy."""
+
     def __init__(self, low, high, name=None):
         self.low = _param(low)
         self.high = _param(high)
@@ -189,6 +203,9 @@ class Uniform(Distribution):
 
 
 class Bernoulli(Distribution):
+    """Bernoulli(probs) over {0, 1}: binary-cross-entropy log_prob on
+    the autograd tape, mean/variance/entropy in closed form."""
+
     def __init__(self, probs, name=None):
         self.probs = _param(probs)
         super().__init__(jnp.shape(_raw(probs)))
@@ -221,6 +238,10 @@ class Bernoulli(Distribution):
 
 
 class Categorical(Distribution):
+    """Categorical over the last axis, parameterized by `logits` OR
+    `probs` (log-softmax normalized either way, so log_prob gradients
+    flow to whichever parameterization was given)."""
+
     def __init__(self, logits=None, probs=None, name=None):
         if logits is not None:
             self._logits = _param(logits)
@@ -264,6 +285,9 @@ class Categorical(Distribution):
 
 
 class Beta(Distribution):
+    """Beta(alpha, beta) on (0, 1): sampled via two Gammas,
+    log-Beta-function densities through jax.scipy.special."""
+
     def __init__(self, alpha, beta, name=None):
         self.alpha = _param(alpha)
         self.beta = _param(beta)
@@ -354,6 +378,9 @@ class Dirichlet(Distribution):
 
 
 class Exponential(Distribution):
+    """Exponential(rate) on [0, inf): inverse-CDF reparameterized
+    sampling, closed-form mean/variance/entropy."""
+
     def __init__(self, rate, name=None):
         self.rate = _param(rate)
         super().__init__(jnp.shape(_raw(rate)))
@@ -749,6 +776,10 @@ class TanhTransform(Transform):
 
 
 class TransformedDistribution(Distribution):
+    """Pushforward of `base` through a chain of bijective Transforms:
+    sample() maps forward, log_prob() inverts the chain and subtracts
+    each transform's forward log-det-Jacobian."""
+
     def __init__(self, base, transforms: Sequence[Transform]):
         self.base = base
         self.transforms = list(transforms)
@@ -786,6 +817,9 @@ _KL_REGISTRY = {}
 
 
 def register_kl(type_p, type_q):
+    """Decorator registering a closed-form KL(p || q) implementation
+    for a (type_p, type_q) distribution pair; `kl_divergence` resolves
+    through this registry (paddle.distribution.register_kl parity)."""
     def deco(fn):
         _KL_REGISTRY[(type_p, type_q)] = fn
         return fn
@@ -793,6 +827,9 @@ def register_kl(type_p, type_q):
 
 
 def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    """KL(p || q) via the `register_kl` registry (closed forms for the
+    registered pairs; raises NotImplementedError for unregistered
+    combinations rather than silently estimating)."""
     for (tp, tq), fn in _KL_REGISTRY.items():
         if isinstance(p, tp) and isinstance(q, tq):
             return fn(p, q)

@@ -34,6 +34,7 @@ backend).
 from __future__ import annotations
 
 import math
+import time
 from collections import OrderedDict
 from typing import (TYPE_CHECKING, Any, Callable, Dict, List, NamedTuple,
                     Optional, Sequence, Tuple)
@@ -560,6 +561,17 @@ class ContinuousBatcher:
     per backend at construction. Every compiled-shape memo keys on the
     resolved impl.
 
+    Observability (`trace=`, `flight_recorder_cap=`): an optional
+    `serving.trace.TraceSink` collects per-request timelines (prepared
+    / prefill_chunk / retired events carrying bucket, pad,
+    cached-token and fused-vs-standalone annotations, keyed by rid);
+    the always-on `flight` FlightRecorder keeps a bounded ring of one
+    record per step tick — mode chosen, unit composition, bucket /
+    group pad, free slots / blocks, compile-memo hit or miss —
+    written BEFORE the device call so a failing step is the ring's
+    last record. Both are host-side bookkeeping only: no device
+    syncs, and the compiled-shape memo keys never see them.
+
     Usage:
         cb = ContinuousBatcher(params, cfg, max_batch=2, block_size=16,
                                max_total_len=256, max_new_tokens=16)
@@ -576,7 +588,8 @@ class ContinuousBatcher:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  max_prefill_bucket: int = 512,
                  fused_prefill: bool = True, fused_units: int = 1,
-                 attention_impl: str = "auto"):
+                 attention_impl: str = "auto",
+                 trace=None, flight_recorder_cap: int = 64):
         self.params, self.cfg = params, cfg
         self.B, self.bs = max_batch, block_size
         # resolved once: every traced fn closes over the concrete
@@ -636,6 +649,28 @@ class ContinuousBatcher:
         # observed real chunk lengths (len -> count): the data a
         # workload-specific bucket ladder is fitted from (bucket_tuner)
         self.prefill_suffix_hist: Dict[int, int] = {}
+        # observability: `trace` is an optional serving.trace.TraceSink
+        # (per-request timelines — prefill chunk / retire events emit
+        # through it, keyed by rid); the flight recorder is ALWAYS on —
+        # one bounded host-side record per step tick, written BEFORE
+        # the device call so a failing tick is the last record in the
+        # ring. Imported lazily like the prefix cache: trace.py is
+        # dependency-free but lives in serving/, and nlp must not pull
+        # the serving package eagerly.
+        from ..serving.trace import FlightRecorder, TraceSink
+        if trace is True:
+            # mirror the engine's bool API: True means "a default sink"
+            trace = TraceSink()
+        elif trace is False:
+            trace = None
+        elif trace is not None and not hasattr(trace, "emit"):
+            # reject now, not as an AttributeError mid-step that would
+            # surface as a device failure and abort in-flight requests
+            raise TypeError(
+                f"trace must be a serving.trace.TraceSink, True/False, "
+                f"or None — got {type(trace).__name__}")
+        self._trace = trace
+        self.flight = FlightRecorder(cap=flight_recorder_cap)
         nb = num_blocks or (max_batch * self.M)
         if prefix_cache:
             # vLLM-style automatic prefix caching: a trie over full-block
@@ -885,6 +920,42 @@ class ContinuousBatcher:
                 jnp.asarray(self.budget, jnp.int32),
                 jnp.asarray(self.stop, jnp.int32))
 
+    # -- observability (host-side bookkeeping ONLY: no device values,
+    #    no syncs — SYNC001's HOT_PATHS covers these helpers) -------------
+    def _trace_emit(self, rid: int, kind: str, dur=None, **attrs) -> None:
+        """Emit one per-request trace event (no-op without a sink).
+        Every attr must already be a plain host value — a jax array
+        here would be a hidden device sync on the hot path."""
+        if self._trace is not None:
+            self._trace.emit(rid, kind, dur=dur, **attrs)
+
+    def _trace_chunks(self, items, bucket: int, fused: bool,
+                      dur: float) -> None:
+        """Emit one prefill_chunk event per packed row: which suffix
+        span ran, at which bucket (and what padding that cost), fused
+        onto the decode chunk or standalone, cold or continuing — and,
+        on the FIRST chunk, how many prompt tokens the prefix cache
+        skipped (the cached-prefix skip the timeline makes visible)."""
+        if self._trace is None:
+            return
+        for rec, start, end in items:
+            self._trace.emit(
+                rec.rid, "prefill_chunk", dur=dur, slot=rec.slot,
+                start=start, end=end, bucket=bucket,
+                pad=bucket - (end - start), fused=fused, cold=start == 0,
+                cached_tokens=rec.cached_len if start == rec.cached_len
+                else 0)
+
+    def _record_tick(self, mode: str, **fields) -> None:
+        """Append one flight-recorder record for this step tick: the
+        scheduler's decision plus pool/queue state, recorded BEFORE the
+        device call so the tick that raises is the ring's last record."""
+        self.flight.record(
+            mode, active_slots=sum(self.active),
+            queue_depth=len(self.queue), pending=len(self._pending),
+            free_slots=self.free_slots(),
+            free_blocks=self.alloc.free_blocks, **fields)
+
     # -- bucketed / chunked / batched prefill -----------------------------
     def _bucket_for(self, S: int) -> int:
         """Smallest ladder bucket that fits a suffix of S tokens; with
@@ -1061,9 +1132,13 @@ class ContinuousBatcher:
                 owned = matched + fresh
                 inserted = self._pcache.insert(toks[:n_full * self.bs],
                                                owned[:n_full])
+        chunks = self._suffix_chunks(cached_len, P)
+        self._trace_emit(rid, "prepared", slot=slot, prompt_len=P,
+                         cached_tokens=cached_len,
+                         cow=cow_src is not None, blocks=need,
+                         chunks=len(chunks))
         return _Admission(slot, rid, list(toks), stop, mn, need, matched,
-                          cached_len, cow_src, fresh, inserted,
-                          self._suffix_chunks(cached_len, P))
+                          cached_len, cow_src, fresh, inserted, chunks)
 
     def _rollback(self, recs: Sequence[_Admission]) -> None:
         """Undo prepared-but-uncommitted admissions after a failed
@@ -1249,6 +1324,14 @@ class ContinuousBatcher:
         executes when the decode set is empty (nothing to stall) or
         fusion is off (`decode_stall_steps` then counts the cost)."""
         entries, items, bucket, cold, final = self._pop_unit()
+        Gp = self._group_pad(len(items))
+        self._record_tick(
+            "prefill", rids=[r.rid for r, _, _ in items], bucket=bucket,
+            group_pad=Gp, cold=cold, final=final,
+            stalls_decode=any(self.active),
+            compile_hit=(Gp, bucket, cold,
+                         self.attention_impl) in self._prefill_cache)
+        t0 = time.perf_counter()
         self._apply_cow([e[0] for e in entries if e[1] == 0])
         logits, li = self._prefill_call(items, bucket, cold)
         if final:
@@ -1260,6 +1343,8 @@ class ContinuousBatcher:
             self._finish_unit(entries, last)
         else:
             entries[0][1] += 1
+        self._trace_chunks(items, bucket, fused=False,
+                           dur=time.perf_counter() - t0)
 
     def _fail_pending(self) -> None:
         """A failed prefill/fused call must not leak blocks: every
@@ -1350,13 +1435,20 @@ class ContinuousBatcher:
         (host copy)."""
         try:
             groups, bucket = self._pop_fused_units()
-            self._apply_cow([e[0] for entries, _, _ in groups
-                             for e in entries if e[1] == 0])
             # every selected unit pads to the SAME group size so the
             # call's shape is (units x Gp, bucket) — drawn from the
             # finite warmed ladder whatever mix of units rides
             Gp = max(self._group_pad(len(items))
                      for _, items, _ in groups)
+            self._record_tick(
+                "fused", units=[[r.rid for r, _, _ in items]
+                                for _, items, _ in groups],
+                bucket=bucket, group_pad=Gp, rows=len(groups) * Gp,
+                compile_hit=(len(groups) * Gp, bucket,
+                             self.attention_impl) in self._fused_cache)
+            t0 = time.perf_counter()
+            self._apply_cow([e[0] for entries, _, _ in groups
+                             for e in entries if e[1] == 0])
             packs = [self._pack_prefill_rows(items, bucket, Gp)
                      for _, items, _ in groups]
             rows, pos, val, tab, li = (
@@ -1386,6 +1478,7 @@ class ContinuousBatcher:
         self._dev_state = (active, budget, stop)
         self.fused_steps += 1
         self.fused_unit_count += len(groups)
+        fused_dur = time.perf_counter() - t0
         # commit IN ORDER: group g's real rows sit at [g*Gp, g*Gp+|items|)
         # of the concatenated prefill batch, so pfirst slices per group
         for g, (entries, items, final) in enumerate(groups):
@@ -1394,11 +1487,14 @@ class ContinuousBatcher:
                                   pfirst[g * Gp:g * Gp + len(items)])
             else:
                 entries[0][1] += 1
+            self._trace_chunks(items, bucket, fused=True, dur=fused_dur)
         return toks
 
     def _retire(self, slot: int) -> None:
         rid = self.slot_req[slot]
         blocks = self.slot_blocks[slot]
+        self._trace_emit(rid, "retired", slot=slot,
+                         generated=len(self.outputs.get(rid, [])))
         if self._pcache is not None:
             # register the finished sequence's FULL blocks (prompt +
             # generated) before releasing: at refcount 0 they park on
@@ -1658,6 +1754,10 @@ class ContinuousBatcher:
             if self._fuse_now():
                 toks = self._step_fused()
             else:
+                self._record_tick(
+                    "decode",
+                    compile_hit=(self.chunk, self.attention_impl)
+                    in self._chunk_cache)
                 if self._dev_state is None:
                     self._dev_state = self._upload_slot_state()
                 active, budget, stop = self._dev_state

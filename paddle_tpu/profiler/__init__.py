@@ -187,6 +187,27 @@ class RecordEvent:
 
 
 def load_profiler_result(filename: str):
+    """Load a serving trace artifact back in-process.
+
+    The serving stack's Chrome-trace JSON (`serving.trace.TraceSink.
+    to_chrome_trace()`, written by `bench_serving.py --trace`) uses the
+    same host clock as the `MetricsRegistry.timer` RecordEvent spans,
+    so its timelines correlate with a concurrent jax-profiler capture.
+    This loader returns that artifact as the parsed dict (inspect
+    ``result["traceEvents"]`` or feed it to tools/trace_report.py).
+    XPlane device traces are still read by TensorBoard/xprof, not
+    reloaded here."""
+    import json
+    # OSError (missing/unreadable path) propagates — a typo'd path
+    # must stay distinguishable from an unsupported trace format
+    with open(filename) as f:
+        try:
+            data = json.load(f)
+        except ValueError:
+            data = None
+    if isinstance(data, dict) and "traceEvents" in data:
+        return data
     raise NotImplementedError(
         "XPlane traces are read by TensorBoard/xprof, not reloaded in-process"
-        " (paddle_tpu/profiler/__init__.py)")
+        " (paddle_tpu/profiler/__init__.py); only serving trace JSON"
+        " (bench_serving.py --trace) loads here")

@@ -24,10 +24,13 @@ fails only the affected requests.
 
 Modules: `engine` (ServingEngine loop), `request` (lifecycle/channels),
 `scheduler` (admission queue: priority + FIFO + aging + backpressure),
-`metrics` (counters/gauges/histograms + profiler-span timers),
+`metrics` (counters/gauges/histograms + profiler-span timers +
+Prometheus text exposition via `MetricsRegistry.to_prometheus()`),
 `cache` (automatic prefix cache: trie index over shared KV blocks,
 refcounted by `RefcountingBlockAllocator` — on by default; pass
-`prefix_cache=False` to serve cold).
+`prefix_cache=False` to serve cold), `trace` (per-request trace
+timelines with Chrome-trace/Perfetto export + the step flight
+recorder the engine dumps on a device-step failure).
 """
 from __future__ import annotations
 
@@ -38,6 +41,7 @@ from .request import (  # noqa: F401
     RequestError, RequestCancelled, RequestFailed, RequestTimedOut,
 )
 from .scheduler import AdmissionQueue, QueueFullError  # noqa: F401
+from .trace import TraceSink, FlightRecorder  # noqa: F401
 
 __all__ = [
     "ServingEngine", "EngineStopped",
@@ -45,6 +49,7 @@ __all__ = [
     "RequestError", "RequestCancelled", "RequestFailed", "RequestTimedOut",
     "AdmissionQueue", "QueueFullError",
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "TraceSink", "FlightRecorder",
     "PrefixCacheIndex", "RefcountingBlockAllocator",
     "ContinuousBatcher", "PagedKVCache",
 ]

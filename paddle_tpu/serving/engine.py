@@ -26,9 +26,20 @@ callback raises fails ONLY that request (its KV blocks return to the
 pool); a device-step failure fails the in-flight requests but leaves the
 engine accepting; shutdown(drain=True) stops admissions, drains
 in-flight work, then joins the thread.
+
+Observability (serving.trace): a per-request TraceSink timeline rides
+every request (enqueued → admitted → prefill chunks → first token →
+decode dispatches → terminal state; `engine.trace.to_chrome_trace()`
+exports Perfetto-loadable JSON), and the batcher's step flight
+recorder is dumped — last N scheduler records plus allocator/queue
+state, as JSON — automatically when a device step raises
+(`last_flight_dump_json`) or on demand (`dump_flight_recorder()`).
+`MetricsRegistry.to_prometheus()` renders the same metrics snapshot()
+reads in the Prometheus text format.
 """
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Dict, Iterator, List, Optional
@@ -36,6 +47,7 @@ from typing import Dict, Iterator, List, Optional
 from .metrics import MetricsRegistry
 from .request import GenerationRequest, RequestState
 from .scheduler import AdmissionQueue, QueueFullError
+from .trace import TraceSink
 
 __all__ = ["ServingEngine", "EngineStopped"]
 
@@ -74,7 +86,21 @@ class ServingEngine:
                  fused_prefill: bool = True, fused_units: int = 1,
                  attention_impl: str = "auto",
                  warmup: bool = False,
+                 trace: bool = True, flight_recorder_cap: int = 64,
+                 flight_dump_path: Optional[str] = None,
                  clock=time.monotonic):
+        # observability: per-request timelines (always-on-cheap unless
+        # trace=False) + the batcher's step flight recorder; a step
+        # failure dumps the ring + allocator/queue state to JSON
+        # (`last_flight_dump_json`, and `flight_dump_path` when set).
+        # max_live covers every request this engine can hold open at
+        # once (queued + in flight), so the sink's leak bound can
+        # never displace a running request's timeline
+        self.trace: Optional[TraceSink] = TraceSink(
+            max_live=max_queue_depth + max_batch + 16) if trace else None
+        self._flight_dump_path = flight_dump_path
+        self.last_flight_dump: Optional[Dict] = None
+        self.last_flight_dump_json: Optional[str] = None
         # lazy: keep `import paddle_tpu` from pulling the whole nlp tree
         from ..nlp.paged import ContinuousBatcher
         self.batcher = ContinuousBatcher(
@@ -84,7 +110,8 @@ class ServingEngine:
             prefix_cache=prefix_cache, prefill_buckets=prefill_buckets,
             max_prefill_bucket=max_prefill_bucket,
             fused_prefill=fused_prefill, fused_units=fused_units,
-            attention_impl=attention_impl)
+            attention_impl=attention_impl, trace=self.trace,
+            flight_recorder_cap=flight_recorder_cap)
         # the RESOLVED backend ("auto" already collapsed to the concrete
         # choice at batcher construction) — bench/snapshot surface
         self.attention_impl = self.batcher.attention_impl
@@ -231,6 +258,12 @@ class ServingEngine:
             req.max_new_tokens = mn      # resolved; admission reads it
             self._c_submitted.inc()
             self._g_queue.set(len(self.queue))
+            if self.trace is not None:
+                req.trace_id = self.trace.start()
+                self.trace.emit(req.trace_id, "enqueued",
+                                prompt_len=len(req.prompt),
+                                priority=req.priority,
+                                timeout_s=req.timeout_s)
             self._work.notify_all()
         return req
 
@@ -338,6 +371,57 @@ class ServingEngine:
             snap["attention_impl"] = self.attention_impl
         return snap
 
+    def dump_flight_recorder(self, path: Optional[str] = None) -> Dict:
+        """On-demand forensic dump: the batcher's last-N step records
+        (mode, unit composition, bucket/pad, pool state, compile-memo
+        hit/miss) plus allocator and queue state, as one JSON-safe
+        dict — written to `path` when given. The same dump fires
+        automatically on a step failure (`last_flight_dump` /
+        `last_flight_dump_json`). Callable from any thread: the ring
+        itself reads through its own lock; the surrounding pool/queue
+        numbers are best-effort point-in-time reads that may be torn
+        against a concurrently-running step() (forensic snapshot, not
+        a transaction — only the failure-path dump, taken by the
+        engine thread itself, is step-consistent)."""
+        dump = self._flight_dump()
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(dump, f, indent=2)
+        return dump
+
+    def _flight_dump(self, error: Optional[BaseException] = None) -> Dict:
+        b = self.batcher
+        with self._lock:
+            records = b.flight.records()
+            return {
+                "error": None if error is None else repr(error),
+                "failing_record": records[-1] if records else None,
+                "records": records,
+                "allocator": dict(b.alloc.stats()),
+                "queue_depth": len(self.queue),
+                "running_rids": sorted(self._running),
+                "pending_rids": [e[0].rid for e in b._pending],
+                "active_slots": sum(b.active),
+                "free_slots": b.free_slots(),
+                "attention_impl": self.attention_impl,
+            }
+
+    def _record_failure_dump(self, error: BaseException) -> None:
+        """Step-failure boundary: snapshot the flight recorder + pool/
+        queue state BEFORE the in-flight set is torn down, keep it on
+        `last_flight_dump`/`last_flight_dump_json`, and best-effort
+        write it to `flight_dump_path` when configured (a dump-write
+        failure must never mask the original step error)."""
+        dump = self._flight_dump(error)
+        self.last_flight_dump = dump
+        self.last_flight_dump_json = json.dumps(dump)
+        if self._flight_dump_path is not None:
+            try:
+                with open(self._flight_dump_path, "w") as f:
+                    f.write(self.last_flight_dump_json)
+            except OSError:
+                pass
+
     # ---- engine thread ---------------------------------------------------
     def _loop(self) -> None:
         while True:
@@ -370,6 +454,10 @@ class ServingEngine:
             # ptlint: disable=EXC001 — step boundary: the error is attached
             # to every in-flight request and re-raised in their result()
             except Exception as e:        # device-step boundary
+                # forensics FIRST: the dump captures the queue/pool
+                # state at failure, before _fail_all_running tears the
+                # in-flight set down
+                self._record_failure_dump(e)
                 self._fail_all_running(e)
                 continue
             self._dispatch(emitted, finished, step_dt=timer.elapsed)
@@ -452,6 +540,12 @@ class ServingEngine:
             req.request_id = rid
             req.state = RequestState.PREFILL
             req.admit_time = now
+            if self.trace is not None and req.trace_id is not None:
+                # batcher-side emissions (prepared / prefill_chunk /
+                # retired) resolve to this request's timeline via rid
+                self.trace.alias(rid, req.trace_id)
+                self.trace.emit(req.trace_id, "admitted", rid=rid,
+                                queue_wait_s=now - req.submit_time)
             req.admitted_index = self._admit_seq
             self._admit_seq += 1
             self._h_wait.observe(now - req.submit_time)
@@ -465,6 +559,11 @@ class ServingEngine:
         ntok = sum(len(t) for t in emitted.values())
         if step_dt is not None and ntok:
             self._h_token.observe(step_dt / ntok)
+        if self.trace is not None and step_dt is not None:
+            # the sink-side twin of the serving.step_s timer span —
+            # same duration, so the Chrome trace's steps lane lines up
+            # with the histogram (and the XPlane RecordEvent spans)
+            self.trace.span("engine.step", dur=step_dt, tokens=ntok)
         for rid, toks in emitted.items():
             req = self._running.get(rid)
             if req is None:
@@ -473,12 +572,22 @@ class ServingEngine:
             if last is not None:
                 self._h_itl.observe(now - last)
             self._last_emit[rid] = now
+            traced = self.trace is not None and req.trace_id is not None
+            ndelivered = 0
             try:
                 for t in toks:
                     if req.first_token_time is None:
                         req.first_token_time = now
                         self._h_ttft.observe(now - req.submit_time)
+                        # emitted at the stamp, not after the loop: a
+                        # later on_token failure must not leave the
+                        # timeline disagreeing with the ttft histogram
+                        if traced:
+                            self.trace.emit(
+                                req.trace_id, "first_token",
+                                ttft_s=now - req.submit_time)
                     req._deliver(t)
+                    ndelivered += 1
                     self._c_tokens.inc()
                     if req.on_token is not None:
                         req.on_token(t)
@@ -486,12 +595,20 @@ class ServingEngine:
             # callback's error fails ONLY this request; it is attached to
             # the handle and re-raised in its result()/stream()
             except Exception as e:        # per-request boundary
+                if traced and ndelivered:
+                    # the tokens up to the failure WERE delivered
+                    self.trace.emit(req.trace_id, "decode_emit",
+                                    n=ndelivered)
                 self.batcher.abort(rid)
                 self.batcher.release(rid)
                 with self._work:
                     self._running.pop(rid, None)
                     self._finish_locked(req, RequestState.FAILED,
                                         "on_token_raised", error=e)
+            else:
+                if traced:
+                    self.trace.emit(req.trace_id, "decode_emit",
+                                    n=len(toks))
         with self._work:
             for rid in finished:
                 self.batcher.release(rid)    # tokens already delivered
@@ -521,6 +638,10 @@ class ServingEngine:
         }[state]
         if not req.done:
             counter.inc()
+            if self.trace is not None and req.trace_id is not None:
+                self.trace.finish(
+                    req.trace_id, state.name.lower(), reason=reason,
+                    error=None if error is None else repr(error))
         self._last_emit.pop(req.request_id, None)
         req._finish(state, reason, error=error, now=self._clock())
         self._work.notify_all()

@@ -15,11 +15,16 @@ schedule.
 """
 from __future__ import annotations
 
+import re
 import threading
 import time
 from typing import Dict, List, Optional
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
+
+# Prometheus metric names: [a-zA-Z_:][a-zA-Z0-9_:]* — everything else
+# (the registry's dotted names like "serving.step_s") maps to "_"
+_PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
 class Counter:
@@ -132,6 +137,16 @@ class Histogram:
             return self._percentile(sorted(vals), q)
 
     def summary(self) -> Dict[str, float]:
+        """Lifetime and windowed statistics, under EXPLICIT keys so a
+        long-lived engine's dashboard can't misread them: `count` /
+        `sum` / `mean` / `min` / `max` are exact over the histogram's
+        LIFETIME, while the percentiles AND `window_count` /
+        `window_min` / `window_max` describe only the most recent
+        `cap` observations still in the ring. Before the ring wraps
+        the two views coincide; after it wraps, lifetime min/max may
+        lie far outside the window the percentiles rank — which is
+        why the windowed extrema get their own keys instead of being
+        silently mixed in."""
         with self._lock:
             if not self._count:
                 return {"count": 0}
@@ -142,6 +157,9 @@ class Histogram:
                 "mean": self._sum / self._count,
                 "min": self._min,
                 "max": self._max,
+                "window_count": len(vals),
+                "window_min": vals[0],
+                "window_max": vals[-1],
                 "p50": self._percentile(vals, 0.50),
                 "p90": self._percentile(vals, 0.90),
                 "p95": self._percentile(vals, 0.95),
@@ -225,3 +243,48 @@ class MetricsRegistry:
                 "histograms": {n: h.summary()
                                for n, h in self._histograms.items()},
             }
+
+    def to_prometheus(self, prefix: str = "paddle_tpu_") -> str:
+        """Render every metric in the Prometheus text exposition
+        format (version 0.0.4) — the direct prerequisite for the
+        multi-replica router's HTTP `/metrics` endpoint (ROADMAP
+        direction 3): an HTTP handler returns exactly this string with
+        content type ``text/plain; version=0.0.4``.
+
+        Counters render as ``<prefix><name>_total``, gauges as
+        ``<prefix><name>``, histograms as Prometheus *summaries*
+        (``{quantile="0.5|0.9|0.95|0.99"}`` over the recent window,
+        plus lifetime ``_sum`` / ``_count``). Registry names are
+        sanitized to the Prometheus charset (``serving.step_s`` →
+        ``serving_step_s``). One atomic snapshot backs the whole
+        rendering, so cross-metric invariants hold within a scrape."""
+        snap = self.snapshot()
+        lines: List[str] = []
+
+        def san(name: str) -> str:
+            return _PROM_NAME_RE.sub("_", name)
+
+        def num(v) -> str:
+            return repr(float(v))
+
+        for name, v in snap["counters"].items():
+            # the _total suffix is part of the family name in the
+            # 0.0.4 text format — a TYPE line for the bare name would
+            # leave the actual samples typed "unknown"
+            base = prefix + san(name) + "_total"
+            lines.append(f"# TYPE {base} counter")
+            lines.append(f"{base} {num(v)}")
+        for name, v in snap["gauges"].items():
+            base = prefix + san(name)
+            lines.append(f"# TYPE {base} gauge")
+            lines.append(f"{base} {num(v)}")
+        for name, s in snap["histograms"].items():
+            base = prefix + san(name)
+            lines.append(f"# TYPE {base} summary")
+            for q, key in ((0.5, "p50"), (0.9, "p90"),
+                           (0.95, "p95"), (0.99, "p99")):
+                if key in s:
+                    lines.append(f'{base}{{quantile="{q}"}} {num(s[key])}')
+            lines.append(f"{base}_sum {num(s.get('sum', 0.0))}")
+            lines.append(f"{base}_count {num(s.get('count', 0))}")
+        return "\n".join(lines) + "\n"

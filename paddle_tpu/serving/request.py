@@ -119,6 +119,7 @@ class GenerationRequest:
         self.first_token_time: Optional[float] = None
         self.finish_time: Optional[float] = None
         self.admitted_index: Optional[int] = None   # global admission order
+        self.trace_id: Optional[str] = None         # serving.trace timeline
 
         self._cancel = threading.Event()
         self._done = threading.Event()

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Summarize a serving trace artifact (bench_serving.py --trace out.json).
+
+Reads the Chrome-trace JSON exported by `serving.trace.TraceSink.
+to_chrome_trace()` and answers, per request and in aggregate, the
+questions the raw timeline is too granular for:
+
+  * per-phase time breakdown — queue wait (enqueued→admitted), prefill
+    (sum of prefill_chunk spans), decode (first_token→terminal), total;
+  * pad waste — bucket-padding tokens vs real suffix tokens across
+    every prefill chunk (the overhead the bucket ladder trades for
+    zero recompiles);
+  * cache-hit attribution — prompt tokens the prefix cache skipped,
+    per request and total, next to the tokens actually prefilled;
+  * scheduling mix — fused vs standalone prefill chunks, engine step
+    span count/total.
+
+Standard library only (no jax import): runs anywhere the JSON landed,
+including the CI bench-smoke job where it ships as a non-blocking
+artifact. `--json` prints the summary as one JSON object instead of
+the text table.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter, defaultdict
+
+TERMINAL = {"finished", "cancelled", "failed", "timed_out"}
+
+
+def load_events(path: str):
+    """The artifact's non-metadata trace events, sorted by timestamp."""
+    with open(path) as f:
+        data = json.load(f)
+    evs = [e for e in data.get("traceEvents", []) if e.get("ph") != "M"]
+    evs.sort(key=lambda e: e.get("ts", 0.0))
+    return evs
+
+
+def summarize(events) -> dict:
+    """Aggregate the per-request phase/pad/cache numbers (all times in
+    milliseconds; `ts`/`dur` in the artifact are microseconds)."""
+    per_req = defaultdict(lambda: {
+        "enqueued_ts": None, "admitted_ts": None, "first_token_ts": None,
+        "terminal_ts": None, "terminal": None, "prompt_len": None,
+        "slot": None, "prefill_ms": 0.0, "chunks": 0, "fused_chunks": 0,
+        "pad_tokens": 0, "real_tokens": 0, "cached_tokens": 0,
+        "generated": 0,
+    })
+    steps = {"count": 0, "total_ms": 0.0}
+    for e in events:
+        name, args = e.get("name"), e.get("args", {})
+        if name == "engine.step":
+            steps["count"] += 1
+            steps["total_ms"] += e.get("dur", 0.0) / 1e3
+            continue
+        tid = args.get("trace_id")
+        if tid is None:
+            continue
+        r = per_req[tid]
+        ts = e.get("ts", 0.0)
+        if name == "enqueued":
+            r["enqueued_ts"] = ts
+            r["prompt_len"] = args.get("prompt_len")
+        elif name == "admitted":
+            r["admitted_ts"] = ts
+        elif name == "prepared":
+            r["slot"] = args.get("slot")
+        elif name == "prefill_chunk":
+            r["chunks"] += 1
+            r["prefill_ms"] += e.get("dur", 0.0) / 1e3
+            r["pad_tokens"] += args.get("pad", 0)
+            r["real_tokens"] += args.get("end", 0) - args.get("start", 0)
+            r["cached_tokens"] += args.get("cached_tokens", 0)
+            if args.get("fused"):
+                r["fused_chunks"] += 1
+        elif name == "first_token":
+            r["first_token_ts"] = ts
+        elif name == "retired":
+            r["generated"] = args.get("generated", 0)
+        elif name in TERMINAL:
+            r["terminal_ts"] = ts
+            r["terminal"] = name
+
+    rows = []
+    for tid, r in per_req.items():
+        def delta(a, b):
+            return None if r[a] is None or r[b] is None \
+                else (r[b] - r[a]) / 1e3
+        rows.append({
+            # an artifact exported mid-run carries requests with no
+            # terminal event yet — report them as "live", don't crash
+            "trace_id": tid, "terminal": r["terminal"] or "live",
+            "slot": r["slot"], "prompt_len": r["prompt_len"],
+            "generated": r["generated"],
+            "queue_wait_ms": delta("enqueued_ts", "admitted_ts"),
+            "ttft_ms": delta("enqueued_ts", "first_token_ts"),
+            "decode_ms": delta("first_token_ts", "terminal_ts"),
+            "total_ms": delta("enqueued_ts", "terminal_ts"),
+            "prefill_ms": round(r["prefill_ms"], 3),
+            "chunks": r["chunks"], "fused_chunks": r["fused_chunks"],
+            "cached_tokens": r["cached_tokens"],
+            "prefilled_tokens": r["real_tokens"],
+            "pad_tokens": r["pad_tokens"],
+        })
+    # (len, str) sorts t2 before t10 — ids are a prefix plus a
+    # monotonic sequence number, so length order IS numeric order
+    rows.sort(key=lambda x: (len(x["trace_id"]), x["trace_id"]))
+    pad = sum(x["pad_tokens"] for x in rows)
+    real = sum(x["prefilled_tokens"] for x in rows)
+    cached = sum(x["cached_tokens"] for x in rows)
+    total = {
+        "requests": len(rows),
+        "terminals": dict(sorted(
+            Counter(x["terminal"] for x in rows).items())),
+        "prefill_chunks": sum(x["chunks"] for x in rows),
+        "fused_chunks": sum(x["fused_chunks"] for x in rows),
+        "prefilled_tokens": real,
+        "pad_tokens": pad,
+        "pad_waste": round(pad / (pad + real), 4) if pad + real else 0.0,
+        "cached_tokens": cached,
+        "cache_hit_rate": round(cached / (cached + real), 4)
+        if cached + real else 0.0,
+        "engine_steps": steps["count"],
+        "engine_step_ms_total": round(steps["total_ms"], 3),
+    }
+    return {"total": total, "requests": rows}
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.2f}"
+    return str(v)
+
+
+def render(summary: dict) -> str:
+    """The human view: one aggregate block + one row per request."""
+    t = summary["total"]
+    lines = [
+        "== serving trace summary ==",
+        f"requests: {t['requests']}  terminals: {t['terminals']}",
+        f"prefill chunks: {t['prefill_chunks']} "
+        f"({t['fused_chunks']} fused)  prefilled tokens: "
+        f"{t['prefilled_tokens']}  pad: {t['pad_tokens']} "
+        f"(waste {t['pad_waste']:.1%})",
+        f"cache-hit tokens: {t['cached_tokens']} "
+        f"(hit rate {t['cache_hit_rate']:.1%})",
+        f"engine steps: {t['engine_steps']} "
+        f"({t['engine_step_ms_total']:.1f} ms total)",
+        "",
+    ]
+    cols = ["trace_id", "terminal", "slot", "prompt_len", "generated",
+            "queue_wait_ms", "ttft_ms", "decode_ms", "prefill_ms",
+            "chunks", "fused_chunks", "cached_tokens", "pad_tokens"]
+    rows = [[_fmt(r[c]) for c in cols] for r in summary["requests"]]
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    lines.append("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    for r in rows:
+        lines.append("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome-trace JSON written by "
+                                  "bench_serving.py --trace")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one JSON object")
+    a = ap.parse_args(argv)
+    summary = summarize(load_events(a.trace))
+    try:
+        print(json.dumps(summary) if a.json else render(summary))
+    except BrokenPipeError:
+        pass                 # downstream (e.g. `| head`) closed early
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
