@@ -31,6 +31,10 @@ test:           ## tier-1 test suite (CPU)
 # trace_report.py summarizes it as a non-blocking artifact), and the
 # tracing-overhead leg FAILS unless traced tok/s >= 0.97x untraced with
 # zero post-warmup recompiles (the always-on-cheap gate).
+# Fault-tolerance leg: --chaos injects a seeded mid-stream fail-on-rid
+# poison and FAILS unless the quarantine contains it — the culprit
+# alone FAILED, every innocent bit-identical to the fault-free run,
+# zero post-warmup recompiles, allocator drained clean.
 bench-smoke:    ## tiny serving benches (non-blocking CI job)
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --prefix-share \
 		--n-requests 6 --max-new 4 --trace /tmp/paddle_tpu_trace.json
@@ -39,6 +43,8 @@ bench-smoke:    ## tiny serving benches (non-blocking CI job)
 		--n-requests 8 --max-new 4
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --fused \
 		--n-requests 8 --max-new 6 --fused-units 2
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --chaos \
+		--n-requests 8 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py \
 		--attention-impl pallas --n-requests 4 --max-new 4
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --trace-overhead \

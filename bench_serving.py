@@ -49,6 +49,20 @@ cancels from the pooled tok/s; it HARD-FAILS unless pooled traced
 tok/s holds >= 0.97x pooled untraced with zero post-warmup recompiles
 across all four legs: the gate that keeps tracing always-on-cheap.
 
+Chaos (`--chaos`): the fault-isolation gate. The staggered-budget
+admission-during-decode workload runs TWICE — fault-free (the token
+baseline) and with a seeded `serving.faults.FaultInjector` arming a
+persistent fail-on-rid fault against one request the moment it streams
+its first token (mid-stream poison landing in a fused batch). The leg
+HARD-FAILS unless the engine's quarantine isolates the blast radius:
+the culprit alone reaches FAILED with its streamed tokens a prefix of
+its baseline (nothing re-emitted or lost), every innocent completes
+with BIT-identical tokens to the fault-free run, post-warmup
+recompiles stay 0 (quarantine probes and victim re-prefills stay on
+the warmed ladder), and the allocator drains clean. The JSON line
+carries quarantines / requests_requeued / culprit_tokens_streamed and
+the engine `health()` snapshot.
+
 `--attention-impl {auto,xla,pallas}` selects the paged-attention
 backend (nlp/ragged_attention.py); the JSON line records the RESOLVED
 impl plus `decode_tok_s` — generated tokens over time spent inside
@@ -80,7 +94,7 @@ def _make_prompts(rng, n_requests: int, workload: str,
         common = list(map(int, rng.randint(1, 200, prefix_len)))
         return [common + list(map(int, rng.randint(1, 200, suffix_len)))
                 for _ in range(n_requests)]
-    if workload in ("mixed", "fused"):
+    if workload in ("mixed", "fused", "chaos"):
         # lengths spanning the whole ladder, incl. past the largest
         # bucket (chunked prefill) — every request a different length
         return [list(map(int, rng.randint(1, 200, int(L))))
@@ -174,6 +188,100 @@ def _ms(v):
     return None if v is None else round(v * 1000.0, 3)
 
 
+def _chaos_leg(params, cfg, prompts, budgets, culprit_idx: int,
+               base_tokens, **kw) -> dict:
+    """The fault-isolation gate: re-serve the same workload with a
+    persistent fail-on-rid fault armed against request `culprit_idx`
+    at its first streamed token, and HARD-FAIL unless quarantine
+    contains the blast radius (see module docstring)."""
+    import threading
+
+    from paddle_tpu import serving
+    from paddle_tpu.serving.faults import FaultInjector
+
+    inj = FaultInjector(seed=0)
+    eng = serving.ServingEngine(
+        params, cfg, max_batch=kw["max_batch"],
+        block_size=kw["block_size"], max_total_len=64,
+        max_new_tokens=kw["max_new"], chunk=kw["chunk"],
+        max_queue_depth=len(prompts), prefix_cache=kw["prefix_cache"],
+        max_prefill_bucket=kw["max_prefill_bucket"],
+        attention_impl=kw["attention_impl"],
+        fused_units=kw["fused_units"], fault_injector=inj, start=False)
+    eng.warmup()
+    eng.start()
+    eng.generate(prompts[0], timeout=600)
+    compiles_warm = eng.batcher.compile_count
+    armed = threading.Event()
+
+    def arm(tok):
+        # first streamed token of the culprit: poison its rid from
+        # here on — the fault lands mid-stream, typically inside a
+        # fused decode+prefill batch carrying innocents
+        if not armed.is_set():
+            armed.set()
+            inj.fail_on_rid(culprit_req.request_id)
+
+    # the handle is built BEFORE submission so the engine-thread
+    # callback never races the submit loop's list bookkeeping
+    culprit_req = serving.GenerationRequest(
+        prompts[culprit_idx], max_new_tokens=int(budgets[culprit_idx]),
+        on_token=arm)
+    reqs = []
+    for i, (p, mn) in enumerate(zip(prompts, budgets)):
+        reqs.append(eng.submit(culprit_req) if i == culprit_idx
+                    else eng.submit(p, max_new_tokens=mn))
+    if not eng.drain(timeout=600):
+        raise RuntimeError("chaos drain timed out — benchmark invalid")
+    recompiles = eng.batcher.compile_count - compiles_warm
+    health = eng.health()
+    blocks_in_use = eng.batcher.alloc.stats()["blocks_in_use"]
+    eng.shutdown()
+
+    culprit = reqs[culprit_idx]
+    failed = [i for i, r in enumerate(reqs)
+              if r.state is serving.RequestState.FAILED]
+    if failed != [culprit_idx]:
+        raise RuntimeError(
+            f"chaos gate: FAILED set {failed} != [{culprit_idx}] — the "
+            f"quarantine did not contain the fault to the culprit")
+    if not culprit.tokens or \
+            culprit.tokens != base_tokens[culprit_idx][:len(culprit.tokens)]:
+        raise RuntimeError(
+            "chaos gate: the culprit's streamed tokens are not a prefix "
+            "of its fault-free run — tokens were re-emitted or lost")
+    for i, r in enumerate(reqs):
+        if i == culprit_idx:
+            continue
+        if r.result() != base_tokens[i]:
+            raise RuntimeError(
+                f"chaos gate: innocent request {i} finished with "
+                f"different tokens than the fault-free run — recovery "
+                f"lost or corrupted streamed output")
+    if recompiles:
+        raise RuntimeError(
+            f"chaos gate: {recompiles} post-warmup recompiles — "
+            f"quarantine re-execution left the warmed ladder")
+    if blocks_in_use:
+        raise RuntimeError(
+            f"chaos gate: {blocks_in_use} KV blocks still in use after "
+            f"drain — the recovery path leaked pool blocks")
+    if not health["quarantines"]:
+        raise RuntimeError(
+            "chaos gate: no quarantine ran — the fault never fired "
+            "(workload produced no poisoned step)")
+    return {
+        "chaos_culprit_index": culprit_idx,
+        "chaos_culprit_tokens_streamed": len(culprit.tokens),
+        "chaos_innocents": len(reqs) - 1,
+        "chaos_quarantines": health["quarantines"],
+        "chaos_requests_requeued": health["requests_requeued"],
+        "chaos_recompiles_after_warmup": recompiles,
+        "chaos_injected": inj.stats()["injected"],
+        "chaos_health_status": health["status"],
+    }
+
+
 def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
          block_size: int = 8, chunk: int = 4, workload: str = "random",
          prefix_len: int = 24, suffix_len: int = 6,
@@ -196,7 +304,7 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
               attention_impl=attention_impl, fused_units=fused_units)
 
     base = None
-    if workload in ("fused", "prefix-share"):
+    if workload in ("fused", "prefix-share", "chaos"):
         # staggered per-request budgets so slots retire at DIFFERENT
         # steps — equal budgets would march the whole batch in lockstep
         # waves and no admission would ever land mid-decode. The fused
@@ -209,6 +317,20 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         # unfused first: the SAME prompts through the PR4 path give the
         # decode_stall_steps / ITL baseline the fused run must beat
         base = _serve(params, cfg, prompts, fused_prefill=False, **kw)
+    chaos = None
+    if workload == "chaos":
+        # fault-free leg first: its per-request tokens are the parity
+        # baseline the chaos engine's survivors must reproduce bit-
+        # identically (and it doubles as this workload's JSON numbers)
+        r0 = _serve(params, cfg, prompts, fused_prefill=True, **kw)
+        base_tokens = [q.result() for q in r0["reqs"]]
+        # the culprit must still be DECODING when its first-token
+        # poison arms, or the fault can never fire mid-stream — pick
+        # the request with the largest decode budget
+        culprit = max(range(len(prompts)), key=lambda i: kw["budgets"][i])
+        chaos = _chaos_leg(
+            params, cfg, prompts, kw["budgets"], culprit, base_tokens,
+            **{k: v for k, v in kw.items() if k != "budgets"})
     untraced = None
     if trace_overhead:
         # the tracing-overhead gate needs BIAS-FREE legs: the first
@@ -234,6 +356,8 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         r = t1
         r["tok_s"] = (t1["tok_s"] + t2["tok_s"]) / 2
         r["recompiles"] = t1["recompiles"] + t2["recompiles"]
+    elif chaos is not None:
+        r = r0            # the fault-free leg doubles as the numbers
     else:
         r = _serve(params, cfg, prompts, fused_prefill=True, **kw)
 
@@ -335,7 +459,9 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
                 f"tracing overhead gate: traced run at {ratio:.3f}x "
                 f"the untraced tok/s (floor 0.97x) — trace emission "
                 f"is no longer always-on-cheap")
-    if workload in ("mixed", "fused") and r["recompiles"]:
+    if chaos is not None:
+        result.update(chaos)
+    if workload in ("mixed", "fused", "chaos") and r["recompiles"]:
         raise RuntimeError(
             f"bucketed workload recompiled {r['recompiles']} prefill "
             f"shapes after warmup — the bucket ladder no longer covers "
@@ -355,6 +481,13 @@ def _cli() -> dict:
                     help="admission-during-decode workload run fused "
                          "AND unfused; asserts the fused run stalls "
                          "decode less and never recompiles")
+    ap.add_argument("--chaos", action="store_true",
+                    help="fault-isolation gate: re-serve the workload "
+                         "with a seeded mid-stream fail-on-rid poison; "
+                         "HARD-FAILS unless the culprit alone FAILS, "
+                         "every innocent finishes bit-identical to the "
+                         "fault-free run, recompiles stay 0 and the "
+                         "pool drains clean")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="serve with the prefix cache disabled")
     ap.add_argument("--attention-impl", default="auto",
@@ -397,19 +530,21 @@ def _cli() -> dict:
                          "16 for --bucketed/--fused so the workload "
                          "chunks)")
     a = ap.parse_args()
-    if sum((a.prefix_share, a.bucketed, a.fused)) > 1:
-        ap.error("--prefix-share, --bucketed and --fused are mutually "
-                 "exclusive")
+    if sum((a.prefix_share, a.bucketed, a.fused, a.chaos)) > 1:
+        ap.error("--prefix-share, --bucketed, --fused and --chaos are "
+                 "mutually exclusive")
     workload = ("prefix-share" if a.prefix_share
                 else "mixed" if a.bucketed
-                else "fused" if a.fused else "random")
+                else "fused" if a.fused
+                else "chaos" if a.chaos else "random")
     bucket_cap = a.max_prefill_bucket
     if bucket_cap is None:
-        # the mixed/fused workloads should also exercise CHUNKED
+        # the mixed/fused/chaos workloads should also exercise CHUNKED
         # prefill, so cap the ladder below their longest prompts
-        bucket_cap = 16 if workload in ("mixed", "fused") else 512
+        bucket_cap = 16 if workload in ("mixed", "fused", "chaos") else 512
     chunk = (a.chunk if a.chunk is not None
-             else 2 if workload in ("fused", "prefix-share") else 4)
+             else 2 if workload in ("fused", "prefix-share", "chaos")
+             else 4)
     return main(n_requests=a.n_requests, max_new=a.max_new,
                 max_batch=a.max_batch, block_size=a.block_size,
                 chunk=chunk, workload=workload,

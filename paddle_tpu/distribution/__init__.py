@@ -725,6 +725,9 @@ class Transform:
 
 
 class AffineTransform(Transform):
+    """Bijector y = loc + scale * x; log|det J| = log|scale| per
+    element (scale must be nonzero)."""
+
     def __init__(self, loc, scale):
         self.loc = _param(loc)
         self.scale = _param(scale)
@@ -742,6 +745,8 @@ class AffineTransform(Transform):
 
 
 class ExpTransform(Transform):
+    """Bijector y = exp(x) (R -> R+); log|det J| = x."""
+
     def forward(self, x):
         return _e(jnp.exp, x)
 
@@ -753,6 +758,9 @@ class ExpTransform(Transform):
 
 
 class SigmoidTransform(Transform):
+    """Bijector y = sigmoid(x) (R -> (0, 1)); inverse is the logit
+    function, log|det J| = -softplus(-x) - softplus(x)."""
+
     def forward(self, x):
         return _e(jax.nn.sigmoid, x)
 
@@ -764,6 +772,9 @@ class SigmoidTransform(Transform):
 
 
 class TanhTransform(Transform):
+    """Bijector y = tanh(x) (R -> (-1, 1)); log|det J| computed in the
+    numerically-stable softplus form 2(log 2 - x - softplus(-2x))."""
+
     def forward(self, x):
         return _e(jnp.tanh, x)
 
@@ -1178,6 +1189,9 @@ class LKJCholesky(Distribution):
 # -- transform long tail ----------------------------------------------------
 
 class AbsTransform(Transform):
+    """y = |x|: not bijective — inverse() returns the positive branch
+    (the reference convention) and the log-det-jacobian is zero."""
+
     def forward(self, x):
         return _e(jnp.abs, x)
 
@@ -1189,6 +1203,9 @@ class AbsTransform(Transform):
 
 
 class PowerTransform(Transform):
+    """Bijector y = x ** power on the positive reals;
+    log|det J| = log|power * x**(power-1)|."""
+
     def __init__(self, power):
         self.power = _param(power)
 
@@ -1204,6 +1221,10 @@ class PowerTransform(Transform):
 
 
 class ReshapeTransform(Transform):
+    """Shape-only bijector reshaping the event part of x from
+    `in_event_shape` to `out_event_shape` (batch dims untouched);
+    volume-preserving, so the log-det-jacobian is zero."""
+
     def __init__(self, in_event_shape, out_event_shape):
         self.in_event_shape = tuple(in_event_shape)
         self.out_event_shape = tuple(out_event_shape)
@@ -1224,6 +1245,11 @@ class ReshapeTransform(Transform):
 
 
 class SoftmaxTransform(Transform):
+    """y = softmax(x) onto the probability simplex; NOT bijective (the
+    simplex loses one degree of freedom), so inverse() is log(y) up to
+    an additive constant and forward_log_det_jacobian raises — use
+    StickBreakingTransform for density transport."""
+
     def forward(self, x):
         return _e(lambda v: jax.nn.softmax(v, axis=-1), x)
 
@@ -1239,6 +1265,11 @@ class SoftmaxTransform(Transform):
 
 
 class StickBreakingTransform(Transform):
+    """Bijector from R^n to the interior of the (n+1)-simplex via
+    iterative stick-breaking (the torch/paddle construction) — the
+    bijective alternative to SoftmaxTransform, with a proper
+    log-det-jacobian for TransformedDistribution densities."""
+
     def forward_log_det_jacobian(self, x):
         def f(v):
             n = v.shape[-1]
@@ -1278,6 +1309,10 @@ class StickBreakingTransform(Transform):
 
 
 class ChainTransform(Transform):
+    """Composition of transforms applied left to right; inverse runs
+    the chain backwards and the log-det-jacobian accumulates each
+    link's contribution at the right intermediate point."""
+
     def __init__(self, transforms):
         self.transforms = list(transforms)
 

@@ -589,8 +589,13 @@ class ContinuousBatcher:
                  max_prefill_bucket: int = 512,
                  fused_prefill: bool = True, fused_units: int = 1,
                  attention_impl: str = "auto",
-                 trace=None, flight_recorder_cap: int = 64):
+                 trace=None, flight_recorder_cap: int = 64,
+                 fault_injector=None):
         self.params, self.cfg = params, cfg
+        # chaos harness: an optional serving.faults.FaultInjector
+        # consulted at every device-call boundary (_gate) — fail /
+        # hang / pass, deterministically. None in production.
+        self._fault = fault_injector
         self.B, self.bs = max_batch, block_size
         # resolved once: every traced fn closes over the concrete
         # backend and every compiled-shape memo keys on it
@@ -909,6 +914,12 @@ class ContinuousBatcher:
         victims = [e[0] for e in self._pending[cut:]]
         self._rollback(victims)
         del self._pending[cut:]
+        for v in victims:
+            # timeline visibility for the cascade: without this event a
+            # rolled-back sibling's re-preparation looks like a second
+            # unexplained "prepared" in trace_report
+            self._trace_emit(v.rid, "requeued",
+                             reason="poisoned_sibling")
         self.queue[:0] = [(v.rid, v.toks, v.stop, v.mn) for v in victims]
 
     # -- internals --------------------------------------------------------
@@ -955,6 +966,15 @@ class ContinuousBatcher:
             queue_depth=len(self.queue), pending=len(self._pending),
             free_slots=self.free_slots(),
             free_blocks=self.alloc.free_blocks, **fields)
+
+    def _gate(self, mode: str, rids, probe: bool = False) -> None:
+        """Fault-injection hook at the device-call boundary: a no-op in
+        production (no injector), the chaos harness's seam in tests and
+        `bench_serving.py --chaos`. Called AFTER `_record_tick` so an
+        injected failure's tick is the flight ring's last record, like
+        a real device fault's would be."""
+        if self._fault is not None:
+            self._fault.check(mode, rids, probe=probe)
 
     # -- bucketed / chunked / batched prefill -----------------------------
     def _bucket_for(self, S: int) -> int:
@@ -1077,7 +1097,8 @@ class ContinuousBatcher:
         return self.compile_count - n0
 
     def _prepare_admission(self, slot: int, rid: int, toks: List[int],
-                           stop: int, max_new: Optional[int]) -> _Admission:
+                           stop: int, max_new: Optional[int],
+                           quiet: bool = False) -> _Admission:
         """Blocks + prefix-cache bookkeeping for one admission, NO model
         compute: share the matched chain, allocate the rest, apply the
         COW clone, and register the prompt's full blocks so same-burst
@@ -1133,10 +1154,11 @@ class ContinuousBatcher:
                 inserted = self._pcache.insert(toks[:n_full * self.bs],
                                                owned[:n_full])
         chunks = self._suffix_chunks(cached_len, P)
-        self._trace_emit(rid, "prepared", slot=slot, prompt_len=P,
-                         cached_tokens=cached_len,
-                         cow=cow_src is not None, blocks=need,
-                         chunks=len(chunks))
+        if not quiet:       # probes re-prepare without timeline noise
+            self._trace_emit(rid, "prepared", slot=slot, prompt_len=P,
+                             cached_tokens=cached_len,
+                             cow=cow_src is not None, blocks=need,
+                             chunks=len(chunks))
         return _Admission(slot, rid, list(toks), stop, mn, need, matched,
                           cached_len, cow_src, fresh, inserted, chunks)
 
@@ -1325,12 +1347,14 @@ class ContinuousBatcher:
         fusion is off (`decode_stall_steps` then counts the cost)."""
         entries, items, bucket, cold, final = self._pop_unit()
         Gp = self._group_pad(len(items))
+        unit_rids = [r.rid for r, _, _ in items]
         self._record_tick(
-            "prefill", rids=[r.rid for r, _, _ in items], bucket=bucket,
+            "prefill", rids=unit_rids, bucket=bucket,
             group_pad=Gp, cold=cold, final=final,
             stalls_decode=any(self.active),
             compile_hit=(Gp, bucket, cold,
                          self.attention_impl) in self._prefill_cache)
+        self._gate("prefill", unit_rids)
         t0 = time.perf_counter()
         self._apply_cow([e[0] for e in entries if e[1] == 0])
         logits, li = self._prefill_call(items, bucket, cold)
@@ -1347,13 +1371,25 @@ class ContinuousBatcher:
                            dur=time.perf_counter() - t0)
 
     def _fail_pending(self) -> None:
-        """A failed prefill/fused call must not leak blocks: every
-        still-pending record rolls back (the slots were never activated,
-        so nothing else would ever free them). All-or-nothing on
-        purpose — later records may lean on the failed unit's registered
-        blocks, so partial survival would strand never-written KV."""
-        self._rollback([e[0] for e in self._pending])
+        """A failed prefill/fused call must not leak blocks OR silently
+        drop work: every still-pending record rolls back (the slots
+        were never activated, so nothing else would ever free them) and
+        requeues at the FRONT of the batcher queue in original order —
+        the caller decides who actually dies (the engine's quarantine
+        probes the requeued records and re-admits the innocent; its
+        fail-all fallback aborts them, which pops queue entries too).
+        All-or-nothing on purpose — later records may lean on the
+        failed unit's registered blocks, so partial survival would
+        strand never-written KV."""
+        victims = [e[0] for e in self._pending]
+        self._rollback(victims)
         self._pending.clear()
+        # no "requeued" trace event here: the DECISION about these
+        # records (quarantine victim / culprit / fail-all) belongs to
+        # the caller, which emits exactly one event per request — a
+        # second one from the rollback would double trace_report's
+        # requeue counts against health()["requests_requeued"]
+        self.queue[:0] = [(v.rid, v.toks, v.stop, v.mn) for v in victims]
 
     def _prefill_pending(self) -> None:
         """Drain the pending pipeline with standalone prefill calls
@@ -1378,6 +1414,60 @@ class ContinuousBatcher:
         except Exception:
             self._fail_pending()
             raise
+
+    # -- quarantine probes (engine-thread only, failure path only) --------
+    def probe_decode_slot(self, slot: int) -> None:
+        """Re-run the failed tick's decode chunk for ONE slot in
+        isolation: the chunk executable runs with every other slot
+        masked inactive, so only this slot's computation can raise.
+        Commits NOTHING — the returned cache/tokens are discarded (the
+        engine requeues the innocent for a warm re-prefill instead),
+        and per-request paged attention makes the masked run exercise
+        exactly this slot's math. Raises whatever the device (or the
+        fault injector) raises; returning means the slot is clean.
+        Failure-path only: never called on the hot path."""
+        rid = self.slot_req[slot]
+        self._gate("probe", [rid], probe=True)
+        act = [False] * self.B
+        act[slot] = True
+        out = self._chunk_exe()(
+            self.params, self.cache, self.cur_tok, jnp.asarray(act),
+            self.cache.lengths, jnp.asarray(self.budget, jnp.int32),
+            jnp.asarray(self.stop, jnp.int32))
+        # force the async dispatch so a data-dependent device failure
+        # surfaces HERE, attributed to this slot (probe verdicts are
+        # the one consumer of these arrays — nothing is kept)
+        jax.block_until_ready(out)
+
+    def probe_queued(self, rid: int) -> None:
+        """Re-run a QUEUED request's first prefill chunk in isolation:
+        prepare its blocks, run one standalone single-record prefill
+        call (a warmed (1, bucket) ladder shape), then roll everything
+        back — the queue entry, the pool and the prefix index end
+        exactly as they were. A failed prefill/fused call requeues its
+        pending records (`_fail_pending`), so this is how the engine's
+        quarantine re-executes the failing tick's prefill units one
+        record at a time. Raises what the device raises; a pool too
+        tight to re-prepare returns silently (inconclusive is NOT a
+        conviction). No-op for a rid not in the queue."""
+        entry = next((e for e in self.queue if e[0] == rid), None)
+        if entry is None:
+            return
+        _, toks, stop, mn = entry
+        self._gate("probe", [rid], probe=True)
+        try:
+            rec = self._prepare_admission(-1, rid, toks, stop, mn,
+                                          quiet=True)
+        except RuntimeError:
+            return        # pool exhausted mid-quarantine: inconclusive
+        try:
+            start, end, bucket = rec.chunks[0]
+            self._apply_cow([rec])
+            logits, _ = self._prefill_call([(rec, start, end)], bucket,
+                                           cold=start == 0)
+            jax.block_until_ready(logits)
+        finally:
+            self._rollback([rec])
 
     def _pop_fused_units(self):
         """Select the units ONE fused call carries, in pending order:
@@ -1440,12 +1530,17 @@ class ContinuousBatcher:
             # finite warmed ladder whatever mix of units rides
             Gp = max(self._group_pad(len(items))
                      for _, items, _ in groups)
+            decode_rids = [self.slot_req[s] for s in range(self.B)
+                           if self.active[s]]
+            unit_rids = [[r.rid for r, _, _ in items]
+                         for _, items, _ in groups]
             self._record_tick(
-                "fused", units=[[r.rid for r, _, _ in items]
-                                for _, items, _ in groups],
+                "fused", units=unit_rids, decode_rids=decode_rids,
                 bucket=bucket, group_pad=Gp, rows=len(groups) * Gp,
                 compile_hit=(len(groups) * Gp, bucket,
                              self.attention_impl) in self._fused_cache)
+            self._gate("fused",
+                       decode_rids + [r for u in unit_rids for r in u])
             t0 = time.perf_counter()
             self._apply_cow([e[0] for entries, _, _ in groups
                              for e in entries if e[1] == 0])
@@ -1754,10 +1849,12 @@ class ContinuousBatcher:
             if self._fuse_now():
                 toks = self._step_fused()
             else:
+                decode_rids = [self.slot_req[s] for s in decoding]
                 self._record_tick(
-                    "decode",
+                    "decode", rids=decode_rids,
                     compile_hit=(self.chunk, self.attention_impl)
                     in self._chunk_cache)
+                self._gate("decode", decode_rids)
                 if self._dev_state is None:
                     self._dev_state = self._upload_slot_state()
                 active, budget, stop = self._dev_state

@@ -30,11 +30,15 @@ Prometheus text exposition via `MetricsRegistry.to_prometheus()`),
 refcounted by `RefcountingBlockAllocator` — on by default; pass
 `prefix_cache=False` to serve cold), `trace` (per-request trace
 timelines with Chrome-trace/Perfetto export + the step flight
-recorder the engine dumps on a device-step failure).
+recorder the engine dumps on a device-step failure), `faults`
+(deterministic fault injection: the chaos harness behind the engine's
+quarantine / retry / watchdog recovery paths and
+`bench_serving.py --chaos`).
 """
 from __future__ import annotations
 
 from .cache import PrefixCacheIndex  # noqa: F401
+from .faults import FaultInjector, InjectedFault  # noqa: F401
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
 from .request import (  # noqa: F401
     GenerationRequest, RequestState, TERMINAL_STATES,
@@ -44,12 +48,13 @@ from .scheduler import AdmissionQueue, QueueFullError  # noqa: F401
 from .trace import TraceSink, FlightRecorder  # noqa: F401
 
 __all__ = [
-    "ServingEngine", "EngineStopped",
+    "ServingEngine", "EngineStopped", "HungStepError",
     "GenerationRequest", "RequestState", "TERMINAL_STATES",
     "RequestError", "RequestCancelled", "RequestFailed", "RequestTimedOut",
     "AdmissionQueue", "QueueFullError",
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "TraceSink", "FlightRecorder",
+    "FaultInjector", "InjectedFault",
     "PrefixCacheIndex", "RefcountingBlockAllocator",
     "ContinuousBatcher", "PagedKVCache",
 ]
@@ -58,7 +63,7 @@ __all__ = [
 def __getattr__(name: str):
     # ServingEngine pulls the nlp model stack — resolve lazily so plain
     # `import paddle_tpu` (which imports this package) stays light
-    if name in ("ServingEngine", "EngineStopped"):
+    if name in ("ServingEngine", "EngineStopped", "HungStepError"):
         from . import engine
         return getattr(engine, name)
     if name in ("ContinuousBatcher", "PagedKVCache",

@@ -21,11 +21,26 @@ talks through locks/channels):
         deliver tokens → request channels (+ on_token)   ── request.py
         update metrics / profiler spans                  ── metrics.py
 
-Robustness: a step-level exception boundary — a request whose on_token
-callback raises fails ONLY that request (its KV blocks return to the
-pool); a device-step failure fails the in-flight requests but leaves the
-engine accepting; shutdown(drain=True) stops admissions, drains
-in-flight work, then joins the thread.
+Robustness (fault-isolated serving): a request whose on_token callback
+raises fails ONLY that request (its KV blocks return to the pool). A
+device-step failure enters a quarantine-and-recover pipeline instead of
+killing every co-batched request: the flight recorder's last record
+names the failing tick's mode and unit composition, each suspect is
+re-executed INDIVIDUALLY (decode slots probe solo through the warmed
+chunk executable, prefill records probe as standalone single-record
+calls), and only convicted culprits fail — the innocent requeue at the
+FRONT of the admission queue and re-admit with `prompt + tokens`, so
+greedy decode resumes exactly where it stopped (warm via the prefix
+cache; streamed tokens are never re-emitted or lost). A culprit whose
+failure looks transient (`retry_transient` predicate) gets
+`max_retries` backoff re-admissions before FAILED. A hung device call
+is caught by the watchdog thread (`watchdog_s`): it dumps the flight
+recorder, flips `health()` to UNHEALTHY, fails the stranded requests'
+handles and lets shutdown()/drain() return instead of silently
+hanging. `health()` is the per-replica signal a multi-replica router
+polls; `serving.faults.FaultInjector` makes every one of these paths
+deterministically testable. shutdown(drain=True) stops admissions,
+drains in-flight work, then joins the thread.
 
 Observability (serving.trace): a per-request TraceSink timeline rides
 every request (enqueued → admitted → prefill chunks → first token →
@@ -49,11 +64,29 @@ from .request import GenerationRequest, RequestState
 from .scheduler import AdmissionQueue, QueueFullError
 from .trace import TraceSink
 
-__all__ = ["ServingEngine", "EngineStopped"]
+__all__ = ["ServingEngine", "EngineStopped", "HungStepError"]
 
 
 class EngineStopped(RuntimeError):
     """submit() after shutdown began."""
+
+
+class HungStepError(RuntimeError):
+    """A device step exceeded the watchdog deadline: the engine thread
+    is presumed wedged inside a device call that will never return.
+    Attached as the terminal error to every stranded request and kept
+    on `last_flight_dump` — `health()` reports UNHEALTHY from the
+    moment the watchdog trips."""
+
+
+def _default_transient(error: BaseException) -> bool:
+    """The default retry predicate: injected faults flagged transient
+    (`serving.faults.InjectedFault(transient=True)`) and
+    RESOURCE_EXHAUSTED-shaped device errors (allocator pressure passes;
+    a retry after backoff usually lands) are worth re-admitting —
+    everything else is treated as deterministic and fails fast."""
+    return bool(getattr(error, "transient", False)) \
+        or "RESOURCE_EXHAUSTED" in repr(error)
 
 
 class ServingEngine:
@@ -88,6 +121,12 @@ class ServingEngine:
                  warmup: bool = False,
                  trace: bool = True, flight_recorder_cap: int = 64,
                  flight_dump_path: Optional[str] = None,
+                 quarantine: bool = True, max_retries: int = 2,
+                 retry_backoff_s: float = 0.05,
+                 retry_transient=None,
+                 watchdog_s: Optional[float] = None,
+                 health_window_s: float = 30.0,
+                 fault_injector=None,
                  clock=time.monotonic):
         # observability: per-request timelines (always-on-cheap unless
         # trace=False) + the batcher's step flight recorder; a step
@@ -111,7 +150,8 @@ class ServingEngine:
             max_prefill_bucket=max_prefill_bucket,
             fused_prefill=fused_prefill, fused_units=fused_units,
             attention_impl=attention_impl, trace=self.trace,
-            flight_recorder_cap=flight_recorder_cap)
+            flight_recorder_cap=flight_recorder_cap,
+            fault_injector=fault_injector)
         # the RESOLVED backend ("auto" already collapsed to the concrete
         # choice at batcher construction) — bench/snapshot surface
         self.attention_impl = self.batcher.attention_impl
@@ -130,6 +170,25 @@ class ServingEngine:
         self._thread: Optional[threading.Thread] = None
         self._alloc_stats = self.batcher.alloc.stats()
         self._prefix_stats = self.batcher.prefix_stats()
+        # fault tolerance: quarantine-by-bisection on step failures,
+        # transient-culprit retries with exponential backoff, hung-step
+        # watchdog, and the health surface a replica router polls
+        self._quarantine_on = bool(quarantine)
+        self._max_retries = int(max_retries)
+        self._retry_backoff_s = float(retry_backoff_s)
+        self._retry_transient = retry_transient or _default_transient
+        self._watchdog_s = watchdog_s
+        self._health_window_s = float(health_window_s)
+        self._parked: List[List] = []       # [ready_time, request]
+        self._wedged = False
+        self._last_fault_t: Optional[float] = None
+        self._fault_streak = 0              # consecutive failed steps
+        self._max_fault_streak = 8          # livelock fuse: then fail-all
+        self._flight_seq = self.batcher.flight.seq
+        self._step_t0: Optional[float] = None   # watchdog reads this
+        self._wd_thread: Optional[threading.Thread] = None
+        self._wd_stop = threading.Event()
+        self._last_dump_error: Optional[str] = None
 
         m = self.metrics
         self._c_submitted = m.counter("requests_submitted")
@@ -171,6 +230,13 @@ class ServingEngine:
         # EVERY compiled device-step shape (prefill/fused ladder + the
         # plain decode chunk) — the zero-post-warmup-recompiles gate
         self._g_compiles = m.gauge("compile_count")
+        # fault-tolerance surface: the counters health() aggregates
+        self._c_step_faults = m.counter("step_faults")
+        self._c_quarantines = m.counter("quarantines")
+        self._c_requeued = m.counter("requests_requeued")
+        self._c_retried = m.counter("requests_retried")
+        self._c_watchdog = m.counter("watchdog_trips")
+        self._c_dump_errors = m.counter("flight_dump_errors")
 
         if warmup:
             self.warmup()
@@ -202,6 +268,11 @@ class ServingEngine:
                     target=self._loop, name="paddle-tpu-serving",
                     daemon=True)
                 self._thread.start()
+            if self._watchdog_s is not None and self._wd_thread is None:
+                self._wd_thread = threading.Thread(
+                    target=self._watchdog_loop,
+                    name="paddle-tpu-watchdog", daemon=True)
+                self._wd_thread.start()
         return self
 
     def submit(self, prompt, *, priority: int = 0,
@@ -291,13 +362,16 @@ class ServingEngine:
     @property
     def is_idle(self) -> bool:
         with self._lock:
-            return not self._running and not len(self.queue)
+            return (not self._running and not len(self.queue)
+                    and not self._parked)
 
     def drain(self, timeout: Optional[float] = None) -> bool:
-        """Block until queue + in-flight are empty; False on timeout."""
+        """Block until queue + parked retries + in-flight are empty;
+        False on timeout. Returns promptly after a watchdog trip (the
+        stranded set is already failed — nothing will ever drain)."""
         deadline = None if timeout is None else self._clock() + timeout
         with self._work:
-            while self._running or len(self.queue):
+            while self._running or len(self.queue) or self._parked:
                 rem = self._idle_poll_s if deadline is None else \
                     min(self._idle_poll_s, deadline - self._clock())
                 if rem <= 0:
@@ -323,10 +397,21 @@ class ServingEngine:
         with self._work:
             self._stop = True
             self._work.notify_all()
+        self._wd_stop.set()
+        if self._wd_thread is not None:
+            self._wd_thread.join(1.0)
         if self._thread is not None:
             # one shared budget: drain may have spent part (or all) of it
-            self._thread.join(None if deadline is None else
-                              max(0.0, deadline - self._clock()))
+            budget = (None if deadline is None
+                      else max(0.0, deadline - self._clock()))
+            if self._wedged:
+                # the engine thread is presumed wedged inside a device
+                # call that may never return — a bounded join instead
+                # of a silent hang; every request handle was already
+                # failed by the watchdog, so nothing is lost by leaving
+                # the daemon thread behind
+                budget = 1.0 if budget is None else min(budget, 1.0)
+            self._thread.join(budget)
             if self._thread.is_alive():
                 # still mid decode-step; it cancels pending work itself
                 # at the next loop check (only the engine thread may
@@ -342,7 +427,11 @@ class ServingEngine:
             self._cancel_pending()
 
     def _cancel_pending(self) -> None:
-        """Cancel everything queued + in flight (lock held)."""
+        """Cancel everything queued + parked + in flight (lock held)."""
+        for _, req in self._parked:
+            self._finish_locked(req, RequestState.CANCELLED,
+                                "engine_shutdown")
+        self._parked.clear()
         for req in self.queue.clear():
             self._finish_locked(req, RequestState.CANCELLED,
                                 "engine_shutdown")
@@ -369,7 +458,46 @@ class ServingEngine:
             snap["allocator"] = dict(self._alloc_stats)
             snap["prefix_cache"] = dict(self._prefix_stats)
             snap["attention_impl"] = self.attention_impl
+            # operators must notice missing forensics: the last failed
+            # flight-dump disk write (None when every write landed)
+            snap["last_flight_dump_error"] = self._last_dump_error
+            snap["health"] = self._health_locked()
         return snap
+
+    def health(self) -> Dict:
+        """Per-replica health: the signal a multi-replica router polls
+        before routing traffic here. `status` is "HEALTHY" (no recent
+        faults), "DEGRADED" (a step fault/quarantine inside the last
+        `health_window_s` — the engine recovered and keeps serving), or
+        "UNHEALTHY" (the hung-step watchdog tripped: the engine thread
+        is presumed wedged and no longer serves). The counters cover
+        the engine's lifetime; `last_fault_age_s` and `parked_retries`
+        describe right now."""
+        with self._lock:
+            return self._health_locked()
+
+    def _health_locked(self) -> Dict:
+        now = self._clock()
+        if self._wedged:
+            status = "UNHEALTHY"
+        elif (self._last_fault_t is not None
+              and now - self._last_fault_t <= self._health_window_s):
+            status = "DEGRADED"
+        else:
+            status = "HEALTHY"
+        return {
+            "status": status,
+            "step_faults": self._c_step_faults.value,
+            "quarantines": self._c_quarantines.value,
+            "requests_requeued": self._c_requeued.value,
+            "requests_retried": self._c_retried.value,
+            "requests_failed": self._c_failed.value,
+            "watchdog_trips": self._c_watchdog.value,
+            "flight_dump_errors": self._c_dump_errors.value,
+            "last_fault_age_s": (None if self._last_fault_t is None
+                                 else now - self._last_fault_t),
+            "parked_retries": len(self._parked),
+        }
 
     def dump_flight_recorder(self, path: Optional[str] = None) -> Dict:
         """On-demand forensic dump: the batcher's last-N step records
@@ -419,13 +547,20 @@ class ServingEngine:
             try:
                 with open(self._flight_dump_path, "w") as f:
                     f.write(self.last_flight_dump_json)
-            except OSError:
-                pass
+            except OSError as we:
+                # counted, never silent: missing forensics on disk is
+                # an operational fact snapshot()/health() must surface
+                # even though it may not mask the original step error
+                self._c_dump_errors.inc()
+                with self._lock:
+                    self._last_dump_error = repr(we)
 
     # ---- engine thread ---------------------------------------------------
     def _loop(self) -> None:
         while True:
             with self._work:
+                if self._wedged:
+                    return    # watchdog tore everything down already
                 if self._stop:
                     # exit path owns the batcher: cancel whatever is
                     # left so no consumer stays blocked on its channel
@@ -433,9 +568,19 @@ class ServingEngine:
                     return
                 self._reap_queued_locked()
                 self._reap_running_locked()
+                self._release_parked_locked()
                 self._admit_locked()
                 self._update_gauges_locked()
                 if not self._running and not len(self.queue):
+                    if self._parked:
+                        # a backoff retry is the only pending work:
+                        # sleep just until the earliest one is ready
+                        delay = min(e[0] for e in self._parked) \
+                            - self._clock()
+                        if delay > 0:
+                            self._work.wait(min(self._idle_poll_s,
+                                                delay))
+                        continue
                     if not self._accepting:
                         return            # graceful drain complete
                     self._work.notify_all()      # wake drain() waiters
@@ -448,18 +593,37 @@ class ServingEngine:
             # ever touched from this thread, so submit()/cancel() stay
             # responsive during device work
             timer = self.metrics.timer("serving.step_s")
+            self._step_t0 = self._clock()    # watchdog arms on this
             try:
                 with timer:
                     emitted, finished = self.batcher.step()
-            # ptlint: disable=EXC001 — step boundary: the error is attached
-            # to every in-flight request and re-raised in their result()
+            # ptlint: disable=EXC001 — step boundary: quarantine decides
+            # per-request fate; errors re-raise in culprits' result()
             except Exception as e:        # device-step boundary
+                self._step_t0 = None
+                if self._wedged:
+                    continue  # watchdog already failed the stranded set
                 # forensics FIRST: the dump captures the queue/pool
-                # state at failure, before _fail_all_running tears the
-                # in-flight set down
+                # state at failure, before recovery reshuffles the
+                # in-flight set
                 self._record_failure_dump(e)
-                self._fail_all_running(e)
+                self._fault_streak += 1
+                ticked = self.batcher.flight.seq != self._flight_seq
+                if (self._quarantine_on and ticked
+                        and self._fault_streak <= self._max_fault_streak):
+                    self._quarantine(e)
+                else:
+                    # no tick recorded (admission-time failure — the
+                    # ring's last record is stale, no basis to convict)
+                    # or the livelock fuse blew: conservative fail-all
+                    self._fail_all_running(e)
+                self._flight_seq = self.batcher.flight.seq
                 continue
+            self._step_t0 = None
+            self._fault_streak = 0
+            self._flight_seq = self.batcher.flight.seq
+            if self._wedged:
+                continue      # stranded set already failed; don't dispatch
             self._dispatch(emitted, finished, step_dt=timer.elapsed)
 
     def _reap_queued_locked(self) -> None:
@@ -469,6 +633,16 @@ class ServingEngine:
             state = (RequestState.CANCELLED if req.cancel_requested
                      else RequestState.TIMED_OUT)
             self._finish_locked(req, state, "reaped_in_queue")
+        # parked backoff retries honor cancellation/deadlines too — a
+        # retry waiting out its backoff is still the consumer's request
+        dead = [e for e in self._parked
+                if e[1].cancel_requested or self._expired(e[1], now)]
+        if dead:
+            self._parked = [e for e in self._parked if e not in dead]
+            for _, req in dead:
+                state = (RequestState.CANCELLED if req.cancel_requested
+                         else RequestState.TIMED_OUT)
+                self._finish_locked(req, state, "reaped_parked")
 
     def _reap_running_locked(self) -> None:
         now = self._clock()
@@ -483,6 +657,14 @@ class ServingEngine:
 
     def _expired(self, req: GenerationRequest, now: float) -> bool:
         return req.deadline is not None and now > req.deadline
+
+    @staticmethod
+    def _effective(req: GenerationRequest) -> List[int]:
+        """The prompt a (re-)admission actually prefills: the original
+        prompt plus every token already streamed — a fresh request's is
+        just its prompt; a quarantine-requeued victim's resumes decode
+        from where the failed step stopped."""
+        return req.prompt + req.tokens if req.tokens else req.prompt
 
     def _admit_locked(self) -> None:
         b = self.batcher
@@ -503,7 +685,8 @@ class ServingEngine:
 
             def prefer(r):
                 if id(r) not in warm:
-                    warm[id(r)] = b.prefix_cached_tokens(r.prompt) > 0
+                    warm[id(r)] = b.prefix_cached_tokens(
+                        self._effective(r)) > 0
                 return warm[id(r)]
         budget = {"blocks": b.alloc.free_blocks}
 
@@ -512,8 +695,9 @@ class ServingEngine:
             # an in-flight request needs fewer blocks of its own.
             # pop_many calls fits once per ACCEPTED item, so the block
             # budget is debited right here.
-            n = b.blocks_needed(len(r.prompt), r.max_new_tokens,
-                                tokens=r.prompt)
+            eff = self._effective(r)
+            n = b.blocks_needed(len(eff), r.max_new_tokens - len(r.tokens),
+                                tokens=eff)
             if n > budget["blocks"]:
                 return False
             budget["blocks"] -= n
@@ -535,21 +719,33 @@ class ServingEngine:
                          else RequestState.TIMED_OUT)
                 self._finish_locked(req, state, "reaped_at_admission")
                 continue
-            rid = b.submit(req.prompt, stop_token_id=req.stop_token_id,
-                           max_new_tokens=req.max_new_tokens)
+            # resume-aware: a quarantine/retry re-admission carries the
+            # tokens already streamed as part of its prompt (warm via
+            # the prefix cache) with the remaining budget, so decode
+            # picks up exactly where it stopped and nothing re-emits
+            resumed = bool(req.tokens) or req.admit_time is not None
+            rid = b.submit(self._effective(req),
+                           stop_token_id=req.stop_token_id,
+                           max_new_tokens=req.max_new_tokens
+                           - len(req.tokens))
             req.request_id = rid
             req.state = RequestState.PREFILL
-            req.admit_time = now
             if self.trace is not None and req.trace_id is not None:
                 # batcher-side emissions (prepared / prefill_chunk /
                 # retired) resolve to this request's timeline via rid
                 self.trace.alias(rid, req.trace_id)
                 self.trace.emit(req.trace_id, "admitted", rid=rid,
+                                resumed=resumed,
                                 queue_wait_s=now - req.submit_time)
-            req.admitted_index = self._admit_seq
-            self._admit_seq += 1
-            self._h_wait.observe(now - req.submit_time)
-            self._c_admitted.inc()
+            if not resumed:
+                # first admission only: queue-wait/admitted measure the
+                # original arrival, not recovery churn (requeues and
+                # retries have their own counters)
+                req.admit_time = now
+                req.admitted_index = self._admit_seq
+                self._admit_seq += 1
+                self._h_wait.observe(now - req.submit_time)
+                self._c_admitted.inc()
             self._running[rid] = req
 
     def _dispatch(self, emitted: Dict[int, List[int]],
@@ -646,8 +842,187 @@ class ServingEngine:
         req._finish(state, reason, error=error, now=self._clock())
         self._work.notify_all()
 
-    def _fail_all_running(self, error: BaseException) -> None:
+    # ---- fault tolerance -------------------------------------------------
+    def _quarantine(self, error: BaseException) -> None:
+        """Step-failure recovery (engine thread): convict by re-running
+        the failing tick's units individually, FAIL (or park for a
+        backoff retry) only the culprits, and requeue every innocent
+        in-flight request at the front of the admission queue — each
+        victim re-admits with `prompt + tokens` so greedy decode
+        resumes exactly where it stopped, warm through the prefix
+        cache (the failed tick's retire path registered its blocks).
+
+        Suspects come from the flight recorder's last record: decode
+        slot rids for a decode tick, decode rids + unit rids for a
+        fused tick, unit rids for a standalone prefill (the batcher
+        already rolled those back onto its queue). A suspect whose solo
+        probe raises is a culprit; when NO probe reproduces the failure
+        (a transient — fail-once-then-heal, allocator pressure), every
+        suspect is treated as a transient culprit and charged a retry,
+        so recovery still converges instead of replaying the same
+        doomed co-batch forever."""
+        b = self.batcher
+        records = b.flight.records()
+        rec = records[-1] if records else {}
+        mode = rec.get("mode")
+        if mode == "fused":
+            suspects = list(rec.get("decode_rids", [])) + \
+                [r for u in rec.get("units", []) for r in u]
+        else:                       # "decode" | "prefill" both use rids
+            suspects = list(rec.get("rids", []))
+        with self._lock:
+            self._c_step_faults.inc()
+            self._c_quarantines.inc()
+            self._last_fault_t = self._clock()
+            suspects = [r for r in suspects if r in self._running]
+        # probes run OUTSIDE the lock (device work; only this thread
+        # touches the batcher) so submit()/cancel() stay responsive —
+        # and UNDER the watchdog (_step_t0 armed per probe): a probe is
+        # a device re-execution and can hang exactly like the step did
+        culprits: Dict[int, BaseException] = {}
+        for rid in suspects:
+            slot = next((s for s in range(b.B)
+                         if b.active[s] and b.slot_req[s] == rid), None)
+            self._step_t0 = self._clock()
+            try:
+                if slot is not None:
+                    b.probe_decode_slot(slot)
+                else:
+                    b.probe_queued(rid)
+            # ptlint: disable=EXC001 — probe verdict boundary: ANY error
+            # re-raised solo convicts this request; it is attached to the
+            # handle and re-raised in its result()
+            except Exception as pe:
+                culprits[rid] = pe
+            finally:
+                self._step_t0 = None
+            if self._wedged:
+                # a hung probe tripped the watchdog: every handle is
+                # already failed — no recovery left to run
+                return
+        convicted = bool(culprits)
+        if not convicted:
+            # nobody reproduces solo: transient — every suspect pays a
+            # retry (bounded by max_retries, so this converges)
+            culprits = {rid: error for rid in suspects}
         with self._work:
+            order = sorted(self._running.items(),
+                           key=lambda kv: kv[1].admitted_index or 0)
+            victims: List[GenerationRequest] = []
+            for rid, req in order:
+                b.abort(rid)
+                b.release(rid)
+                self._last_emit.pop(rid, None)
+                if rid in culprits:
+                    self._retry_or_fail_locked(req, culprits[rid],
+                                               convicted)
+                else:
+                    victims.append(req)
+            self._running.clear()
+            for req in victims:
+                self._c_requeued.inc()
+                if self.trace is not None and req.trace_id is not None:
+                    self.trace.emit(req.trace_id, "requeued",
+                                    reason="quarantine_victim",
+                                    tokens_kept=len(req.tokens))
+            self.queue.requeue(victims)
+            self._update_gauges_locked()
+            self._work.notify_all()
+
+    def _retry_or_fail_locked(self, req: GenerationRequest,
+                              error: BaseException,
+                              convicted: bool) -> None:
+        """A quarantined culprit's fate: transient-looking failures
+        (per the `retry_transient` predicate) park for an exponential-
+        backoff re-admission until `max_retries` is spent; everything
+        else — and an exhausted budget — is terminal FAILED."""
+        try:
+            transient = bool(self._retry_transient(error))
+        # ptlint: disable=EXC001 — user-supplied predicate boundary: a
+        # broken predicate must degrade to fail-fast, not kill the loop
+        except Exception:
+            transient = False
+        if transient and req.retries < self._max_retries:
+            req.retries += 1
+            self._c_retried.inc()
+            backoff = self._retry_backoff_s * (2.0 ** (req.retries - 1))
+            if self.trace is not None and req.trace_id is not None:
+                self.trace.emit(req.trace_id, "retried",
+                                retries=req.retries, backoff_s=backoff,
+                                convicted=convicted, error=repr(error))
+            self._parked.append([self._clock() + backoff, req])
+        else:
+            reason = ("retries_exhausted" if transient
+                      else "quarantine_culprit")
+            self._finish_locked(req, RequestState.FAILED, reason,
+                                error=error)
+
+    def _release_parked_locked(self) -> None:
+        """Move backoff-expired retries to the front of the admission
+        queue (they held admission before; fresh traffic waits)."""
+        if not self._parked:
+            return
+        now = self._clock()
+        ready = [e[1] for e in self._parked if e[0] <= now]
+        if ready:
+            self._parked = [e for e in self._parked if e[0] > now]
+            self.queue.requeue(ready)
+
+    def _watchdog_loop(self) -> None:
+        """Monitor thread: a device step still running past
+        `watchdog_s` means the engine thread is wedged inside a call
+        that may never return — dump forensics, flip health to
+        UNHEALTHY and fail the stranded requests' HANDLES (channels
+        and events only: the batcher belongs to the wedged thread and
+        its device state is unrecoverable anyway) so consumers,
+        drain() and shutdown() unblock with a clear error."""
+        poll = max(0.005, min(0.05, self._watchdog_s / 4.0))
+        while not self._wd_stop.wait(poll):
+            t0 = self._step_t0
+            if t0 is None or self._wedged:
+                continue
+            stuck = self._clock() - t0
+            if stuck > self._watchdog_s:
+                self._trip_watchdog(stuck)
+
+    def _trip_watchdog(self, stuck_s: float) -> None:
+        err = HungStepError(
+            f"device step exceeded the {self._watchdog_s}s watchdog "
+            f"deadline ({stuck_s:.3f}s and counting) — engine thread "
+            f"presumed wedged; see last_flight_dump for the hung "
+            f"tick's mode and unit composition")
+        # forensics first: the flight ring's last record IS the hung
+        # tick (recorded before its device call)
+        self._record_failure_dump(err)
+        with self._work:
+            if self._wedged:
+                return
+            self._wedged = True
+            self._accepting = False
+            self._c_watchdog.inc()
+            self._c_step_faults.inc()
+            self._last_fault_t = self._clock()
+            stranded = list(self._running.items())
+            self._running.clear()
+            parked = [e[1] for e in self._parked]
+            self._parked.clear()
+            queued = self.queue.clear()
+            for _, req in stranded:
+                self._finish_locked(req, RequestState.FAILED,
+                                    "watchdog_hung_step", error=err)
+            for req in parked + queued:
+                self._finish_locked(req, RequestState.FAILED,
+                                    "watchdog_engine_unhealthy",
+                                    error=err)
+            self._work.notify_all()
+
+    def _fail_all_running(self, error: BaseException) -> None:
+        """The conservative step-failure fallback (quarantine off, no
+        tick recorded, or the consecutive-failure fuse blew): every
+        in-flight request fails with the step error attached."""
+        with self._work:
+            self._c_step_faults.inc()
+            self._last_fault_t = self._clock()
             for rid, req in list(self._running.items()):
                 self.batcher.abort(rid)
                 self.batcher.release(rid)

@@ -15,7 +15,11 @@ State machine (engine-thread writes, any thread reads):
 
 QUEUED can jump straight to CANCELLED / TIMED_OUT / FAILED (reaped
 before admission). Terminal states free the request's KV blocks back to
-the pool and close the channel.
+the pool and close the channel. One loop exists off the happy path:
+the engine's quarantine may requeue an in-flight request after a step
+failure, re-entering PREFILL from PREFILL or DECODING — the request
+resumes from `prompt + tokens`, so the channel only ever sees each
+token once.
 """
 from __future__ import annotations
 
@@ -88,7 +92,14 @@ class GenerationRequest:
     emitted (per-request — rides the ContinuousBatcher's per-slot stop
     support). `on_token` is called in the engine thread per generated
     token; if it raises, only THIS request fails (the engine's
-    exception boundary)."""
+    exception boundary).
+
+    Fault tolerance: `retries` counts backoff re-admissions the
+    engine's quarantine granted this request as a transient-failure
+    culprit (victims of SOMEONE ELSE'S fault are requeued without
+    consuming it). A re-admitted request resumes from
+    `prompt + tokens` — already-streamed tokens are never re-emitted
+    or lost — and `request_id` moves to the new batcher rid."""
 
     def __init__(self, prompt, *, priority: int = 0,
                  max_new_tokens: Optional[int] = None,
@@ -110,6 +121,7 @@ class GenerationRequest:
         self.tokens: List[int] = []
         self.error: Optional[BaseException] = None
         self.finish_reason: Optional[str] = None
+        self.retries = 0          # transient-culprit re-admissions used
 
         # engine-stamped timeline (engine clock, typically time.monotonic)
         self.request_id: Optional[int] = None       # batcher rid once admitted

@@ -43,6 +43,12 @@ class AdmissionQueue:
     9 intervals competes with fresh priority-0 traffic. Ties (same
     effective priority) break by submission order."""
 
+    # requeued items outrank every real priority level; aging can only
+    # make real priorities SMALLER over time, but never by anywhere
+    # near this much (2^30 aging intervals), so front entries stay in
+    # front without freezing the aging math
+    _FRONT_PRIORITY = -(1 << 30)
+
     def __init__(self, max_depth: int = 256,
                  aging_interval_s: float = 2.0,
                  clock: Callable[[], float] = time.monotonic):
@@ -53,6 +59,7 @@ class AdmissionQueue:
         self._clock = clock
         self._items: List[_Entry] = []
         self._seq = 0
+        self._front = 0        # decreasing seqs for front-requeued items
         self._lock = threading.Lock()
 
     def __len__(self) -> int:
@@ -118,6 +125,23 @@ class AdmissionQueue:
                 self._items.remove(best)
                 out.append(best.item)
         return out
+
+    def requeue(self, items) -> None:
+        """Insert `items` at the FRONT of the queue — before every
+        waiting request at any priority, preserving the given order
+        among themselves (a later requeue batch goes in front of an
+        earlier one). The engine's quarantine/retry paths use this to
+        re-admit recovered in-flight work before fresh traffic, so a
+        step failure costs the victims one re-prefill, not a trip to
+        the back of the line. Deliberately exempt from `max_depth`:
+        these items already held admission once, and bouncing them on
+        backpressure would turn recovery into data loss."""
+        with self._lock:
+            now = self._clock()
+            for item in reversed(list(items)):
+                self._front -= 1
+                self._items.append(_Entry(self._FRONT_PRIORITY,
+                                          self._front, now, item))
 
     def peek(self):
         """The item pop() would consider next (no removal)."""

@@ -60,7 +60,11 @@ class TraceSink:
     batcher rid onto it so batcher-side emissions resolve to the same
     timeline, `emit()` appends typed events, and `finish()` appends the
     terminal event and moves the timeline onto a bounded ring of
-    completed requests. An int ref with no alias auto-opens a timeline
+    completed requests. Event kinds are free-form strings; the serving
+    stack's vocabulary includes the fault-tolerance events `requeued`
+    (a quarantine victim or rolled-back pending sibling going back to
+    the queue front) and `retried` (a transient culprit parked for a
+    backoff re-admission) next to the lifecycle kinds listed above. An int ref with no alias auto-opens a timeline
     keyed ``rid<n>`` so a standalone `ContinuousBatcher` can trace
     without an engine.
 
@@ -329,6 +333,17 @@ class FlightRecorder:
     def cap(self) -> int:
         """Ring capacity: the last `cap` step records are retained."""
         return self._ring.maxlen
+
+    @property
+    def seq(self) -> int:
+        """Records ever written (not just retained). The engine's
+        quarantine compares this against the value it saw after the
+        last successful step: an exception with an UNCHANGED seq came
+        from before any tick was recorded (an admission-time failure),
+        so the ring's last record would be a stale tick — no basis for
+        convicting anyone."""
+        with self._lock:
+            return self._seq
 
     def record(self, mode: str, **fields) -> None:
         """Append one step record: `mode` is the scheduler's decision

@@ -13,7 +13,12 @@ questions the raw timeline is too granular for:
   * cache-hit attribution — prompt tokens the prefix cache skipped,
     per request and total, next to the tokens actually prefilled;
   * scheduling mix — fused vs standalone prefill chunks, engine step
-    span count/total.
+    span count/total;
+  * recovery churn — the "requeued" phase: how often each request went
+    back to the queue front (quarantine victims, rolled-back pending
+    siblings) and how many backoff retries it consumed, so a
+    fault-tolerance event cascade is visible instead of reading as
+    unexplained repeat prefills.
 
 Standard library only (no jax import): runs anywhere the JSON landed,
 including the CI bench-smoke job where it ships as a non-blocking
@@ -47,7 +52,7 @@ def summarize(events) -> dict:
         "terminal_ts": None, "terminal": None, "prompt_len": None,
         "slot": None, "prefill_ms": 0.0, "chunks": 0, "fused_chunks": 0,
         "pad_tokens": 0, "real_tokens": 0, "cached_tokens": 0,
-        "generated": 0,
+        "generated": 0, "requeues": 0, "retries": 0,
     })
     steps = {"count": 0, "total_ms": 0.0}
     for e in events:
@@ -80,6 +85,10 @@ def summarize(events) -> dict:
             r["first_token_ts"] = ts
         elif name == "retired":
             r["generated"] = args.get("generated", 0)
+        elif name == "requeued":
+            r["requeues"] += 1
+        elif name == "retried":
+            r["retries"] += 1
         elif name in TERMINAL:
             r["terminal_ts"] = ts
             r["terminal"] = name
@@ -104,6 +113,7 @@ def summarize(events) -> dict:
             "cached_tokens": r["cached_tokens"],
             "prefilled_tokens": r["real_tokens"],
             "pad_tokens": r["pad_tokens"],
+            "requeues": r["requeues"], "retries": r["retries"],
         })
     # (len, str) sorts t2 before t10 — ids are a prefix plus a
     # monotonic sequence number, so length order IS numeric order
@@ -125,6 +135,8 @@ def summarize(events) -> dict:
         if cached + real else 0.0,
         "engine_steps": steps["count"],
         "engine_step_ms_total": round(steps["total_ms"], 3),
+        "requeued_events": sum(x["requeues"] for x in rows),
+        "retried_events": sum(x["retries"] for x in rows),
     }
     return {"total": total, "requests": rows}
 
@@ -151,11 +163,14 @@ def render(summary: dict) -> str:
         f"(hit rate {t['cache_hit_rate']:.1%})",
         f"engine steps: {t['engine_steps']} "
         f"({t['engine_step_ms_total']:.1f} ms total)",
+        f"recovery: {t['requeued_events']} requeues, "
+        f"{t['retried_events']} retries",
         "",
     ]
     cols = ["trace_id", "terminal", "slot", "prompt_len", "generated",
             "queue_wait_ms", "ttft_ms", "decode_ms", "prefill_ms",
-            "chunks", "fused_chunks", "cached_tokens", "pad_tokens"]
+            "chunks", "fused_chunks", "cached_tokens", "pad_tokens",
+            "requeues", "retries"]
     rows = [[_fmt(r[c]) for c in cols] for r in summary["requests"]]
     widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
               for i, c in enumerate(cols)]
