@@ -35,6 +35,10 @@ test:           ## tier-1 test suite (CPU)
 # poison and FAILS unless the quarantine contains it — the culprit
 # alone FAILED, every innocent bit-identical to the fault-free run,
 # zero post-warmup recompiles, allocator drained clean.
+# Quantized leg: --quantized runs the fp/w8/int8-KV/w8+int8-KV matrix
+# and FAILS on any post-warmup recompile, any warm-vs-cold token
+# mismatch, int8 KV gather bytes > 0.55x fp, or quantized-vs-fp
+# greedy divergence below the documented floor.
 bench-smoke:    ## tiny serving benches (non-blocking CI job)
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --prefix-share \
 		--n-requests 6 --max-new 4 --trace /tmp/paddle_tpu_trace.json
@@ -44,6 +48,8 @@ bench-smoke:    ## tiny serving benches (non-blocking CI job)
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --fused \
 		--n-requests 8 --max-new 6 --fused-units 2
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --chaos \
+		--n-requests 8 --max-new 6
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --quantized \
 		--n-requests 8 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py \
 		--attention-impl pallas --n-requests 4 --max-new 4

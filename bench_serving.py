@@ -49,6 +49,19 @@ cancels from the pooled tok/s; it HARD-FAILS unless pooled traced
 tok/s holds >= 0.97x pooled untraced with zero post-warmup recompiles
 across all four legs: the gate that keeps tracing always-on-cheap.
 
+Quantized (`--quantized`): the quantized-serving gate. The mixed
+workload runs through FOUR engine configurations — fp, w8 weights,
+int8 paged KV, and w8+int8-KV — each a full lifecycle of AOT warmup, a
+cold round, and a warm round of the SAME prompts (prefix-cache hits
+re-read the quantized pool the cold round committed). HARD-FAILS on
+any post-warmup recompile (the (weight_dtype, kv_dtype) memo keys must
+stay on the warmed ladder), any warm-vs-cold token mismatch, int8 KV
+gather bytes above 0.55x the fp pool's per-token bytes (scale-pool
+overhead included), or quantized-vs-fp greedy divergence below the
+documented floor. The JSON line carries decode_tok_s_{fp,w8,int8kv,
+w8kv8}, kv_pool_bytes, kv_bytes_per_token_{fp,int8}, kv_gather_ratio
+and the per-leg token-match rates.
+
 Chaos (`--chaos`): the fault-isolation gate. The staggered-budget
 admission-during-decode workload runs TWICE — fault-free (the token
 baseline) and with a seeded `serving.faults.FaultInjector` arming a
@@ -94,7 +107,7 @@ def _make_prompts(rng, n_requests: int, workload: str,
         common = list(map(int, rng.randint(1, 200, prefix_len)))
         return [common + list(map(int, rng.randint(1, 200, suffix_len)))
                 for _ in range(n_requests)]
-    if workload in ("mixed", "fused", "chaos"):
+    if workload in ("mixed", "fused", "chaos", "quantized"):
         # lengths spanning the whole ladder, incl. past the largest
         # bucket (chunked prefill) — every request a different length
         return [list(map(int, rng.randint(1, 200, int(L))))
@@ -186,6 +199,143 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
 
 def _ms(v):
     return None if v is None else round(v * 1000.0, 3)
+
+
+# Documented quantized-vs-fp greedy divergence floor on the smoke model
+# (README "Quantized serving" has the bound's rationale): across the
+# workload, at least this fraction of the fp run's greedy tokens must
+# match the quantized run position-for-position up to each request's
+# first divergence. Weight/KV int8 error on the tiny random-init model
+# flips the argmax on a small minority of steps; a collapse below the
+# floor means the quantized math broke, not that rounding moved a
+# borderline logit.
+QUANT_MATCH_FLOOR = 0.60
+
+# int8 KV must at least HALVE the per-token gather bytes vs the fp
+# pool modulo the per-block scale overhead — 0.55x is the gate with
+# that overhead priced in (bs >= 8 keeps the scale share under 5%).
+KV_GATHER_RATIO_CEIL = 0.55
+
+
+def _prefix_match(base, quant) -> float:
+    """Fraction of baseline greedy tokens the quantized run reproduces
+    up to each request's first divergence (1.0 = bit-identical)."""
+    total = sum(len(b) for b in base)
+    lcp = 0
+    for b, t in zip(base, quant):
+        for x, y in zip(b, t):
+            if x != y:
+                break
+            lcp += 1
+    return lcp / total if total else 1.0
+
+
+def _quantized_leg(params, cfg, prompts, budgets, *, weight_dtype,
+                   kv_dtype, **kw) -> dict:
+    """One quantization configuration through a full engine lifecycle:
+    AOT warmup, a COLD round over the workload, then a WARM round of
+    the SAME prompts (prefix-cache hits re-read the quantized pool the
+    cold round committed). HARD-FAILS on any post-warmup recompile
+    (the quantized ladder must be as warmable as fp) and on any
+    warm-vs-cold token mismatch (cached-prefix reads must reproduce
+    the cold prefill exactly — the pool stores what every consumer
+    dequantizes)."""
+    import time as _t
+
+    from paddle_tpu import serving
+
+    eng = serving.ServingEngine(
+        params, cfg, max_batch=kw["max_batch"],
+        block_size=kw["block_size"], max_total_len=64,
+        max_new_tokens=kw["max_new"], chunk=kw["chunk"],
+        max_queue_depth=len(prompts), prefix_cache=kw["prefix_cache"],
+        max_prefill_bucket=kw["max_prefill_bucket"],
+        attention_impl=kw["attention_impl"],
+        fused_units=kw["fused_units"], weight_dtype=weight_dtype,
+        kv_dtype=kv_dtype, start=False)
+    eng.warmup()
+    eng.start()
+    warm_compiles = eng.batcher.compile_count
+    step_h = eng.metrics.histogram("serving.step_s")
+
+    def _round():
+        t0 = _t.perf_counter()
+        s0 = step_h.summary().get("sum", 0.0)
+        reqs = [eng.submit(p, max_new_tokens=mn)
+                for p, mn in zip(prompts, budgets)]
+        if not eng.drain(timeout=600):
+            raise RuntimeError(
+                "quantized drain timed out — benchmark invalid")
+        toks = [r.result() for r in reqs]
+        wall = _t.perf_counter() - t0
+        step_s = step_h.summary().get("sum", 0.0) - s0
+        n = sum(len(t) for t in toks)
+        return toks, n / wall, (n / step_s if step_s else None)
+
+    cold, tok_s, decode_tok_s = _round()
+    warm, _, _ = _round()
+    recompiles = eng.batcher.compile_count - warm_compiles
+    snap = eng.snapshot()
+    eng.shutdown()
+    leg = f"{weight_dtype}/{kv_dtype}"
+    if recompiles:
+        raise RuntimeError(
+            f"quantized leg {leg} recompiled {recompiles} shapes after "
+            f"warmup — the (weight_dtype, kv_dtype) memo keys fell off "
+            f"the warmed ladder")
+    if warm != cold:
+        raise RuntimeError(
+            f"quantized leg {leg} lost warm==cold token parity — "
+            f"cached-prefix reads disagree with the cold prefill under "
+            f"quantization")
+    return {"tokens": cold, "tok_s": tok_s, "decode_tok_s": decode_tok_s,
+            "quant": snap["quantization"]}
+
+
+def _quantized_gates(params, cfg, prompts, budgets, **kw) -> dict:
+    """The --quantized matrix: fp / w8 / int8-KV / w8+int8-KV over the
+    same workload, each warm==cold and recompile-free, plus the two
+    cross-leg gates — int8 KV gather bytes <= 0.55x fp and quantized
+    greedy divergence within the documented floor vs the fp leg."""
+    legs = {}
+    for name, (wd, kd) in (("fp", ("fp", "fp")), ("w8", ("int8", "fp")),
+                           ("int8kv", ("fp", "int8")),
+                           ("w8kv8", ("int8", "int8"))):
+        legs[name] = _quantized_leg(params, cfg, prompts, budgets,
+                                    weight_dtype=wd, kv_dtype=kd, **kw)
+    fp_bpt = legs["fp"]["quant"]["kv_bytes_per_token"]
+    q_bpt = legs["w8kv8"]["quant"]["kv_bytes_per_token"]
+    ratio = q_bpt / fp_bpt
+    if ratio > KV_GATHER_RATIO_CEIL:
+        raise RuntimeError(
+            f"quantized gate: int8 KV gather bytes at {ratio:.3f}x fp "
+            f"(ceiling {KV_GATHER_RATIO_CEIL}) — the int8 pool no "
+            f"longer halves per-token HBM traffic")
+    out = {
+        "kv_bytes_per_token_fp": fp_bpt,
+        "kv_bytes_per_token_int8": q_bpt,
+        "kv_gather_ratio": round(ratio, 4),
+        "kv_pool_bytes": legs["w8kv8"]["quant"]["kv_pool_bytes"],
+        "kv_pool_bytes_fp": legs["fp"]["quant"]["kv_pool_bytes"],
+        "weight_bytes_fp": legs["fp"]["quant"]["weight_bytes"],
+        "weight_bytes_w8": legs["w8"]["quant"]["weight_bytes"],
+        "quantized_recompiles_after_warmup": 0,   # each leg hard-gated
+    }
+    base = legs["fp"]["tokens"]
+    for name in ("w8", "int8kv", "w8kv8"):
+        m = _prefix_match(base, legs[name]["tokens"])
+        if m < QUANT_MATCH_FLOOR:
+            raise RuntimeError(
+                f"quantized gate: {name} greedy output matches only "
+                f"{m:.3f} of the fp run (documented floor "
+                f"{QUANT_MATCH_FLOOR}) — quantization error exceeds "
+                f"the accuracy bound")
+        out[f"quantized_token_match_{name}"] = round(m, 4)
+    for name, leg in legs.items():
+        out[f"tok_s_{name}"] = round(leg["tok_s"], 1)
+        out[f"decode_tok_s_{name}"] = (round(leg["decode_tok_s"], 1)
+                                       if leg["decode_tok_s"] else None)
+    return out
 
 
 def _chaos_leg(params, cfg, prompts, budgets, culprit_idx: int,
@@ -304,7 +454,7 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
               attention_impl=attention_impl, fused_units=fused_units)
 
     base = None
-    if workload in ("fused", "prefix-share", "chaos"):
+    if workload in ("fused", "prefix-share", "chaos", "quantized"):
         # staggered per-request budgets so slots retire at DIFFERENT
         # steps — equal budgets would march the whole batch in lockstep
         # waves and no admission would ever land mid-decode. The fused
@@ -317,6 +467,14 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         # unfused first: the SAME prompts through the PR4 path give the
         # decode_stall_steps / ITL baseline the fused run must beat
         base = _serve(params, cfg, prompts, fused_prefill=False, **kw)
+    quant = None
+    if workload == "quantized":
+        # the fp/w8/int8-KV/w8+int8-KV matrix with its warm==cold,
+        # recompile, gather-bytes and divergence gates; the plain
+        # fp _serve below still provides the base JSON numbers
+        quant = _quantized_gates(
+            params, cfg, prompts, kw["budgets"],
+            **{k: v for k, v in kw.items() if k != "budgets"})
     chaos = None
     if workload == "chaos":
         # fault-free leg first: its per-request tokens are the parity
@@ -403,6 +561,12 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         "prefill_suffix_hist": r["suffix_hist"],
         "fused_steps": r["fused_steps"],
         "decode_stall_steps": r["decode_stall_steps"],
+        # resolved quantization config + byte accounting (bucket_tuner
+        # reads kv_bytes_per_token to price pad tokens in gather bytes)
+        "weight_dtype": snap["quantization"]["weight_dtype"],
+        "kv_dtype": snap["quantization"]["kv_dtype"],
+        "kv_bytes_per_token": snap["quantization"]["kv_bytes_per_token"],
+        "kv_pool_bytes": snap["quantization"]["kv_pool_bytes"],
     }
     pc = snap["prefix_cache"]
     if pc.get("enabled"):
@@ -461,7 +625,10 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
                 f"is no longer always-on-cheap")
     if chaos is not None:
         result.update(chaos)
-    if workload in ("mixed", "fused", "chaos") and r["recompiles"]:
+    if quant is not None:
+        result.update(quant)
+    if workload in ("mixed", "fused", "chaos", "quantized") \
+            and r["recompiles"]:
         raise RuntimeError(
             f"bucketed workload recompiled {r['recompiles']} prefill "
             f"shapes after warmup — the bucket ladder no longer covers "
@@ -488,6 +655,14 @@ def _cli() -> dict:
                          "every innocent finishes bit-identical to the "
                          "fault-free run, recompiles stay 0 and the "
                          "pool drains clean")
+    ap.add_argument("--quantized", action="store_true",
+                    help="quantized-serving gate: the same workload "
+                         "through fp, w8, int8-KV and w8+int8-KV "
+                         "engines; HARD-FAILS on any post-warmup "
+                         "recompile, any warm-vs-cold token mismatch, "
+                         "int8 KV gather bytes > 0.55x fp, or "
+                         "quantized-vs-fp greedy divergence below the "
+                         "documented floor")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="serve with the prefix cache disabled")
     ap.add_argument("--attention-impl", default="auto",
@@ -530,20 +705,24 @@ def _cli() -> dict:
                          "16 for --bucketed/--fused so the workload "
                          "chunks)")
     a = ap.parse_args()
-    if sum((a.prefix_share, a.bucketed, a.fused, a.chaos)) > 1:
-        ap.error("--prefix-share, --bucketed, --fused and --chaos are "
-                 "mutually exclusive")
+    if sum((a.prefix_share, a.bucketed, a.fused, a.chaos,
+            a.quantized)) > 1:
+        ap.error("--prefix-share, --bucketed, --fused, --chaos and "
+                 "--quantized are mutually exclusive")
     workload = ("prefix-share" if a.prefix_share
                 else "mixed" if a.bucketed
                 else "fused" if a.fused
-                else "chaos" if a.chaos else "random")
+                else "chaos" if a.chaos
+                else "quantized" if a.quantized else "random")
     bucket_cap = a.max_prefill_bucket
     if bucket_cap is None:
-        # the mixed/fused/chaos workloads should also exercise CHUNKED
-        # prefill, so cap the ladder below their longest prompts
-        bucket_cap = 16 if workload in ("mixed", "fused", "chaos") else 512
+        # the mixed/fused/chaos/quantized workloads should also exercise
+        # CHUNKED prefill, so cap the ladder below their longest prompts
+        bucket_cap = (16 if workload in ("mixed", "fused", "chaos",
+                                         "quantized") else 512)
     chunk = (a.chunk if a.chunk is not None
-             else 2 if workload in ("fused", "prefix-share", "chaos")
+             else 2 if workload in ("fused", "prefix-share", "chaos",
+                                    "quantized")
              else 4)
     return main(n_requests=a.n_requests, max_new=a.max_new,
                 max_batch=a.max_batch, block_size=a.block_size,

@@ -31,9 +31,15 @@ HOT_PATHS: Tuple[Tuple[str, str], ...] = (
     ("nlp/paged.py",
      r"^(step|run|_step_fused|_prefill_pending|_run_standalone_unit"
      r"|_paged_gqa_attention|forward_paged"
+     r"|_write_pool|_write_pool_int8"
      r"|_trace_emit|_trace_chunks|_record_tick)$"),
     ("nlp/ragged_attention.py",
      r"^(ragged_paged_attention|_rpa_kernel|resolve_attention_impl)$"),
+    # int8 paged-KV math: quantize/rescale/dequantize run inside every
+    # compiled decode and prefill step when kv_dtype="int8" — a host
+    # sync hiding in them would tax every token
+    ("quantization/kv.py",
+     r"^(quantize|dequantize|rescale_codes|scale_of)$"),
     ("serving/engine.py", r"^(_loop|_dispatch|step)$"),
     # trace emission helpers run once per scheduler tick / dispatched
     # token batch with tracing always on — a device sync hiding in an

@@ -333,6 +333,10 @@ class Beta(Distribution):
 
 
 class Dirichlet(Distribution):
+    """Dirichlet(concentration) on the simplex: normalized
+    independent Gammas for sampling, log-multivariate-Beta densities —
+    the conjugate prior over Categorical/Multinomial probs."""
+
     def __init__(self, concentration, name=None):
         self.concentration = _param(concentration)
         shp = jnp.shape(_raw(concentration))
@@ -406,6 +410,9 @@ class Exponential(Distribution):
 
 
 class Gamma(Distribution):
+    """Gamma(concentration, rate): Marsaglia-Tsang rejection sampling
+    under jax.random, log-densities via lgamma/digamma special fns."""
+
     def __init__(self, concentration, rate, name=None):
         self.concentration = _param(concentration)
         self.rate = _param(rate)
@@ -440,6 +447,9 @@ class Gamma(Distribution):
 
 
 class Laplace(Distribution):
+    """Laplace(loc, scale): double-exponential — inverse-CDF
+    sampling from a symmetric uniform, |x - loc| / scale densities."""
+
     def __init__(self, loc, scale, name=None):
         self.loc = _param(loc)
         self.scale = _param(scale)
@@ -472,6 +482,9 @@ class Laplace(Distribution):
 
 
 class Gumbel(Distribution):
+    """Gumbel(loc, scale) extreme-value distribution: -log(-log U)
+    sampling (the max-trick / Gumbel-softmax primitive)."""
+
     def __init__(self, loc, scale, name=None):
         self.loc = _param(loc)
         self.scale = _param(scale)
@@ -505,6 +518,9 @@ class Gumbel(Distribution):
 
 
 class LogNormal(Distribution):
+    """LogNormal(loc, scale): exp of a Normal — log-space densities
+    carry the 1/x change-of-variables term."""
+
     def __init__(self, loc, scale, name=None):
         self.loc = _param(loc)
         self.scale = _param(scale)
@@ -539,6 +555,10 @@ class LogNormal(Distribution):
 
 
 class Multinomial(Distribution):
+    """Multinomial(total_count, probs): total_count Categorical draws
+    summed to a count vector; log_prob is the multinomial coefficient
+    plus the count-weighted log-probs."""
+
     def __init__(self, total_count, probs, name=None):
         self.total_count = int(total_count)
         self.probs = _param(probs)
@@ -584,6 +604,9 @@ class Multinomial(Distribution):
 
 
 class Poisson(Distribution):
+    """Poisson(rate) counts: Knuth/jax.random.poisson sampling,
+    k*log(rate) - rate - lgamma(k+1) densities."""
+
     def __init__(self, rate, name=None):
         self.rate = _param(rate)
         super().__init__(jnp.shape(_raw(rate)))
@@ -607,6 +630,10 @@ class Poisson(Distribution):
 
 
 class StudentT(Distribution):
+    """StudentT(df, loc, scale) heavy-tailed location-scale family:
+    Normal / sqrt(Gamma/df) sampling, Beta-function densities —
+    approaches Normal as df grows."""
+
     def __init__(self, df, loc, scale, name=None):
         self.df = _param(df)
         self.loc = _param(loc)
@@ -953,6 +980,9 @@ ExponentialFamily = Distribution  # base-class parity (natural-parameter
 
 
 class Binomial(Distribution):
+    """Binomial(total_count, probs) successes in total_count trials:
+    log-binomial-coefficient densities, mean/variance in closed form."""
+
     def __init__(self, total_count, probs, name=None):
         self.total_count = _param(total_count)
         self.probs = _param(probs)
@@ -1005,6 +1035,9 @@ class Binomial(Distribution):
 
 
 class Cauchy(Distribution):
+    """Cauchy(loc, scale): undefined-moment heavy tails — tan-of-
+    uniform sampling, arctan CDF; mean/variance deliberately raise."""
+
     def __init__(self, loc, scale, name=None):
         self.loc = _param(loc)
         self.scale = _param(scale)
@@ -1044,6 +1077,11 @@ ChiSquared = Chi2  # informal alias
 
 
 class ContinuousBernoulli(Distribution):
+    """Continuous relaxation of Bernoulli on [0, 1] (the VAE
+    reconstruction density): Bernoulli-shaped log-density plus the
+    lambda-dependent log-normalizer, series-expanded near probs=0.5
+    (the `lims` window) where the closed form is singular."""
+
     def __init__(self, probs, lims=(0.499, 0.501), name=None):
         self.probs = _param(probs)
         self._lims = lims
@@ -1087,6 +1125,10 @@ class ContinuousBernoulli(Distribution):
 
 
 class MultivariateNormal(Distribution):
+    """MVN(loc, covariance|scale_tril|precision): one Cholesky factor
+    drives rsample (loc + L @ eps), log_prob (triangular solve) and
+    entropy — whichever parameterization the caller hands over."""
+
     def __init__(self, loc, covariance_matrix=None, scale_tril=None,
                  precision_matrix=None, name=None):
         self.loc = _param(loc)

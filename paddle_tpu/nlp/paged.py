@@ -49,18 +49,25 @@ if TYPE_CHECKING:  # annotation-only: the nlp -> serving edge stays lazy
 
 from ..kernels.rms_norm import rms_norm_ref
 from ..kernels.rope import rope_freqs, apply_rope_half
+from ..quantization import kv as kvq
 from . import llama
-from .generation import _wq, _mlp_cached, _final_head_cached, _sample
+from .generation import (_wq, _mlp_cached, _final_head_cached, _sample,
+                         quantize_for_serving)
 from .ragged_attention import resolve_attention_impl
 
 
 class PagedKVCache(NamedTuple):
     """k/v: [L, N_blocks, block_size, KV, hd]; table: [B, M] int32 block
-    ids (-1 = unassigned); lengths: [B] int32 tokens currently cached."""
+    ids (-1 = unassigned); lengths: [B] int32 tokens currently cached.
+    k_scale/v_scale: [L, N_blocks] f32 per-(layer, block) abs-max
+    dequant scales when the pool stores int8 codes (kv_dtype="int8",
+    quantization.kv has the math), None for the fp pool."""
     k: jax.Array
     v: jax.Array
     table: jax.Array
     lengths: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
     @property
     def block_size(self) -> int:
@@ -303,11 +310,21 @@ class _Admission(NamedTuple):
     chunks: List[Tuple[int, int, int]]   # (start, end, bucket) per chunk
 
 
-def init_pool(cfg: llama.LlamaConfig, num_blocks: int, block_size: int):
+def init_pool(cfg: llama.LlamaConfig, num_blocks: int, block_size: int,
+              kv_dtype: str = "fp"):
+    """Zeroed K/V pools → (k, v, k_scale, v_scale). The fp pool stores
+    the compute dtype with no scales (None); kv_dtype="int8" stores
+    int8 codes plus zero-initialized [L, N] per-(layer, block) abs-max
+    scales — scale 0 is the never-written sentinel that dequantizes to
+    the same exact zeros a fresh fp pool holds."""
     L, KV, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
                  cfg.head_dim)
+    if kvq.resolve_kv_dtype(kv_dtype) == "int8":
+        z = jnp.zeros((L, num_blocks, block_size, KV, hd), jnp.int8)
+        s = jnp.zeros((L, num_blocks), jnp.float32)
+        return z, z, s, s
     z = jnp.zeros((L, num_blocks, block_size, KV, hd), cfg.dtype)
-    return z, z
+    return z, z, None, None
 
 
 def build_table(allocator: BlockAllocator, lengths, max_len: int,
@@ -338,8 +355,58 @@ def _write_pool(pool, table, positions, new, valid):
     return poolf.reshape(pool.shape)
 
 
+def _write_pool_int8(pool, scale, table, positions, new, valid):
+    """int8 twin of `_write_pool`: quantize new [B, P, KV, hd] rows into
+    the int8 pool [N, bs, KV, hd] through the block table, maintaining
+    ONE per-block abs-max scale [N] (this layer's slice of the sibling
+    scale pool; quantization.kv holds the math). Grow-only scale
+    discipline: when this call's writes raise a block's abs-max, the
+    block's EXISTING codes rescale once under the new scale — only the
+    TOUCHED blocks gather/rescale/scatter (a full-pool pass would cost
+    O(pool) HBM every decode step). Returns (pool', scale', dq): dq is
+    the just-written rows dequantized at the committed scales, so the
+    cold-prefill flash path attends over exactly what the pool now
+    stores (warm reads of the same blocks see the same values —
+    warm == cold by construction, not by tolerance)."""
+    N, bs = pool.shape[0], pool.shape[1]
+    B, P = positions.shape
+    blk = jnp.take_along_axis(table, positions // bs, axis=1)   # [B, P]
+    new32 = new.astype(jnp.float32)
+    amax_w = jnp.where(valid, jnp.max(jnp.abs(new32), axis=(2, 3)), 0.0)
+    tgt = jnp.where(valid, blk, N)            # invalid writes drop at N
+    amax = jnp.zeros((N,), jnp.float32).at[tgt.reshape(-1)].max(
+        amax_w.reshape(-1), mode="drop")
+    scale2 = jnp.maximum(scale, kvq.scale_of(amax))
+    touched = jnp.clip(blk, 0)
+
+    def _rescale_touched(p):
+        # duplicate targets all scatter the same rescaled contents
+        sub = kvq.rescale_codes(p[touched],
+                                scale[touched][:, :, None, None, None],
+                                scale2[touched][:, :, None, None, None])
+        return p.at[tgt.reshape(-1)].set(
+            sub.reshape(B * P, bs, *p.shape[2:]), mode="drop")
+
+    # rescale the touched blocks ONLY when some scale actually grew:
+    # the steady-state decode step (no growth) would otherwise read and
+    # rewrite every slot's full block just to store identical codes —
+    # 2*block_size x the fp path's one-row write, eroding the gather-
+    # bytes win. The no-growth branch is an exact no-op by the rescale
+    # identity, so skipping it never changes pool contents.
+    pool = lax.cond(jnp.any(scale2 > scale), _rescale_touched,
+                    lambda p: p, pool)
+    # quantize + scatter the new rows at the committed block scales
+    s_tok = scale2[touched][:, :, None, None]                 # [B, P, 1, 1]
+    codes = kvq.quantize(new32, s_tok)
+    flat = jnp.where(valid, blk * bs + positions % bs, N * bs)
+    poolf = pool.reshape(N * bs, *pool.shape[2:])
+    poolf = poolf.at[flat.reshape(-1)].set(
+        codes.reshape(B * P, *codes.shape[2:]), mode="drop")
+    return poolf.reshape(pool.shape), scale2, kvq.dequantize(codes, s_tok)
+
+
 def _paged_gqa_attention(q, k_pool, v_pool, table, positions, valid=None,
-                         impl: str = "xla"):
+                         impl: str = "xla", k_scale=None, v_scale=None):
     """q [B, P, H, hd] against pool blocks gathered through the table.
     positions [B, P]: query p sees pool keys at absolute positions
     j <= positions[b, p] — per-query causal, so this one path serves
@@ -353,16 +420,35 @@ def _paged_gqa_attention(q, k_pool, v_pool, table, positions, valid=None,
     ignores `valid` — padded rows compute never-read garbage).
     impl="pallas" dispatches to the ragged Pallas kernel, which walks
     only each request's live block chain and zeroes invalid rows;
-    parity is tight-tolerance, not bitwise (online softmax)."""
+    parity is tight-tolerance, not bitwise (online softmax).
+
+    k_scale/v_scale [N] f32 (this layer's per-block scales) mark an
+    int8 pool: the XLA path dequantizes AFTER the gather (the bit-
+    stable reference formulation), the Pallas kernel dequantizes inside
+    its block-chunk loop with the scales riding scalar prefetch — so
+    the quantized gather moves int8 bytes, not fp bytes."""
     if impl == "pallas":
         from .ragged_attention import ragged_paged_attention
         return ragged_paged_attention(q, k_pool, v_pool, table, positions,
-                                      valid)
+                                      valid, k_scale=k_scale,
+                                      v_scale=v_scale)
     B, P, H, hd = q.shape
     N, bs, KV, _ = k_pool.shape
     M = table.shape[1]
-    k = k_pool[jnp.clip(table, 0)].reshape(B, M * bs, KV, hd)
-    v = v_pool[jnp.clip(table, 0)].reshape(B, M * bs, KV, hd)
+    tb = jnp.clip(table, 0)
+    if k_scale is not None:
+        # dequantize after the gather: [B, M] block scales broadcast
+        # over each gathered block's [bs, KV, hd] codes (the reference
+        # the in-kernel dequant is pinned against)
+        k = kvq.dequantize(k_pool[tb],
+                           k_scale[tb][:, :, None, None, None])
+        v = kvq.dequantize(v_pool[tb],
+                           v_scale[tb][:, :, None, None, None])
+        k = k.reshape(B, M * bs, KV, hd)
+        v = v.reshape(B, M * bs, KV, hd)
+    else:
+        k = k_pool[tb].reshape(B, M * bs, KV, hd)
+        v = v_pool[tb].reshape(B, M * bs, KV, hd)
     rep = H // KV
     qg = q.reshape(B, P, KV, rep, hd)
     s = jnp.einsum("bpkrd,btkd->bkrpt", qg, k,
@@ -378,10 +464,13 @@ def _paged_gqa_attention(q, k_pool, v_pool, table, positions, valid=None,
 
 
 def _attention_paged(x, lp, cfg, cos, sin, pk, pv, table, positions,
-                     valid, is_prefill, attention_impl: str = "xla"):
+                     valid, is_prefill, attention_impl: str = "xla",
+                     pks=None, pvs=None):
     """One layer's attention. positions [B, P] per-request absolute
     positions of x's tokens; valid masks padded slots. Returns
-    (out, pk', pv') with the new tokens written into the pool."""
+    (out, pk', pv', pks', pvs') with the new tokens written into the
+    pool — quantized on the commit write when pks/pvs carry this
+    layer's int8 block scales (None = fp pool, the unchanged path)."""
     B, P, D = x.shape
     H, KV, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                  cfg.head_dim)
@@ -390,21 +479,32 @@ def _attention_paged(x, lp, cfg, cos, sin, pk, pv, table, positions,
     k = (x @ _wq(lp, "k_proj", cd)).reshape(B, P, KV, hd)
     v = (x @ _wq(lp, "v_proj", cd)).reshape(B, P, KV, hd)
     q, k = apply_rope_half(q, k, cos, sin, positions)
-    pk = _write_pool(pk, table, positions, k, valid)
-    pv = _write_pool(pv, table, positions, v, valid)
+    if pks is None:
+        pk = _write_pool(pk, table, positions, k, valid)
+        pv = _write_pool(pv, table, positions, v, valid)
+        kq, vq = k, v
+    else:
+        pk, pks, kq = _write_pool_int8(pk, pks, table, positions, k, valid)
+        pv, pvs, vq = _write_pool_int8(pv, pvs, table, positions, v, valid)
+        # every consumer sees the quantize→dequantize roundtrip of this
+        # call's own writes — a later cached-prefix read of the same
+        # blocks sees the same KV values (warm == cold by construction)
+        kq, vq = kq.astype(cd), vq.astype(cd)
     if is_prefill:
         # the prompt attends only to itself: plain causal self-attention
         # over the right-padded batch (rows past each request's length
         # produce garbage that is never read — their pool writes are
         # dropped and their logits never selected)
         from ..kernels import flash_attention as fa
-        o = fa._flash_impl(q, k, v, True, None)
+        o = fa._flash_impl(q, kq, vq, True, None)
     else:
         # decode AND cached-prefix suffix prefill: gather through the
         # table with per-query causal visibility (j <= position)
         o = _paged_gqa_attention(q, pk, pv, table, positions, valid,
-                                 impl=attention_impl)
-    return (o.reshape(B, P, H * hd) @ _wq(lp, "o_proj", cd)), pk, pv
+                                 impl=attention_impl, k_scale=pks,
+                                 v_scale=pvs)
+    return (o.reshape(B, P, H * hd) @ _wq(lp, "o_proj", cd)), pk, pv, \
+        pks, pvs
 
 
 def forward_paged(params, tokens, cache: PagedKVCache, positions, valid,
@@ -423,26 +523,40 @@ def forward_paged(params, tokens, cache: PagedKVCache, positions, valid,
     visible_len = positions[:, -1] + 1
 
     def body(carry, lp):
-        x, pk_all, pv_all, li = carry
+        # ks_all/vs_all are the [L, N] scale pools in int8-KV mode and
+        # None for fp — the None branch traces to the exact pre-
+        # quantization jaxpr (None adds no carry leaves), keeping the
+        # fp path byte-identical with quantization off
+        x, pk_all, pv_all, ks_all, vs_all, li = carry
         pk = lax.dynamic_slice_in_dim(pk_all, li, 1, 0)[0]
         pv = lax.dynamic_slice_in_dim(pv_all, li, 1, 0)[0]
+        ks = None if ks_all is None else \
+            lax.dynamic_slice_in_dim(ks_all, li, 1, 0)[0]
+        vs = None if vs_all is None else \
+            lax.dynamic_slice_in_dim(vs_all, li, 1, 0)[0]
         h = rms_norm_ref(x, lp["input_layernorm"], cfg.rms_norm_eps)
-        a, pk, pv = _attention_paged(h, lp, cfg, cos, sin, pk, pv,
-                                     cache.table, positions, valid,
-                                     is_prefill, attention_impl)
+        a, pk, pv, ks, vs = _attention_paged(
+            h, lp, cfg, cos, sin, pk, pv, cache.table, positions, valid,
+            is_prefill, attention_impl, ks, vs)
         pk_all = lax.dynamic_update_slice_in_dim(pk_all, pk[None], li, 0)
         pv_all = lax.dynamic_update_slice_in_dim(pv_all, pv[None], li, 0)
+        if ks_all is not None:
+            ks_all = lax.dynamic_update_slice_in_dim(ks_all, ks[None],
+                                                     li, 0)
+            vs_all = lax.dynamic_update_slice_in_dim(vs_all, vs[None],
+                                                     li, 0)
         x = x + a
         h = rms_norm_ref(x, lp["post_attention_layernorm"],
                          cfg.rms_norm_eps)
         x = x + _mlp_cached(h, lp, cfg)
-        return (x, pk_all, pv_all, li + 1), None
+        return (x, pk_all, pv_all, ks_all, vs_all, li + 1), None
 
-    (x, pk, pv, _), _ = lax.scan(
-        body, (x, cache.k, cache.v, jnp.int32(0)), params["layers"])
+    (x, pk, pv, ks, vs, _), _ = lax.scan(
+        body, (x, cache.k, cache.v, cache.k_scale, cache.v_scale,
+               jnp.int32(0)), params["layers"])
     logits = _final_head_cached(params, x, cfg)
     new_len = jnp.maximum(cache.lengths, visible_len)
-    return logits, PagedKVCache(pk, pv, cache.table, new_len)
+    return logits, PagedKVCache(pk, pv, cache.table, new_len, ks, vs)
 
 
 def paged_generate(params, tokens, lengths, cfg: llama.LlamaConfig,
@@ -472,9 +586,9 @@ def paged_generate(params, tokens, lengths, cfg: llama.LlamaConfig,
         n = num_blocks or (B * -(-max_total // block_size))
         allocator = BlockAllocator(n)
     table, owned = build_table(allocator, lengths_np, max_total, block_size)
-    kp, vp = init_pool(cfg, allocator.num_blocks, block_size)
+    kp, vp, ksc, vsc = init_pool(cfg, allocator.num_blocks, block_size)
     cache = PagedKVCache(kp, vp, table,
-                         jnp.zeros((B,), jnp.int32))
+                         jnp.zeros((B,), jnp.int32), ksc, vsc)
     if key is None:
         key = jax.random.PRNGKey(0)
     lengths = jnp.asarray(lengths, jnp.int32)
@@ -561,6 +675,21 @@ class ContinuousBatcher:
     per backend at construction. Every compiled-shape memo keys on the
     resolved impl.
 
+    Quantized serving (`weight_dtype=`, `kv_dtype=`): "int8" weights
+    route params through generation.quantize_for_serving (int8 codes +
+    per-output-channel scales, dequantized in-register at the consuming
+    dot — the path bench.py's w8 decode numbers measure); "int8" KV
+    stores the block pools as int8 codes with per-(layer, block)
+    abs-max scales in a sibling scale pool (quantization.kv is the
+    single-source math), quantized on every prefill/decode commit
+    write, dequantized after the gather on the XLA path and inside the
+    kernel's block-chunk loop on the Pallas path — per-request decode
+    HBM traffic drops to ~half of fp block bytes (kv_bytes_per_token()
+    quantifies it, scale overhead included). Defaults ("fp") keep both
+    paths byte-identical to the pre-quantization behavior; every
+    compiled-shape memo keys on (weight_dtype, kv_dtype) next to the
+    attention impl.
+
     Observability (`trace=`, `flight_recorder_cap=`): an optional
     `serving.trace.TraceSink` collects per-request timelines (prepared
     / prefill_chunk / retired events carrying bucket, pad,
@@ -589,8 +718,33 @@ class ContinuousBatcher:
                  max_prefill_bucket: int = 512,
                  fused_prefill: bool = True, fused_units: int = 1,
                  attention_impl: str = "auto",
+                 weight_dtype: Optional[str] = None,
+                 kv_dtype: Optional[str] = None,
                  trace=None, flight_recorder_cap: int = 64,
                  fault_injector=None):
+        # quantized serving (ROADMAP direction 4): weight_dtype="int8"
+        # routes params through generation.quantize_for_serving (the
+        # same int8 weight-only path bench.py measures — idempotent on
+        # already-quantized trees, so a caller that pre-quantized for
+        # mesh placement via generation.quantized_specs, the way
+        # inference/llm.py does, passes through); kv_dtype="int8"
+        # stores the K/V
+        # pools as int8 codes with per-(layer, block) abs-max scales in
+        # a sibling scale pool (quantization.kv holds the single-source
+        # math), quantized on every prefill/decode commit write and
+        # dequantized after the gather (xla) or inside the kernel's
+        # block-chunk loop (pallas). Defaults keep the fp path
+        # byte-identical to the pre-quantization behavior.
+        self.weight_dtype = "fp" if weight_dtype in (None, "fp") \
+            else weight_dtype
+        if self.weight_dtype not in ("fp", "int8"):
+            raise ValueError(
+                f"weight_dtype must be 'fp'/'int8' (or None), "
+                f"got {weight_dtype!r}")
+        if self.weight_dtype == "int8" and not any(
+                k.endswith(":scale") for k in params["layers"]):
+            params = quantize_for_serving(params, bits=8)
+        self.kv_dtype = kvq.resolve_kv_dtype(kv_dtype)
         self.params, self.cfg = params, cfg
         # chaos harness: an optional serving.faults.FaultInjector
         # consulted at every device-call boundary (_gate) — fail /
@@ -598,8 +752,12 @@ class ContinuousBatcher:
         self._fault = fault_injector
         self.B, self.bs = max_batch, block_size
         # resolved once: every traced fn closes over the concrete
-        # backend and every compiled-shape memo keys on it
+        # backend and every compiled-shape memo keys on it — and on the
+        # resolved (weight_dtype, kv_dtype) pair, so the warmup ladder
+        # a quantized batcher compiles can never be confused with an fp
+        # one's (the zero-post-warmup-recompiles gate covers both)
         self.attention_impl = resolve_attention_impl(attention_impl)
+        self._qkey = (self.weight_dtype, self.kv_dtype)
         self.max_total = max_total_len
         self.M = -(-max_total_len // block_size)
         self.max_new = max_new_tokens
@@ -694,10 +852,11 @@ class ContinuousBatcher:
         else:
             self._pcache = None
             self.alloc = BlockAllocator(nb)
-        kp, vp = init_pool(cfg, nb, block_size)
+        kp, vp, ksc, vsc = init_pool(cfg, nb, block_size,
+                                     kv_dtype=self.kv_dtype)
         self.cache = PagedKVCache(
             kp, vp, jnp.zeros((max_batch, self.M), jnp.int32),
-            jnp.zeros((max_batch,), jnp.int32))
+            jnp.zeros((max_batch,), jnp.int32), ksc, vsc)
         self.active = [False] * max_batch
         self.slot_req: List[Optional[int]] = [None] * max_batch
         self.slot_blocks: List[Optional[List[int]]] = [None] * max_batch
@@ -765,7 +924,46 @@ class ContinuousBatcher:
         if tokens is not None and self._pcache is not None:
             matched, _, _ = self._match_cached(list(tokens))
             need -= sum(1 for b in matched if self.alloc.refcount(b) > 0)
+        # NOTE: block COUNTS are kv_dtype-invariant by construction —
+        # the int8 scale pool is indexed by the same block ids (one
+        # scale slot per pool block, allocated and freed with it), so
+        # cached-aware deferral admits identically under "fp" and
+        # "int8". What changes is bytes per block: kv_block_bytes()
+        # below is the single source for that, scale overhead included.
         return need
+
+    # -- quantized-serving byte accounting --------------------------------
+    def kv_block_bytes(self) -> int:
+        """HBM bytes ONE pool block occupies (all layers, K+V pools,
+        int8 scale-pool overhead included) — quantization.kv's
+        kv_block_bytes under this batcher's geometry and kv_dtype."""
+        cfg = self.cfg
+        return kvq.kv_block_bytes(
+            cfg.num_hidden_layers, self.bs, cfg.num_key_value_heads,
+            cfg.head_dim, self.kv_dtype,
+            fp_itemsize=jnp.dtype(cfg.dtype).itemsize)
+
+    def kv_pool_bytes(self) -> int:
+        """Total KV pool footprint: capacity blocks x kv_block_bytes()
+        — equals the device arrays' nbytes sum (asserted in tests)."""
+        return self.alloc.num_blocks * self.kv_block_bytes()
+
+    def kv_cached_bytes(self) -> int:
+        """Bytes held by reclaimable (refcount-0, prefix-cached) blocks
+        — the reusable-KV share of the pool a router/dashboard reads."""
+        return self.alloc.stats().get("cached_blocks", 0) \
+            * self.kv_block_bytes()
+
+    def kv_bytes_per_token(self) -> float:
+        """HBM bytes one cached token costs (and one decode-step gather
+        moves per live token): kv_block_bytes / block_size. The bench's
+        quantized gate asserts int8 <= 0.55x fp on this number."""
+        return self.kv_block_bytes() / self.bs
+
+    def weight_bytes(self) -> int:
+        """Resident parameter bytes (codes + scales for a w8 tree) —
+        host-side .nbytes sum, no device sync."""
+        return sum(x.nbytes for x in jax.tree_util.tree_leaves(self.params))
 
     def _match_cached(self, toks: List[int]
                       ) -> Tuple[List[int], int, Optional[int]]:
@@ -1011,12 +1209,13 @@ class ContinuousBatcher:
         lives host-side in `_prefill_exe` (TRACE001)."""
         cfg, impl = self.cfg, self.attention_impl
 
-        def prefill(params, rows, k, v, table, positions, valid, lengths):
-            sub = PagedKVCache(k, v, table, lengths)
+        def prefill(params, rows, k, v, ks, vs, table, positions, valid,
+                    lengths):
+            sub = PagedKVCache(k, v, table, lengths, ks, vs)
             logits, sub = forward_paged(params, rows, sub, positions,
                                         valid, cfg, is_prefill=cold,
                                         attention_impl=impl)
-            return logits, sub.k, sub.v
+            return logits, sub.k, sub.v, sub.k_scale, sub.v_scale
 
         return jax.jit(prefill)
 
@@ -1026,7 +1225,7 @@ class ContinuousBatcher:
         the whole ladder without running a single FLOP; steady-state
         admission dispatches straight to a compiled executable and never
         retraces."""
-        key = (G, Pb, cold, self.attention_impl)
+        key = (G, Pb, cold, self.attention_impl) + self._qkey
         exe = self._prefill_cache.get(key)
         if exe is None:
             fn = self._prefill_fns.get(cold)
@@ -1040,10 +1239,19 @@ class ContinuousBatcher:
                 pstruct, sds((G, Pb), i32),
                 sds(self.cache.k.shape, self.cache.k.dtype),
                 sds(self.cache.v.shape, self.cache.v.dtype),
+                self._scale_aval(self.cache.k_scale),
+                self._scale_aval(self.cache.v_scale),
                 sds((G, self.M), i32), sds((G, Pb), i32),
                 sds((G, Pb), jnp.bool_), sds((G,), i32)).compile()
             self._prefill_cache[key] = exe
         return exe
+
+    @staticmethod
+    def _scale_aval(scale):
+        """AOT-lowering aval for a scale pool: None (no leaves — the fp
+        pool's lowered signature is unchanged) or the [L, N] f32 shape."""
+        return None if scale is None else \
+            jax.ShapeDtypeStruct(jnp.shape(scale), scale.dtype)
 
     def warmup_prefill(self, buckets: Optional[Sequence[int]] = None,
                        group_sizes: Optional[Sequence[int]] = None,
@@ -1135,6 +1343,18 @@ class ContinuousBatcher:
             if pinned:
                 self.alloc.release(pinned)
             raise
+        if self.kv_dtype == "int8" and fresh:
+            # a recycled block keeps its previous tenant's scale (free
+            # is host-side bookkeeping); writing under that inflated
+            # scale would quantize this request's KV coarser than a
+            # fresh block would — reset to the never-written sentinel
+            # so quantization depends only on what THIS request writes
+            # (warm == cold stays by construction, whatever the pool's
+            # reuse history). Admission path, not the decode hot path.
+            idx = jnp.asarray(fresh)
+            self.cache = self.cache._replace(
+                k_scale=self.cache.k_scale.at[:, idx].set(0.0),
+                v_scale=self.cache.v_scale.at[:, idx].set(0.0))
         # NOTE: the copy-on-write clone (fresh[0] <- pool[cow_src]) is
         # NOT applied here — a same-burst neighbor may have registered
         # the source block moments ago with its prefill still pending,
@@ -1158,7 +1378,10 @@ class ContinuousBatcher:
             self._trace_emit(rid, "prepared", slot=slot, prompt_len=P,
                              cached_tokens=cached_len,
                              cow=cow_src is not None, blocks=need,
-                             chunks=len(chunks))
+                             chunks=len(chunks),
+                             weight_dtype=self.weight_dtype,
+                             kv_dtype=self.kv_dtype,
+                             kv_block_bytes=self.kv_block_bytes())
         return _Admission(slot, rid, list(toks), stop, mn, need, matched,
                           cached_len, cow_src, fresh, inserted, chunks)
 
@@ -1211,11 +1434,12 @@ class ContinuousBatcher:
         Gp = self._group_pad(len(items))
         rows, pos, val, tab, li = self._pack_prefill_rows(items, Pb, Gp)
         exe = self._prefill_exe(Gp, Pb, cold)
-        logits, k, v = exe(self.params, jnp.asarray(rows), self.cache.k,
-                           self.cache.v, jnp.asarray(tab),
-                           jnp.asarray(pos), jnp.asarray(val),
-                           jnp.zeros((Gp,), jnp.int32))
-        self.cache = self.cache._replace(k=k, v=v)
+        logits, k, v, ks, vs = exe(
+            self.params, jnp.asarray(rows), self.cache.k, self.cache.v,
+            self.cache.k_scale, self.cache.v_scale, jnp.asarray(tab),
+            jnp.asarray(pos), jnp.asarray(val),
+            jnp.zeros((Gp,), jnp.int32))
+        self.cache = self.cache._replace(k=k, v=v, k_scale=ks, v_scale=vs)
         return logits, li
 
     def _units(self,
@@ -1272,6 +1496,14 @@ class ContinuousBatcher:
                         self.cache.k[:, rec.cow_src]),
                     v=self.cache.v.at[:, dst].set(
                         self.cache.v[:, rec.cow_src]))
+                if self.cache.k_scale is not None:
+                    # int8 pool: the clone's codes are meaningless
+                    # without the source block's dequant scales
+                    self.cache = self.cache._replace(
+                        k_scale=self.cache.k_scale.at[:, dst].set(
+                            self.cache.k_scale[:, rec.cow_src]),
+                        v_scale=self.cache.v_scale.at[:, dst].set(
+                            self.cache.v_scale[:, rec.cow_src]))
 
     def _commit(self, rec: _Admission, first: int) -> None:
         """Activate a successfully prefilled admission in its slot."""
@@ -1352,8 +1584,8 @@ class ContinuousBatcher:
             "prefill", rids=unit_rids, bucket=bucket,
             group_pad=Gp, cold=cold, final=final,
             stalls_decode=any(self.active),
-            compile_hit=(Gp, bucket, cold,
-                         self.attention_impl) in self._prefill_cache)
+            compile_hit=(Gp, bucket, cold, self.attention_impl)
+            + self._qkey in self._prefill_cache)
         self._gate("prefill", unit_rids)
         t0 = time.perf_counter()
         self._apply_cow([e[0] for e in entries if e[1] == 0])
@@ -1538,7 +1770,8 @@ class ContinuousBatcher:
                 "fused", units=unit_rids, decode_rids=decode_rids,
                 bucket=bucket, group_pad=Gp, rows=len(groups) * Gp,
                 compile_hit=(len(groups) * Gp, bucket,
-                             self.attention_impl) in self._fused_cache)
+                             self.attention_impl) + self._qkey
+                in self._fused_cache)
             self._gate("fused",
                        decode_rids + [r for u in unit_rids for r in u])
             t0 = time.perf_counter()
@@ -1553,8 +1786,10 @@ class ContinuousBatcher:
             if self._dev_state is None:
                 self._dev_state = self._upload_slot_state()
             active, budget, stop = self._dev_state
-            (k, v, lengths, tok, budget, active, toks, pfirst) = exe(
+            (k, v, ks, vs, lengths, tok, budget, active, toks,
+             pfirst) = exe(
                 self.params, self.cache.k, self.cache.v,
+                self.cache.k_scale, self.cache.v_scale,
                 self.cache.table, self.cache.lengths, self.cur_tok,
                 active, budget, stop, jnp.asarray(rows),
                 jnp.asarray(pos), jnp.asarray(val), jnp.asarray(tab),
@@ -1568,7 +1803,8 @@ class ContinuousBatcher:
             # decode state untouched (the assignments below never ran)
             self._fail_pending()
             raise
-        self.cache = self.cache._replace(k=k, v=v, lengths=lengths)
+        self.cache = self.cache._replace(k=k, v=v, k_scale=ks,
+                                         v_scale=vs, lengths=lengths)
         self.cur_tok = tok
         self._dev_state = (active, budget, stop)
         self.fused_steps += 1
@@ -1730,7 +1966,7 @@ class ContinuousBatcher:
         chunk fn compiled lazily on the first standalone-decode step,
         and a decode-only stretch AFTER a fused stretch (whose steps
         all ran `_fused_exe`) paid a post-warmup compile."""
-        key = (self.chunk, self.attention_impl)
+        key = (self.chunk, self.attention_impl) + self._qkey
         exe = self._chunk_cache.get(key)
         if exe is None:
             if self._chunk_fn is None:
@@ -1766,8 +2002,8 @@ class ContinuousBatcher:
         impl = self.attention_impl
         maxpos = self.M * self.bs - 1
 
-        def run_fused(params, k, v, table, lengths, tok, active, budget,
-                      stop, prows, ppos, pval, ptab, plast):
+        def run_fused(params, k, v, ks, vs, table, lengths, tok, active,
+                      budget, stop, prows, ppos, pval, ptab, plast):
             Gp, Pb = prows.shape
             # decode rows ride the prefill chunk's width: token in
             # column 0 at the slot's current position, the rest padding
@@ -1779,7 +2015,7 @@ class ContinuousBatcher:
             dval = jnp.zeros((B, Pb), jnp.bool_).at[:, 0].set(active)
             sub = PagedKVCache(
                 k, v, jnp.concatenate([table, ptab], 0),
-                jnp.zeros((B + Gp,), jnp.int32))
+                jnp.zeros((B + Gp,), jnp.int32), ks, vs)
             logits, sub = forward_paged(
                 params, jnp.concatenate([dtok, prows], 0), sub,
                 jnp.concatenate([dpos, ppos], 0),
@@ -1790,13 +2026,15 @@ class ContinuousBatcher:
                                 axis=-1).astype(jnp.int32)
             nxt, lengths, budget, active = self._emit_one(
                 logits[:B, 0], tok, active, lengths, budget, stop)
-            cache = PagedKVCache(sub.k, sub.v, table, lengths)
+            cache = PagedKVCache(sub.k, sub.v, table, lengths,
+                                 sub.k_scale, sub.v_scale)
             step = self._decode_step_body(params, stop)
             (cache, tok, lengths, budget, active), toks = jax.lax.scan(
                 step, (cache, nxt, lengths, budget, active), None,
                 length=chunk - 1)
             toks = jnp.concatenate([nxt[None], toks], 0)
-            return (cache.k, cache.v, lengths, tok, budget, active,
+            return (cache.k, cache.v, cache.k_scale, cache.v_scale,
+                    lengths, tok, budget, active,
                     toks.T, pfirst)                       # toks [B, chunk]
 
         return jax.jit(run_fused)
@@ -1809,7 +2047,7 @@ class ContinuousBatcher:
         row count of the call: units x per-unit group pad for a
         multi-unit step, so (units, group) pairs with the same product
         share one executable."""
-        key = (Gp, Pb, self.attention_impl)
+        key = (Gp, Pb, self.attention_impl) + self._qkey
         exe = self._fused_cache.get(key)
         if exe is None:
             if self._fused_fn is None:
@@ -1822,6 +2060,8 @@ class ContinuousBatcher:
                 pstruct,
                 sds(self.cache.k.shape, self.cache.k.dtype),
                 sds(self.cache.v.shape, self.cache.v.dtype),
+                self._scale_aval(self.cache.k_scale),
+                self._scale_aval(self.cache.v_scale),
                 sds((B, self.M), i32), sds((B,), i32), sds((B,), i32),
                 sds((B,), jnp.bool_), sds((B,), i32), sds((B,), i32),
                 sds((Gp, Pb), i32), sds((Gp, Pb), i32),
@@ -1853,7 +2093,7 @@ class ContinuousBatcher:
                 self._record_tick(
                     "decode", rids=decode_rids,
                     compile_hit=(self.chunk, self.attention_impl)
-                    in self._chunk_cache)
+                    + self._qkey in self._chunk_cache)
                 self._gate("decode", decode_rids)
                 if self._dev_state is None:
                     self._dev_state = self._upload_slot_state()

@@ -35,9 +35,14 @@ default (`resolve_attention_impl("auto")`), and the parity suite
 fused and prefix-cache-COW batches — on CPU via `interpret=True`, which
 this wrapper selects automatically off-TPU.
 
-Follow-on recorded in ROADMAP direction 4: int8 paged-KV blocks with
-per-block scales dequantized INSIDE this kernel's block loop — the
-gather-fused structure makes the dequant free.
+int8 paged KV (ROADMAP direction 4, the PR 6 follow-on): when the pool
+stores int8 codes, per-(layer, block) abs-max scales ride scalar
+prefetch next to the block table and the kernel dequantizes each
+gathered block INSIDE the block-chunk loop (quantization.kv's
+`dequantize`, the same math as the XLA path's after-the-gather
+reference) — the gather-fused structure makes the dequant free, so a
+quantized request's HBM traffic is its int8 block bytes, ~half the fp
+bytes the unquantized chain moves.
 """
 from __future__ import annotations
 
@@ -46,6 +51,8 @@ import math
 
 import jax
 import jax.numpy as jnp
+
+from ..quantization import kv as kvq
 
 __all__ = ["ragged_paged_attention", "resolve_attention_impl"]
 
@@ -70,8 +77,7 @@ def resolve_attention_impl(impl: str) -> str:
     return impl
 
 
-def _rpa_kernel(tab_ref, live_ref, pos_ref, val_ref, q_ref, k_ref, v_ref,
-                o_ref, acc_ref, m_ref, l_ref, *, bs: int, scale: float):
+def _rpa_kernel(*refs, bs: int, scale: float, quantized: bool):
     """One (row, query-tile, block-chunk) grid step of the ragged kernel.
 
     Refs (per BlockSpec):
@@ -81,9 +87,19 @@ def _rpa_kernel(tab_ref, live_ref, pos_ref, val_ref, q_ref, k_ref, v_ref,
       table; o_ref [1, Pt, H, hd]; scratch acc [Pt, H, hd] f32,
       m/l [Pt, H] f32. `live_ref` is per (row, tile): a tile's chain
       walk stops at ITS OWN last visible block, not the row's.
+      `quantized` adds ks_ref/vs_ref [N] f32 per-block dequant scales
+      to the scalar prefetch: the block's codes dequantize right after
+      the pipeline DMA lands them in VMEM — the fused-dequant gather.
     """
     import jax.experimental.pallas as pl
 
+    if quantized:
+        (tab_ref, live_ref, ks_ref, vs_ref, pos_ref, val_ref, q_ref,
+         k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        (tab_ref, live_ref, pos_ref, val_ref, q_ref, k_ref, v_ref,
+         o_ref, acc_ref, m_ref, l_ref) = refs
+        ks_ref = vs_ref = None
     r, t, c = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nlive = live_ref[r, t]
 
@@ -96,8 +112,17 @@ def _rpa_kernel(tab_ref, live_ref, pos_ref, val_ref, q_ref, k_ref, v_ref,
     @pl.when(c < nlive)
     def _accumulate():
         q = q_ref[0].astype(jnp.float32) * scale          # [P, H, hd]
-        k = k_ref[0].astype(jnp.float32)                  # [bs, KV, hd]
-        v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            # dequantize THIS chunk's block under its prefetched scale
+            # (chain chunk c of row r is pool block tab[r, c] — live,
+            # since c < nlive here): the same quantization.kv math the
+            # XLA path applies after its gather
+            b = jnp.maximum(tab_ref[r, c], 0)
+            k = kvq.dequantize(k_ref[0], ks_ref[b])       # [bs, KV, hd]
+            v = kvq.dequantize(v_ref[0], vs_ref[b])
+        else:
+            k = k_ref[0].astype(jnp.float32)              # [bs, KV, hd]
+            v = v_ref[0].astype(jnp.float32)
         P, H, hd = q.shape
         KV = k.shape[1]
         rep = H // KV
@@ -139,7 +164,8 @@ def _rpa_kernel(tab_ref, live_ref, pos_ref, val_ref, q_ref, k_ref, v_ref,
 
 
 def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
-                           *, q_tile: int = 128, interpret=None):
+                           *, k_scale=None, v_scale=None,
+                           q_tile: int = 128, interpret=None):
     """Paged GQA attention walking only each request's live block chain.
 
     Drop-in twin of the XLA `_paged_gqa_attention` gather path
@@ -151,6 +177,13 @@ def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
       valid [R, P] bool query mask (None = all valid). Returns
       [R, P, H, hd] in q's dtype; INVALID queries return zeros (the XLA
       path leaves never-read garbage there).
+
+    k_scale/v_scale [N] f32 mark an int8 pool (kv_dtype="int8"): the
+    per-block abs-max scales ride scalar prefetch next to the table and
+    each live chunk's codes dequantize INSIDE the block loop, right
+    after the pipeline DMA — the gather moves int8 bytes, the dequant
+    is fused compute. Dead chunks still skip their fetch, so a
+    quantized request's HBM traffic is ~half its fp block bytes.
 
     The query dimension tiles at the largest divisor of P that is
     <= `q_tile` rows per grid step (q_tile itself for the serving
@@ -198,13 +231,15 @@ def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
         jnp.where(valid, positions + 1, 0).reshape(R, T, Pt), axis=2)
     live = ((live_tok + bs - 1) // bs).astype(jnp.int32)
 
-    def _tile_map(r, t, c, tab, live):
+    quantized = k_scale is not None
+
+    def _tile_map(r, t, c, tab, live, *scales):
         return (r, t)
 
-    def _tile3_map(r, t, c, tab, live):
+    def _tile3_map(r, t, c, tab, live, *scales):
         return (r, t, 0, 0)
 
-    def _kv_map(r, t, c, tab, live):
+    def _kv_map(r, t, c, tab, live, *scales):
         # chunk c of (row r, tile t) reads pool block table[r, c]; DEAD
         # chunks (c >= live[r, t]) re-resolve to the last live block —
         # an unchanged index, so the pipeline skips the fetch
@@ -212,7 +247,9 @@ def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
         return (jnp.maximum(tab[r, j], 0), 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        # int8 pools prefetch the per-block dequant scales next to the
+        # table/live-lengths so the kernel body reads them from SMEM
+        num_scalar_prefetch=4 if quantized else 2,
         grid=(R, T, M),
         in_specs=[
             pl.BlockSpec((1, Pt), _tile_map),
@@ -228,9 +265,15 @@ def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
             pltpu.VMEM((Pt, H), jnp.float32),
         ],
     )
-    return pl.pallas_call(
-        functools.partial(_rpa_kernel, bs=bs, scale=1.0 / math.sqrt(hd)),
+    call = pl.pallas_call(
+        functools.partial(_rpa_kernel, bs=bs, scale=1.0 / math.sqrt(hd),
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((R, P, H, hd), q.dtype),
         interpret=interpret,
-    )(table, live, positions, val, q, k_pool, v_pool)
+    )
+    if quantized:
+        return call(table, live, k_scale.astype(jnp.float32),
+                    v_scale.astype(jnp.float32), positions, val, q,
+                    k_pool, v_pool)
+    return call(table, live, positions, val, q, k_pool, v_pool)

@@ -118,6 +118,8 @@ class ServingEngine:
                  prefill_buckets=None, max_prefill_bucket: int = 512,
                  fused_prefill: bool = True, fused_units: int = 1,
                  attention_impl: str = "auto",
+                 weight_dtype: Optional[str] = None,
+                 kv_dtype: Optional[str] = None,
                  warmup: bool = False,
                  trace: bool = True, flight_recorder_cap: int = 64,
                  flight_dump_path: Optional[str] = None,
@@ -149,12 +151,19 @@ class ServingEngine:
             prefix_cache=prefix_cache, prefill_buckets=prefill_buckets,
             max_prefill_bucket=max_prefill_bucket,
             fused_prefill=fused_prefill, fused_units=fused_units,
-            attention_impl=attention_impl, trace=self.trace,
+            attention_impl=attention_impl,
+            weight_dtype=weight_dtype, kv_dtype=kv_dtype,
+            trace=self.trace,
             flight_recorder_cap=flight_recorder_cap,
             fault_injector=fault_injector)
         # the RESOLVED backend ("auto" already collapsed to the concrete
-        # choice at batcher construction) — bench/snapshot surface
+        # choice at batcher construction) — bench/snapshot surface.
+        # Same for the resolved quantization config: the batcher owns
+        # quantize_for_serving and the int8 KV pool; the engine mirrors
+        # the resolved choice into snapshot()/gauges/bench JSON.
         self.attention_impl = self.batcher.attention_impl
+        self.weight_dtype = self.batcher.weight_dtype
+        self.kv_dtype = self.batcher.kv_dtype
         self.metrics = metrics or MetricsRegistry()
         self._clock = clock
         self._idle_poll_s = idle_poll_s
@@ -230,6 +239,14 @@ class ServingEngine:
         # EVERY compiled device-step shape (prefill/fused ladder + the
         # plain decode chunk) — the zero-post-warmup-recompiles gate
         self._g_compiles = m.gauge("compile_count")
+        # quantized-serving byte surface: pool + weight footprints are
+        # fixed at construction; kv_cached_bytes tracks the reclaimable
+        # prefix-cached share of the pool as requests retire
+        self._g_kv_pool_bytes = m.gauge("kv_pool_bytes")
+        self._g_kv_cached_bytes = m.gauge("kv_cached_bytes")
+        self._g_weight_bytes = m.gauge("weight_bytes")
+        self._g_kv_pool_bytes.set(self.batcher.kv_pool_bytes())
+        self._g_weight_bytes.set(self.batcher.weight_bytes())
         # fault-tolerance surface: the counters health() aggregates
         self._c_step_faults = m.counter("step_faults")
         self._c_quarantines = m.counter("quarantines")
@@ -458,6 +475,18 @@ class ServingEngine:
             snap["allocator"] = dict(self._alloc_stats)
             snap["prefix_cache"] = dict(self._prefix_stats)
             snap["attention_impl"] = self.attention_impl
+            # the RESOLVED quantization config + the byte accounting it
+            # implies (kv_block_bytes includes the int8 scale-pool
+            # overhead — quantization.kv is the single source)
+            b = self.batcher
+            snap["quantization"] = {
+                "weight_dtype": self.weight_dtype,
+                "kv_dtype": self.kv_dtype,
+                "weight_bytes": b.weight_bytes(),
+                "kv_pool_bytes": b.kv_pool_bytes(),
+                "kv_block_bytes": b.kv_block_bytes(),
+                "kv_bytes_per_token": b.kv_bytes_per_token(),
+            }
             # operators must notice missing forensics: the last failed
             # flight-dump disk write (None when every write landed)
             snap["last_flight_dump_error"] = self._last_dump_error
@@ -1046,6 +1075,7 @@ class ServingEngine:
         self._g_fused_steps.set(self.batcher.fused_steps)
         self._g_fused_units.set(self.batcher.fused_unit_count)
         self._g_decode_stalls.set(self.batcher.decode_stall_steps)
+        self._g_kv_cached_bytes.set(self.batcher.kv_cached_bytes())
         if pc.get("enabled"):
             self._g_pc_hit_tokens.set(pc["hit_tokens"])
             self._g_pc_hit_rate.set(pc["hit_rate"])

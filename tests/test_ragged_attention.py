@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from paddle_tpu.nlp import llama, paged
 from paddle_tpu.nlp.ragged_attention import (ragged_paged_attention,
                                              resolve_attention_impl)
+from paddle_tpu.quantization import kv as kvq
 
 
 def _pools(seed, N, bs, KV, hd):
@@ -212,6 +213,113 @@ class TestKernelParity:
         assert out.dtype == jnp.bfloat16
 
 
+def _quantize_pools(kp, vp):
+    """Per-block abs-max int8 quantization of an fp pool — the layout
+    PagedKVCache's sibling scale pool stores ([N] scales per layer)."""
+    ks = jnp.max(jnp.abs(kp), axis=(1, 2, 3)) / kvq.BOUND
+    vs = jnp.max(jnp.abs(vp), axis=(1, 2, 3)) / kvq.BOUND
+    kq = kvq.quantize(kp, ks[:, None, None, None])
+    vq = kvq.quantize(vp, vs[:, None, None, None])
+    return kq, vq, ks, vs
+
+
+class TestKernelParityInt8:
+    """int8 paged KV: the kernel's in-block-loop dequant (scales on
+    scalar prefetch) pinned against the XLA path's after-the-gather
+    dequant — the bit-stable reference — in interpret mode. Same math
+    (quantization.kv) on both sides, so parity is the online-softmax
+    tolerance, exactly like the fp rows."""
+
+    N, bs, KV, hd, H, M = 12, 4, 2, 8, 4, 5
+
+    def _q(self, rng, R, P):
+        return jnp.asarray(rng.randn(R, P, self.H, self.hd), jnp.float32)
+
+    def _assert_parity_q(self, q, kq, vq, ks, vs, table, pos, val,
+                         tol=2e-5):
+        ref = paged._paged_gqa_attention(q, kq, vq, table, pos,
+                                         k_scale=ks, v_scale=vs)
+        ref = np.where(np.asarray(val)[:, :, None, None],
+                       np.asarray(ref), 0.0)
+        out = np.asarray(ragged_paged_attention(
+            q, kq, vq, table, pos, val, k_scale=ks, v_scale=vs))
+        np.testing.assert_allclose(out, ref, atol=tol, rtol=tol)
+
+    def test_decode_rows_int8(self):
+        """P=1 decode rows over an int8 pool at heterogeneous live
+        lengths — the quantized steady-state decode shape."""
+        rng, kp, vp = _pools(20, self.N, self.bs, self.KV, self.hd)
+        kq, vq, ks, vs = _quantize_pools(kp, vp)
+        lengths = [1, 6, 17, 9]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        pos, val = _suffix_qpv(rng, lengths, 1, self.M, self.bs)
+        self._assert_parity_q(self._q(rng, 4, 1), kq, vq, ks, vs,
+                              table, pos, val)
+
+    def test_bucketed_prefill_rows_int8(self):
+        """Bucket-padded cached-prefix suffix rows against quantized
+        prefix blocks — the warm-admission shape."""
+        rng, kp, vp = _pools(21, self.N, self.bs, self.KV, self.hd)
+        kq, vq, ks, vs = _quantize_pools(kp, vp)
+        lengths = [3, 11, 19]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        pos, val = _suffix_qpv(rng, lengths, 8, self.M, self.bs)
+        self._assert_parity_q(self._q(rng, 3, 8), kq, vq, ks, vs,
+                              table, pos, val)
+
+    def test_block_size_boundary_int8(self):
+        """length == block_size under int8: the boundary block's last
+        key dequantizes and the walk must not read the next (garbage)
+        table entry's scale either."""
+        rng, kp, vp = _pools(22, self.N, self.bs, self.KV, self.hd)
+        kq, vq, ks, vs = _quantize_pools(kp, vp)
+        lengths = [self.bs, 2 * self.bs, self.bs + 1]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        pos, val = _suffix_qpv(rng, lengths, 1, self.M, self.bs)
+        self._assert_parity_q(self._q(rng, 3, 1), kq, vq, ks, vs,
+                              table, pos, val)
+
+    def test_all_padded_batch_int8_exact_zeros(self):
+        """Every query invalid: the quantized kernel emits EXACT zeros
+        (never-written blocks carry scale 0, and no live chain is
+        touched at all)."""
+        rng, kp, vp = _pools(23, self.N, self.bs, self.KV, self.hd)
+        kq, vq, ks, vs = _quantize_pools(kp, vp)
+        R, P = 3, 2
+        q = self._q(rng, R, P)
+        table = jnp.zeros((R, self.M), jnp.int32)
+        pos = jnp.zeros((R, P), jnp.int32)
+        val = jnp.zeros((R, P), bool)
+        out = np.asarray(ragged_paged_attention(
+            q, kq, vq, table, pos, val, k_scale=ks, v_scale=vs))
+        assert (out == 0.0).all()
+
+    def test_cow_cloned_chain_int8(self):
+        """The prefix-cache COW shape under int8: the clone block
+        copies the source's CODES AND SCALE (paged._apply_cow copies
+        both pools) — identical queries over the shared prefix must
+        agree across the original and the cloned chain."""
+        rng, kp, vp = _pools(24, self.N, self.bs, self.KV, self.hd)
+        kq, vq, ks, vs = _quantize_pools(kp, vp)
+        L = 2 * self.bs + 2
+        table = np.zeros((2, self.M), np.int32)
+        table[0, :3] = [3, 7, 5]
+        table[1, :3] = [3, 7, 9]                     # 9 := clone of 5
+        kq = kq.at[9].set(kq[5])
+        vq = vq.at[9].set(vq[5])
+        ks = ks.at[9].set(ks[5])
+        vs = vs.at[9].set(vs[5])
+        pos, val = _suffix_qpv(rng, [L, L], 2, self.M, self.bs)
+        q = self._q(rng, 1, 2)
+        q = jnp.concatenate([q, q], 0)               # identical queries
+        self._assert_parity_q(q, kq, vq, ks, vs, jnp.asarray(table),
+                              pos, val)
+        out = np.asarray(ragged_paged_attention(
+            q, kq, vq, jnp.asarray(table), pos, val,
+            k_scale=ks, v_scale=vs))
+        np.testing.assert_allclose(out[0], out[1], atol=2e-6)
+
+
 class TestResolveImpl:
     def test_auto_resolves_off_tpu(self):
         """CPU CI: auto means the XLA reference (pallas off-TPU is
@@ -335,4 +443,8 @@ class TestBatcherParity:
         cb.warmup_prefill()
         keys = (list(cb._prefill_cache) + list(cb._fused_cache)
                 + list(cb._chunk_cache))
-        assert keys and all(k[-1] == "pallas" for k in keys)
+        # ... and on the resolved quantization config (the trailing
+        # (weight_dtype, kv_dtype) pair), so a quantized batcher never
+        # aliases an fp executable either
+        assert keys and all("pallas" in k and k[-2:] == ("fp", "fp")
+                            for k in keys)

@@ -116,6 +116,20 @@ def tune(bench: Dict, max_buckets: int = 0) -> Dict:
     }
     if current:
         out["pad_reduction"] = round(1.0 - best / current, 4)
+    # price the padding in KV-gather bytes under the run's kv_dtype:
+    # kv_bytes_per_token (emitted by bench_serving from quantization.
+    # kv.kv_block_bytes) already includes the int8 scale-pool overhead,
+    # so an int8-KV run's pad bytes are ~half an fp run's for the same
+    # ladder — the tuner's recommendation stays token-driven (the DP is
+    # dtype-invariant), but the byte stakes it reports reflect what the
+    # attention gather actually moves.
+    bpt = bench.get("kv_bytes_per_token")
+    if bpt:
+        out["kv_dtype"] = bench.get("kv_dtype", "fp")
+        out["kv_bytes_per_token"] = bpt
+        if current is not None:
+            out["pad_kv_bytes_current_ladder"] = int(current * bpt)
+        out["pad_kv_bytes_recommended"] = int(best * bpt)
     return out
 
 
@@ -150,6 +164,12 @@ def main(argv=None) -> int:
           f"-> {r['pad_tokens_recommended']} pad tokens "
           f"({r.get('pad_reduction', 0) * 100:.1f}% less padding, "
           f"same <= {r['max_buckets']}-bucket compile budget)")
+    if "kv_bytes_per_token" in r:
+        cur = r.get("pad_kv_bytes_current_ladder")
+        print(f"pad gather cost : {cur if cur is not None else '-'} -> "
+              f"{r['pad_kv_bytes_recommended']} KV bytes at "
+              f"{r['kv_bytes_per_token']:.0f} B/token "
+              f"(kv_dtype={r['kv_dtype']}, scale overhead included)")
     print("apply with      : ContinuousBatcher(..., prefill_buckets="
           f"{tuple(r['recommended_ladder'])}) or the ServingEngine "
           "kwarg of the same name")

@@ -14,6 +14,10 @@ questions the raw timeline is too granular for:
     per request and total, next to the tokens actually prefilled;
   * scheduling mix — fused vs standalone prefill chunks, engine step
     span count/total;
+  * quantization — the resolved weight/KV dtype config each request
+    was prepared under, and the KV bytes its block footprint pins
+    (per-block bytes off the prepared event, int8 scale overhead
+    included);
   * recovery churn — the "requeued" phase: how often each request went
     back to the queue front (quarantine victims, rolled-back pending
     siblings) and how many backoff retries it consumed, so a
@@ -52,9 +56,10 @@ def summarize(events) -> dict:
         "terminal_ts": None, "terminal": None, "prompt_len": None,
         "slot": None, "prefill_ms": 0.0, "chunks": 0, "fused_chunks": 0,
         "pad_tokens": 0, "real_tokens": 0, "cached_tokens": 0,
-        "generated": 0, "requeues": 0, "retries": 0,
+        "generated": 0, "requeues": 0, "retries": 0, "kv_bytes": 0,
     })
     steps = {"count": 0, "total_ms": 0.0}
+    quant = {"weight_dtype": None, "kv_dtype": None}
     for e in events:
         name, args = e.get("name"), e.get("args", {})
         if name == "engine.step":
@@ -73,6 +78,15 @@ def summarize(events) -> dict:
             r["admitted_ts"] = ts
         elif name == "prepared":
             r["slot"] = args.get("slot")
+            # quantized-serving bytes: the batcher stamps its resolved
+            # dtype config + per-block bytes (scale overhead included)
+            # on every prepared event, so the report can price each
+            # request's KV residency without re-deriving model geometry
+            r["kv_bytes"] = (args.get("blocks", 0)
+                             * args.get("kv_block_bytes", 0))
+            quant["weight_dtype"] = args.get("weight_dtype",
+                                             quant["weight_dtype"])
+            quant["kv_dtype"] = args.get("kv_dtype", quant["kv_dtype"])
         elif name == "prefill_chunk":
             r["chunks"] += 1
             r["prefill_ms"] += e.get("dur", 0.0) / 1e3
@@ -114,6 +128,7 @@ def summarize(events) -> dict:
             "prefilled_tokens": r["real_tokens"],
             "pad_tokens": r["pad_tokens"],
             "requeues": r["requeues"], "retries": r["retries"],
+            "kv_bytes": r["kv_bytes"],
         })
     # (len, str) sorts t2 before t10 — ids are a prefix plus a
     # monotonic sequence number, so length order IS numeric order
@@ -137,6 +152,9 @@ def summarize(events) -> dict:
         "engine_step_ms_total": round(steps["total_ms"], 3),
         "requeued_events": sum(x["requeues"] for x in rows),
         "retried_events": sum(x["retries"] for x in rows),
+        "weight_dtype": quant["weight_dtype"],
+        "kv_dtype": quant["kv_dtype"],
+        "kv_bytes_total": sum(x["kv_bytes"] for x in rows),
     }
     return {"total": total, "requests": rows}
 
@@ -165,12 +183,15 @@ def render(summary: dict) -> str:
         f"({t['engine_step_ms_total']:.1f} ms total)",
         f"recovery: {t['requeued_events']} requeues, "
         f"{t['retried_events']} retries",
+        f"quantization: weights {t['weight_dtype'] or '-'}, "
+        f"kv {t['kv_dtype'] or '-'}  kv bytes admitted: "
+        f"{t['kv_bytes_total']}",
         "",
     ]
     cols = ["trace_id", "terminal", "slot", "prompt_len", "generated",
             "queue_wait_ms", "ttft_ms", "decode_ms", "prefill_ms",
             "chunks", "fused_chunks", "cached_tokens", "pad_tokens",
-            "requeues", "retries"]
+            "requeues", "retries", "kv_bytes"]
     rows = [[_fmt(r[c]) for c in cols] for r in summary["requests"]]
     widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
               for i, c in enumerate(cols)]
