@@ -39,6 +39,15 @@ test:           ## tier-1 test suite (CPU)
 # and FAILS on any post-warmup recompile, any warm-vs-cold token
 # mismatch, int8 KV gather bytes > 0.55x fp, or quantized-vs-fp
 # greedy divergence below the documented floor.
+# Router leg: --router serves the mixed workload as SSE streams over a
+# real socket through 2 Router replicas + the asyncio HTTP frontend,
+# then hangs the victim's replica mid-stream; FAILS unless every
+# stranded request fails over to the survivor with streams
+# bit-identical to the single-engine reference (pre-failover part a
+# strict prefix), zero post-warmup recompiles on both replicas.
+# Load leg: --load is the closed-loop generator (Poisson arrivals,
+# multi-turn sessions, shared system prompts) emitting goodput and
+# p99-under-load as tracked JSON fields (timing-based, not gated).
 bench-smoke:    ## tiny serving benches (non-blocking CI job)
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --prefix-share \
 		--n-requests 6 --max-new 4 --trace /tmp/paddle_tpu_trace.json
@@ -51,6 +60,10 @@ bench-smoke:    ## tiny serving benches (non-blocking CI job)
 		--n-requests 8 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --quantized \
 		--n-requests 8 --max-new 6
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --router \
+		--n-requests 8 --max-new 6
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --load \
+		--sessions 4 --turns 2 --max-new 4
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py \
 		--attention-impl pallas --n-requests 4 --max-new 4
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --trace-overhead \

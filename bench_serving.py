@@ -76,6 +76,30 @@ the warmed ladder), and the allocator drains clean. The JSON line
 carries quarantines / requests_requeued / culprit_tokens_streamed and
 the engine `health()` snapshot.
 
+Router (`--router`): the multi-replica failover gate, e2e over HTTP.
+The mixed workload first runs through ONE engine (the token
+reference), then through 2 `ServingEngine` replicas behind
+`serving.Router` + `serving.HttpFrontend` as concurrent SSE streams
+over a real socket. When the longest-budget request (the victim)
+streams its first token, a seeded chaos hang poisons its serving
+replica's next device calls: the hung-step watchdog flips that
+replica UNHEALTHY and the router must fail its stranded/queued
+requests over to the survivor, resuming each from `prompt + tokens`.
+HARD-FAILS unless the victim completes on the OTHER replica with its
+pre-failover stream a strict prefix of the final one, EVERY request's
+streamed tokens are bit-identical to the single-engine reference
+(innocents included), post-warmup recompiles stay 0 on both replicas,
+and the survivor's pool drains clean. The JSON line carries
+router_failovers / router_victim_tokens_kept /
+router_recompiles_after_warmup / router_serving_replicas.
+
+Load (`--load`): the closed-loop load generator (ROADMAP direction-3
+follow-on): Poisson session arrivals, multi-turn sessions (each turn
+extends the previous prompt + generated tokens — the prefix-cache
+steady state), shared-system-prompt populations. Emits goodput
+(tokens of requests completed within `--deadline-s`, per wall second)
+and request-latency p50/p99 under load as tracked JSON fields.
+
 `--attention-impl {auto,xla,pallas}` selects the paged-attention
 backend (nlp/ragged_attention.py); the JSON line records the RESOLVED
 impl plus `decode_tok_s` — generated tokens over time spent inside
@@ -107,7 +131,7 @@ def _make_prompts(rng, n_requests: int, workload: str,
         common = list(map(int, rng.randint(1, 200, prefix_len)))
         return [common + list(map(int, rng.randint(1, 200, suffix_len)))
                 for _ in range(n_requests)]
-    if workload in ("mixed", "fused", "chaos", "quantized"):
+    if workload in ("mixed", "fused", "chaos", "quantized", "router"):
         # lengths spanning the whole ladder, incl. past the largest
         # bucket (chunked prefill) — every request a different length
         return [list(map(int, rng.randint(1, 200, int(L))))
@@ -432,12 +456,273 @@ def _chaos_leg(params, cfg, prompts, budgets, culprit_idx: int,
     }
 
 
+def _sse_stream(host: str, port: int, payload: dict):
+    """One SSE round-trip over a real socket (stdlib http.client):
+    POST /v1/stream, parse the event stream incrementally. Yields
+    ("routed"|"token"|"done"|"error", data) tuples as they arrive, so
+    the caller can react mid-stream (the chaos arm)."""
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=600)
+    try:
+        conn.request("POST", "/v1/stream", json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"/v1/stream answered {resp.status}: {resp.read()!r}")
+        event = None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.decode().rstrip("\n")
+            if line.startswith("event: "):
+                event = line[len("event: "):].strip()
+            elif line.startswith("data: "):
+                data = json.loads(line[len("data: "):])
+                yield (event or ("token" if "token" in data else "data"),
+                       data)
+                event = None
+    finally:
+        conn.close()
+
+
+def _router_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
+    """The cross-replica failover gate, e2e over HTTP: 2 replicas
+    behind a Router + HttpFrontend serve the mixed workload as
+    concurrent SSE streams; when the longest-budget request (the
+    victim) streams its first token, a seeded chaos hang poisons its
+    serving replica's next device calls — the hung-step watchdog flips
+    that replica UNHEALTHY and every stranded/queued request must fail
+    over to the survivor. HARD-FAILS unless the victim completes on
+    the OTHER replica with its pre-failover stream a strict prefix of
+    the final one, every request's tokens are bit-identical to the
+    single-engine reference, post-warmup recompiles stay 0 on both
+    replicas, and the survivor's pool drains clean."""
+    import threading
+
+    from paddle_tpu import serving
+    from paddle_tpu.serving.faults import FaultInjector
+
+    injs = [FaultInjector(seed=0), FaultInjector(seed=1)]
+    router = serving.Router(
+        params, cfg, replicas=2, max_batch=kw["max_batch"],
+        block_size=kw["block_size"], max_total_len=64,
+        max_new_tokens=kw["max_new"], chunk=kw["chunk"],
+        max_queue_depth=2 * len(prompts),
+        prefix_cache=kw["prefix_cache"],
+        max_prefill_bucket=kw["max_prefill_bucket"],
+        attention_impl=kw["attention_impl"],
+        fused_units=kw["fused_units"], watchdog_s=0.5,
+        per_replica=[{"fault_injector": injs[0]},
+                     {"fault_injector": injs[1]}],
+        start=False)
+    warmed = router.warmup()
+    router.start()
+    compiles_warm = [e.batcher.compile_count for e in router.engines]
+    fe = serving.HttpFrontend(router, port=0, shutdown_router=False)
+    host, port = fe.start()
+
+    victim = max(range(len(prompts)), key=lambda i: budgets[i])
+    armed = threading.Event()
+    results = [None] * len(prompts)
+
+    def run_one(i):
+        toks, routed, final = [], None, None
+        for event, data in _sse_stream(
+                host, port, {"prompt": prompts[i],
+                             "max_new_tokens": int(budgets[i])}):
+            if event == "routed":
+                routed = data["replica"]
+            elif event in ("done", "error"):
+                final = data
+            elif "token" in data:
+                toks.append(data["token"])
+                if i == victim and not armed.is_set():
+                    # first streamed token of the victim: hang its
+                    # serving replica's next few device calls (a spread
+                    # of step numbers absorbs the arm-vs-step race;
+                    # only the first match fires, the rest stay idle)
+                    armed.set()
+                    inj = injs[int(routed[1:])]
+                    c = inj.stats()["calls"]
+                    for k in range(1, 6):
+                        inj.hang_on_step(c + k, 3.0)
+        results[i] = {"tokens": toks, "routed": routed, "final": final}
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run_one, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    wall = time.perf_counter() - t0
+    recompiles = sum(e.batcher.compile_count - c0
+                     for e, c0 in zip(router.engines, compiles_warm))
+    snap = router.snapshot()
+    health = router.health()
+    fe.shutdown(drain=True)
+    router.shutdown(drain=False)
+
+    v = results[victim]
+    if v is None or v["final"] is None:
+        raise RuntimeError("router gate: the victim's SSE stream never "
+                           "finished — failover did not recover it")
+    if v["final"]["state"] != "FINISHED":
+        raise RuntimeError(
+            f"router gate: victim ended {v['final']['state']} "
+            f"({v['final'].get('error')}) instead of completing on the "
+            f"surviving replica")
+    if not v["final"]["failovers"] or v["final"]["replica"] == v["routed"]:
+        raise RuntimeError(
+            f"router gate: victim finished on {v['final']['replica']} "
+            f"with {v['final']['failovers']} failovers — the chaos hang "
+            f"never forced a cross-replica failover")
+    log = {e["router_rid"]: e for e in snap["failover_log"]}
+    kept = log.get(v["final"]["request_id"], {}).get("tokens_kept", 0)
+    if not (0 < kept < len(base_tokens[victim])):
+        raise RuntimeError(
+            f"router gate: victim kept {kept} of "
+            f"{len(base_tokens[victim])} tokens across failover — the "
+            f"pre-failover stream is not a strict prefix (fault fired "
+            f"before the first token, or after the last)")
+    for i, r in enumerate(results):
+        if r is None or r["tokens"] != base_tokens[i]:
+            got = None if r is None else r["tokens"]
+            raise RuntimeError(
+                f"router gate: request {i} streamed {got} != the "
+                f"single-engine reference — failover re-emitted, lost "
+                f"or corrupted tokens")
+    if recompiles:
+        raise RuntimeError(
+            f"router gate: {recompiles} post-warmup recompiles across "
+            f"replicas — failover re-prefills left the warmed ladder")
+    survivor = next(e for e in router.engines
+                    if e.replica_id != v["routed"])
+    leaked = survivor.batcher.alloc.stats()["blocks_in_use"]
+    if leaked:
+        raise RuntimeError(
+            f"router gate: {leaked} KV blocks still in use on the "
+            f"survivor after drain — cross-replica recovery leaked")
+    ntok = sum(len(r["tokens"]) for r in results)
+    return {
+        "router_replicas": 2,
+        "router_tok_s": round(ntok / wall, 1),
+        "router_shapes_warmed": warmed,
+        "router_failovers": health["failovers"],
+        "router_victim_tokens_kept": kept,
+        "router_victim_replicas": [v["routed"], v["final"]["replica"]],
+        "router_recompiles_after_warmup": recompiles,
+        "router_serving_replicas": health["serving_replicas"],
+        "router_watchdog_trips": sum(
+            h["watchdog_trips"] for h in health["replicas"].values()),
+    }
+
+
+def _load_leg(params, cfg, *, sessions: int, turns: int, rate_hz: float,
+              deadline_s: float, **kw) -> dict:
+    """The closed-loop load generator: `sessions` clients arrive as a
+    Poisson process (`rate_hz`), each runs `turns` multi-turn rounds
+    (turn N+1's prompt is turn N's prompt + generated tokens + fresh
+    user tokens — the prefix-cache steady state), and the population
+    shares a small set of system prompts. Closed-loop: a session
+    blocks on its own previous turn, so offered load self-limits the
+    way real clients do. Emits goodput (tokens of requests that
+    completed within `deadline_s`, over the wall) and request-latency
+    percentiles under load — the tracked direction-3 numbers."""
+    import threading
+
+    from paddle_tpu import serving
+
+    eng = serving.ServingEngine(
+        params, cfg, max_batch=kw["max_batch"],
+        block_size=kw["block_size"], max_total_len=64,
+        max_new_tokens=kw["max_new"], chunk=kw["chunk"],
+        max_queue_depth=max(64, sessions * turns),
+        prefix_cache=kw["prefix_cache"],
+        max_prefill_bucket=kw["max_prefill_bucket"],
+        attention_impl=kw["attention_impl"],
+        fused_units=kw["fused_units"], start=False)
+    eng.warmup()
+    eng.start()
+    rng = np.random.RandomState(7)
+    system_prompts = [list(map(int, rng.randint(1, 200, 12)))
+                      for _ in range(2)]
+    eng.generate(system_prompts[0] + [1, 2, 3], timeout=600)
+    pc0 = eng.snapshot()["prefix_cache"]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_hz, sessions))
+    lock = threading.Lock()
+    samples = []          # (latency_s, ntok, within_deadline)
+
+    def session(si):
+        srng = np.random.RandomState(100 + si)
+        t_arrive = t0 + arrivals[si]
+        delay = t_arrive - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        history = list(system_prompts[si % len(system_prompts)])
+        for _ in range(turns):
+            history = history + list(map(int, srng.randint(1, 200, 4)))
+            t_s = time.perf_counter()
+            req = eng.submit(history, max_new_tokens=kw["max_new"])
+            toks = req.result(timeout=600)
+            lat = time.perf_counter() - t_s
+            with lock:
+                samples.append((lat, len(toks), lat <= deadline_s))
+            history = history + toks
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=session, args=(i,))
+               for i in range(sessions)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    wall = time.perf_counter() - t0
+    snap = eng.snapshot()
+    pc = snap["prefix_cache"]
+    eng.shutdown()
+    lats = sorted(s[0] for s in samples)
+    good_tok = sum(n for _, n, ok in samples if ok)
+    total_tok = sum(n for _, n, _ in samples)
+    lookups = pc["prompt_tokens"] - pc0["prompt_tokens"]
+    saved = pc["hit_tokens"] - pc0["hit_tokens"]
+    pct = lambda q: (round(lats[min(len(lats) - 1,
+                                    int(round(q * (len(lats) - 1))))], 4)
+                     if lats else None)
+    return {
+        "metric": "serving_load_goodput_tok_s",
+        "value": round(good_tok / wall, 1),
+        "unit": "tokens/s",
+        "workload": "load",
+        "goodput_tok_s": round(good_tok / wall, 1),
+        "tok_s_total": round(total_tok / wall, 1),
+        "sessions": sessions,
+        "turns": turns,
+        "arrival_rate_hz": rate_hz,
+        "deadline_s": deadline_s,
+        "requests_total": len(samples),
+        "requests_in_deadline": sum(1 for s in samples if s[2]),
+        "latency_s_p50_load": pct(0.50),
+        "latency_s_p99_load": pct(0.99),
+        "wall_s": round(wall, 3),
+        "prefix_cache_hit_rate": (round(saved / lookups, 4)
+                                  if lookups else 0.0),
+        "max_batch": kw["max_batch"],
+        "max_new_tokens": kw["max_new"],
+    }
+
+
 def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
          block_size: int = 8, chunk: int = 4, workload: str = "random",
          prefix_len: int = 24, suffix_len: int = 6,
          prefix_cache: bool = True,
          max_prefill_bucket: int = 512,
          attention_impl: str = "auto", fused_units: int = 1,
+         sessions: int = 6, turns: int = 3, rate_hz: float = 8.0,
+         deadline_s: float = 5.0,
          trace_path=None, trace_overhead: bool = False) -> dict:
     import jax
     from paddle_tpu.nlp import llama
@@ -452,9 +737,15 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
               prefix_cache=prefix_cache,
               max_prefill_bucket=max_prefill_bucket,
               attention_impl=attention_impl, fused_units=fused_units)
+    if workload == "load":
+        # the closed-loop generator builds its own session workload —
+        # none of the offline result assembly below applies
+        return _load_leg(params, cfg, sessions=sessions, turns=turns,
+                         rate_hz=rate_hz, deadline_s=deadline_s, **kw)
 
     base = None
-    if workload in ("fused", "prefix-share", "chaos", "quantized"):
+    if workload in ("fused", "prefix-share", "chaos", "quantized",
+                    "router"):
         # staggered per-request budgets so slots retire at DIFFERENT
         # steps — equal budgets would march the whole batch in lockstep
         # waves and no admission would ever land mid-decode. The fused
@@ -474,6 +765,17 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         # fp _serve below still provides the base JSON numbers
         quant = _quantized_gates(
             params, cfg, prompts, kw["budgets"],
+            **{k: v for k, v in kw.items() if k != "budgets"})
+    routed = None
+    if workload == "router":
+        # single-engine leg first: its per-request tokens are the
+        # parity reference the 2-replica HTTP run must reproduce
+        # bit-identically (and it provides this workload's base JSON
+        # numbers); then the router+frontend leg with its failover gate
+        r0 = _serve(params, cfg, prompts, fused_prefill=True, **kw)
+        base_tokens = [q.result() for q in r0["reqs"]]
+        routed = _router_leg(
+            params, cfg, prompts, kw["budgets"], base_tokens,
             **{k: v for k, v in kw.items() if k != "budgets"})
     chaos = None
     if workload == "chaos":
@@ -514,8 +816,8 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         r = t1
         r["tok_s"] = (t1["tok_s"] + t2["tok_s"]) / 2
         r["recompiles"] = t1["recompiles"] + t2["recompiles"]
-    elif chaos is not None:
-        r = r0            # the fault-free leg doubles as the numbers
+    elif chaos is not None or routed is not None:
+        r = r0            # the reference leg doubles as the numbers
     else:
         r = _serve(params, cfg, prompts, fused_prefill=True, **kw)
 
@@ -625,9 +927,11 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
                 f"is no longer always-on-cheap")
     if chaos is not None:
         result.update(chaos)
+    if routed is not None:
+        result.update(routed)
     if quant is not None:
         result.update(quant)
-    if workload in ("mixed", "fused", "chaos", "quantized") \
+    if workload in ("mixed", "fused", "chaos", "quantized", "router") \
             and r["recompiles"]:
         raise RuntimeError(
             f"bucketed workload recompiled {r['recompiles']} prefill "
@@ -655,6 +959,31 @@ def _cli() -> dict:
                          "every innocent finishes bit-identical to the "
                          "fault-free run, recompiles stay 0 and the "
                          "pool drains clean")
+    ap.add_argument("--router", action="store_true",
+                    help="multi-replica failover gate: 2 ServingEngine "
+                         "replicas behind Router + HttpFrontend serve "
+                         "the mixed workload as concurrent SSE streams "
+                         "over a real socket; a seeded chaos hang "
+                         "poisons the victim's replica mid-stream; "
+                         "HARD-FAILS unless the victim completes on "
+                         "the survivor (pre-failover stream a strict "
+                         "prefix), every request bit-matches the "
+                         "single-engine reference, and recompiles "
+                         "stay 0 on both replicas")
+    ap.add_argument("--load", action="store_true",
+                    help="closed-loop load generator: Poisson session "
+                         "arrivals, multi-turn rounds, shared system "
+                         "prompts; emits goodput (completed-within-"
+                         "deadline tok/s) and latency percentiles "
+                         "under load")
+    ap.add_argument("--sessions", type=int, default=6,
+                    help="concurrent client sessions for --load")
+    ap.add_argument("--turns", type=int, default=3,
+                    help="multi-turn rounds per session for --load")
+    ap.add_argument("--arrival-rate", type=float, default=8.0,
+                    help="Poisson session arrival rate (1/s) for --load")
+    ap.add_argument("--deadline-s", type=float, default=5.0,
+                    help="per-request goodput deadline for --load")
     ap.add_argument("--quantized", action="store_true",
                     help="quantized-serving gate: the same workload "
                          "through fp, w8, int8-KV and w8+int8-KV "
@@ -706,23 +1035,28 @@ def _cli() -> dict:
                          "chunks)")
     a = ap.parse_args()
     if sum((a.prefix_share, a.bucketed, a.fused, a.chaos,
-            a.quantized)) > 1:
-        ap.error("--prefix-share, --bucketed, --fused, --chaos and "
-                 "--quantized are mutually exclusive")
+            a.quantized, a.router, a.load)) > 1:
+        ap.error("--prefix-share, --bucketed, --fused, --chaos, "
+                 "--quantized, --router and --load are mutually "
+                 "exclusive")
     workload = ("prefix-share" if a.prefix_share
                 else "mixed" if a.bucketed
                 else "fused" if a.fused
                 else "chaos" if a.chaos
-                else "quantized" if a.quantized else "random")
+                else "quantized" if a.quantized
+                else "router" if a.router
+                else "load" if a.load else "random")
     bucket_cap = a.max_prefill_bucket
     if bucket_cap is None:
-        # the mixed/fused/chaos/quantized workloads should also exercise
-        # CHUNKED prefill, so cap the ladder below their longest prompts
+        # the mixed/fused/chaos/quantized/router workloads should also
+        # exercise CHUNKED prefill, so cap the ladder below their
+        # longest prompts (load's multi-turn histories chunk too)
         bucket_cap = (16 if workload in ("mixed", "fused", "chaos",
-                                         "quantized") else 512)
+                                         "quantized", "router", "load")
+                      else 512)
     chunk = (a.chunk if a.chunk is not None
              else 2 if workload in ("fused", "prefix-share", "chaos",
-                                    "quantized")
+                                    "quantized", "router")
              else 4)
     return main(n_requests=a.n_requests, max_new=a.max_new,
                 max_batch=a.max_batch, block_size=a.block_size,
@@ -732,6 +1066,8 @@ def _cli() -> dict:
                 max_prefill_bucket=bucket_cap,
                 attention_impl=a.attention_impl,
                 fused_units=a.fused_units,
+                sessions=a.sessions, turns=a.turns,
+                rate_hz=a.arrival_rate, deadline_s=a.deadline_s,
                 trace_path=a.trace, trace_overhead=a.trace_overhead)
 
 

@@ -22,6 +22,14 @@ engine's `_work` IS `_lock`). Calling a method of another class that
 itself takes `with self._lock` (resolved through the constructor-
 assignment type map) counts as acquiring that class's lock, which is
 how the `ServingEngine._lock → AdmissionQueue._lock` edge is seen.
+
+The serving tier's global order is `Router._lock →
+ServingEngine._lock → AdmissionQueue._lock`: the router may call into
+a replica engine (submit/cancel/load/health) while holding its own
+lock, the engine may touch its admission queue under its lock, and no
+engine or queue code path may ever call back into the router — the
+token bridge (`Router._bridge`) runs on the engine thread but touches
+only the outer handle's lock-free channel, never a router lock.
 """
 from __future__ import annotations
 

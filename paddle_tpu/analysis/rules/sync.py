@@ -40,7 +40,18 @@ HOT_PATHS: Tuple[Tuple[str, str], ...] = (
     # sync hiding in them would tax every token
     ("quantization/kv.py",
      r"^(quantize|dequantize|rescale_codes|scale_of)$"),
-    ("serving/engine.py", r"^(_loop|_dispatch|step)$"),
+    ("serving/engine.py", r"^(_loop|_dispatch|step|load)$"),
+    # router/frontend tier: the per-request routing decision, the
+    # monitor sweep (terminal fan-in + failover) and the HTTP token
+    # bridge run once per request or per tick with the event loop /
+    # router lock held — these modules are host-only today, and a
+    # device value leaking into them would tax every routed request,
+    # so the rule pins them hot from day one
+    ("serving/router.py",
+     r"^(submit|_place|_views|_bridge|_monitor_loop|_sweep_locked"
+     r"|_handle_terminal|_failover)$"),
+    ("serving/frontend.py",
+     r"^(_handle|_generate|_stream_sse|_submit|_read_request)$"),
     # trace emission helpers run once per scheduler tick / dispatched
     # token batch with tracing always on — a device sync hiding in an
     # event attr would tax EVERY step, so they are hot paths too

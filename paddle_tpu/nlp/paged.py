@@ -721,7 +721,12 @@ class ContinuousBatcher:
                  weight_dtype: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
                  trace=None, flight_recorder_cap: int = 64,
-                 fault_injector=None):
+                 fault_injector=None, replica_id: str = "r0"):
+        # multi-replica attribution: stamped on every `prepared` trace
+        # event so a Router's merged trace artifact (and
+        # tools/trace_report.py's per-replica grouping) can tell which
+        # replica's batcher admitted each request
+        self.replica_id = str(replica_id)
         # quantized serving (ROADMAP direction 4): weight_dtype="int8"
         # routes params through generation.quantize_for_serving (the
         # same int8 weight-only path bench.py measures — idempotent on
@@ -1381,7 +1386,8 @@ class ContinuousBatcher:
                              chunks=len(chunks),
                              weight_dtype=self.weight_dtype,
                              kv_dtype=self.kv_dtype,
-                             kv_block_bytes=self.kv_block_bytes())
+                             kv_block_bytes=self.kv_block_bytes(),
+                             replica_id=self.replica_id)
         return _Admission(slot, rid, list(toks), stop, mn, need, matched,
                           cached_len, cow_src, fresh, inserted, chunks)
 

@@ -33,7 +33,11 @@ timelines with Chrome-trace/Perfetto export + the step flight
 recorder the engine dumps on a device-step failure), `faults`
 (deterministic fault injection: the chaos harness behind the engine's
 quarantine / retry / watchdog recovery paths and
-`bench_serving.py --chaos`).
+`bench_serving.py --chaos`), `router` (N-replica routing: health +
+occupancy + prefix-affinity policy, cross-replica failover via
+resume-from-`prompt + tokens`), `frontend` (stdlib asyncio HTTP:
+`POST /v1/generate`, `POST /v1/stream` SSE, `GET /health`,
+`GET /metrics` with per-replica labels).
 """
 from __future__ import annotations
 
@@ -57,6 +61,7 @@ __all__ = [
     "FaultInjector", "InjectedFault",
     "PrefixCacheIndex", "RefcountingBlockAllocator",
     "ContinuousBatcher", "PagedKVCache",
+    "Router", "NoReplicaAvailable", "default_policy", "HttpFrontend",
 ]
 
 
@@ -66,6 +71,12 @@ def __getattr__(name: str):
     if name in ("ServingEngine", "EngineStopped", "HungStepError"):
         from . import engine
         return getattr(engine, name)
+    if name in ("Router", "NoReplicaAvailable", "default_policy"):
+        from . import router
+        return getattr(router, name)
+    if name == "HttpFrontend":
+        from . import frontend
+        return getattr(frontend, name)
     if name in ("ContinuousBatcher", "PagedKVCache",
                 "RefcountingBlockAllocator"):
         from ..nlp import paged

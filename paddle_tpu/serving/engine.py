@@ -129,7 +129,14 @@ class ServingEngine:
                  watchdog_s: Optional[float] = None,
                  health_window_s: float = 30.0,
                  fault_injector=None,
+                 replica_id: str = "r0",
                  clock=time.monotonic):
+        # multi-replica attribution: every snapshot, health report,
+        # flight dump and batcher-side `prepared` trace event carries
+        # this id, so a Router's merged forensics stay attributable to
+        # the replica that produced them (default "r0": a standalone
+        # engine IS replica zero)
+        self.replica_id = str(replica_id)
         # observability: per-request timelines (always-on-cheap unless
         # trace=False) + the batcher's step flight recorder; a step
         # failure dumps the ring + allocator/queue state to JSON
@@ -155,7 +162,8 @@ class ServingEngine:
             weight_dtype=weight_dtype, kv_dtype=kv_dtype,
             trace=self.trace,
             flight_recorder_cap=flight_recorder_cap,
-            fault_injector=fault_injector)
+            fault_injector=fault_injector,
+            replica_id=self.replica_id)
         # the RESOLVED backend ("auto" already collapsed to the concrete
         # choice at batcher construction) — bench/snapshot surface.
         # Same for the resolved quantization config: the batcher owns
@@ -472,6 +480,7 @@ class ServingEngine:
         live allocator, which only the engine thread may touch."""
         with self._lock:
             snap = self.metrics.snapshot()
+            snap["replica_id"] = self.replica_id
             snap["allocator"] = dict(self._alloc_stats)
             snap["prefix_cache"] = dict(self._prefix_stats)
             snap["attention_impl"] = self.attention_impl
@@ -492,6 +501,26 @@ class ServingEngine:
             snap["last_flight_dump_error"] = self._last_dump_error
             snap["health"] = self._health_locked()
         return snap
+
+    def load(self) -> Dict:
+        """Cheap per-replica routing view (no full metrics snapshot):
+        admission-queue depth, in-flight count, KV block-pool occupancy
+        (engine-thread cached allocator stats — never the live
+        allocator) and whether submit() would currently accept. The
+        Router's policy scores replicas on exactly this dict plus
+        `health()` — one lock hop per replica per routing decision."""
+        with self._lock:
+            stats = self._alloc_stats
+            return {
+                "replica_id": self.replica_id,
+                "queue_depth": len(self.queue),
+                "in_flight": len(self._running),
+                "parked_retries": len(self._parked),
+                "kv_utilization": (stats["blocks_in_use"]
+                                   / stats["capacity_blocks"]),
+                "accepting": self._accepting and not self._stop
+                and not self._wedged,
+            }
 
     def health(self) -> Dict:
         """Per-replica health: the signal a multi-replica router polls
@@ -516,6 +545,7 @@ class ServingEngine:
             status = "HEALTHY"
         return {
             "status": status,
+            "replica_id": self.replica_id,
             "step_faults": self._c_step_faults.value,
             "quarantines": self._c_quarantines.value,
             "requests_requeued": self._c_requeued.value,
@@ -561,6 +591,7 @@ class ServingEngine:
                 "active_slots": sum(b.active),
                 "free_slots": b.free_slots(),
                 "attention_impl": self.attention_impl,
+                "replica_id": self.replica_id,
             }
 
     def _record_failure_dump(self, error: BaseException) -> None:

@@ -1,0 +1,421 @@
+"""paddle_tpu.serving.frontend — stdlib-only asyncio HTTP frontend.
+
+The HTTP layer of the "millions of users" serving tier (ROADMAP
+direction 3): one `HttpFrontend` serves a `Router` (or a bare
+`ServingEngine` — anything with submit/health/to_prometheus) over a
+minimal asyncio HTTP/1.1 server built on `asyncio.start_server`. No
+third-party dependencies, by design (the container bakes no web
+framework): the request parser handles exactly what the endpoints
+need — a request line, headers, a Content-Length body.
+
+Endpoints:
+
+  * ``POST /v1/generate`` — JSON in (``{"prompt": [ints], ...}``),
+    JSON out (request id, replica, tokens, finish reason). Blocks the
+    REQUEST, never the event loop: completion is awaited by polling
+    the handle's append-only token list on the loop clock.
+  * ``POST /v1/stream`` — Server-Sent Events: a ``routed`` event
+    (request id + serving replica), one ``data:`` event per token as
+    it streams, a terminal ``done``/``error`` event. Bridged from
+    ``submit()``'s handle without blocking the event loop (the engine
+    thread appends tokens; the handler drains new ones each tick and
+    awaits the socket drain), so one slow client never stalls another.
+  * ``GET /health`` — the router's aggregated worst-of status plus
+    per-replica detail; HTTP 200 while at least one replica serves,
+    503 when none can.
+  * ``GET /metrics`` — `Router.to_prometheus()`: every replica's
+    exposition merged with ``replica="rN"`` labels
+    (``text/plain; version=0.0.4``).
+
+Backpressure and lifecycle: `NoReplicaAvailable`/`QueueFullError`
+(every replica's admission queue rejected) maps to **429**, a prompt
+that can never fit to 400, shutdown-in-progress to 503. `shutdown()`
+drains gracefully: the listener closes, in-flight handlers finish
+their requests, then the router shuts down (drain=True) underneath.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from .request import RequestState
+from .scheduler import QueueFullError
+
+__all__ = ["HttpFrontend"]
+
+_MAX_BODY = 1 << 20          # 1 MiB request-body cap (413 past it)
+_MAX_HEADER = 32 * 1024
+
+_STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                405: "Method Not Allowed", 408: "Request Timeout",
+                413: "Payload Too Large", 429: "Too Many Requests",
+                499: "Client Closed Request", 500: "Internal Server Error",
+                503: "Service Unavailable", 504: "Gateway Timeout"}
+
+# terminal request state -> HTTP status for the one-shot endpoint
+_STATE_HTTP = {RequestState.FINISHED: 200, RequestState.TIMED_OUT: 504,
+               RequestState.CANCELLED: 499, RequestState.FAILED: 500}
+
+
+def _headers(status: int, ctype: str, length: Optional[int] = None,
+             extra: str = "") -> bytes:
+    text = _STATUS_TEXT.get(status, "")
+    head = (f"HTTP/1.1 {status} {text}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Connection: close\r\n{extra}")
+    if length is not None:
+        head += f"Content-Length: {length}\r\n"
+    return (head + "\r\n").encode()
+
+
+def _json_body(status: int, payload: Dict[str, Any]) -> bytes:
+    body = json.dumps(payload).encode()
+    return _headers(status, "application/json", len(body)) + body
+
+
+def _sse_event(data: Dict[str, Any], event: Optional[str] = None) -> bytes:
+    head = f"event: {event}\n" if event else ""
+    return (head + f"data: {json.dumps(data)}\n\n").encode()
+
+
+class HttpFrontend:
+    """Asyncio HTTP server over a `Router` (stdlib only).
+
+    Runs its own event loop on a background thread, so the serving
+    stack stays usable from synchronous code and tests:
+
+        fe = HttpFrontend(router, host="127.0.0.1", port=0)
+        host, port = fe.start()          # port=0 → ephemeral, returned
+        ...                              # POST /v1/generate, /v1/stream
+        fe.shutdown()                    # drain handlers, then router
+
+    `poll_s` is the token-bridge tick: how often a streaming handler
+    checks the handle for new tokens (the engine thread appends them;
+    the handler only ever reads — no cross-thread wakeups needed, and
+    the event loop never blocks on engine work). `shutdown_router=False`
+    leaves the router running after the HTTP layer stops."""
+
+    def __init__(self, router, host: str = "127.0.0.1", port: int = 0,
+                 *, poll_s: float = 0.005,
+                 request_timeout_s: Optional[float] = 600.0,
+                 shutdown_router: bool = True):
+        self.router = router
+        self._host = host
+        self._port = port
+        self._poll_s = float(poll_s)
+        self._request_timeout_s = request_timeout_s
+        self._shutdown_router = shutdown_router
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._draining = False
+        self._active = 0                    # loop-thread only
+        self._idle: Optional[asyncio.Event] = None
+        self.address: Optional[Tuple[str, int]] = None
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self, timeout: float = 10.0) -> Tuple[str, int]:
+        """Bind and serve on a background event-loop thread; returns
+        the bound (host, port) — pass port=0 at construction for an
+        ephemeral port."""
+        if self._thread is not None:
+            if not self._started.wait(timeout) or self.address is None:
+                raise RuntimeError("frontend failed to start")
+            return self.address
+        self._thread = threading.Thread(target=self._run,
+                                        name="paddle-tpu-http",
+                                        daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout) or self.address is None:
+            raise RuntimeError("frontend failed to start")
+        return self.address
+
+    def _run(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        self._idle = asyncio.Event()
+        self._idle.set()
+
+        async def boot():
+            self._server = await asyncio.start_server(
+                self._handle, self._host, self._port)
+            self.address = self._server.sockets[0].getsockname()[:2]
+        try:
+            loop.run_until_complete(boot())
+        # ptlint: disable=EXC001 — bind failures (port in use) must
+        # release start()'s waiter instead of hanging it; the error
+        # surfaces as the RuntimeError start() raises on no address
+        except Exception:
+            self.address = None
+            self._started.set()
+            return
+        self._started.set()
+        try:
+            loop.run_forever()
+        finally:
+            loop.close()
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = 30.0) -> bool:
+        """Graceful stop: refuse new requests (503), wait for in-flight
+        handlers to finish their responses (bounded by `timeout`), stop
+        the loop, then shut the router down (drain semantics forwarded)
+        unless `shutdown_router=False`."""
+        clean = True
+        if self._loop is not None and self._thread is not None \
+                and self._thread.is_alive():
+            fut = asyncio.run_coroutine_threadsafe(
+                self._shutdown_async(drain, timeout), self._loop)
+            try:
+                clean = fut.result(None if timeout is None
+                                   else timeout + 5.0)
+            # ptlint: disable=EXC001 — a loop torn down mid-shutdown
+            # must not leak out of the caller; the router still stops
+            except Exception:
+                clean = False
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(5.0)
+            if self._thread.is_alive():
+                clean = False
+        if self._shutdown_router:
+            if not self.router.shutdown(drain=drain, timeout=timeout):
+                clean = False
+        return clean
+
+    async def _shutdown_async(self, drain: bool,
+                              timeout: Optional[float]) -> bool:
+        self._draining = True
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        if drain and self._active:
+            try:
+                await asyncio.wait_for(self._idle.wait(), timeout)
+            except asyncio.TimeoutError:
+                return False
+        return True
+
+    def __enter__(self) -> "HttpFrontend":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # ---- request handling ------------------------------------------------
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        self._active += 1
+        self._idle.clear()
+        try:
+            try:
+                method, path, body = await self._read_request(reader)
+            except _HttpError as e:
+                writer.write(_json_body(e.status, {"error": e.message}))
+                await writer.drain()
+                return
+            if self._draining:
+                writer.write(_json_body(
+                    503, {"error": "frontend is draining"}))
+            elif path == "/health" and method == "GET":
+                await self._health(writer)
+            elif path == "/metrics" and method == "GET":
+                await self._metrics(writer)
+            elif path == "/v1/generate" and method == "POST":
+                await self._generate(writer, body)
+            elif path == "/v1/stream" and method == "POST":
+                await self._stream_sse(writer, body)
+            elif path in ("/health", "/metrics", "/v1/generate",
+                          "/v1/stream"):
+                writer.write(_json_body(
+                    405, {"error": f"{method} not allowed on {path}"}))
+            else:
+                writer.write(_json_body(
+                    404, {"error": f"no route for {path}"}))
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass                       # client went away mid-response
+        # ptlint: disable=EXC001 — top-level handler boundary: an
+        # unexpected error answers 500 on THIS connection instead of
+        # killing the accept loop for every client
+        except Exception as e:
+            try:
+                writer.write(_json_body(500, {"error": repr(e)}))
+                await writer.drain()
+            except (ConnectionError, RuntimeError):
+                pass
+        finally:
+            try:
+                writer.close()
+            except RuntimeError:
+                pass
+            self._active -= 1
+            if self._active == 0:
+                self._idle.set()
+
+    async def _read_request(self, reader) -> Tuple[str, str, bytes]:
+        try:
+            head = await asyncio.wait_for(
+                reader.readuntil(b"\r\n\r\n"), self._request_timeout_s)
+        except asyncio.TimeoutError:
+            raise _HttpError(408, "timed out reading request head")
+        except asyncio.LimitOverrunError:
+            raise _HttpError(413, "request head too large")
+        if len(head) > _MAX_HEADER:
+            raise _HttpError(413, "request head too large")
+        lines = head.decode("latin-1").split("\r\n")
+        parts = lines[0].split(" ")
+        if len(parts) < 3:
+            raise _HttpError(400, f"malformed request line: {lines[0]!r}")
+        method, path = parts[0].upper(), parts[1].split("?", 1)[0]
+        length = 0
+        for line in lines[1:]:
+            if line.lower().startswith("content-length:"):
+                try:
+                    length = int(line.split(":", 1)[1].strip())
+                except ValueError:
+                    raise _HttpError(400, "bad Content-Length")
+        if length > _MAX_BODY:
+            raise _HttpError(413, f"body over {_MAX_BODY} bytes")
+        body = b""
+        if length:
+            body = await asyncio.wait_for(reader.readexactly(length),
+                                          self._request_timeout_s)
+        return method, path, body
+
+    @staticmethod
+    def _parse_submit(body: bytes) -> Dict[str, Any]:
+        try:
+            req = json.loads(body.decode() or "{}")
+        except (ValueError, UnicodeDecodeError):
+            raise _HttpError(400, "body is not valid JSON")
+        prompt = req.get("prompt")
+        if not isinstance(prompt, list) or not prompt \
+                or not all(isinstance(t, int) for t in prompt):
+            raise _HttpError(
+                400, "prompt must be a non-empty list of token ids")
+        kw: Dict[str, Any] = {"prompt": prompt}
+        for key, cast in (("priority", int), ("max_new_tokens", int),
+                          ("stop_token_id", int), ("timeout_s", float)):
+            if req.get(key) is not None:
+                try:
+                    kw[key] = cast(req[key])
+                except (TypeError, ValueError):
+                    raise _HttpError(400, f"bad {key}: {req[key]!r}")
+        return kw
+
+    def _submit(self, kw: Dict[str, Any]):
+        """Route one parsed request; maps backpressure/validation onto
+        HTTP errors. Submission is a queue push behind short locks —
+        safe to run on the event loop directly."""
+        prompt = kw.pop("prompt")
+        try:
+            return self.router.submit(prompt, **kw)
+        except QueueFullError as e:       # incl. NoReplicaAvailable
+            raise _HttpError(429, str(e))
+        except ValueError as e:
+            raise _HttpError(400, str(e))
+        except RuntimeError as e:         # router/engine shutting down
+            raise _HttpError(503, str(e))
+
+    async def _generate(self, writer, body: bytes) -> None:
+        try:
+            req = self._submit(self._parse_submit(body))
+        except _HttpError as e:
+            writer.write(_json_body(e.status, {"error": e.message}))
+            return
+        while not req.done:
+            if writer.transport is None or writer.transport.is_closing():
+                # client gave up: don't keep burning a batch slot and
+                # KV blocks generating tokens nobody will read
+                req.cancel()
+                return
+            await asyncio.sleep(self._poll_s)
+        status = _STATE_HTTP.get(req.state, 500)
+        writer.write(_json_body(status, {
+            "request_id": req.request_id,
+            "replica": getattr(req, "replica_id", None),
+            "state": req.state.name,
+            "finish_reason": req.finish_reason,
+            "tokens": list(req.tokens),
+            "failovers": getattr(req, "router_failovers", 0),
+            "error": None if req.error is None else repr(req.error),
+        }))
+
+    async def _stream_sse(self, writer, body: bytes) -> None:
+        try:
+            req = self._submit(self._parse_submit(body))
+        except _HttpError as e:
+            writer.write(_json_body(e.status, {"error": e.message}))
+            return
+        writer.write(_headers(200, "text/event-stream",
+                              extra="Cache-Control: no-cache\r\n"))
+        writer.write(_sse_event(
+            {"request_id": req.request_id,
+             "replica": getattr(req, "replica_id", None)},
+            event="routed"))
+        await writer.drain()
+        # the bridge: `req.tokens` is append-only (engine-thread
+        # writes, this task reads a snapshot length) — each tick ships
+        # the new suffix, and the terminal check runs only after a
+        # tick that shipped nothing new, so no token can be lost
+        sent = 0
+        try:
+            while True:
+                if writer.transport is None \
+                        or writer.transport.is_closing():
+                    req.cancel()        # client went away mid-stream
+                    return
+                n = len(req.tokens)
+                if n > sent:
+                    for t in req.tokens[sent:n]:
+                        writer.write(_sse_event({"token": int(t)}))
+                    sent = n
+                    await writer.drain()
+                    continue
+                if req.done:
+                    break
+                await asyncio.sleep(self._poll_s)
+        except ConnectionError:
+            # the write path saw the disconnect first: stop generating
+            # for a reader that no longer exists, then let _handle's
+            # connection boundary swallow the error
+            req.cancel()
+            raise
+        writer.write(_sse_event(
+            {"request_id": req.request_id,
+             "replica": getattr(req, "replica_id", None),
+             "state": req.state.name,
+             "finish_reason": req.finish_reason,
+             "tokens_generated": len(req.tokens),
+             "failovers": getattr(req, "router_failovers", 0),
+             "error": None if req.error is None else repr(req.error)},
+            event="error" if req.state in (RequestState.FAILED,
+                                           RequestState.TIMED_OUT)
+            else "done"))
+
+    async def _health(self, writer) -> None:
+        h = self.router.health()
+        serving = h.get("serving_replicas",
+                        0 if h.get("status") == "UNHEALTHY" else 1)
+        writer.write(_json_body(200 if serving else 503, h))
+
+    async def _metrics(self, writer) -> None:
+        text = self.router.to_prometheus()
+        body = text.encode()
+        writer.write(_headers(200, "text/plain; version=0.0.4",
+                              len(body)) + body)
+
+
+class _HttpError(Exception):
+    """Internal: an HTTP error response (status + message) raised by
+    parsing/submission helpers and rendered by the handler."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message

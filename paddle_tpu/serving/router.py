@@ -1,0 +1,741 @@
+"""paddle_tpu.serving.router — multi-replica routing over N ServingEngines.
+
+The "millions of users" tier the ROADMAP's direction 3 names: one
+`Router` owns N `ServingEngine` replicas (each with its own batcher,
+KV block pool and prefix cache) and picks a replica per request by a
+pluggable policy scoring
+
+  * **health** — `engine.health()`: UNHEALTHY replicas are hard-excluded
+    (a wedged engine thread serves nobody), DEGRADED ones are penalized
+    but stay in rotation;
+  * **occupancy** — `engine.load()`: admission-queue depth, in-flight
+    count and KV block-pool utilization, so bursts spread instead of
+    piling onto one pool;
+  * **prefix affinity** — a router-level token-content prefix index
+    (keys are pure token tuples over full KV blocks, exactly the PR 3
+    `PrefixCacheIndex` keying): prefix siblings land on the replica
+    already holding their blocks, so the per-replica prefix caches see
+    hits instead of N cold copies of the same system prompt.
+
+Cross-replica failover (the PR 8 follow-on): every client request is a
+router-owned handle; the replica-side request streams into it through
+an `on_token` bridge. When a replica flips UNHEALTHY (hung-step
+watchdog) its stranded and quarantine-requeued requests FAIL with
+`HungStepError` — the router re-admits each on a different healthy
+replica with `prompt + tokens already streamed` (the PR 8
+replica-agnostic resume mechanism), so the client's stream continues
+where it stopped: streamed tokens are never re-emitted or lost, and
+the pre-failover stream is a strict prefix of the final one.
+
+Lock order (LOCK001): `Router._lock` → `ServingEngine._lock` →
+`AdmissionQueue._lock` — the router may call into an engine while
+holding its own lock; no engine code path ever calls back into the
+router.
+
+    router = Router(params, cfg, replicas=2, max_batch=4, ...)
+    req = router.submit(prompt_ids)        # routed GenerationRequest
+    for tok in req.stream(): ...
+    router.health()                        # worst-of + per-replica
+    router.to_prometheus()                 # per-replica exposition,
+                                           # replica="rN" labels
+    router.shutdown()                      # graceful drain
+
+`serving.frontend.HttpFrontend` serves this object over HTTP.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .engine import EngineStopped, HungStepError
+from .metrics import MetricsRegistry
+from .request import GenerationRequest, RequestState
+from .scheduler import QueueFullError
+
+__all__ = ["Router", "NoReplicaAvailable", "default_policy"]
+
+# default_policy weights: one queued-or-running request costs
+# QUEUE_PENALTY, full KV-pool utilization costs UTIL_PENALTY, each
+# affinity-matched full block earns AFFINITY_BLOCK_SCORE (capped at
+# AFFINITY_BLOCK_CAP so a long warm prefix cannot justify an unbounded
+# queue), and a DEGRADED replica pays DEGRADED_PENALTY — larger than
+# the affinity cap, so a healthy cold replica always outranks a
+# degraded warm one.
+QUEUE_PENALTY = 0.5
+UTIL_PENALTY = 2.0
+AFFINITY_BLOCK_SCORE = 1.0
+AFFINITY_BLOCK_CAP = 8
+DEGRADED_PENALTY = 16.0
+
+_HEALTH_ORDER = {"HEALTHY": 0, "DEGRADED": 1, "UNHEALTHY": 2}
+
+
+class NoReplicaAvailable(QueueFullError):
+    """Every replica either refused admission (queue full), stopped
+    accepting, or is UNHEALTHY — the router-level backpressure signal
+    (`serving.frontend` maps it to HTTP 429). Subclasses
+    `QueueFullError` so engine-style backpressure handling composes."""
+
+
+def default_policy(view: Dict[str, Any]) -> float:
+    """Score one replica for one request (higher = better). `view` is
+    the merged `engine.load()` + `engine.health()["status"]` dict plus
+    `affinity_blocks`/`affinity_tokens` from the router's prefix index
+    (UNHEALTHY replicas never reach the policy — the router
+    hard-excludes them first). The default trades occupancy against
+    prefix warmth: an affinity block outweighs up to two queued
+    requests, a DEGRADED state outweighs the whole affinity cap.
+    Replace with any callable of the same shape via
+    `Router(policy=...)`."""
+    score = 0.0
+    if view["status"] == "DEGRADED":
+        score -= DEGRADED_PENALTY
+    score -= QUEUE_PENALTY * (view["queue_depth"] + view["in_flight"]
+                              + view["parked_retries"])
+    score -= UTIL_PENALTY * view["kv_utilization"]
+    score += AFFINITY_BLOCK_SCORE * min(view["affinity_blocks"],
+                                        AFFINITY_BLOCK_CAP)
+    return score
+
+
+class _AffinityNode:
+    """One full block of an observed prefix chain: `key` is the block's
+    token tuple, `replica` the index of the replica last routed a
+    request carrying this prefix (last-writer-wins, so failover
+    re-points siblings at the surviving replica), `parent` the
+    children-dict this node lives in (unlink without a root walk)."""
+
+    __slots__ = ("key", "replica", "children", "parent", "uid")
+
+    def __init__(self, key: Tuple[int, ...], replica: int,
+                 parent: Dict, uid: int):
+        self.key = key
+        self.replica = replica
+        self.parent = parent
+        self.uid = uid
+        self.children: Dict[Tuple[int, ...], "_AffinityNode"] = {}
+
+
+class _AffinityIndex:
+    """Router-level prefix→replica index: a bounded trie over FULL-block
+    token contents (the PR 3 keying — exact tuples, no hash aliasing)
+    mapping each observed prefix block to the replica last routed a
+    request carrying it. Unlike the per-replica `PrefixCacheIndex` this
+    tracks no pool blocks and owns no refcounts — it only remembers
+    *where* a prefix's KV is likely warm. FIFO-bounded at `cap` nodes:
+    the oldest observation unlinks (descendants go unreachable and age
+    out the same way, mirroring PrefixCacheIndex.evict's
+    orphan-tolerant bookkeeping)."""
+
+    def __init__(self, block_size: int, cap: int = 4096):
+        self.bs = max(1, int(block_size))
+        self.cap = max(1, int(cap))
+        self._children: Dict[Tuple[int, ...], _AffinityNode] = {}
+        self._order: "OrderedDict[int, _AffinityNode]" = OrderedDict()
+        self._uid = 0
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    def observe(self, tokens: Sequence[int], replica: int) -> None:
+        """Record that `tokens`' full-block prefix chain was just routed
+        to `replica` (creates missing nodes, re-points existing ones)."""
+        children = self._children
+        for i in range(len(tokens) // self.bs):
+            key = tuple(tokens[i * self.bs:(i + 1) * self.bs])
+            node = children.get(key)
+            if node is None:
+                node = _AffinityNode(key, int(replica), children, self._uid)
+                children[key] = node
+                self._order[self._uid] = node
+                self._uid += 1
+                while len(self._order) > self.cap:
+                    _, old = self._order.popitem(last=False)
+                    if old.parent.get(old.key) is old:
+                        del old.parent[old.key]
+            else:
+                node.replica = int(replica)
+            children = node.children
+
+    def match(self, tokens: Sequence[int]) -> Dict[int, int]:
+        """Matched-prefix tokens per replica: walk the longest recorded
+        chain for `tokens` and credit each matched block's `block_size`
+        tokens to the replica owning it (a chain re-pointed mid-way by
+        failover credits both owners their share)."""
+        out: Dict[int, int] = {}
+        children = self._children
+        for i in range(len(tokens) // self.bs):
+            node = children.get(tuple(tokens[i * self.bs:(i + 1) * self.bs]))
+            if node is None:
+                break
+            out[node.replica] = out.get(node.replica, 0) + self.bs
+            children = node.children
+        return out
+
+
+class _Routed:
+    """Router-side state of one in-flight request: the client-facing
+    `outer` handle, the replica-side `inner` request currently serving
+    it, the serving replica index, and the failover budget spent."""
+
+    __slots__ = ("outer", "inner", "idx", "failovers", "user_on_token",
+                 "total_new")
+
+    def __init__(self, outer, inner, idx, user_on_token, total_new):
+        self.outer = outer
+        self.inner = inner
+        self.idx = idx
+        self.failovers = 0
+        self.user_on_token = user_on_token
+        self.total_new = total_new
+
+
+def _default_failover_on(req: GenerationRequest,
+                         error: Optional[BaseException],
+                         reason: Optional[str]) -> bool:
+    """The default failover predicate: re-admit on another replica only
+    when the failure indicts the REPLICA, not the request — the
+    hung-step watchdog's `HungStepError` terminals (stranded in-flight
+    work and quarantine-requeued victims failed when the engine thread
+    wedged). Convicted quarantine culprits, exhausted retries and
+    on_token failures stay terminal: a request that poisons one
+    replica would poison the next."""
+    if reason in ("watchdog_hung_step", "watchdog_engine_unhealthy"):
+        return True
+    return isinstance(error, HungStepError)
+
+
+class Router:
+    """N `ServingEngine` replicas behind one submit()/stream() surface.
+
+    Construction: either pass `params, cfg` plus `replicas=N` and
+    engine kwargs (each replica gets its own engine, `replica_id`
+    "r0".."rN-1", `per_replica=[{...}, ...]` overrides individual
+    replicas — e.g. a fault injector on one), or pass prebuilt
+    `engines=[...]` (they must not be started yet). `warmup()`
+    AOT-compiles every replica's ladder (before `start()`), `start()`
+    launches the engine loops and the router's monitor thread.
+
+    `submit()` routes by `policy` (default `default_policy`: health,
+    occupancy, prefix affinity) and returns a router-owned
+    `GenerationRequest` handle — `result()`, `stream()`, `cancel()`
+    work exactly as on an engine-submitted request, across failovers.
+    `failover=True` re-admits requests stranded on an UNHEALTHY
+    replica onto a healthy one (resume from `prompt + tokens`; the
+    predicate is pluggable via `failover_on`). Backpressure: when every
+    replica refuses admission, `submit()` raises `NoReplicaAvailable`.
+    """
+
+    def __init__(self, params=None, cfg=None, *, replicas: int = 2,
+                 engines: Optional[Sequence] = None,
+                 policy: Optional[Callable[[Dict], float]] = None,
+                 failover: bool = True,
+                 max_failovers: Optional[int] = None,
+                 failover_on: Optional[Callable] = None,
+                 affinity_cap: int = 4096,
+                 affinity_block_size: Optional[int] = None,
+                 idle_poll_s: float = 0.01,
+                 metrics: Optional[MetricsRegistry] = None,
+                 start: bool = True,
+                 per_replica: Optional[Sequence[Optional[Dict]]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 **engine_kwargs):
+        if engines is None:
+            if params is None or cfg is None:
+                raise ValueError(
+                    "Router needs either prebuilt engines= or "
+                    "params+cfg to build replicas from")
+            if replicas < 1:
+                raise ValueError("replicas must be >= 1")
+            from .engine import ServingEngine     # lazy: pulls nlp tree
+            built = []
+            for i in range(int(replicas)):
+                kw = dict(engine_kwargs)
+                if per_replica is not None and per_replica[i]:
+                    kw.update(per_replica[i])
+                kw.setdefault("replica_id", f"r{i}")
+                kw["start"] = False
+                built.append(ServingEngine(params, cfg, **kw))
+            engines = built
+        elif engine_kwargs or per_replica is not None:
+            raise ValueError(
+                "engine kwargs only apply when the Router builds the "
+                "replicas itself (engines= was given)")
+        self.engines: List = list(engines)
+        if not self.engines:
+            raise ValueError("Router needs at least one replica")
+        self.policy = policy or default_policy
+        self._failover_enabled = bool(failover)
+        self._max_failovers = (len(self.engines) - 1
+                               if max_failovers is None
+                               else int(max_failovers))
+        self._failover_on = failover_on or _default_failover_on
+        bs = affinity_block_size
+        if bs is None:
+            batcher = getattr(self.engines[0], "batcher", None)
+            bs = getattr(batcher, "bs", 16)
+        self._affinity = _AffinityIndex(bs, cap=affinity_cap)
+        self._clock = clock
+        self._idle_poll_s = float(idle_poll_s)
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._routed: Dict[str, _Routed] = {}       # router rid -> state
+        self._rid_seq = 0
+        self._accepting = True
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._failover_log: List[Dict] = []         # bounded forensics
+
+        self.metrics = metrics or MetricsRegistry()
+        m = self.metrics
+        self._c_routed = m.counter("requests_routed")
+        self._c_rejected = m.counter("requests_rejected_all_replicas")
+        self._c_failovers = m.counter("failovers")
+        self._c_failover_exhausted = m.counter("failovers_exhausted")
+        self._c_monitor_errors = m.counter("router_monitor_errors")
+        self._g_inflight = m.gauge("router_inflight")
+        self._h_ttft = m.histogram("router_ttft_s")
+        self._per_replica_routed = [
+            m.counter(f"routed_{eng.replica_id}") for eng in self.engines]
+
+        if start:
+            self.start()
+
+    # ---- lifecycle -------------------------------------------------------
+    def warmup(self) -> int:
+        """AOT-compile every replica's prefill/decode ladder (must run
+        before `start()` — same rule as `ServingEngine.warmup`).
+        Returns total shapes compiled across replicas."""
+        return sum(eng.warmup() for eng in self.engines)
+
+    def start(self) -> "Router":
+        """Start every replica's engine loop plus the router monitor
+        thread (terminal fan-in, cancellation forwarding, failover)."""
+        with self._work:
+            if self._stop:
+                raise RuntimeError("router already shut down")
+            if self._thread is None:
+                for eng in self.engines:
+                    eng.start()
+                self._thread = threading.Thread(
+                    target=self._monitor_loop,
+                    name="paddle-tpu-router", daemon=True)
+                self._thread.start()
+        return self
+
+    def __enter__(self) -> "Router":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    @property
+    def is_idle(self) -> bool:
+        with self._lock:
+            return not self._routed
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until no routed request is in flight anywhere; False
+        on timeout."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._work:
+            while self._routed:
+                rem = self._idle_poll_s if deadline is None else \
+                    min(self._idle_poll_s, deadline - self._clock())
+                if rem <= 0:
+                    return False
+                self._work.wait(rem)
+        return True
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> bool:
+        """Stop the router. drain=True completes in-flight work first
+        (failover stays armed during the drain); drain=False cancels
+        everything. Replica engines shut down after the router-level
+        drain, so a request mid-failover is not cut off by its new
+        replica stopping underneath it."""
+        clean = True
+        with self._work:
+            self._accepting = False
+            self._work.notify_all()
+        if drain and self._thread is not None:
+            clean = self.drain(timeout)
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        for eng in self.engines:
+            if not eng.shutdown(drain=drain, timeout=timeout):
+                clean = False
+        if self._thread is not None:
+            self._thread.join(2.0)
+            if self._thread.is_alive():
+                clean = False
+        with self._work:
+            for ent in list(self._routed.values()):
+                if not ent.outer.done:
+                    ent.outer._finish(RequestState.CANCELLED,
+                                      "router_shutdown",
+                                      now=self._clock())
+            self._routed.clear()
+            self._g_inflight.set(0)
+            self._work.notify_all()
+        return clean
+
+    # ---- submission ------------------------------------------------------
+    def submit(self, prompt, *, priority: int = 0,
+               max_new_tokens: Optional[int] = None,
+               stop_token_id: Optional[int] = None,
+               timeout_s: Optional[float] = None,
+               on_token=None) -> GenerationRequest:
+        """Route and queue one request; returns the router-owned handle
+        immediately. Raises `NoReplicaAvailable` when every replica
+        refuses admission (backpressure — the frontend's 429),
+        ValueError when the request can never fit a replica's pool, and
+        RuntimeError after shutdown began."""
+        outer = GenerationRequest(prompt, priority=priority,
+                                  max_new_tokens=max_new_tokens,
+                                  stop_token_id=stop_token_id,
+                                  timeout_s=timeout_s)
+        with self._work:
+            if self._stop or not self._accepting:
+                raise RuntimeError("router is shutting down")
+            now = self._clock()
+            outer.request_id = f"req{self._rid_seq}"
+            self._rid_seq += 1
+            outer.replica_id = None       # set by _place on success
+            outer.router_failovers = 0
+            outer.submit_time = now
+            if timeout_s is not None:
+                outer.deadline = now + timeout_s
+            # state stamps BEFORE the engine sees the request: the
+            # bridge's first-token PREFILL→DECODING transition races
+            # the placement otherwise (a failed placement discards the
+            # handle, so the early stamp can't leak a live PREFILL)
+            outer.state = RequestState.PREFILL
+            inner, idx = self._place(outer, on_token, exclude=(),
+                                     tokens_kept=0)
+            ent = _Routed(outer, inner, idx, on_token,
+                          inner.max_new_tokens)
+            outer.max_new_tokens = inner.max_new_tokens
+            self._routed[outer.request_id] = ent
+            self._g_inflight.set(len(self._routed))
+            self._work.notify_all()
+        return outer
+
+    def generate(self, prompt, timeout: Optional[float] = None,
+                 **kw) -> List[int]:
+        """Blocking one-shot through the router (cancel-on-timeout,
+        like `ServingEngine.generate`)."""
+        req = self.submit(prompt, **kw)
+        try:
+            return req.result(timeout)
+        except TimeoutError:
+            self.cancel(req)
+            raise
+
+    def stream(self, prompt, **kw):
+        """Incremental one-shot: yields tokens as they stream (across
+        failovers — the handle survives replica death)."""
+        return self.submit(prompt, **kw).stream()
+
+    def cancel(self, req: GenerationRequest) -> None:
+        """Request cancellation; forwarded to the serving replica at
+        the monitor's next tick (the handle's own `cancel()` reaches
+        the same path)."""
+        req.cancel()
+        with self._work:
+            self._work.notify_all()
+
+    # ---- routing ---------------------------------------------------------
+    def _views(self, eff: Sequence[int],
+               exclude: Sequence[int]) -> List[Tuple[float, int, Dict]]:
+        """Policy-scored candidate replicas for a prompt, best first.
+        UNHEALTHY / non-accepting / excluded replicas never appear."""
+        aff = self._affinity.match(eff)
+        out: List[Tuple[float, int, Dict]] = []
+        for i, eng in enumerate(self.engines):
+            if i in exclude:
+                continue
+            status = eng.health()["status"]
+            if status == "UNHEALTHY":
+                continue
+            view = eng.load()
+            if not view.get("accepting", True):
+                continue
+            view["status"] = status
+            view["replica"] = i
+            view["affinity_tokens"] = aff.get(i, 0)
+            view["affinity_blocks"] = aff.get(i, 0) // self._affinity.bs
+            out.append((float(self.policy(view)), i, view))
+        # best score first; ties break toward the lower replica index
+        out.sort(key=lambda t: (-t[0], t[1]))
+        return out
+
+    def _place(self, outer: GenerationRequest, user_on_token,
+               exclude: Sequence[int],
+               tokens_kept: int) -> Tuple[GenerationRequest, int]:
+        """Build the replica-side request for `outer`'s remaining work
+        and submit it to the best-scoring replica that accepts
+        (head-of-policy refusals fall through to the next candidate).
+        Called under the router lock. Raises NoReplicaAvailable when
+        nobody accepts."""
+        eff = outer.prompt + outer.tokens
+        remaining_new = (None if outer.max_new_tokens is None
+                         else outer.max_new_tokens - len(outer.tokens))
+        remaining_t = (None if outer.deadline is None
+                       else max(0.001, outer.deadline - self._clock()))
+        candidates = self._views(eff, exclude)
+        last_err: Optional[BaseException] = None
+        for score, i, view in candidates:
+            eng = self.engines[i]
+            inner = GenerationRequest(
+                eff, priority=outer.priority,
+                max_new_tokens=remaining_new,
+                stop_token_id=outer.stop_token_id,
+                timeout_s=remaining_t,
+                on_token=self._bridge(outer, user_on_token))
+            try:
+                eng.submit(inner)
+            except (QueueFullError, EngineStopped) as e:
+                # queue-full backpressure or a replica that stopped
+                # accepting between the view and the submit: fall
+                # through to the next candidate. Anything else — a
+                # ValueError for a request that can NEVER fit, or a
+                # genuine engine bug — propagates: rewriting it as
+                # backpressure would 429 a broken service
+                last_err = e
+                continue
+            self._affinity.observe(eff, i)
+            # the outer handle advertises its CURRENT serving replica
+            # (updated on failover) — the frontend's SSE events and the
+            # bench read it without reaching into router internals
+            outer.replica_id = eng.replica_id
+            self._c_routed.inc()
+            self._per_replica_routed[i].inc()
+            if eng.trace is not None and inner.trace_id is not None:
+                eng.trace.emit(inner.trace_id, "routed",
+                               replica=eng.replica_id,
+                               score=round(score, 4),
+                               router_rid=outer.request_id,
+                               affinity_tokens=view["affinity_tokens"],
+                               resumed_tokens=tokens_kept)
+            return inner, i
+        self._c_rejected.inc()
+        raise NoReplicaAvailable(
+            f"no replica accepted the request "
+            f"({len(self.engines)} replicas, "
+            f"{len(candidates)} eligible; last error: {last_err!r})")
+
+    def _bridge(self, outer: GenerationRequest, user_on_token):
+        """The replica→client token bridge: the inner request's
+        on_token forwards each token into the outer handle's channel
+        (append-only, so a failover's resume can never re-emit) and
+        then the user callback. Runs on the serving replica's engine
+        thread; a user-callback error fails the INNER request there —
+        the engine's per-request boundary — and surfaces on the outer
+        handle as a terminal FAILED, never a failover."""
+        def fwd(tok: int) -> None:
+            if outer.first_token_time is None:
+                outer.first_token_time = self._clock()
+                self._h_ttft.observe(
+                    outer.first_token_time - outer.submit_time)
+            outer._deliver(tok)
+            if user_on_token is not None:
+                user_on_token(tok)
+        return fwd
+
+    # ---- monitor thread --------------------------------------------------
+    def _monitor_loop(self) -> None:
+        while True:
+            with self._work:
+                if self._stop:
+                    return
+                self._sweep_locked()
+                self._work.wait(self._idle_poll_s)
+
+    def _sweep_locked(self) -> None:
+        """One monitor tick: forward client cancellations to the
+        serving replica, fan replica-side terminals into the outer
+        handles, and fail over eligible failures to another replica.
+        Per-entry exception boundary: a broken pluggable policy or
+        failover predicate fails THAT request — it must never kill the
+        monitor thread, which would wedge every handle forever."""
+        done: List[str] = []
+        for rid, ent in self._routed.items():
+            try:
+                if ent.outer.cancel_requested \
+                        and not ent.inner.cancel_requested:
+                    ent.inner.cancel()
+                    self.engines[ent.idx].cancel(ent.inner)
+                if ent.inner.done:
+                    if self._handle_terminal(ent):
+                        done.append(rid)
+            # ptlint: disable=EXC001 — monitor boundary: the error is
+            # attached to the request's handle and re-raised in its
+            # result(); losing the monitor loop instead would silently
+            # strand every in-flight and future request
+            except Exception as e:
+                self._c_monitor_errors.inc()
+                if not ent.outer.done:
+                    ent.outer._finish(RequestState.FAILED,
+                                      "router_monitor_error", error=e,
+                                      now=self._clock())
+                done.append(rid)
+        if done:
+            for rid in done:
+                del self._routed[rid]
+            self._g_inflight.set(len(self._routed))
+            self._work.notify_all()
+
+    def _handle_terminal(self, ent: _Routed) -> bool:
+        """Map one finished replica-side request onto its outer handle.
+        Returns True when the outer is terminal (entry can drop), False
+        when the request failed over and lives on elsewhere."""
+        inner, outer = ent.inner, ent.outer
+        now = self._clock()
+        if inner.state is RequestState.FAILED and self._failover_enabled \
+                and not outer.cancel_requested \
+                and self._failover_on(inner, inner.error,
+                                      inner.finish_reason):
+            if ent.failovers < self._max_failovers:
+                if self._failover(ent):
+                    return False
+            self._c_failover_exhausted.inc()
+        outer._finish(inner.state, inner.finish_reason,
+                      error=inner.error, now=now)
+        return True
+
+    def _failover(self, ent: _Routed) -> bool:
+        """Re-admit `ent`'s request on a different healthy replica,
+        resuming from `prompt + tokens` (nothing re-emits: the outer
+        channel already holds every streamed token, and the resumed
+        decode continues from exactly that suffix). Returns False when
+        no replica accepts — the caller then finishes the outer with
+        the original error."""
+        outer = ent.outer
+        from_idx = ent.idx
+        from_id = self.engines[from_idx].replica_id
+        kept = len(outer.tokens)
+        try:
+            inner, idx = self._place(outer, ent.user_on_token,
+                                     exclude=(from_idx,),
+                                     tokens_kept=kept)
+        except NoReplicaAvailable:
+            return False
+        ent.inner = inner
+        ent.idx = idx
+        ent.failovers += 1
+        outer.router_failovers = ent.failovers
+        self._c_failovers.inc()
+        to_eng = self.engines[idx]
+        entry = {"router_rid": outer.request_id,
+                 "from_replica": from_id,
+                 "to_replica": to_eng.replica_id,
+                 "tokens_kept": kept,
+                 "failover_n": ent.failovers}
+        self._failover_log.append(entry)
+        del self._failover_log[:-64]       # bounded forensics ring
+        if to_eng.trace is not None and inner.trace_id is not None:
+            to_eng.trace.emit(inner.trace_id, "failover", **entry)
+        return True
+
+    # ---- observability ---------------------------------------------------
+    def health(self) -> Dict:
+        """Aggregated health: `status` is the WORST replica state (the
+        conservative operator view), `serving_replicas` counts replicas
+        still able to serve, and `replicas` carries each replica's full
+        `engine.health()` detail keyed by replica id."""
+        per = [eng.health() for eng in self.engines]
+        worst = max(per, key=lambda h: _HEALTH_ORDER[h["status"]])
+        return {
+            "status": worst["status"],
+            "replica_count": len(per),
+            "serving_replicas": sum(1 for h in per
+                                    if h["status"] != "UNHEALTHY"),
+            "failovers": self._c_failovers.value,
+            "requests_routed": self._c_routed.value,
+            "requests_rejected": self._c_rejected.value,
+            "replicas": {h["replica_id"]: h for h in per},
+        }
+
+    def snapshot(self) -> Dict:
+        """Router metrics + failover log + affinity-index size, plus
+        every replica's full `engine.snapshot()` keyed by replica id."""
+        with self._lock:
+            snap = {
+                "router": self.metrics.snapshot(),
+                "failover_log": [dict(e) for e in self._failover_log],
+                "affinity_indexed_blocks": len(self._affinity),
+                "replicas": {},
+            }
+        for eng in self.engines:
+            snap["replicas"][eng.replica_id] = eng.snapshot()
+        return snap
+
+    def to_prometheus(self, prefix: str = "paddle_tpu_") -> str:
+        """Every replica's `MetricsRegistry.to_prometheus()` plus the
+        router's own registry, merged into ONE valid exposition: each
+        sample gains a `replica="rN"` label (`replica="router"` for
+        router-level metrics) and samples are re-grouped per family so
+        a strict parser sees each family exactly once."""
+        chunks = [("router", self.metrics.to_prometheus(prefix))]
+        chunks += [(eng.replica_id, eng.metrics.to_prometheus(prefix))
+                   for eng in self.engines]
+        families: "OrderedDict[str, List[str]]" = OrderedDict()
+        for rid, text in chunks:
+            family = None
+            for line in text.splitlines():
+                if not line:
+                    continue
+                if line.startswith("# TYPE "):
+                    family = line
+                    families.setdefault(family, [])
+                    continue
+                if line.startswith("#"):
+                    continue
+                name, _, value = line.rpartition(" ")
+                if "{" in name:
+                    name = name[:-1] + f',replica="{rid}"}}'
+                else:
+                    name = name + f'{{replica="{rid}"}}'
+                families.setdefault(family or "# TYPE _orphan untyped",
+                                    []).append(f"{name} {value}")
+        lines: List[str] = []
+        for family, samples in families.items():
+            lines.append(family)
+            lines.extend(samples)
+        return "\n".join(lines) + "\n"
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """Merged Chrome-trace across replicas: each replica's sink
+        exports on its own pid (process name carries the replica id),
+        timestamps are aligned onto one global origin, and every
+        event's `trace_id` arg is prefixed `rN:` so per-request rows
+        stay unique across replicas in `tools/trace_report.py`."""
+        sinks = [(i, eng) for i, eng in enumerate(self.engines)
+                 if eng.trace is not None]
+        if not sinks:
+            return {"traceEvents": [], "displayTimeUnit": "ms"}
+        origin = min(eng.trace.origin for _, eng in sinks)
+        events: List[Dict[str, Any]] = []
+        for i, eng in sinks:
+            shift_us = (eng.trace.origin - origin) * 1e6
+            pid = i + 1
+            for e in eng.trace.to_chrome_trace()["traceEvents"]:
+                e = dict(e)
+                e["pid"] = pid
+                if e.get("ph") == "M":
+                    if e.get("name") == "process_name":
+                        e["args"] = {
+                            "name": f"paddle_tpu.serving {eng.replica_id}"}
+                else:
+                    e["ts"] = e.get("ts", 0.0) + shift_us
+                args = e.get("args")
+                if args and "trace_id" in args:
+                    e["args"] = {
+                        **args,
+                        "trace_id": f"{eng.replica_id}:{args['trace_id']}"}
+                events.append(e)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -22,7 +22,13 @@ questions the raw timeline is too granular for:
     back to the queue front (quarantine victims, rolled-back pending
     siblings) and how many backoff retries it consumed, so a
     fault-tolerance event cascade is visible instead of reading as
-    unexplained repeat prefills.
+    unexplained repeat prefills;
+  * replica attribution — which replica served each request (the
+    `replica_id` the batcher stamps on `prepared` events, or the
+    Router's `routed`/`failover` events in a merged multi-replica
+    artifact), a per-replica request breakdown in the totals, and a
+    `failovers` churn column so the cross-replica recovery path reads
+    like the in-replica requeue one.
 
 Standard library only (no jax import): runs anywhere the JSON landed,
 including the CI bench-smoke job where it ships as a non-blocking
@@ -57,6 +63,7 @@ def summarize(events) -> dict:
         "slot": None, "prefill_ms": 0.0, "chunks": 0, "fused_chunks": 0,
         "pad_tokens": 0, "real_tokens": 0, "cached_tokens": 0,
         "generated": 0, "requeues": 0, "retries": 0, "kv_bytes": 0,
+        "replica": None, "failovers": 0,
     })
     steps = {"count": 0, "total_ms": 0.0}
     quant = {"weight_dtype": None, "kv_dtype": None}
@@ -76,8 +83,16 @@ def summarize(events) -> dict:
             r["prompt_len"] = args.get("prompt_len")
         elif name == "admitted":
             r["admitted_ts"] = ts
+        elif name == "routed":
+            # the Router's placement decision (replica + policy score)
+            r["replica"] = args.get("replica", r["replica"])
+        elif name == "failover":
+            # cross-replica recovery: the request resumed elsewhere
+            r["failovers"] += 1
+            r["replica"] = args.get("to_replica", r["replica"])
         elif name == "prepared":
             r["slot"] = args.get("slot")
+            r["replica"] = args.get("replica_id", r["replica"])
             # quantized-serving bytes: the batcher stamps its resolved
             # dtype config + per-block bytes (scale overhead included)
             # on every prepared event, so the report can price each
@@ -116,6 +131,7 @@ def summarize(events) -> dict:
             # an artifact exported mid-run carries requests with no
             # terminal event yet — report them as "live", don't crash
             "trace_id": tid, "terminal": r["terminal"] or "live",
+            "replica": r["replica"], "failovers": r["failovers"],
             "slot": r["slot"], "prompt_len": r["prompt_len"],
             "generated": r["generated"],
             "queue_wait_ms": delta("enqueued_ts", "admitted_ts"),
@@ -152,6 +168,10 @@ def summarize(events) -> dict:
         "engine_step_ms_total": round(steps["total_ms"], 3),
         "requeued_events": sum(x["requeues"] for x in rows),
         "retried_events": sum(x["retries"] for x in rows),
+        "failover_events": sum(x["failovers"] for x in rows),
+        "replicas": dict(sorted(Counter(
+            x["replica"] for x in rows
+            if x["replica"] is not None).items())),
         "weight_dtype": quant["weight_dtype"],
         "kv_dtype": quant["kv_dtype"],
         "kv_bytes_total": sum(x["kv_bytes"] for x in rows),
@@ -182,16 +202,18 @@ def render(summary: dict) -> str:
         f"engine steps: {t['engine_steps']} "
         f"({t['engine_step_ms_total']:.1f} ms total)",
         f"recovery: {t['requeued_events']} requeues, "
-        f"{t['retried_events']} retries",
+        f"{t['retried_events']} retries, "
+        f"{t['failover_events']} failovers",
+        f"replicas: {t['replicas'] or '-'}",
         f"quantization: weights {t['weight_dtype'] or '-'}, "
         f"kv {t['kv_dtype'] or '-'}  kv bytes admitted: "
         f"{t['kv_bytes_total']}",
         "",
     ]
-    cols = ["trace_id", "terminal", "slot", "prompt_len", "generated",
-            "queue_wait_ms", "ttft_ms", "decode_ms", "prefill_ms",
-            "chunks", "fused_chunks", "cached_tokens", "pad_tokens",
-            "requeues", "retries", "kv_bytes"]
+    cols = ["trace_id", "terminal", "replica", "slot", "prompt_len",
+            "generated", "queue_wait_ms", "ttft_ms", "decode_ms",
+            "prefill_ms", "chunks", "fused_chunks", "cached_tokens",
+            "pad_tokens", "requeues", "retries", "failovers", "kv_bytes"]
     rows = [[_fmt(r[c]) for c in cols] for r in summary["requests"]]
     widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
               for i, c in enumerate(cols)]
