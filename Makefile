@@ -45,9 +45,15 @@ test:           ## tier-1 test suite (CPU)
 # stranded request fails over to the survivor with streams
 # bit-identical to the single-engine reference (pre-failover part a
 # strict prefix), zero post-warmup recompiles on both replicas.
-# Load leg: --load is the closed-loop generator (Poisson arrivals,
+# Restart leg: --restart is the same chaos shape with auto_restart on;
+# FAILS unless the dead slot is respawned through the supervisor's
+# readiness gate, rejoins rotation, serves a post-restart request, and
+# recompiles stay 0 on every engine incarnation (breaker shut).
+# Load legs: --load is the closed-loop generator (Poisson arrivals,
 # multi-turn sessions, shared system prompts) emitting goodput and
-# p99-under-load as tracked JSON fields (timing-based, not gated).
+# p99-under-load as tracked JSON fields (timing-based, not gated);
+# --load --router runs the same generator through a 2-replica Router
+# (multi-replica goodput scaling, per-replica routing counts).
 bench-smoke:    ## tiny serving benches (non-blocking CI job)
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --prefix-share \
 		--n-requests 6 --max-new 4 --trace /tmp/paddle_tpu_trace.json
@@ -62,7 +68,11 @@ bench-smoke:    ## tiny serving benches (non-blocking CI job)
 		--n-requests 8 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --router \
 		--n-requests 8 --max-new 6
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --restart \
+		--n-requests 8 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --load \
+		--sessions 4 --turns 2 --max-new 4
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --load --router \
 		--sessions 4 --turns 2 --max-new 4
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py \
 		--attention-impl pallas --n-requests 4 --max-new 4

@@ -93,12 +93,26 @@ and the survivor's pool drains clean. The JSON line carries
 router_failovers / router_victim_tokens_kept /
 router_recompiles_after_warmup / router_serving_replicas.
 
+Restart (`--restart`): the self-healing gate. Same chaos shape as
+`--router` — a seeded hang kills the victim's serving replica
+mid-stream and every stranded SSE stream must fail over with the
+strict-prefix invariant — but the Router runs `auto_restart=True`:
+the leg then HARD-FAILS unless the dead slot is respawned through the
+supervisor's readiness gate (teardown → rebuild → AOT warmup →
+synthetic probe), rejoins rotation, serves a post-restart request,
+and recompiles stay 0 on every engine incarnation with the crash-loop
+breaker shut.
+
 Load (`--load`): the closed-loop load generator (ROADMAP direction-3
 follow-on): Poisson session arrivals, multi-turn sessions (each turn
 extends the previous prompt + generated tokens — the prefix-cache
 steady state), shared-system-prompt populations. Emits goodput
 (tokens of requests completed within `--deadline-s`, per wall second)
 and request-latency p50/p99 under load as tracked JSON fields.
+`--load --router` runs the same generator through a 2-replica Router
+(the "load-leg router mode" follow-on): multi-replica
+`goodput_tok_s` / `latency_s_p99_load` plus per-replica routing
+counts land in the JSON line.
 
 `--attention-impl {auto,xla,pallas}` selects the paged-attention
 backend (nlp/ragged_attention.py); the JSON line records the RESOLVED
@@ -131,7 +145,8 @@ def _make_prompts(rng, n_requests: int, workload: str,
         common = list(map(int, rng.randint(1, 200, prefix_len)))
         return [common + list(map(int, rng.randint(1, 200, suffix_len)))
                 for _ in range(n_requests)]
-    if workload in ("mixed", "fused", "chaos", "quantized", "router"):
+    if workload in ("mixed", "fused", "chaos", "quantized", "router",
+                    "restart"):
         # lengths spanning the whole ladder, incl. past the largest
         # bucket (chunked prefill) — every request a different length
         return [list(map(int, rng.randint(1, 200, int(L))))
@@ -488,6 +503,90 @@ def _sse_stream(host: str, port: int, payload: dict):
         conn.close()
 
 
+def _sse_chaos_run(host, port, prompts, budgets, injs, hang_s):
+    """The shared chaos harness of the --router and --restart legs:
+    stream every prompt concurrently over SSE through the frontend;
+    when the victim (the largest-budget request — it must still be
+    DECODING when the poison arms) streams its first token, hang its
+    serving replica's next device calls (a spread of step numbers
+    absorbs the arm-vs-step race; only the first match fires, the
+    rest stay idle). Returns (results, victim_index, wall_s) where
+    results[i] = {"tokens", "routed", "final"}."""
+    import threading
+
+    victim = max(range(len(prompts)), key=lambda i: budgets[i])
+    armed = threading.Event()
+    results = [None] * len(prompts)
+
+    def run_one(i):
+        toks, routed, final = [], None, None
+        for event, data in _sse_stream(
+                host, port, {"prompt": prompts[i],
+                             "max_new_tokens": int(budgets[i])}):
+            if event == "routed":
+                routed = data["replica"]
+            elif event in ("done", "error"):
+                final = data
+            elif "token" in data:
+                toks.append(data["token"])
+                if i == victim and not armed.is_set():
+                    armed.set()
+                    inj = injs[int(routed[1:])]
+                    c = inj.stats()["calls"]
+                    for k in range(1, 6):
+                        inj.hang_on_step(c + k, hang_s)
+        results[i] = {"tokens": toks, "routed": routed, "final": final}
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run_one, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    return results, victim, time.perf_counter() - t0
+
+
+def _check_sse_failover(results, victim, base_tokens, snap, gate):
+    """The shared failover gates of the --router and --restart legs:
+    the victim finished on ANOTHER replica after >=1 failover, its
+    pre-failover stream is a strict prefix of the final one, and
+    EVERY stream is bit-identical to the single-engine reference.
+    Returns (tokens_kept, dead_replica_id) on success; raises the
+    gate's hard failure otherwise."""
+    v = results[victim]
+    if v is None or v["final"] is None:
+        raise RuntimeError(
+            f"{gate} gate: the victim's SSE stream never finished — "
+            f"failover did not recover it")
+    if v["final"]["state"] != "FINISHED":
+        raise RuntimeError(
+            f"{gate} gate: victim ended {v['final']['state']} "
+            f"({v['final'].get('error')}) instead of completing on "
+            f"the surviving replica")
+    if not v["final"]["failovers"] or v["final"]["replica"] == v["routed"]:
+        raise RuntimeError(
+            f"{gate} gate: victim finished on {v['final']['replica']} "
+            f"with {v['final']['failovers']} failovers — the chaos "
+            f"hang never forced a cross-replica failover")
+    log = {e["router_rid"]: e for e in snap["failover_log"]}
+    kept = log.get(v["final"]["request_id"], {}).get("tokens_kept", 0)
+    if not (0 < kept < len(base_tokens[victim])):
+        raise RuntimeError(
+            f"{gate} gate: victim kept {kept} of "
+            f"{len(base_tokens[victim])} tokens across failover — the "
+            f"pre-failover stream is not a strict prefix (fault fired "
+            f"before the first token, or after the last)")
+    for i, r in enumerate(results):
+        if r is None or r["tokens"] != base_tokens[i]:
+            got = None if r is None else r["tokens"]
+            raise RuntimeError(
+                f"{gate} gate: request {i} streamed {got} != the "
+                f"single-engine reference — failover re-emitted, lost "
+                f"or corrupted tokens")
+    return kept, v["routed"]
+
+
 def _router_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
     """The cross-replica failover gate, e2e over HTTP: 2 replicas
     behind a Router + HttpFrontend serve the mixed workload as
@@ -500,8 +599,6 @@ def _router_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
     the final one, every request's tokens are bit-identical to the
     single-engine reference, post-warmup recompiles stay 0 on both
     replicas, and the survivor's pool drains clean."""
-    import threading
-
     from paddle_tpu import serving
     from paddle_tpu.serving.faults import FaultInjector
 
@@ -523,42 +620,8 @@ def _router_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
     compiles_warm = [e.batcher.compile_count for e in router.engines]
     fe = serving.HttpFrontend(router, port=0, shutdown_router=False)
     host, port = fe.start()
-
-    victim = max(range(len(prompts)), key=lambda i: budgets[i])
-    armed = threading.Event()
-    results = [None] * len(prompts)
-
-    def run_one(i):
-        toks, routed, final = [], None, None
-        for event, data in _sse_stream(
-                host, port, {"prompt": prompts[i],
-                             "max_new_tokens": int(budgets[i])}):
-            if event == "routed":
-                routed = data["replica"]
-            elif event in ("done", "error"):
-                final = data
-            elif "token" in data:
-                toks.append(data["token"])
-                if i == victim and not armed.is_set():
-                    # first streamed token of the victim: hang its
-                    # serving replica's next few device calls (a spread
-                    # of step numbers absorbs the arm-vs-step race;
-                    # only the first match fires, the rest stay idle)
-                    armed.set()
-                    inj = injs[int(routed[1:])]
-                    c = inj.stats()["calls"]
-                    for k in range(1, 6):
-                        inj.hang_on_step(c + k, 3.0)
-        results[i] = {"tokens": toks, "routed": routed, "final": final}
-
-    t0 = time.perf_counter()
-    threads = [threading.Thread(target=run_one, args=(i,))
-               for i in range(len(prompts))]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(600)
-    wall = time.perf_counter() - t0
+    results, victim, wall = _sse_chaos_run(
+        host, port, prompts, budgets, injs, hang_s=3.0)
     recompiles = sum(e.batcher.compile_count - c0
                      for e, c0 in zip(router.engines, compiles_warm))
     snap = router.snapshot()
@@ -566,41 +629,14 @@ def _router_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
     fe.shutdown(drain=True)
     router.shutdown(drain=False)
 
-    v = results[victim]
-    if v is None or v["final"] is None:
-        raise RuntimeError("router gate: the victim's SSE stream never "
-                           "finished — failover did not recover it")
-    if v["final"]["state"] != "FINISHED":
-        raise RuntimeError(
-            f"router gate: victim ended {v['final']['state']} "
-            f"({v['final'].get('error')}) instead of completing on the "
-            f"surviving replica")
-    if not v["final"]["failovers"] or v["final"]["replica"] == v["routed"]:
-        raise RuntimeError(
-            f"router gate: victim finished on {v['final']['replica']} "
-            f"with {v['final']['failovers']} failovers — the chaos hang "
-            f"never forced a cross-replica failover")
-    log = {e["router_rid"]: e for e in snap["failover_log"]}
-    kept = log.get(v["final"]["request_id"], {}).get("tokens_kept", 0)
-    if not (0 < kept < len(base_tokens[victim])):
-        raise RuntimeError(
-            f"router gate: victim kept {kept} of "
-            f"{len(base_tokens[victim])} tokens across failover — the "
-            f"pre-failover stream is not a strict prefix (fault fired "
-            f"before the first token, or after the last)")
-    for i, r in enumerate(results):
-        if r is None or r["tokens"] != base_tokens[i]:
-            got = None if r is None else r["tokens"]
-            raise RuntimeError(
-                f"router gate: request {i} streamed {got} != the "
-                f"single-engine reference — failover re-emitted, lost "
-                f"or corrupted tokens")
+    kept, dead_rid = _check_sse_failover(results, victim, base_tokens,
+                                         snap, "router")
     if recompiles:
         raise RuntimeError(
             f"router gate: {recompiles} post-warmup recompiles across "
             f"replicas — failover re-prefills left the warmed ladder")
     survivor = next(e for e in router.engines
-                    if e.replica_id != v["routed"])
+                    if e.replica_id != dead_rid)
     leaked = survivor.batcher.alloc.stats()["blocks_in_use"]
     if leaked:
         raise RuntimeError(
@@ -613,7 +649,8 @@ def _router_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
         "router_shapes_warmed": warmed,
         "router_failovers": health["failovers"],
         "router_victim_tokens_kept": kept,
-        "router_victim_replicas": [v["routed"], v["final"]["replica"]],
+        "router_victim_replicas": [
+            dead_rid, results[victim]["final"]["replica"]],
         "router_recompiles_after_warmup": recompiles,
         "router_serving_replicas": health["serving_replicas"],
         "router_watchdog_trips": sum(
@@ -621,8 +658,146 @@ def _router_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
     }
 
 
+def _restart_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
+    """The self-healing gate (`--restart`), e2e over HTTP: like the
+    `--router` leg, a seeded chaos hang kills the victim's replica
+    mid-stream and every stranded SSE stream must fail over to the
+    survivor with the strict-prefix invariant intact — but here the
+    Router runs `auto_restart=True`, so the leg then HARD-FAILS unless
+    the dead slot is respawned through the supervisor's readiness gate
+    (teardown → rebuild → AOT warmup → synthetic probe), rejoins
+    rotation, and serves a post-restart request — with zero
+    post-warmup recompiles on EVERY engine incarnation (the originals
+    against their warmup baseline, the respawn against the compile
+    count its readiness gate recorded) and no circuit-breaker trip."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving.faults import FaultInjector
+
+    injs = [FaultInjector(seed=0), FaultInjector(seed=1)]
+    router = serving.Router(
+        params, cfg, replicas=2, max_batch=kw["max_batch"],
+        block_size=kw["block_size"], max_total_len=64,
+        max_new_tokens=kw["max_new"], chunk=kw["chunk"],
+        max_queue_depth=2 * len(prompts),
+        prefix_cache=kw["prefix_cache"],
+        max_prefill_bucket=kw["max_prefill_bucket"],
+        attention_impl=kw["attention_impl"],
+        # compile-scale watchdog headroom: a supervisor respawn runs
+        # jax tracing + XLA compile CONCURRENTLY with the survivor's
+        # serving steps, and a sub-second deadline can trip on that CPU
+        # contention alone (the injected hang below is 8s — far past
+        # any honest step)
+        fused_units=kw["fused_units"], watchdog_s=2.0,
+        per_replica=[{"fault_injector": injs[0]},
+                     {"fault_injector": injs[1]}],
+        auto_restart=True,
+        # leftover hang rules from the arm spread can poison the first
+        # respawn probes (the injector follows the slot) — threshold 5
+        # keeps the breaker shut through that worst case; the leg
+        # heals the injectors as soon as the streams complete
+        restart_opts={"backoff_s": 0.1, "breaker_threshold": 5,
+                      "probe_timeout_s": 120.0},
+        start=False)
+    warmed = router.warmup()
+    router.start()
+    compiles_warm = {e.replica_id: e.batcher.compile_count
+                     for e in router.engines}
+    originals = {e.replica_id: e for e in router.engines}
+    fe = serving.HttpFrontend(router, port=0, shutdown_router=False)
+    host, port = fe.start()
+    results, victim, wall = _sse_chaos_run(
+        host, port, prompts, budgets, injs, hang_s=8.0)
+    # streams done (failover complete): disarm the chaos so the
+    # supervisor's respawn probes run against a clean replica
+    for inj in injs:
+        inj.heal()
+    kept, dead_rid = _check_sse_failover(results, victim, base_tokens,
+                                         router.snapshot(), "restart")
+
+    # --- the self-healing half: the dead slot must rejoin ---------------
+    deadline = time.perf_counter() + 300
+    while time.perf_counter() < deadline:
+        h = router.health()
+        if h["serving_replicas"] == 2 and h["replica_restarts"] >= 1:
+            break
+        time.sleep(0.05)
+    else:
+        h = router.health()
+        raise RuntimeError(
+            f"restart gate: the dead slot never rejoined rotation "
+            f"(serving_replicas={h['serving_replicas']}, "
+            f"restarts={h['replica_restarts']}, "
+            f"supervisor={h.get('supervisor')})")
+    if h["circuit_open"]:
+        raise RuntimeError(
+            "restart gate: the crash-loop breaker opened on what "
+            "should have been a recoverable replica")
+    # the respawned engine must be a NEW incarnation in the same slot
+    respawn = next(e for e in router.engines if e.replica_id == dead_rid)
+    if respawn is originals[dead_rid]:
+        raise RuntimeError(
+            "restart gate: the victim slot still holds the dead "
+            "engine — no respawn happened")
+
+    # post-restart: a concurrent burst of FRESH prompts (short enough
+    # to carry no affinity blocks, so placement is pure occupancy and
+    # spreads) must land traffic on the respawned slot and complete
+    post_rng = np.random.RandomState(99)
+    post = [router.submit(list(map(int, post_rng.randint(1, 200, 5))),
+                          max_new_tokens=kw["max_new"])
+            for _ in range(4)]
+    outs = [q.result(300) for q in post]
+    if not all(outs):
+        raise RuntimeError(
+            "restart gate: a post-restart request generated nothing")
+    served = [q.replica_id for q in post]
+    if dead_rid not in served:
+        raise RuntimeError(
+            f"restart gate: the respawned slot {dead_rid} served none "
+            f"of the post-restart burst (placements: {served}) — it "
+            f"rejoined health but not rotation")
+
+    # recompile accounting per incarnation: survivors vs their warmup
+    # baseline, the respawn vs the compile count its readiness gate
+    # recorded (supervisor slot info)
+    sup = router.health()["supervisor"]
+    recompiles = 0
+    for e in router.engines:
+        if e is respawn:
+            recompiles += e.batcher.compile_count \
+                - sup[e.replica_id]["warm_compile_count"]
+        else:
+            recompiles += e.batcher.compile_count \
+                - compiles_warm[e.replica_id]
+    if recompiles:
+        raise RuntimeError(
+            f"restart gate: {recompiles} post-warmup recompiles across "
+            f"engine incarnations — the respawn's readiness gate or "
+            f"the failover re-prefills left the warmed ladder")
+    health = router.health()
+    fe.shutdown(drain=True)
+    router.shutdown(drain=False)
+    ntok = sum(len(r["tokens"]) for r in results)
+    return {
+        "restart_replicas": 2,
+        "restart_tok_s": round(ntok / wall, 1),
+        "restart_shapes_warmed": warmed,
+        "restart_failovers": health["failovers"],
+        "restart_victim_tokens_kept": kept,
+        "restart_victim_replica": dead_rid,
+        "restart_replica_restarts": health["replica_restarts"],
+        "restart_respawn_attempts": health["restart_failures"] + 1,
+        "restart_circuit_open": health["circuit_open"],
+        "restart_recompiles_after_warmup": recompiles,
+        "restart_serving_replicas": health["serving_replicas"],
+        "restart_post_burst_replicas": sorted(set(served)),
+        "restart_injector_attachments": [
+            inj.stats()["attachments"] for inj in injs],
+    }
+
+
 def _load_leg(params, cfg, *, sessions: int, turns: int, rate_hz: float,
-              deadline_s: float, **kw) -> dict:
+              deadline_s: float, router_replicas: int = 0, **kw) -> dict:
     """The closed-loop load generator: `sessions` clients arrive as a
     Poisson process (`rate_hz`), each runs `turns` multi-turn rounds
     (turn N+1's prompt is turn N's prompt + generated tokens + fresh
@@ -631,27 +806,52 @@ def _load_leg(params, cfg, *, sessions: int, turns: int, rate_hz: float,
     blocks on its own previous turn, so offered load self-limits the
     way real clients do. Emits goodput (tokens of requests that
     completed within `deadline_s`, over the wall) and request-latency
-    percentiles under load — the tracked direction-3 numbers."""
+    percentiles under load — the tracked direction-3 numbers.
+
+    `router_replicas > 0` (the `--load --router` combination) runs the
+    SAME generator through a `serving.Router` over that many replicas
+    instead of one engine — the multi-replica goodput-scaling view the
+    ROADMAP's "load-leg router mode" follow-on asked for (prefix
+    affinity keeps a session's turns on the replica already holding
+    its history, so the per-replica caches stay warm)."""
     import threading
 
     from paddle_tpu import serving
 
-    eng = serving.ServingEngine(
-        params, cfg, max_batch=kw["max_batch"],
-        block_size=kw["block_size"], max_total_len=64,
-        max_new_tokens=kw["max_new"], chunk=kw["chunk"],
-        max_queue_depth=max(64, sessions * turns),
+    common = dict(
+        max_batch=kw["max_batch"], block_size=kw["block_size"],
+        max_total_len=64, max_new_tokens=kw["max_new"],
+        chunk=kw["chunk"], max_queue_depth=max(64, sessions * turns),
         prefix_cache=kw["prefix_cache"],
         max_prefill_bucket=kw["max_prefill_bucket"],
         attention_impl=kw["attention_impl"],
         fused_units=kw["fused_units"], start=False)
+    if router_replicas:
+        eng = serving.Router(params, cfg, replicas=router_replicas,
+                             **common)
+    else:
+        eng = serving.ServingEngine(params, cfg, **common)
     eng.warmup()
     eng.start()
+
+    def pc_stats():
+        # aggregated prefix-cache counters (summed across replicas in
+        # router mode — hit attribution per replica lives in snapshot)
+        snap = eng.snapshot()
+        if router_replicas:
+            out = {"prompt_tokens": 0, "hit_tokens": 0}
+            for s in snap["replicas"].values():
+                pc = s["prefix_cache"]
+                out["prompt_tokens"] += pc.get("prompt_tokens", 0)
+                out["hit_tokens"] += pc.get("hit_tokens", 0)
+            return out
+        return snap["prefix_cache"]
+
     rng = np.random.RandomState(7)
     system_prompts = [list(map(int, rng.randint(1, 200, 12)))
                       for _ in range(2)]
     eng.generate(system_prompts[0] + [1, 2, 3], timeout=600)
-    pc0 = eng.snapshot()["prefix_cache"]
+    pc0 = pc_stats()
     arrivals = np.cumsum(rng.exponential(1.0 / rate_hz, sessions))
     lock = threading.Lock()
     samples = []          # (latency_s, ntok, within_deadline)
@@ -681,8 +881,13 @@ def _load_leg(params, cfg, *, sessions: int, turns: int, rate_hz: float,
     for t in threads:
         t.join(600)
     wall = time.perf_counter() - t0
-    snap = eng.snapshot()
-    pc = snap["prefix_cache"]
+    pc = pc_stats()
+    routed_per_replica = None
+    if router_replicas:
+        h = eng.health()
+        routed_per_replica = {
+            rid: eng.metrics.counter(f"routed_{rid}").value
+            for rid in h["replicas"]}
     eng.shutdown()
     lats = sorted(s[0] for s in samples)
     good_tok = sum(n for _, n, ok in samples if ok)
@@ -692,7 +897,7 @@ def _load_leg(params, cfg, *, sessions: int, turns: int, rate_hz: float,
     pct = lambda q: (round(lats[min(len(lats) - 1,
                                     int(round(q * (len(lats) - 1))))], 4)
                      if lats else None)
-    return {
+    out = {
         "metric": "serving_load_goodput_tok_s",
         "value": round(good_tok / wall, 1),
         "unit": "tokens/s",
@@ -713,6 +918,10 @@ def _load_leg(params, cfg, *, sessions: int, turns: int, rate_hz: float,
         "max_batch": kw["max_batch"],
         "max_new_tokens": kw["max_new"],
     }
+    if router_replicas:
+        out["load_router_replicas"] = router_replicas
+        out["load_routed_per_replica"] = routed_per_replica
+    return out
 
 
 def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
@@ -722,7 +931,7 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
          max_prefill_bucket: int = 512,
          attention_impl: str = "auto", fused_units: int = 1,
          sessions: int = 6, turns: int = 3, rate_hz: float = 8.0,
-         deadline_s: float = 5.0,
+         deadline_s: float = 5.0, load_router_replicas: int = 0,
          trace_path=None, trace_overhead: bool = False) -> dict:
     import jax
     from paddle_tpu.nlp import llama
@@ -741,11 +950,12 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         # the closed-loop generator builds its own session workload —
         # none of the offline result assembly below applies
         return _load_leg(params, cfg, sessions=sessions, turns=turns,
-                         rate_hz=rate_hz, deadline_s=deadline_s, **kw)
+                         rate_hz=rate_hz, deadline_s=deadline_s,
+                         router_replicas=load_router_replicas, **kw)
 
     base = None
     if workload in ("fused", "prefix-share", "chaos", "quantized",
-                    "router"):
+                    "router", "restart"):
         # staggered per-request budgets so slots retire at DIFFERENT
         # steps — equal budgets would march the whole batch in lockstep
         # waves and no admission would ever land mid-decode. The fused
@@ -767,14 +977,17 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
             params, cfg, prompts, kw["budgets"],
             **{k: v for k, v in kw.items() if k != "budgets"})
     routed = None
-    if workload == "router":
+    if workload in ("router", "restart"):
         # single-engine leg first: its per-request tokens are the
         # parity reference the 2-replica HTTP run must reproduce
         # bit-identically (and it provides this workload's base JSON
-        # numbers); then the router+frontend leg with its failover gate
+        # numbers); then the router+frontend leg with its failover
+        # gate — or, for --restart, the self-healing leg that also
+        # demands the dead slot respawn, rejoin and serve
         r0 = _serve(params, cfg, prompts, fused_prefill=True, **kw)
         base_tokens = [q.result() for q in r0["reqs"]]
-        routed = _router_leg(
+        leg = _restart_leg if workload == "restart" else _router_leg
+        routed = leg(
             params, cfg, prompts, kw["budgets"], base_tokens,
             **{k: v for k, v in kw.items() if k != "budgets"})
     chaos = None
@@ -931,8 +1144,8 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         result.update(routed)
     if quant is not None:
         result.update(quant)
-    if workload in ("mixed", "fused", "chaos", "quantized", "router") \
-            and r["recompiles"]:
+    if workload in ("mixed", "fused", "chaos", "quantized", "router",
+                    "restart") and r["recompiles"]:
         raise RuntimeError(
             f"bucketed workload recompiled {r['recompiles']} prefill "
             f"shapes after warmup — the bucket ladder no longer covers "
@@ -970,12 +1183,24 @@ def _cli() -> dict:
                          "prefix), every request bit-matches the "
                          "single-engine reference, and recompiles "
                          "stay 0 on both replicas")
+    ap.add_argument("--restart", action="store_true",
+                    help="self-healing gate: like --router (a chaos "
+                         "hang kills the victim's replica mid-stream, "
+                         "stranded SSE streams must fail over with "
+                         "the strict-prefix invariant) but with "
+                         "auto_restart on; HARD-FAILS unless the dead "
+                         "slot is respawned through the supervisor's "
+                         "readiness gate, rejoins rotation and serves "
+                         "a post-restart request with zero recompiles "
+                         "on every engine incarnation")
     ap.add_argument("--load", action="store_true",
                     help="closed-loop load generator: Poisson session "
                          "arrivals, multi-turn rounds, shared system "
                          "prompts; emits goodput (completed-within-"
                          "deadline tok/s) and latency percentiles "
-                         "under load")
+                         "under load. Combine with --router to run "
+                         "the generator through a 2-replica Router "
+                         "(multi-replica goodput scaling)")
     ap.add_argument("--sessions", type=int, default=6,
                     help="concurrent client sessions for --load")
     ap.add_argument("--turns", type=int, default=3,
@@ -1034,29 +1259,36 @@ def _cli() -> dict:
                          "16 for --bucketed/--fused so the workload "
                          "chunks)")
     a = ap.parse_args()
+    # --load --router is the one legal combination (the load generator
+    # through the Router); every other pairing stays exclusive
+    load_router = a.load and a.router
+    if load_router:
+        a.router = False
     if sum((a.prefix_share, a.bucketed, a.fused, a.chaos,
-            a.quantized, a.router, a.load)) > 1:
+            a.quantized, a.router, a.restart, a.load)) > 1:
         ap.error("--prefix-share, --bucketed, --fused, --chaos, "
-                 "--quantized, --router and --load are mutually "
-                 "exclusive")
+                 "--quantized, --router, --restart and --load are "
+                 "mutually exclusive (except --load --router)")
     workload = ("prefix-share" if a.prefix_share
                 else "mixed" if a.bucketed
                 else "fused" if a.fused
                 else "chaos" if a.chaos
                 else "quantized" if a.quantized
                 else "router" if a.router
+                else "restart" if a.restart
                 else "load" if a.load else "random")
     bucket_cap = a.max_prefill_bucket
     if bucket_cap is None:
-        # the mixed/fused/chaos/quantized/router workloads should also
-        # exercise CHUNKED prefill, so cap the ladder below their
-        # longest prompts (load's multi-turn histories chunk too)
+        # the mixed/fused/chaos/quantized/router/restart workloads
+        # should also exercise CHUNKED prefill, so cap the ladder below
+        # their longest prompts (load's multi-turn histories chunk too)
         bucket_cap = (16 if workload in ("mixed", "fused", "chaos",
-                                         "quantized", "router", "load")
+                                         "quantized", "router",
+                                         "restart", "load")
                       else 512)
     chunk = (a.chunk if a.chunk is not None
              else 2 if workload in ("fused", "prefix-share", "chaos",
-                                    "quantized", "router")
+                                    "quantized", "router", "restart")
              else 4)
     return main(n_requests=a.n_requests, max_new=a.max_new,
                 max_batch=a.max_batch, block_size=a.block_size,
@@ -1068,6 +1300,7 @@ def _cli() -> dict:
                 fused_units=a.fused_units,
                 sessions=a.sessions, turns=a.turns,
                 rate_hz=a.arrival_rate, deadline_s=a.deadline_s,
+                load_router_replicas=2 if load_router else 0,
                 trace_path=a.trace, trace_overhead=a.trace_overhead)
 
 

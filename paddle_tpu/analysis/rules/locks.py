@@ -30,6 +30,15 @@ lock, the engine may touch its admission queue under its lock, and no
 engine or queue code path may ever call back into the router — the
 token bridge (`Router._bridge`) runs on the engine thread but touches
 only the outer handle's lock-free channel, never a router lock.
+
+The replica supervisor (`serving.supervisor.ReplicaSupervisor`, its
+own thread) adds NO new edge to that order: it takes `Router._lock`
+only for slot-state flips and the engine swap — never while calling
+into an engine — and its blocking work (engine teardown/construction/
+warmup, the readiness probe, backoff waits) runs with no lock held;
+engine calls (health/submit/result/shutdown) happen lock-free from
+the supervisor thread, so the deepest chain it creates is the
+engine's own `ServingEngine._lock → AdmissionQueue._lock`.
 """
 from __future__ import annotations
 

@@ -52,6 +52,12 @@ HOT_PATHS: Tuple[Tuple[str, str], ...] = (
      r"|_handle_terminal|_failover)$"),
     ("serving/frontend.py",
      r"^(_handle|_generate|_stream_sse|_submit|_read_request)$"),
+    # replica supervisor: the health-poll loop runs every poll tick and
+    # slot_serving() runs per candidate per routing decision — both
+    # host-only by design; a device value leaking into the lifecycle
+    # state machine would stall routing and restarts alike
+    ("serving/supervisor.py",
+     r"^(_loop|_restart_slot|_probe|slot_serving|info)$"),
     # trace emission helpers run once per scheduler tick / dispatched
     # token batch with tracing always on — a device sync hiding in an
     # event attr would tax EVERY step, so they are hot paths too

@@ -753,8 +753,14 @@ class ContinuousBatcher:
         self.params, self.cfg = params, cfg
         # chaos harness: an optional serving.faults.FaultInjector
         # consulted at every device-call boundary (_gate) — fail /
-        # hang / pass, deterministically. None in production.
+        # hang / pass, deterministically. None in production. The
+        # attach notification lets an injector that follows a replica
+        # slot across supervisor respawns re-arm per-incarnation rules
+        # (hasattr-guarded: any object with a check() works here).
         self._fault = fault_injector
+        if fault_injector is not None and hasattr(fault_injector,
+                                                  "attach"):
+            fault_injector.attach(replica_id)
         self.B, self.bs = max_batch, block_size
         # resolved once: every traced fn closes over the concrete
         # backend and every compiled-shape memo keys on it — and on the

@@ -35,9 +35,12 @@ recorder the engine dumps on a device-step failure), `faults`
 quarantine / retry / watchdog recovery paths and
 `bench_serving.py --chaos`), `router` (N-replica routing: health +
 occupancy + prefix-affinity policy, cross-replica failover via
-resume-from-`prompt + tokens`), `frontend` (stdlib asyncio HTTP:
-`POST /v1/generate`, `POST /v1/stream` SSE, `GET /health`,
-`GET /metrics` with per-replica labels).
+resume-from-`prompt + tokens`), `supervisor` (self-healing replica
+lifecycle: auto-restart with a readiness gate, exponential backoff
+and a crash-loop circuit breaker — `Router(auto_restart=True)`),
+`frontend` (stdlib asyncio HTTP: `POST /v1/generate`,
+`POST /v1/stream` SSE, `GET /health`, `GET /metrics` with
+per-replica labels).
 """
 from __future__ import annotations
 
@@ -62,6 +65,7 @@ __all__ = [
     "PrefixCacheIndex", "RefcountingBlockAllocator",
     "ContinuousBatcher", "PagedKVCache",
     "Router", "NoReplicaAvailable", "default_policy", "HttpFrontend",
+    "ReplicaSupervisor",
 ]
 
 
@@ -77,6 +81,9 @@ def __getattr__(name: str):
     if name == "HttpFrontend":
         from . import frontend
         return getattr(frontend, name)
+    if name == "ReplicaSupervisor":
+        from . import supervisor
+        return getattr(supervisor, name)
     if name in ("ContinuousBatcher", "PagedKVCache",
                 "RefcountingBlockAllocator"):
         from ..nlp import paged

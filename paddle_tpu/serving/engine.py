@@ -127,6 +127,7 @@ class ServingEngine:
                  retry_backoff_s: float = 0.05,
                  retry_transient=None,
                  watchdog_s: Optional[float] = None,
+                 watchdog_compile_grace: float = 16.0,
                  health_window_s: float = 30.0,
                  fault_injector=None,
                  replica_id: str = "r0",
@@ -195,9 +196,26 @@ class ServingEngine:
         self._retry_backoff_s = float(retry_backoff_s)
         self._retry_transient = retry_transient or _default_transient
         self._watchdog_s = watchdog_s
+        # compile-vs-hang disambiguation: an engine serving WITHOUT a
+        # prior warmup() pays trace+compile inside the step that first
+        # meets each shape (prefill buckets, the decode chunk fn, ...),
+        # and any of those can dwarf a sane watchdog deadline — so
+        # until warmup() has run, every step's deadline is multiplied
+        # by this grace factor. The documented tradeoff: an unwarmed
+        # engine detects a REAL hang `grace`x slower; warmup() before
+        # start() removes the ambiguity entirely and is the deploy
+        # guidance for tight deadlines (1.0 restores the old
+        # undifferentiated behavior)
+        self._wd_grace = max(1.0, float(watchdog_compile_grace))
         self._health_window_s = float(health_window_s)
         self._parked: List[List] = []       # [ready_time, request]
         self._wedged = False
+        self._warmed = False                # warmup() ran (AOT ladder)
+        # livelock fuse tripped: the engine declared itself UNHEALTHY
+        # (reason string) and stopped serving — a supervisor's cue to
+        # respawn the replica, like a watchdog trip but with a live
+        # (cleanly parked) engine thread
+        self._broken: Optional[str] = None
         self._last_fault_t: Optional[float] = None
         self._fault_streak = 0              # consecutive failed steps
         self._max_fault_streak = 8          # livelock fuse: then fail-all
@@ -281,6 +299,7 @@ class ServingEngine:
                     "warmup() must run before start() — the engine "
                     "thread owns the batcher once the loop is live")
             n = self.batcher.warmup_prefill()
+            self._warmed = True
             self._update_gauges_locked()
             return n
 
@@ -519,7 +538,7 @@ class ServingEngine:
                 "kv_utilization": (stats["blocks_in_use"]
                                    / stats["capacity_blocks"]),
                 "accepting": self._accepting and not self._stop
-                and not self._wedged,
+                and not self._wedged and self._broken is None,
             }
 
     def health(self) -> Dict:
@@ -536,7 +555,7 @@ class ServingEngine:
 
     def _health_locked(self) -> Dict:
         now = self._clock()
-        if self._wedged:
+        if self._wedged or self._broken is not None:
             status = "UNHEALTHY"
         elif (self._last_fault_t is not None
               and now - self._last_fault_t <= self._health_window_s):
@@ -546,6 +565,14 @@ class ServingEngine:
         return {
             "status": status,
             "replica_id": self.replica_id,
+            # readiness: warmed (no cold-compile TTFT cliffs left),
+            # loop live, and not declared dead — the supervisor's
+            # readiness gate requires this True (plus a served probe)
+            # before a respawned replica rejoins rotation
+            "ready": (self._warmed and self._thread is not None
+                      and not self._wedged and self._broken is None
+                      and not self._stop),
+            "broken": self._broken,
             "step_faults": self._c_step_faults.value,
             "quarantines": self._c_quarantines.value,
             "requests_requeued": self._c_requeued.value,
@@ -621,6 +648,8 @@ class ServingEngine:
             with self._work:
                 if self._wedged:
                     return    # watchdog tore everything down already
+                if self._broken is not None:
+                    return    # livelock fuse declared the engine dead
                 if self._stop:
                     # exit path owns the batcher: cancel whatever is
                     # left so no consumer stays blocked on its channel
@@ -677,6 +706,12 @@ class ServingEngine:
                     # ring's last record is stale, no basis to convict)
                     # or the livelock fuse blew: conservative fail-all
                     self._fail_all_running(e)
+                    if self._fault_streak > self._max_fault_streak:
+                        # the fuse is a replica-level verdict: this
+                        # engine cannot complete a step — declare it
+                        # UNHEALTHY so a supervisor respawns it instead
+                        # of it livelocking through fail-all forever
+                        self._mark_broken("fault_streak", e)
                 self._flight_seq = self.batcher.flight.seq
                 continue
             self._step_t0 = None
@@ -1041,8 +1076,18 @@ class ServingEngine:
             t0 = self._step_t0
             if t0 is None or self._wedged:
                 continue
+            # compile-vs-hang: on a never-warmed engine ANY step may be
+            # paying a fresh trace+compile (first prefill bucket, the
+            # decode chunk fn, a new shape later) — a cost the deadline
+            # was never sized for, and one that used to masquerade as
+            # a hung device call. The compile-grace multiplier covers
+            # exactly the unwarmed window; a warmed engine gets no
+            # grace (every serving-path executable already compiled).
+            deadline = self._watchdog_s
+            if not self._warmed:
+                deadline *= self._wd_grace
             stuck = self._clock() - t0
-            if stuck > self._watchdog_s:
+            if stuck > deadline:
                 self._trip_watchdog(stuck)
 
     def _trip_watchdog(self, stuck_s: float) -> None:
@@ -1074,6 +1119,29 @@ class ServingEngine:
                 self._finish_locked(req, RequestState.FAILED,
                                     "watchdog_engine_unhealthy",
                                     error=err)
+            self._work.notify_all()
+
+    def _mark_broken(self, reason: str, error: BaseException) -> None:
+        """Livelock-fuse verdict (engine thread): the engine declares
+        itself UNHEALTHY without a wedged thread — in-flight requests
+        were already failed by `_fail_all_running`; queued and parked
+        ones fail here with `fault_streak_engine_unhealthy` (a
+        replica-indicting reason: the Router's default failover
+        predicate re-places them on a healthy replica, and a
+        supervisor sees UNHEALTHY and respawns this one). The loop
+        parks at its next tick; shutdown() joins normally."""
+        with self._work:
+            if self._broken is not None:
+                return
+            self._broken = reason
+            self._accepting = False
+            parked = [e[1] for e in self._parked]
+            self._parked.clear()
+            for req in parked + self.queue.clear():
+                self._finish_locked(req, RequestState.FAILED,
+                                    "fault_streak_engine_unhealthy",
+                                    error=error)
+            self._update_gauges_locked()
             self._work.notify_all()
 
     def _fail_all_running(self, error: BaseException) -> None:

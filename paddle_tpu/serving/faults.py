@@ -111,7 +111,37 @@ class FaultInjector:
         self._rules: List[_Rule] = []
         self.calls = 0                 # real device-call ticks seen
         self.probes = 0
+        self.attachments = 0           # batchers this injector armed
+        self._on_attach = None
         self._injected: Dict[str, int] = {}
+
+    # ---- respawn chaos hook ---------------------------------------------
+    def on_attach(self, callback) -> "FaultInjector":
+        """Register `callback(injector, attach_count, replica_id)` to
+        run every time a batcher wires this injector in — once at
+        first construction and AGAIN for every supervisor respawn (a
+        respawned replica re-applies its per-replica overrides, so the
+        same injector instance follows the slot; `replica_id` names
+        the attaching batcher, so one injector shared across replicas
+        can still tell incarnations apart). The hook is how a chaos
+        test poisons EVERY incarnation of a replica (e.g. re-arm a
+        hang on the respawned engine's first device calls to drive
+        the crash-loop circuit breaker open) instead of only the
+        first. Step counters persist across attachments."""
+        with self._lock:
+            self._on_attach = callback
+        return self
+
+    def attach(self, replica_id: str = "r0") -> None:
+        """Called by `ContinuousBatcher` when the injector is wired
+        into a (possibly respawned) batcher: bumps `attachments` and
+        fires the `on_attach` hook outside the lock (the hook arms
+        rules, which takes the lock itself)."""
+        with self._lock:
+            self.attachments += 1
+            cb, n = self._on_attach, self.attachments
+        if cb is not None:
+            cb(self, n, str(replica_id))
 
     # ---- arming ---------------------------------------------------------
     def _arm(self, rule: _Rule) -> "FaultInjector":
@@ -232,6 +262,7 @@ class FaultInjector:
         """Calls seen and injections delivered, per fault kind."""
         with self._lock:
             return {"calls": self.calls, "probes": self.probes,
+                    "attachments": self.attachments,
                     "injected": dict(self._injected),
                     "armed_rules": sum(1 for r in self._rules
                                        if not r.exhausted())}

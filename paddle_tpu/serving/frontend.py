@@ -21,8 +21,11 @@ Endpoints:
     thread appends tokens; the handler drains new ones each tick and
     awaits the socket drain), so one slow client never stalls another.
   * ``GET /health`` — the router's aggregated worst-of status plus
-    per-replica detail; HTTP 200 while at least one replica serves,
-    503 when none can.
+    per-replica detail (and, with auto-restart on, the supervisor's
+    per-slot SERVING/RESTARTING/FAILED lifecycle states); HTTP 200
+    while at least one replica serves, 503 when none can — with a
+    ``Retry-After: 1`` hint when a slot is RESTARTING (recovery is
+    underway) and none when the fleet is breaker-pinned FAILED.
   * ``GET /metrics`` — `Router.to_prometheus()`: every replica's
     exposition merged with ``replica="rN"`` labels
     (``text/plain; version=0.0.4``).
@@ -70,9 +73,10 @@ def _headers(status: int, ctype: str, length: Optional[int] = None,
     return (head + "\r\n").encode()
 
 
-def _json_body(status: int, payload: Dict[str, Any]) -> bytes:
+def _json_body(status: int, payload: Dict[str, Any],
+               extra: str = "") -> bytes:
     body = json.dumps(payload).encode()
-    return _headers(status, "application/json", len(body)) + body
+    return _headers(status, "application/json", len(body), extra) + body
 
 
 def _sse_event(data: Dict[str, Any], event: Optional[str] = None) -> bytes:
@@ -402,7 +406,17 @@ class HttpFrontend:
         h = self.router.health()
         serving = h.get("serving_replicas",
                         0 if h.get("status") == "UNHEALTHY" else 1)
-        writer.write(_json_body(200 if serving else 503, h))
+        if serving:
+            writer.write(_json_body(200, h))
+            return
+        # nobody serves right now — but RESTARTING and FAILED are
+        # different outages: a slot behind the supervisor's readiness
+        # gate is coming back (tell the load balancer to retry soon),
+        # a breaker-pinned FAILED fleet is not. The JSON body carries
+        # the per-slot supervisor detail either way.
+        extra = ("Retry-After: 1\r\n"
+                 if h.get("restarting_replicas", 0) else "")
+        writer.write(_json_body(503, h, extra=extra))
 
     async def _metrics(self, writer) -> None:
         text = self.router.to_prometheus()

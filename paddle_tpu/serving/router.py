@@ -27,10 +27,24 @@ replica-agnostic resume mechanism), so the client's stream continues
 where it stopped: streamed tokens are never re-emitted or lost, and
 the pre-failover stream is a strict prefix of the final one.
 
+Self-healing (PR 12): with `auto_restart=True` a `ReplicaSupervisor`
+(`serving.supervisor`) watches every slot and closes the
+detect→kill→respawn→re-warm→rejoin loop: an UNHEALTHY replica is torn
+down and a fresh engine is rebuilt IN THE SAME SLOT (same
+`replica_id`, from the router's retained params/cfg/per-replica
+overrides), held off-rotation behind a readiness gate (AOT `warmup()`
+plus a synthetic probe generation) until it proves it can serve, with
+exponential backoff + jitter between failed attempts and a crash-loop
+circuit breaker that pins a flapping slot FAILED. Affinity entries
+pointing at the respawned slot are invalidated at swap (its KV pool
+is empty) and re-learn from routed traffic.
+
 Lock order (LOCK001): `Router._lock` → `ServingEngine._lock` →
 `AdmissionQueue._lock` — the router may call into an engine while
 holding its own lock; no engine code path ever calls back into the
-router.
+router. The supervisor thread takes `Router._lock` only for slot
+state flips and the engine swap — all blocking work (teardown,
+construction, warmup, probe, backoff waits) runs lock-free.
 
     router = Router(params, cfg, replicas=2, max_batch=4, ...)
     req = router.submit(prompt_ids)        # routed GenerationRequest
@@ -174,6 +188,22 @@ class _AffinityIndex:
             children = node.children
         return out
 
+    def invalidate(self, replica: int) -> int:
+        """Drop every node pointing at `replica` — called when a slot's
+        engine is respawned with an EMPTY KV pool: last-writer-wins
+        re-pointing must not keep steering prefix siblings to a cold
+        replica. Descendant nodes owned by other replicas may go
+        unreachable and age out through the FIFO bound (the same
+        orphan-tolerant bookkeeping eviction uses). Returns the number
+        of nodes dropped; the index re-learns from routed traffic."""
+        doomed = [uid for uid, node in self._order.items()
+                  if node.replica == int(replica)]
+        for uid in doomed:
+            node = self._order.pop(uid)
+            if node.parent.get(node.key) is node:
+                del node.parent[node.key]
+        return len(doomed)
+
 
 class _Routed:
     """Router-side state of one in-flight request: the client-facing
@@ -199,10 +229,13 @@ def _default_failover_on(req: GenerationRequest,
     when the failure indicts the REPLICA, not the request — the
     hung-step watchdog's `HungStepError` terminals (stranded in-flight
     work and quarantine-requeued victims failed when the engine thread
-    wedged). Convicted quarantine culprits, exhausted retries and
-    on_token failures stay terminal: a request that poisons one
-    replica would poison the next."""
-    if reason in ("watchdog_hung_step", "watchdog_engine_unhealthy"):
+    wedged), and the fault-streak fuse's `fault_streak_engine_unhealthy`
+    (queued/parked requests the broken replica never served — the
+    replica died, not the request). Convicted quarantine culprits,
+    exhausted retries and on_token failures stay terminal: a request
+    that poisons one replica would poison the next."""
+    if reason in ("watchdog_hung_step", "watchdog_engine_unhealthy",
+                  "fault_streak_engine_unhealthy"):
         return True
     return isinstance(error, HungStepError)
 
@@ -226,6 +259,13 @@ class Router:
     replica onto a healthy one (resume from `prompt + tokens`; the
     predicate is pluggable via `failover_on`). Backpressure: when every
     replica refuses admission, `submit()` raises `NoReplicaAvailable`.
+
+    `auto_restart=True` (router-built replicas only) attaches a
+    `serving.supervisor.ReplicaSupervisor`: an UNHEALTHY replica is
+    torn down and respawned in its slot behind a readiness gate, with
+    backoff + a crash-loop circuit breaker — knobs via
+    `restart_opts={...}` (see `ReplicaSupervisor`). Requests stranded
+    mid-restart ride the normal cross-replica failover.
     """
 
     def __init__(self, params=None, cfg=None, *, replicas: int = 2,
@@ -240,8 +280,17 @@ class Router:
                  metrics: Optional[MetricsRegistry] = None,
                  start: bool = True,
                  per_replica: Optional[Sequence[Optional[Dict]]] = None,
+                 auto_restart: bool = False,
+                 restart_opts: Optional[Dict] = None,
                  clock: Callable[[], float] = time.monotonic,
                  **engine_kwargs):
+        # retained rebuild recipe: the supervisor respawns a dead
+        # replica IN ITS SLOT from exactly these (same replica_id, so
+        # metrics/trace attribution stays stable across restarts)
+        self._params, self._cfg = params, cfg
+        self._engine_kwargs = dict(engine_kwargs)
+        self._per_replica = (list(per_replica)
+                             if per_replica is not None else None)
         if engines is None:
             if params is None or cfg is None:
                 raise ValueError(
@@ -249,20 +298,18 @@ class Router:
                     "params+cfg to build replicas from")
             if replicas < 1:
                 raise ValueError("replicas must be >= 1")
-            from .engine import ServingEngine     # lazy: pulls nlp tree
-            built = []
-            for i in range(int(replicas)):
-                kw = dict(engine_kwargs)
-                if per_replica is not None and per_replica[i]:
-                    kw.update(per_replica[i])
-                kw.setdefault("replica_id", f"r{i}")
-                kw["start"] = False
-                built.append(ServingEngine(params, cfg, **kw))
-            engines = built
-        elif engine_kwargs or per_replica is not None:
-            raise ValueError(
-                "engine kwargs only apply when the Router builds the "
-                "replicas itself (engines= was given)")
+            engines = [self._build_replica(i)
+                       for i in range(int(replicas))]
+        else:
+            if engine_kwargs or per_replica is not None:
+                raise ValueError(
+                    "engine kwargs only apply when the Router builds "
+                    "the replicas itself (engines= was given)")
+            if auto_restart:
+                raise ValueError(
+                    "auto_restart needs the Router to own the rebuild "
+                    "recipe — pass params+cfg (+ engine kwargs), not "
+                    "prebuilt engines=")
         self.engines: List = list(engines)
         if not self.engines:
             raise ValueError("Router needs at least one replica")
@@ -299,9 +346,40 @@ class Router:
         self._h_ttft = m.histogram("router_ttft_s")
         self._per_replica_routed = [
             m.counter(f"routed_{eng.replica_id}") for eng in self.engines]
+        # self-healing surface: registered whether or not the
+        # supervisor runs, so the Prometheus exposition is stable
+        # (zeros mean "no restarts", absence would mean "old binary")
+        self._c_restarts = m.counter("replica_restarts")
+        self._c_restart_failures = m.counter("restart_failures")
+        self._c_circuit_open = m.counter("circuit_open")
+        # per-slot: restarts run concurrently (one supervisor thread
+        # per slot), so a shared gauge would let one slot's recovery
+        # zero out another slot's in-progress backoff
+        self._g_restart_backoff = [
+            m.gauge(f"restart_backoff_s_{eng.replica_id}")
+            for eng in self.engines]
+        self._supervisor = None
+        if auto_restart:
+            from .supervisor import ReplicaSupervisor   # lazy sibling
+            self._supervisor = ReplicaSupervisor(
+                self, clock=clock, **(restart_opts or {}))
 
         if start:
             self.start()
+
+    def _build_replica(self, i: int):
+        """Construct (never start) slot `i`'s engine from the retained
+        params/cfg/engine kwargs + per-replica overrides — used for the
+        initial build AND every supervisor respawn, so a respawned
+        replica is configured exactly like the one it replaces
+        (including its chaos injector, replica_id and metrics names)."""
+        from .engine import ServingEngine         # lazy: pulls nlp tree
+        kw = dict(self._engine_kwargs)
+        if self._per_replica is not None and self._per_replica[i]:
+            kw.update(self._per_replica[i])
+        kw.setdefault("replica_id", f"r{i}")
+        kw["start"] = False
+        return ServingEngine(self._params, self._cfg, **kw)
 
     # ---- lifecycle -------------------------------------------------------
     def warmup(self) -> int:
@@ -312,7 +390,8 @@ class Router:
 
     def start(self) -> "Router":
         """Start every replica's engine loop plus the router monitor
-        thread (terminal fan-in, cancellation forwarding, failover)."""
+        thread (terminal fan-in, cancellation forwarding, failover)
+        and, with `auto_restart=True`, the replica supervisor."""
         with self._work:
             if self._stop:
                 raise RuntimeError("router already shut down")
@@ -323,6 +402,8 @@ class Router:
                     target=self._monitor_loop,
                     name="paddle-tpu-router", daemon=True)
                 self._thread.start()
+        if self._supervisor is not None:
+            self._supervisor.start()
         return self
 
     def __enter__(self) -> "Router":
@@ -360,6 +441,14 @@ class Router:
         with self._work:
             self._accepting = False
             self._work.notify_all()
+        # supervisor first: it must not swap engines (or sit in a
+        # backoff wait holding a half-built replica) while the
+        # shutdown below walks the slot list; stop() interrupts an
+        # in-flight restart at its next bounded wait and tears down
+        # any engine it built but never swapped in
+        if self._supervisor is not None:
+            if not self._supervisor.stop(timeout=timeout):
+                clean = False
         if drain and self._thread is not None:
             clean = self.drain(timeout)
         with self._work:
@@ -455,8 +544,14 @@ class Router:
         UNHEALTHY / non-accepting / excluded replicas never appear."""
         aff = self._affinity.match(eff)
         out: List[Tuple[float, int, Dict]] = []
+        sup = self._supervisor
         for i, eng in enumerate(self.engines):
             if i in exclude:
+                continue
+            if sup is not None and not sup.slot_serving(i):
+                # readiness gate: a RESTARTING slot (fresh engine still
+                # warming / probing) or a breaker-pinned FAILED slot is
+                # never offered to the policy
                 continue
             status = eng.health()["status"]
             if status == "UNHEALTHY":
@@ -645,20 +740,41 @@ class Router:
     def health(self) -> Dict:
         """Aggregated health: `status` is the WORST replica state (the
         conservative operator view), `serving_replicas` counts replicas
-        still able to serve, and `replicas` carries each replica's full
-        `engine.health()` detail keyed by replica id."""
+        still able to serve (in rotation AND not UNHEALTHY), and
+        `replicas` carries each replica's full `engine.health()`
+        detail keyed by replica id. With `auto_restart=True` the
+        self-healing surface rides along: per-slot `supervisor` detail
+        (state SERVING/RESTARTING/FAILED, restart + failure counts,
+        current backoff, circuit-breaker flag), `restarting_replicas`
+        / `failed_replicas` counts and the lifetime restart counters —
+        so `/health` distinguishes a slot that is coming back from one
+        that is permanently lost."""
+        sup = self._supervisor
+        states = sup.states() if sup is not None else None
         per = [eng.health() for eng in self.engines]
         worst = max(per, key=lambda h: _HEALTH_ORDER[h["status"]])
-        return {
+        out = {
             "status": worst["status"],
             "replica_count": len(per),
-            "serving_replicas": sum(1 for h in per
-                                    if h["status"] != "UNHEALTHY"),
+            "serving_replicas": sum(
+                1 for i, h in enumerate(per)
+                if h["status"] != "UNHEALTHY"
+                and (states is None or states[i] == "SERVING")),
             "failovers": self._c_failovers.value,
             "requests_routed": self._c_routed.value,
             "requests_rejected": self._c_rejected.value,
+            "replica_restarts": self._c_restarts.value,
+            "restart_failures": self._c_restart_failures.value,
+            "circuit_open": self._c_circuit_open.value,
+            "restarting_replicas": (0 if states is None else
+                                    states.count("RESTARTING")),
+            "failed_replicas": (0 if states is None else
+                                states.count("FAILED")),
             "replicas": {h["replica_id"]: h for h in per},
         }
+        if sup is not None:
+            out["supervisor"] = sup.info()
+        return out
 
     def snapshot(self) -> Dict:
         """Router metrics + failover log + affinity-index size, plus
@@ -668,6 +784,8 @@ class Router:
                 "router": self.metrics.snapshot(),
                 "failover_log": [dict(e) for e in self._failover_log],
                 "affinity_indexed_blocks": len(self._affinity),
+                "supervisor": (None if self._supervisor is None
+                               else self._supervisor.info()),
                 "replicas": {},
             }
         for eng in self.engines:

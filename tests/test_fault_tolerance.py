@@ -366,6 +366,68 @@ class TestWatchdog:
         assert h["status"] == "HEALTHY" and h["watchdog_trips"] == 0
         assert eng.shutdown() is True
 
+    def test_first_step_grace_covers_unwarmed_compile(self, setup):
+        """Arming watchdog_s WITHOUT a prior warmup() used to let the
+        first step's trace+compile masquerade as a hung device call.
+        The first-step grace multiplier covers exactly that window:
+        a deadline far below any compile time still serves, no trips."""
+        cfg, params = setup
+        eng = serving.ServingEngine(
+            params, cfg, max_batch=1, block_size=4, max_total_len=32,
+            max_new_tokens=4, chunk=2, prefill_buckets=(8,),
+            fused_prefill=False, watchdog_s=0.05,
+            watchdog_compile_grace=2400.0)      # 0.05s * grace = 120s
+        assert eng.generate(PROMPTS[0], timeout=300)
+        h = eng.health()
+        assert h["status"] == "HEALTHY" and h["watchdog_trips"] == 0
+        # a WARMED engine gets no grace at all: a genuinely hung step
+        # trips at the plain deadline even with a huge grace factor
+        inj_late = FaultInjector()
+        eng2 = serving.ServingEngine(
+            params, cfg, max_batch=1, block_size=4, max_total_len=32,
+            max_new_tokens=8, chunk=2, prefill_buckets=(8,),
+            fused_prefill=False, watchdog_s=2.0,
+            watchdog_compile_grace=2400.0, fault_injector=inj_late,
+            start=False)
+        eng2.warmup()     # warmed: the grace is OFF from step one
+        eng2.start()
+        assert eng2.generate(PROMPTS[1], timeout=300)
+        armed = threading.Event()
+
+        def arm(tok):
+            if not armed.is_set():
+                armed.set()
+                inj_late.hang_on_rid(r2.request_id, seconds=30.0)
+
+        r2 = serving.GenerationRequest(PROMPTS[0], on_token=arm)
+        eng2.submit(r2)
+        deadline = time.monotonic() + 20.0
+        while (eng2.health()["status"] != "UNHEALTHY"
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert eng2.health()["watchdog_trips"] == 1
+        eng.shutdown()
+        eng2.shutdown(drain=False)
+
+    def test_no_grace_trips_on_unwarmed_first_step(self, setup):
+        """The regression half: grace forced to 1.0 on an UNWARMED
+        engine with a deadline below compile time reproduces the old
+        misfire — proving the grace multiplier (not luck) is what
+        keeps test_first_step_grace_covers_unwarmed_compile green."""
+        cfg, params = setup
+        eng = serving.ServingEngine(
+            params, cfg, max_batch=1, block_size=4, max_total_len=32,
+            max_new_tokens=4, chunk=2, prefill_buckets=(8,),
+            fused_prefill=False, watchdog_s=0.05,
+            watchdog_compile_grace=1.0)
+        r = eng.submit(PROMPTS[0])
+        with pytest.raises(serving.RequestFailed) as ei:
+            r.result(timeout=300)
+        assert "watchdog" in repr(ei.value.request.error)
+        assert eng.health()["status"] == "UNHEALTHY"
+        assert eng.health()["watchdog_trips"] == 1
+        eng.shutdown(drain=False)
+
 
 # ---- chaos under races: no leaks ---------------------------------------
 class TestChaosRaces:

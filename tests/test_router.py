@@ -326,6 +326,9 @@ class TestRouterFailover:
         r = _router(setup, watchdog_s=0.3, failover=False,
                     per_replica=[{"fault_injector": injs[0]},
                                  {"fault_injector": injs[1]}])
+        # warmed: the tight 0.3s deadline must not be stretched by the
+        # unwarmed-engine compile grace (the injected hang is 1.5s)
+        r.warmup()
         r.start()
         armed = threading.Event()
         ready = threading.Event()

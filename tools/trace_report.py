@@ -28,7 +28,11 @@ questions the raw timeline is too granular for:
     Router's `routed`/`failover` events in a merged multi-replica
     artifact), a per-replica request breakdown in the totals, and a
     `failovers` churn column so the cross-replica recovery path reads
-    like the in-replica requeue one.
+    like the in-replica requeue one;
+  * self-healing churn — supervisor `restarting`/`restarted` events
+    (replica-scoped spans, no trace_id) counted into the recovery
+    totals next to failovers, so a replica that died and was respawned
+    is visible in the same summary as the requests it stranded.
 
 Standard library only (no jax import): runs anywhere the JSON landed,
 including the CI bench-smoke job where it ships as a non-blocking
@@ -67,11 +71,17 @@ def summarize(events) -> dict:
     })
     steps = {"count": 0, "total_ms": 0.0}
     quant = {"weight_dtype": None, "kv_dtype": None}
+    # replica-scoped (not request-scoped) churn: supervisor restart
+    # events ride the engine sinks' span lane with no trace_id
+    restarts = {"restarting": 0, "restarted": 0}
     for e in events:
         name, args = e.get("name"), e.get("args", {})
         if name == "engine.step":
             steps["count"] += 1
             steps["total_ms"] += e.get("dur", 0.0) / 1e3
+            continue
+        if name in ("restarting", "restarted"):
+            restarts[name] += 1
             continue
         tid = args.get("trace_id")
         if tid is None:
@@ -169,6 +179,8 @@ def summarize(events) -> dict:
         "requeued_events": sum(x["requeues"] for x in rows),
         "retried_events": sum(x["retries"] for x in rows),
         "failover_events": sum(x["failovers"] for x in rows),
+        "restart_events": restarts["restarted"],
+        "restarting_events": restarts["restarting"],
         "replicas": dict(sorted(Counter(
             x["replica"] for x in rows
             if x["replica"] is not None).items())),
@@ -203,7 +215,8 @@ def render(summary: dict) -> str:
         f"({t['engine_step_ms_total']:.1f} ms total)",
         f"recovery: {t['requeued_events']} requeues, "
         f"{t['retried_events']} retries, "
-        f"{t['failover_events']} failovers",
+        f"{t['failover_events']} failovers, "
+        f"{t['restart_events']} restarts",
         f"replicas: {t['replicas'] or '-'}",
         f"quantization: weights {t['weight_dtype'] or '-'}, "
         f"kv {t['kv_dtype'] or '-'}  kv bytes admitted: "
