@@ -54,6 +54,14 @@ test:           ## tier-1 test suite (CPU)
 # p99-under-load as tracked JSON fields (timing-based, not gated);
 # --load --router runs the same generator through a 2-replica Router
 # (multi-replica goodput scaling, per-replica routing counts).
+# SLO leg: --slo FAILS unless sampled device timing holds tok/s >=
+# 0.97x the sampling-off legs with zero recompiles, an injected
+# latency fault (4s hangs short of the watchdog) drives an itl_ms_p99
+# BREACH visible end-to-end (engine health -> router rollup ->
+# /health detail without flipping the 200 -> slo_breaches_total in
+# the merged /metrics) that CLEARS after the fault heals, and a
+# /debug/profile capture window completes with device-wall spans in
+# the merged trace.
 bench-smoke:    ## tiny serving benches (non-blocking CI job)
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --prefix-share \
 		--n-requests 6 --max-new 4 --trace /tmp/paddle_tpu_trace.json
@@ -69,6 +77,8 @@ bench-smoke:    ## tiny serving benches (non-blocking CI job)
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --router \
 		--n-requests 8 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --restart \
+		--n-requests 8 --max-new 6
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --slo \
 		--n-requests 8 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --load \
 		--sessions 4 --turns 2 --max-new 4

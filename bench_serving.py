@@ -146,7 +146,7 @@ def _make_prompts(rng, n_requests: int, workload: str,
         return [common + list(map(int, rng.randint(1, 200, suffix_len)))
                 for _ in range(n_requests)]
     if workload in ("mixed", "fused", "chaos", "quantized", "router",
-                    "restart"):
+                    "restart", "slo"):
         # lengths spanning the whole ladder, incl. past the largest
         # bucket (chunked prefill) — every request a different length
         return [list(map(int, rng.randint(1, 200, int(L))))
@@ -159,10 +159,13 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
            block_size: int, chunk: int, prefix_cache: bool,
            max_prefill_bucket: int, fused_prefill: bool,
            attention_impl: str = "auto", fused_units: int = 1,
-           budgets=None, trace: bool = True) -> dict:
+           budgets=None, trace: bool = True,
+           profile_sample_every: int = 0) -> dict:
     """One engine lifecycle over `prompts`: warmup (AOT ladder + one
     served request), timed serve, drain. Returns the raw numbers the
-    workload-specific JSON assembly picks from."""
+    workload-specific JSON assembly picks from. `profile_sample_every`
+    defaults OFF here (unlike the engine's 64) so every non-SLO leg's
+    numbers stay fence-free; the --slo leg passes it explicitly."""
     from paddle_tpu import serving
 
     eng = serving.ServingEngine(
@@ -171,7 +174,8 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
         max_queue_depth=len(prompts), prefix_cache=prefix_cache,
         max_prefill_bucket=max_prefill_bucket,
         fused_prefill=fused_prefill, fused_units=fused_units,
-        attention_impl=attention_impl, trace=trace, start=False)
+        attention_impl=attention_impl, trace=trace,
+        profile_sample_every=profile_sample_every, start=False)
     # warmup: AOT-compile EVERY prefill shape (group ladder x bucket
     # ladder x cold/cached, + the fused variants) before the loop
     # starts, then serve one request to compile the decode chunk fn
@@ -222,6 +226,7 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
         "decode_tok_s": toks / step_s if step_s else None,
         "attention_impl": eng.attention_impl,
         "recompiles": b.compile_count - compiles_warm,
+        "profile_samples": b.profiler.report()["samples"],
         "compile_count": b.prefill_compile_count,
         "compile_count_total": b.compile_count,
         "fused_unit_count": b.fused_unit_count,
@@ -796,6 +801,191 @@ def _restart_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
     }
 
 
+def _slo_breach_leg(params, cfg, prompts, budgets, **kw) -> dict:
+    """The SLO-engine gate, e2e over the whole surface: a 1-replica
+    Router + HttpFrontend serve the mixed workload while a seeded
+    `FaultInjector` hangs several device steps for 4 s each — SHORT of
+    the 30 s watchdog (latency degradation, not a dead replica). The
+    leg HARD-FAILS unless the injected latency drives an
+    `itl_ms_p99` BREACH that is visible end-to-end — engine
+    `health()["slo"]`, the router rollup, the `/health` JSON detail
+    (still HTTP 200: SLOs degrade, supervision decides), and
+    `slo_breaches_total >= 1` for BOTH the replica and the router
+    rollup in the merged `/metrics` exposition — AND the verdict
+    clears back to OK after the fault heals, with zero post-warmup
+    recompiles. A `POST /debug/profile` capture window during the
+    recovery traffic must also complete and land device-wall spans in
+    the merged trace (the device-time-attribution half of the PR)."""
+    import threading
+
+    from paddle_tpu import serving
+    from paddle_tpu.serving.faults import FaultInjector
+
+    inj = FaultInjector(seed=0)
+    router = serving.Router(
+        params, cfg, replicas=1, max_batch=kw["max_batch"],
+        block_size=kw["block_size"], max_total_len=64,
+        max_new_tokens=kw["max_new"], chunk=kw["chunk"],
+        max_queue_depth=2 * len(prompts),
+        prefix_cache=kw["prefix_cache"],
+        max_prefill_bucket=kw["max_prefill_bucket"],
+        attention_impl=kw["attention_impl"],
+        fused_units=kw["fused_units"],
+        # the hang must stay SHORT of the watchdog: this is the
+        # latency-degradation shape, not the dead-replica one
+        watchdog_s=30.0,
+        slo_objectives={"itl_ms_p99": 2000.0, "error_rate": 0.5},
+        slo_opts={"fast_window_s": 1.0, "slow_window_s": 3.0,
+                  "eval_every_s": 0.05},
+        per_replica=[{"fault_injector": inj}],
+        start=False)
+    router.warmup()
+    router.start()
+    eng = router.engines[0]
+    router.generate(prompts[0], timeout=600)
+    compiles_warm = eng.batcher.compile_count
+    fe = serving.HttpFrontend(router, port=0, shutdown_router=False)
+    host, port = fe.start()
+
+    # arm: the next few device calls each stall 4 s — far past the
+    # 2000 ms itl objective, far short of the 30 s watchdog
+    c = inj.stats()["calls"]
+    for k in range(1, 4):
+        inj.hang_on_step(c + k, 4.0)
+    reqs = [router.submit(p, max_new_tokens=mn)
+            for p, mn in zip(prompts, budgets)]
+    breach_seen = None
+    deadline = time.perf_counter() + 300
+    while time.perf_counter() < deadline:
+        h = eng.health()
+        if h["slo"]["verdict"] == "BREACH":
+            breach_seen = h["slo"]
+            break
+        if all(r.done for r in reqs):
+            break
+        time.sleep(0.05)
+    for r in reqs:
+        r.result(600)
+    if breach_seen is None:
+        raise RuntimeError(
+            "slo gate: the injected 4s step hangs never drove an SLO "
+            "BREACH — the tracker is not watching the latency the "
+            "engine serves")
+    if breach_seen["objectives"]["itl_ms_p99"]["verdict"] != "BREACH":
+        raise RuntimeError(
+            f"slo gate: breach fired on the wrong objective — "
+            f"{breach_seen['objectives']}")
+    rh = router.health()
+    if rh["slo"]["verdict"] not in ("BREACH", "WARN"):
+        raise RuntimeError(
+            f"slo gate: router rollup says {rh['slo']['verdict']} "
+            f"while the replica breached — fleet aggregation is blind")
+    if rh["slo"]["breaches_total"] < 1:
+        raise RuntimeError("slo gate: rollup lost the breach count")
+
+    # the HTTP surface: /health keeps its 200 (SLOs degrade,
+    # supervision decides) while carrying the verdict detail, and the
+    # merged /metrics exposition counts the breach for the replica AND
+    # the router rollup
+    import http.client
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("GET", "/health")
+    resp = conn.getresponse()
+    health_body = json.loads(resp.read())
+    conn.close()
+    if resp.status != 200:
+        raise RuntimeError(
+            f"slo gate: /health flipped to {resp.status} on an SLO "
+            f"breach — breaches are detail, not outage")
+    if "slo" not in health_body or "objectives" not in health_body["slo"]:
+        raise RuntimeError("slo gate: /health carries no slo detail")
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("GET", "/metrics")
+    prom = conn.getresponse().read().decode()
+    conn.close()
+    counts = {}
+    for ln in prom.splitlines():
+        if ln.startswith("paddle_tpu_slo_breaches_total{"):
+            label = ln.split("{")[1].split("}")[0]
+            counts[label] = float(ln.split()[-1])
+    if counts.get('replica="r0"', 0) < 1 \
+            or counts.get('replica="router"', 0) < 1:
+        raise RuntimeError(
+            f"slo gate: slo_breaches_total missing from the merged "
+            f"exposition (saw {counts})")
+
+    # heal → the verdict must CLEAR once the windows forget the spike
+    inj.heal()
+    clear_deadline = time.perf_counter() + 120
+    post_rng = np.random.RandomState(123)
+    while time.perf_counter() < clear_deadline:
+        router.generate(
+            list(map(int, post_rng.randint(1, 200, 6))),
+            max_new_tokens=2, timeout=600)
+        if eng.health()["slo"]["verdict"] == "OK":
+            break
+        time.sleep(0.1)
+    final = eng.health()["slo"]
+    if final["verdict"] != "OK":
+        raise RuntimeError(
+            f"slo gate: verdict stuck at {final['verdict']} after the "
+            f"fault healed — breach→recover hysteresis never released")
+
+    # device-time capture through the frontend while traffic flows
+    done = threading.Event()
+
+    def burst():
+        for _ in range(4):
+            router.generate(
+                list(map(int, post_rng.randint(1, 200, 8))),
+                max_new_tokens=kw["max_new"], timeout=600)
+        done.set()
+
+    t = threading.Thread(target=burst)
+    t.start()
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    conn.request("POST", "/debug/profile",
+                 json.dumps({"steps": 3, "timeout_s": 60}),
+                 {"Content-Type": "application/json"})
+    profile = json.loads(conn.getresponse().read())
+    conn.close()
+    t.join(600)
+    cap = profile["r0"]["capture"]
+    if not cap["complete"] or cap["steps_captured"] < 3:
+        raise RuntimeError(
+            f"slo gate: the /debug/profile capture window never "
+            f"completed under live traffic ({cap})")
+    dev_spans = sum(
+        1 for e in router.to_chrome_trace()["traceEvents"]
+        if str(e.get("name", "")).startswith("device."))
+    if dev_spans < 3:
+        raise RuntimeError(
+            f"slo gate: only {dev_spans} device-wall spans in the "
+            f"merged trace — capture fences are not reaching the "
+            f"timelines")
+    recompiles = eng.batcher.compile_count - compiles_warm
+    if recompiles:
+        raise RuntimeError(
+            f"slo gate: {recompiles} post-warmup recompiles — the SLO "
+            f"tracker or the capture fences touched the compiled-shape "
+            f"memo")
+    breaches_total = final["breaches_total"]
+    fe.shutdown(drain=True)
+    router.shutdown(drain=False)
+    return {
+        "slo_breaches_total": breaches_total,
+        "slo_breach_objective": "itl_ms_p99",
+        "slo_breach_burn_rate_fast":
+            breach_seen["objectives"]["itl_ms_p99"]["burn_rate_fast"],
+        "slo_verdict_peak": "BREACH",
+        "slo_verdict_final": final["verdict"],
+        "slo_injected_hangs": inj.stats()["injected"].get("hang", 0),
+        "slo_recompiles_after_warmup": recompiles,
+        "slo_profile_steps_captured": cap["steps_captured"],
+        "slo_device_spans": dev_spans,
+    }
+
+
 def _load_leg(params, cfg, *, sessions: int, turns: int, rate_hz: float,
               deadline_s: float, router_replicas: int = 0, **kw) -> dict:
     """The closed-loop load generator: `sessions` clients arrive as a
@@ -955,7 +1145,7 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
 
     base = None
     if workload in ("fused", "prefix-share", "chaos", "quantized",
-                    "router", "restart"):
+                    "router", "restart", "slo"):
         # staggered per-request budgets so slots retire at DIFFERENT
         # steps — equal budgets would march the whole batch in lockstep
         # waves and no admission would ever land mid-decode. The fused
@@ -976,6 +1166,49 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         quant = _quantized_gates(
             params, cfg, prompts, kw["budgets"],
             **{k: v for k, v in kw.items() if k != "budgets"})
+    slo = None
+    if workload == "slo":
+        # sampled device timing must be nearly free: a discarded leg
+        # burns process warm-up, then an ABBA sequence — sampling off,
+        # on, on, off — so each side runs once early and once late and
+        # first-order warm-state drift cancels from the pooled tok/s
+        # (the --trace-overhead methodology, applied to the fence)
+        kw_on = dict(kw, profile_sample_every=4)
+        _serve(params, cfg, prompts, fused_prefill=True, **kw)
+        u1 = _serve(params, cfg, prompts, fused_prefill=True, **kw)
+        s1 = _serve(params, cfg, prompts, fused_prefill=True, **kw_on)
+        s2 = _serve(params, cfg, prompts, fused_prefill=True, **kw_on)
+        u2 = _serve(params, cfg, prompts, fused_prefill=True, **kw)
+        tok_off = (u1["tok_s"] + u2["tok_s"]) / 2
+        tok_on = (s1["tok_s"] + s2["tok_s"]) / 2
+        ratio = tok_on / tok_off
+        samples = s1["profile_samples"] + s2["profile_samples"]
+        recompiles = sum(x["recompiles"] for x in (u1, s1, s2, u2))
+        if samples < 1:
+            raise RuntimeError(
+                "slo gate: the sampled legs fenced ZERO steps — the "
+                "overhead comparison is vacuous (sample_every too "
+                "large for this workload?)")
+        if recompiles:
+            raise RuntimeError(
+                f"slo gate: {recompiles} post-warmup recompiles across "
+                f"the sampling legs — the fence touched the "
+                f"compiled-shape memo")
+        if ratio < 0.97:
+            raise RuntimeError(
+                f"slo gate: sampled run at {ratio:.3f}x the "
+                f"sampling-off tok/s (floor 0.97x) — the device-time "
+                f"fence is no longer cheap enough to leave on")
+        slo = {
+            "slo_tok_s_sampling_off": round(tok_off, 1),
+            "slo_tok_s_sampling_on": round(tok_on, 1),
+            "slo_sampling_overhead_ratio": round(ratio, 4),
+            "slo_profile_samples": samples,
+        }
+        slo.update(_slo_breach_leg(
+            params, cfg, prompts, kw["budgets"],
+            **{k: v for k, v in kw.items() if k != "budgets"}))
+        r0 = u1           # the first clean leg doubles as the numbers
     routed = None
     if workload in ("router", "restart"):
         # single-engine leg first: its per-request tokens are the
@@ -1029,7 +1262,7 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         r = t1
         r["tok_s"] = (t1["tok_s"] + t2["tok_s"]) / 2
         r["recompiles"] = t1["recompiles"] + t2["recompiles"]
-    elif chaos is not None or routed is not None:
+    elif chaos is not None or routed is not None or slo is not None:
         r = r0            # the reference leg doubles as the numbers
     else:
         r = _serve(params, cfg, prompts, fused_prefill=True, **kw)
@@ -1144,8 +1377,10 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         result.update(routed)
     if quant is not None:
         result.update(quant)
+    if slo is not None:
+        result.update(slo)
     if workload in ("mixed", "fused", "chaos", "quantized", "router",
-                    "restart") and r["recompiles"]:
+                    "restart", "slo") and r["recompiles"]:
         raise RuntimeError(
             f"bucketed workload recompiled {r['recompiles']} prefill "
             f"shapes after warmup — the bucket ladder no longer covers "
@@ -1193,6 +1428,20 @@ def _cli() -> dict:
                          "readiness gate, rejoins rotation and serves "
                          "a post-restart request with zero recompiles "
                          "on every engine incarnation")
+    ap.add_argument("--slo", action="store_true",
+                    help="SLO-engine gate: the mixed workload with "
+                         "sampled device timing on vs off (HARD-FAILS "
+                         "unless sampled tok/s >= 0.97x with zero "
+                         "recompiles), then a 1-replica Router + "
+                         "frontend leg where injected 4s step hangs "
+                         "(short of the watchdog) must drive an "
+                         "itl_ms_p99 BREACH visible end-to-end — "
+                         "engine health, router rollup, /health "
+                         "detail (still 200), slo_breaches_total in "
+                         "the merged /metrics — and CLEAR after the "
+                         "fault heals; plus a /debug/profile capture "
+                         "window landing device-wall spans in the "
+                         "merged trace")
     ap.add_argument("--load", action="store_true",
                     help="closed-loop load generator: Poisson session "
                          "arrivals, multi-turn rounds, shared system "
@@ -1265,10 +1514,10 @@ def _cli() -> dict:
     if load_router:
         a.router = False
     if sum((a.prefix_share, a.bucketed, a.fused, a.chaos,
-            a.quantized, a.router, a.restart, a.load)) > 1:
+            a.quantized, a.router, a.restart, a.slo, a.load)) > 1:
         ap.error("--prefix-share, --bucketed, --fused, --chaos, "
-                 "--quantized, --router, --restart and --load are "
-                 "mutually exclusive (except --load --router)")
+                 "--quantized, --router, --restart, --slo and --load "
+                 "are mutually exclusive (except --load --router)")
     workload = ("prefix-share" if a.prefix_share
                 else "mixed" if a.bucketed
                 else "fused" if a.fused
@@ -1276,19 +1525,21 @@ def _cli() -> dict:
                 else "quantized" if a.quantized
                 else "router" if a.router
                 else "restart" if a.restart
+                else "slo" if a.slo
                 else "load" if a.load else "random")
     bucket_cap = a.max_prefill_bucket
     if bucket_cap is None:
-        # the mixed/fused/chaos/quantized/router/restart workloads
+        # the mixed/fused/chaos/quantized/router/restart/slo workloads
         # should also exercise CHUNKED prefill, so cap the ladder below
         # their longest prompts (load's multi-turn histories chunk too)
         bucket_cap = (16 if workload in ("mixed", "fused", "chaos",
                                          "quantized", "router",
-                                         "restart", "load")
+                                         "restart", "slo", "load")
                       else 512)
     chunk = (a.chunk if a.chunk is not None
              else 2 if workload in ("fused", "prefix-share", "chaos",
-                                    "quantized", "router", "restart")
+                                    "quantized", "router", "restart",
+                                    "slo")
              else 4)
     return main(n_requests=a.n_requests, max_new=a.max_new,
                 max_batch=a.max_batch, block_size=a.block_size,

@@ -32,7 +32,12 @@ HOT_PATHS: Tuple[Tuple[str, str], ...] = (
      r"^(step|run|_step_fused|_prefill_pending|_run_standalone_unit"
      r"|_paged_gqa_attention|forward_paged"
      r"|_write_pool|_write_pool_int8"
-     r"|_trace_emit|_trace_chunks|_record_tick)$"),
+     r"|_trace_emit|_trace_chunks|_record_tick"
+     # sampled device-time attribution: _profile_t0 runs EVERY device
+     # call tick (must stay a counter bump), _profile_commit is the
+     # documented sample-gate exception (its block_until_ready fence
+     # runs one step in profile_sample_every, never unfenced)
+     r"|_profile_t0|_profile_commit)$"),
     ("nlp/ragged_attention.py",
      r"^(ragged_paged_attention|_rpa_kernel|resolve_attention_impl)$"),
     # int8 paged-KV math: quantize/rescale/dequantize run inside every
@@ -40,7 +45,16 @@ HOT_PATHS: Tuple[Tuple[str, str], ...] = (
     # sync hiding in them would tax every token
     ("quantization/kv.py",
      r"^(quantize|dequantize|rescale_codes|scale_of)$"),
-    ("serving/engine.py", r"^(_loop|_dispatch|step|load)$"),
+    ("serving/engine.py", r"^(_loop|_dispatch|step|load|_slo_eval)$"),
+    # SLO engine + step profiler: record_* runs per dispatched token
+    # batch / admission, should_fence per device-call tick, evaluate
+    # per health poll — all host-side window math by design; a device
+    # value leaking into an SLO sample would sync every dispatch
+    ("serving/slo.py",
+     r"^(record_ttft|record_itl|record_queue_wait|record_tokens"
+     r"|record_request|_record|evaluate|pop_transitions)$"),
+    ("serving/profiling.py",
+     r"^(should_fence|record|arm_capture|capture_active)$"),
     # router/frontend tier: the per-request routing decision, the
     # monitor sweep (terminal fan-in + failover) and the HTTP token
     # bridge run once per request or per tick with the event loop /

@@ -721,6 +721,7 @@ class ContinuousBatcher:
                  weight_dtype: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
                  trace=None, flight_recorder_cap: int = 64,
+                 profile_sample_every: int = 64,
                  fault_injector=None, replica_id: str = "r0"):
         # multi-replica attribution: stamped on every `prepared` trace
         # event so a Router's merged trace artifact (and
@@ -831,7 +832,14 @@ class ContinuousBatcher:
         # ring. Imported lazily like the prefix cache: trace.py is
         # dependency-free but lives in serving/, and nlp must not pull
         # the serving package eagerly.
+        from ..serving.profiling import StepProfiler
         from ..serving.trace import FlightRecorder, TraceSink
+        # sampled device-time attribution: every Nth device-call tick
+        # (profile_sample_every; 0 disables) is fenced with
+        # block_until_ready and its device wall lands in bounded
+        # per-shape histograms — see _profile_t0/_profile_commit for
+        # the documented SYNC001 sample gate
+        self.profiler = StepProfiler(sample_every=profile_sample_every)
         if trace is True:
             # mirror the engine's bool API: True means "a default sink"
             trace = TraceSink()
@@ -1150,21 +1158,27 @@ class ContinuousBatcher:
             self._trace.emit(rid, kind, dur=dur, **attrs)
 
     def _trace_chunks(self, items, bucket: int, fused: bool,
-                      dur: float) -> None:
+                      dur: float, device_dur=None) -> None:
         """Emit one prefill_chunk event per packed row: which suffix
         span ran, at which bucket (and what padding that cost), fused
         onto the decode chunk or standalone, cold or continuing — and,
         on the FIRST chunk, how many prompt tokens the prefix cache
-        skipped (the cached-prefix skip the timeline makes visible)."""
+        skipped (the cached-prefix skip the timeline makes visible).
+        `device_dur` (seconds) rides along when the sampled profiler
+        fenced this call: the chunk's DEVICE wall next to its host
+        wall, so a capture window's timelines attribute regressions to
+        the kernel vs host scheduling."""
         if self._trace is None:
             return
         for rec, start, end in items:
+            extra = {} if device_dur is None \
+                else {"device_dur": round(device_dur, 6)}
             self._trace.emit(
                 rec.rid, "prefill_chunk", dur=dur, slot=rec.slot,
                 start=start, end=end, bucket=bucket,
                 pad=bucket - (end - start), fused=fused, cold=start == 0,
                 cached_tokens=rec.cached_len if start == rec.cached_len
-                else 0)
+                else 0, **extra)
 
     def _record_tick(self, mode: str, **fields) -> None:
         """Append one flight-recorder record for this step tick: the
@@ -1175,6 +1189,49 @@ class ContinuousBatcher:
             queue_depth=len(self.queue), pending=len(self._pending),
             free_slots=self.free_slots(),
             free_blocks=self.alloc.free_blocks, **fields)
+
+    def _profile_t0(self):
+        """The sampled-profiler gate, taken once per device-call tick:
+        returns a perf_counter start time when THIS tick is fenced
+        (every `profile_sample_every`th tick, or any tick of an armed
+        capture window), None otherwise. The unfenced path is one
+        locked counter bump — no device work, no syncs."""
+        return time.perf_counter() if self.profiler.should_fence() \
+            else None
+
+    def _profile_commit(self, t0, outputs, *, mode: str, bucket: int,
+                        units: int, rids) -> Optional[float]:
+        """Fence an ALREADY-ISSUED device call and attribute its walls:
+        host_s is dispatch wall (the call returning control), device_s
+        is call-start → block_until_ready completion. Records into the
+        profiler's per-(mode, bucket, units, impl, qkey) histograms
+        and, when a sink is attached, a device-lane trace span so
+        timelines carry device wall next to host wall. Returns
+        device_s, or None for an unfenced tick.
+
+        THE DOCUMENTED SYNC001 SAMPLE GATE: `jax.block_until_ready`
+        here is a deliberate host↔device sync — one fenced step in
+        `profile_sample_every`, never in the unfenced path, and the
+        compiled-shape memo keys never see the profiler (zero
+        post-warmup recompiles holds with sampling on — gated by
+        `bench_serving.py --slo`)."""
+        if t0 is None:
+            return None
+        host_s = time.perf_counter() - t0
+        jax.block_until_ready(outputs)
+        device_s = time.perf_counter() - t0
+        self.profiler.record(
+            mode=mode, bucket=int(bucket), units=int(units),
+            impl=self.attention_impl, weight_dtype=self.weight_dtype,
+            kv_dtype=self.kv_dtype, device_s=device_s, host_s=host_s,
+            detail={"rids": [int(r) for r in rids]})
+        if self._trace is not None:
+            self._trace.span(
+                "device." + mode, dur=device_s, lane="device",
+                mode=mode, bucket=int(bucket), units=int(units),
+                host_s=round(host_s, 6), impl=self.attention_impl,
+                replica_id=self.replica_id)
+        return device_s
 
     def _gate(self, mode: str, rids, probe: bool = False) -> None:
         """Fault-injection hook at the device-call boundary: a no-op in
@@ -1601,7 +1658,12 @@ class ContinuousBatcher:
         self._gate("prefill", unit_rids)
         t0 = time.perf_counter()
         self._apply_cow([e[0] for e in entries if e[1] == 0])
+        t_prof = self._profile_t0()
         logits, li = self._prefill_call(items, bucket, cold)
+        dev_s = self._profile_commit(
+            t_prof, (logits, self.cache.k, self.cache.v),
+            mode="prefill", bucket=bucket,
+            units=self._group_pad(len(items)), rids=unit_rids)
         if final:
             # ragged last-token logits per row, ONE readback per unit
             # (inside _finish_unit) — li came packed with the rows
@@ -1612,7 +1674,8 @@ class ContinuousBatcher:
         else:
             entries[0][1] += 1
         self._trace_chunks(items, bucket, fused=False,
-                           dur=time.perf_counter() - t0)
+                           dur=time.perf_counter() - t0,
+                           device_dur=dev_s)
 
     def _fail_pending(self) -> None:
         """A failed prefill/fused call must not leak blocks OR silently
@@ -1798,6 +1861,7 @@ class ContinuousBatcher:
             if self._dev_state is None:
                 self._dev_state = self._upload_slot_state()
             active, budget, stop = self._dev_state
+            t_prof = self._profile_t0()
             (k, v, ks, vs, lengths, tok, budget, active, toks,
              pfirst) = exe(
                 self.params, self.cache.k, self.cache.v,
@@ -1806,6 +1870,10 @@ class ContinuousBatcher:
                 active, budget, stop, jnp.asarray(rows),
                 jnp.asarray(pos), jnp.asarray(val), jnp.asarray(tab),
                 jnp.asarray(li))
+            dev_s = self._profile_commit(
+                t_prof, (k, v, toks, pfirst), mode="fused",
+                bucket=bucket, units=len(groups),
+                rids=decode_rids + [r for u in unit_rids for r in u])
             # one host sync serves BOTH the decode chunk's tokens and
             # the prefill rows' first tokens — and, dispatch being
             # async, surfaces any device-side failure HERE, before the
@@ -1830,7 +1898,8 @@ class ContinuousBatcher:
                                   pfirst[g * Gp:g * Gp + len(items)])
             else:
                 entries[0][1] += 1
-            self._trace_chunks(items, bucket, fused=True, dur=fused_dur)
+            self._trace_chunks(items, bucket, fused=True, dur=fused_dur,
+                               device_dur=dev_s)
         return toks
 
     def _retire(self, slot: int) -> None:
@@ -2110,10 +2179,15 @@ class ContinuousBatcher:
                 if self._dev_state is None:
                     self._dev_state = self._upload_slot_state()
                 active, budget, stop = self._dev_state
+                t_prof = self._profile_t0()
                 (self.cache, self.cur_tok, lengths, budget, active,
                  toks) = self._chunk_exe()(
                     self.params, self.cache, self.cur_tok, active,
                     self.cache.lengths, budget, stop)
+                self._profile_commit(
+                    t_prof, (self.cache.k, self.cur_tok, toks),
+                    mode="decode", bucket=self.chunk, units=0,
+                    rids=decode_rids)
                 self.cache = self.cache._replace(lengths=lengths)
                 # steady state: the chunk's own outputs are next chunk's
                 # inputs; _retire/_commit null this when the host diverges

@@ -40,7 +40,15 @@ lifecycle: auto-restart with a readiness gate, exponential backoff
 and a crash-loop circuit breaker — `Router(auto_restart=True)`),
 `frontend` (stdlib asyncio HTTP: `POST /v1/generate`,
 `POST /v1/stream` SSE, `GET /health`, `GET /metrics` with
-per-replica labels).
+per-replica labels, `POST /admin/reset_breaker`,
+`POST /debug/profile`), `slo` (the SLO engine: declarative
+objectives evaluated over dual rolling windows into burn rates and
+OK/WARN/BREACH verdicts — `health()["slo"]`, `slo_burn_rate_*`
+gauges, `slo_breaches_total` counters, fleet rollup in the Router),
+`profiling` (sampled device-time attribution: every Nth step fenced
+with block_until_ready into per-shape device-wall histograms, plus
+on-demand capture windows whose device spans land in the trace
+timelines).
 """
 from __future__ import annotations
 
@@ -51,7 +59,9 @@ from .request import (  # noqa: F401
     GenerationRequest, RequestState, TERMINAL_STATES,
     RequestError, RequestCancelled, RequestFailed, RequestTimedOut,
 )
+from .profiling import StepProfiler  # noqa: F401
 from .scheduler import AdmissionQueue, QueueFullError  # noqa: F401
+from .slo import SloTracker, DEFAULT_OBJECTIVES  # noqa: F401
 from .trace import TraceSink, FlightRecorder  # noqa: F401
 
 __all__ = [
@@ -61,6 +71,7 @@ __all__ = [
     "AdmissionQueue", "QueueFullError",
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "TraceSink", "FlightRecorder",
+    "SloTracker", "StepProfiler",
     "FaultInjector", "InjectedFault",
     "PrefixCacheIndex", "RefcountingBlockAllocator",
     "ContinuousBatcher", "PagedKVCache",

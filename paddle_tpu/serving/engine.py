@@ -51,6 +51,18 @@ state, as JSON — automatically when a device step raises
 (`last_flight_dump_json`) or on demand (`dump_flight_recorder()`).
 `MetricsRegistry.to_prometheus()` renders the same metrics snapshot()
 reads in the Prometheus text format.
+
+SLOs & device-time attribution (serving.slo / serving.profiling): an
+in-process `SloTracker` watches declarative latency/goodput/error
+objectives over dual rolling windows — burn rates and OK/WARN/BREACH
+verdicts in `health()["slo"]`, `slo_burn_rate_*` gauges and
+`slo_breaches_total` counters in the exposition, `slo_breach` trace
+events for request correlation; a BREACH is detail, never an outage
+signal (SLOs degrade, supervision decides). The batcher's sampled
+step profiler fences every Nth device call (`profile_sample_every=`)
+to attribute DEVICE wall per compiled shape, and
+`capture_profile(steps=K)` fences a whole window on demand so trace
+timelines carry device wall next to host wall.
 """
 from __future__ import annotations
 
@@ -59,9 +71,10 @@ import threading
 import time
 from typing import Dict, Iterator, List, Optional
 
-from .metrics import MetricsRegistry
+from .metrics import LATENCY_BUCKETS, MetricsRegistry
 from .request import GenerationRequest, RequestState
 from .scheduler import AdmissionQueue, QueueFullError
+from .slo import SloTracker
 from .trace import TraceSink
 
 __all__ = ["ServingEngine", "EngineStopped", "HungStepError"]
@@ -130,6 +143,10 @@ class ServingEngine:
                  watchdog_compile_grace: float = 16.0,
                  health_window_s: float = 30.0,
                  fault_injector=None,
+                 slo: bool = True,
+                 slo_objectives: Optional[Dict[str, float]] = None,
+                 slo_opts: Optional[Dict] = None,
+                 profile_sample_every: int = 64,
                  replica_id: str = "r0",
                  clock=time.monotonic):
         # multi-replica attribution: every snapshot, health report,
@@ -163,6 +180,7 @@ class ServingEngine:
             weight_dtype=weight_dtype, kv_dtype=kv_dtype,
             trace=self.trace,
             flight_recorder_cap=flight_recorder_cap,
+            profile_sample_every=profile_sample_every,
             fault_injector=fault_injector,
             replica_id=self.replica_id)
         # the RESOLVED backend ("auto" already collapsed to the concrete
@@ -238,14 +256,19 @@ class ServingEngine:
         self._g_running = m.gauge("requests_in_flight")
         self._g_blocks = m.gauge("kv_blocks_in_use")
         self._g_util = m.gauge("kv_block_utilization")
-        self._h_ttft = m.histogram("ttft_s")
-        self._h_wait = m.histogram("queue_wait_s")
+        # the three request-latency histograms carry a cumulative
+        # bucket ladder so to_prometheus() exports native histogram
+        # families (<name>_hist_bucket{le=...}) an external Prometheus
+        # can compute its own burn rates from
+        self._h_ttft = m.histogram("ttft_s", buckets=LATENCY_BUCKETS)
+        self._h_wait = m.histogram("queue_wait_s",
+                                   buckets=LATENCY_BUCKETS)
         self._h_token = m.histogram("per_token_s")
         # inter-token latency per request: the gap between consecutive
         # step dispatches that delivered this request tokens — its p99
         # is where admission-during-decode stalls show up (and what the
         # fused prefill+decode step exists to flatten)
-        self._h_itl = m.histogram("itl_s")
+        self._h_itl = m.histogram("itl_s", buckets=LATENCY_BUCKETS)
         self._last_emit: Dict[int, float] = {}    # rid -> last dispatch
         # prefix-cache surface (flat-line zeros when the cache is off)
         self._g_pc_hit_tokens = m.gauge("prefix_cache_hit_tokens")
@@ -280,6 +303,23 @@ class ServingEngine:
         self._c_retried = m.counter("requests_retried")
         self._c_watchdog = m.counter("watchdog_trips")
         self._c_dump_errors = m.counter("flight_dump_errors")
+
+        # SLO engine: declarative objectives over dual rolling windows
+        # (serving.slo) — fed from the same observations the
+        # histograms record, surfaced in health()["slo"], Prometheus
+        # (slo_burn_rate_* gauges, slo_breaches_total counter) and
+        # slo_breach/slo_recovered TraceSink events. SLOs degrade,
+        # supervision decides: a BREACH never stops this engine.
+        self._slo: Optional[SloTracker] = None
+        self._g_slo_burn: Dict[str, object] = {}
+        self._c_slo_breaches = m.counter("slo_breaches")
+        self._slo_breaches_seen = 0
+        if slo:
+            self._slo = SloTracker(slo_objectives, clock=clock,
+                                   **(slo_opts or {}))
+            for name in self._slo.objectives:
+                self._g_slo_burn[name] = m.gauge(
+                    f"slo_burn_rate_{name}")
 
         if warmup:
             self.warmup()
@@ -583,7 +623,70 @@ class ServingEngine:
             "last_fault_age_s": (None if self._last_fault_t is None
                                  else now - self._last_fault_t),
             "parked_retries": len(self._parked),
+            # the SLO engine's verdict (None with slo=False): burn
+            # rates + OK/WARN/BREACH per objective. Detail, not a
+            # health state — a BREACH degrades, supervision decides
+            "slo": self._slo_eval(),
         }
+
+    def _slo_eval(self) -> Optional[Dict]:
+        """Evaluate the SLO tracker (cached per its eval_every_s),
+        sync the burn-rate gauges and breach counter, and emit one
+        slo_breach / slo_recovered trace span per verdict transition
+        (the tracker hands each edge out exactly once). Called with
+        self._lock held (health() and the loop's gauge refresh); the
+        tracker and sink take only their own leaf locks."""
+        if self._slo is None:
+            return None
+        report = self._slo.evaluate()
+        for name, o in report["objectives"].items():
+            self._g_slo_burn[name].set(o["burn_rate_fast"])
+        new = report["breaches_total"] - self._slo_breaches_seen
+        if new > 0:
+            self._c_slo_breaches.inc(new)
+            self._slo_breaches_seen = report["breaches_total"]
+        for tr in self._slo.pop_transitions():
+            if self.trace is not None:
+                self.trace.span(
+                    "slo_breach" if tr["edge"] == "breach"
+                    else "slo_recovered", dur=0.0,
+                    objective=tr["objective"],
+                    burn_rate_fast=tr["burn_rate_fast"],
+                    target=tr["target"],
+                    value_fast=tr["value_fast"],
+                    # the breach verdict was computed over the trailing
+                    # fast window — trace_report extends the breach
+                    # window start back by this, so the requests whose
+                    # samples TRIGGERED the breach are attributed to it
+                    window_s=self._slo.fast_window_s,
+                    replica_id=self.replica_id)
+        return report
+
+    def capture_profile(self, steps: int = 8,
+                        timeout: Optional[float] = 30.0) -> Dict:
+        """On-demand device-time capture window: fence the next
+        `steps` batcher ticks (every device call, not just sampled
+        ones), block until the window closes (bounded by `timeout` —
+        an IDLE engine produces no ticks, so the report then comes
+        back with ``capture.complete`` False), and return the
+        profiler's report: per-shape device-wall histograms plus one
+        record per captured step. The fenced steps also land
+        device-lane spans and per-chunk ``device_dur`` annotations in
+        the TraceSink, so ``to_chrome_trace()`` timelines carry device
+        wall next to host wall. Callable from any thread — the
+        frontend's ``POST /debug/profile`` calls exactly this."""
+        prof = self.batcher.profiler
+        prof.arm_capture(steps)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while prof.capture_active():
+            if deadline is not None and time.monotonic() > deadline:
+                # disarm on timeout: a leftover window would fence
+                # every future tick once traffic resumes
+                prof.cancel_capture()
+                break
+            time.sleep(0.005)
+        return prof.report()
 
     def dump_flight_recorder(self, path: Optional[str] = None) -> Dict:
         """On-demand forensic dump: the batcher's last-N step records
@@ -840,6 +943,8 @@ class ServingEngine:
                 req.admitted_index = self._admit_seq
                 self._admit_seq += 1
                 self._h_wait.observe(now - req.submit_time)
+                if self._slo is not None:
+                    self._slo.record_queue_wait(now - req.submit_time)
                 self._c_admitted.inc()
             self._running[rid] = req
 
@@ -850,6 +955,8 @@ class ServingEngine:
         ntok = sum(len(t) for t in emitted.values())
         if step_dt is not None and ntok:
             self._h_token.observe(step_dt / ntok)
+        if self._slo is not None and ntok:
+            self._slo.record_tokens(ntok)   # goodput floor's numerator
         if self.trace is not None and step_dt is not None:
             # the sink-side twin of the serving.step_s timer span —
             # same duration, so the Chrome trace's steps lane lines up
@@ -862,6 +969,8 @@ class ServingEngine:
             last = self._last_emit.get(rid)
             if last is not None:
                 self._h_itl.observe(now - last)
+                if self._slo is not None:
+                    self._slo.record_itl(now - last)
             self._last_emit[rid] = now
             traced = self.trace is not None and req.trace_id is not None
             ndelivered = 0
@@ -870,6 +979,8 @@ class ServingEngine:
                     if req.first_token_time is None:
                         req.first_token_time = now
                         self._h_ttft.observe(now - req.submit_time)
+                        if self._slo is not None:
+                            self._slo.record_ttft(now - req.submit_time)
                         # emitted at the stamp, not after the loop: a
                         # later on_token failure must not leave the
                         # timeline disagreeing with the ttft histogram
@@ -929,6 +1040,13 @@ class ServingEngine:
         }[state]
         if not req.done:
             counter.inc()
+            if self._slo is not None and state in (
+                    RequestState.FINISHED, RequestState.FAILED,
+                    RequestState.TIMED_OUT):
+                # error_rate feed: FAILED/TIMED_OUT are server misses;
+                # a cancellation is the client's choice, not recorded
+                self._slo.record_request(
+                    state is not RequestState.FINISHED)
             if self.trace is not None and req.trace_id is not None:
                 self.trace.finish(
                     req.trace_id, state.name.lower(), reason=reason,
@@ -1160,6 +1278,7 @@ class ServingEngine:
             self._update_gauges_locked()
 
     def _update_gauges_locked(self) -> None:
+        self._slo_eval()
         stats = self.batcher.alloc.stats()
         self._alloc_stats = stats          # snapshot() reads this cache
         pc = self.batcher.prefix_stats()

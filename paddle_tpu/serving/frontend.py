@@ -28,7 +28,20 @@ Endpoints:
     underway) and none when the fleet is breaker-pinned FAILED.
   * ``GET /metrics`` — `Router.to_prometheus()`: every replica's
     exposition merged with ``replica="rN"`` labels
-    (``text/plain; version=0.0.4``).
+    (``text/plain; version=0.0.4``) — including the SLO engine's
+    ``slo_burn_rate_*`` gauges / ``slo_breaches_total`` counters and
+    the native ``*_hist_bucket{le=...}`` latency histograms.
+  * ``POST /admin/reset_breaker`` — operator recovery for a
+    breaker-pinned FAILED slot (``{"slot": 1}`` or
+    ``{"replica": "r1"}``): clears the crash-loop history and
+    re-enters the supervisor's readiness-gated recovery cycle. 200
+    with the slot's new state, 409 when the slot is not FAILED, 404
+    for an unknown slot, 400 without a supervisor.
+  * ``POST /debug/profile`` — on-demand device-time capture window
+    (``{"steps": 8, "timeout_s": 30}``): fences the next K batcher
+    ticks on every replica and returns the per-shape device-wall
+    report (`Router.capture_profile`). The fenced steps also annotate
+    the trace timelines with device wall next to host wall.
 
 Backpressure and lifecycle: `NoReplicaAvailable`/`QueueFullError`
 (every replica's admission queue rejected) maps to **429**, a prompt
@@ -233,8 +246,13 @@ class HttpFrontend:
                 await self._generate(writer, body)
             elif path == "/v1/stream" and method == "POST":
                 await self._stream_sse(writer, body)
+            elif path == "/admin/reset_breaker" and method == "POST":
+                await self._reset_breaker(writer, body)
+            elif path == "/debug/profile" and method == "POST":
+                await self._profile(writer, body)
             elif path in ("/health", "/metrics", "/v1/generate",
-                          "/v1/stream"):
+                          "/v1/stream", "/admin/reset_breaker",
+                          "/debug/profile"):
                 writer.write(_json_body(
                     405, {"error": f"{method} not allowed on {path}"}))
             else:
@@ -423,6 +441,85 @@ class HttpFrontend:
         body = text.encode()
         writer.write(_headers(200, "text/plain; version=0.0.4",
                               len(body)) + body)
+
+    async def _reset_breaker(self, writer, body: bytes) -> None:
+        """Operator recovery: revive a breaker-pinned FAILED slot —
+        `Router.reset_breaker` behind JSON. The slot re-enters the
+        readiness-gated recovery cycle; it does NOT serve until the
+        probe passes."""
+        try:
+            req = json.loads(body.decode() or "{}")
+        except (ValueError, UnicodeDecodeError):
+            writer.write(_json_body(400,
+                                    {"error": "body is not valid JSON"}))
+            return
+        slot = req.get("replica") if req.get("replica") is not None \
+            else req.get("slot")
+        if slot is None:
+            writer.write(_json_body(
+                400, {"error": "pass \"slot\" (index) or \"replica\" "
+                               "(id like \"r1\")"}))
+            return
+        reset = getattr(self.router, "reset_breaker", None)
+        if reset is None:
+            writer.write(_json_body(
+                400, {"error": "backend has no reset_breaker "
+                               "(bare engine, not a Router)"}))
+            return
+        try:
+            # blocking-safe: state flips under short locks plus a
+            # thread spawn — no engine rebuild happens on this call
+            out = reset(slot)
+        except LookupError as e:
+            writer.write(_json_body(404, {"error": str(e)}))
+            return
+        except RuntimeError as e:        # no supervisor attached
+            writer.write(_json_body(400, {"error": str(e)}))
+            return
+        status = 200 if out.get("reset") else 409
+        payload = {"ok": bool(out.get("reset")), **out}
+        if status == 409:
+            payload["error"] = (
+                f"slot {out.get('replica')} is {out.get('state')}, "
+                f"not FAILED — nothing to reset")
+        writer.write(_json_body(status, payload))
+
+    async def _profile(self, writer, body: bytes) -> None:
+        """On-demand device-time capture: arm + await the capture
+        window WITHOUT blocking the event loop (the wait runs on the
+        default executor — token streaming keeps flowing while the
+        fenced steps run)."""
+        try:
+            req = json.loads(body.decode() or "{}")
+        except (ValueError, UnicodeDecodeError):
+            writer.write(_json_body(400,
+                                    {"error": "body is not valid JSON"}))
+            return
+        try:
+            steps = int(req.get("steps", 8))
+            timeout_s = float(req.get("timeout_s", 30.0))
+        except (TypeError, ValueError):
+            writer.write(_json_body(
+                400, {"error": "steps must be an int, timeout_s a "
+                               "number"}))
+            return
+        # hard caps: a capture window fences EVERY device call it
+        # covers and the wait pins an executor thread — an unbounded
+        # request could tax the whole fleet's latency indefinitely
+        if not 1 <= steps <= 1024 or not 0 < timeout_s <= 300:
+            writer.write(_json_body(
+                400, {"error": "steps must be in [1, 1024] and "
+                               "timeout_s in (0, 300]"}))
+            return
+        cap = getattr(self.router, "capture_profile", None)
+        if cap is None:
+            writer.write(_json_body(
+                400, {"error": "backend has no capture_profile"}))
+            return
+        loop = asyncio.get_running_loop()
+        report = await loop.run_in_executor(
+            None, lambda: cap(steps=steps, timeout=timeout_s))
+        writer.write(_json_body(200, report))
 
 
 class _HttpError(Exception):

@@ -18,7 +18,7 @@ from __future__ import annotations
 import re
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
 
@@ -71,18 +71,33 @@ class Gauge:
             return self._value
 
 
+# Default cumulative-bucket ladder for latency histograms exported as
+# native Prometheus histograms (seconds; +Inf is implicit) — wide
+# enough for TTFT under compile-cliff conditions, fine enough for
+# inter-token gaps.
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
 class Histogram:
     """Latency distribution (TTFT, queue wait, per-step decode time).
 
     Keeps a bounded ring of raw observations (default 2048): count/sum
     are exact over the histogram's lifetime, percentiles are over the
     most recent window — the steady-state view a serving dashboard
-    wants, without unbounded memory on long-lived engines."""
+    wants, without unbounded memory on long-lived engines.
+
+    `buckets` (optional, ascending upper bounds; +Inf implicit) adds
+    EXACT lifetime cumulative bucket counts next to the ring — the
+    data a native Prometheus histogram family exports so an external
+    Prometheus can compute its own burn rates instead of trusting the
+    in-process windowed quantiles."""
 
     __slots__ = ("name", "_lock", "_ring", "_cap", "_count", "_sum",
-                 "_min", "_max")
+                 "_min", "_max", "_bounds", "_bucket_counts")
 
-    def __init__(self, name: str, lock: threading.RLock, cap: int = 2048):
+    def __init__(self, name: str, lock: threading.RLock, cap: int = 2048,
+                 buckets: Optional[List[float]] = None):
         self.name = name
         self._lock = lock
         self._ring: List[float] = []
@@ -91,6 +106,10 @@ class Histogram:
         self._sum = 0.0
         self._min: Optional[float] = None
         self._max: Optional[float] = None
+        self._bounds: Optional[List[float]] = \
+            None if buckets is None else sorted(float(b) for b in buckets)
+        self._bucket_counts: Optional[List[int]] = \
+            None if buckets is None else [0] * len(self._bounds)
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -103,6 +122,26 @@ class Histogram:
             self._sum += v
             self._min = v if self._min is None else min(self._min, v)
             self._max = v if self._max is None else max(self._max, v)
+            if self._bounds is not None:
+                # non-cumulative per-bucket counts here; buckets()
+                # renders the cumulative le= view Prometheus expects
+                for i, b in enumerate(self._bounds):
+                    if v <= b:
+                        self._bucket_counts[i] += 1
+                        break
+
+    def buckets(self) -> Optional[List[Tuple[float, int]]]:
+        """Lifetime-exact CUMULATIVE (le, count) pairs (the +Inf bucket
+        is the lifetime count and is implicit), or None when this
+        histogram was created without a bucket ladder."""
+        with self._lock:
+            if self._bounds is None:
+                return None
+            out, acc = [], 0
+            for b, c in zip(self._bounds, self._bucket_counts):
+                acc += c
+                out.append((b, acc))
+            return out
 
     @staticmethod
     def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -219,10 +258,15 @@ class MetricsRegistry:
                 self._gauges[name] = Gauge(name, self._lock)
             return self._gauges[name]
 
-    def histogram(self, name: str, cap: int = 2048) -> Histogram:
+    def histogram(self, name: str, cap: int = 2048,
+                  buckets: Optional[List[float]] = None) -> Histogram:
+        """Get-or-create histogram `name`. `buckets` (first creation
+        only) arms exact cumulative bucket counts so `to_prometheus`
+        exports a native histogram family next to the summary."""
         with self._lock:
             if name not in self._histograms:
-                self._histograms[name] = Histogram(name, self._lock, cap)
+                self._histograms[name] = Histogram(name, self._lock,
+                                                   cap, buckets=buckets)
             return self._histograms[name]
 
     def timer(self, name: str, record_event: bool = True) -> _Timer:
@@ -257,8 +301,28 @@ class MetricsRegistry:
         plus lifetime ``_sum`` / ``_count``). Registry names are
         sanitized to the Prometheus charset (``serving.step_s`` →
         ``serving_step_s``). One atomic snapshot backs the whole
-        rendering, so cross-metric invariants hold within a scrape."""
-        snap = self.snapshot()
+        rendering, so cross-metric invariants hold within a scrape.
+
+        Histograms created with a bucket ladder ADDITIONALLY export a
+        native histogram family ``<prefix><name>_hist`` — cumulative
+        ``_bucket{le="..."}`` series (lifetime-exact counts, ``+Inf``
+        included) plus ``_hist_sum`` / ``_hist_count`` — so an
+        external Prometheus can compute its own burn rates instead of
+        trusting the in-process windowed quantiles. The ``_hist``
+        suffix keeps the summary and histogram as two distinct
+        families, which a strict 0.0.4 parser requires."""
+        with self._lock:
+            # ONE lock acquisition (RLock — snapshot() re-enters) for
+            # the summary snapshot AND the bucket counts: an observe()
+            # landing between two separate reads would render a finite
+            # le bucket above the +Inf count — a non-monotone
+            # histogram Prometheus rejects into NaN quantiles
+            snap = self.snapshot()
+            hist_buckets = {}
+            for n, h in self._histograms.items():
+                cum = h.buckets()
+                if cum is not None:
+                    hist_buckets[n] = cum
         lines: List[str] = []
 
         def san(name: str) -> str:
@@ -287,4 +351,15 @@ class MetricsRegistry:
                     lines.append(f'{base}{{quantile="{q}"}} {num(s[key])}')
             lines.append(f"{base}_sum {num(s.get('sum', 0.0))}")
             lines.append(f"{base}_count {num(s.get('count', 0))}")
+            cum = hist_buckets.get(name)
+            if cum is not None:
+                hb = base + "_hist"
+                lines.append(f"# TYPE {hb} histogram")
+                for le, count in cum:
+                    lines.append(
+                        f'{hb}_bucket{{le="{le}"}} {num(count)}')
+                lines.append(f'{hb}_bucket{{le="+Inf"}} '
+                             f'{num(s.get("count", 0))}')
+                lines.append(f"{hb}_sum {num(s.get('sum', 0.0))}")
+                lines.append(f"{hb}_count {num(s.get('count', 0))}")
         return "\n".join(lines) + "\n"

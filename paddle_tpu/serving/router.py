@@ -67,6 +67,7 @@ from .engine import EngineStopped, HungStepError
 from .metrics import MetricsRegistry
 from .request import GenerationRequest, RequestState
 from .scheduler import QueueFullError
+from .slo import rollup as slo_rollup
 
 __all__ = ["Router", "NoReplicaAvailable", "default_policy"]
 
@@ -358,6 +359,18 @@ class Router:
         self._g_restart_backoff = [
             m.gauge(f"restart_backoff_s_{eng.replica_id}")
             for eng in self.engines]
+        # operator recovery surface: FAILED slots revived without a
+        # process restart (POST /admin/reset_breaker)
+        self._c_breaker_resets = m.counter("breaker_resets")
+        # fleet-wide SLO rollup: worst-of verdicts / max burn rates
+        # exported with replica="router" next to the per-replica
+        # series; the router's slo_breaches counter accumulates
+        # per-ENGINE-INCARNATION deltas (keyed by engine identity —
+        # a respawned replica's fresh tracker restarts at 0, and
+        # diffing the GLOBAL sum would swallow real breaches until
+        # the sum re-climbed past its old high-water mark)
+        self._c_slo_breaches = m.counter("slo_breaches")
+        self._slo_breach_marks: Dict[int, int] = {}
         self._supervisor = None
         if auto_restart:
             from .supervisor import ReplicaSupervisor   # lazy sibling
@@ -736,7 +749,102 @@ class Router:
             to_eng.trace.emit(inner.trace_id, "failover", **entry)
         return True
 
+    # ---- operator recovery ----------------------------------------------
+    def reset_breaker(self, slot) -> Dict:
+        """Revive a breaker-pinned FAILED slot without a process
+        restart (the PR 12 operator gap): clears the slot's crash-loop
+        history and re-enters the normal RESTARTING → readiness-gate →
+        SERVING recovery cycle. `slot` is a replica index or id
+        ("r1"). Returns ``{"slot", "replica", "reset", "state"}`` —
+        `reset` False when the slot was not FAILED (nothing to do).
+        Raises RuntimeError without a supervisor (auto_restart off)
+        and LookupError for an unknown slot. Bumps the
+        `breaker_resets` counter and emits a `breaker_reset` trace
+        event on success; `POST /admin/reset_breaker` on the frontend
+        calls exactly this."""
+        if self._supervisor is None:
+            raise RuntimeError(
+                "reset_breaker needs auto_restart=True — without a "
+                "supervisor there is no breaker to reset")
+        if isinstance(slot, str):
+            idx = next((i for i, e in enumerate(self.engines)
+                        if e.replica_id == slot), None)
+            if idx is None:
+                raise LookupError(f"unknown replica {slot!r}")
+        else:
+            idx = int(slot)
+            if not 0 <= idx < len(self.engines):
+                raise LookupError(
+                    f"slot {idx} out of range "
+                    f"[0, {len(self.engines)})")
+        ok = self._supervisor.reset_breaker(idx)
+        if ok:
+            self._c_breaker_resets.inc()
+            eng = self.engines[idx]
+            if eng.trace is not None:
+                # on the dead engine's sink: it is what the slot still
+                # exports until the respawn swaps a fresh sink in
+                eng.trace.span("breaker_reset", dur=0.0,
+                               replica=eng.replica_id)
+        return {"slot": idx, "replica": self.engines[idx].replica_id,
+                "reset": ok,
+                "state": self._supervisor.states()[idx]}
+
+    def capture_profile(self, steps: int = 8,
+                        timeout: Optional[float] = 30.0) -> Dict:
+        """Fleet-wide device-time capture: arm EVERY replica's capture
+        window (so the fences overlap instead of serializing), then
+        wait for each to close (bounded by one shared `timeout` — an
+        idle replica's report comes back ``complete`` False). Returns
+        ``{replica_id: StepProfiler.report()}``; the frontend's
+        ``POST /debug/profile`` returns exactly this."""
+        for eng in self.engines:
+            eng.batcher.profiler.arm_capture(steps)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        out: Dict[str, Dict] = {}
+        for eng in self.engines:
+            prof = eng.batcher.profiler
+            while prof.capture_active():
+                if deadline is not None and time.monotonic() > deadline:
+                    # disarm the idle replica's leftover window: it
+                    # must not fence future ticks nobody waits for
+                    prof.cancel_capture()
+                    break
+                time.sleep(0.005)
+            out[eng.replica_id] = prof.report()
+        return out
+
     # ---- observability ---------------------------------------------------
+    def _slo_rollup(self, per: Optional[List[Dict]] = None) -> Dict:
+        """Fleet SLO aggregation (serving.slo.rollup) + the router-side
+        Prometheus mirror: worst-of verdicts and max burn rates land in
+        replica="router" gauges, and the router's monotonic
+        slo_breaches counter accumulates per-incarnation deltas —
+        each engine object's breach total is high-water-marked by
+        identity, so a supervisor respawn (fresh tracker at 0) neither
+        decrements the fleet counter nor swallows the NEXT real
+        breaches behind the old global sum."""
+        engines = list(self.engines)
+        if per is None:
+            per = [eng.health() for eng in engines]
+        agg = slo_rollup([h.get("slo") for h in per])
+        for name, o in agg["objectives"].items():
+            self.metrics.gauge(
+                f"slo_burn_rate_{name}").set(o["burn_rate_fast"])
+        with self._lock:      # concurrent health()/scrape callers
+            marks: Dict[int, int] = {}
+            new = 0
+            for eng, h in zip(engines, per):
+                total = (h.get("slo") or {}).get("breaches_total", 0)
+                seen = self._slo_breach_marks.get(id(eng), 0)
+                new += max(0, total - seen)
+                marks[id(eng)] = max(total, seen)
+            self._slo_breach_marks = marks    # dead incarnations drop
+            if new > 0:
+                self._c_slo_breaches.inc(new)
+        return agg
+
     def health(self) -> Dict:
         """Aggregated health: `status` is the WORST replica state (the
         conservative operator view), `serving_replicas` counts replicas
@@ -770,6 +878,11 @@ class Router:
                                     states.count("RESTARTING")),
             "failed_replicas": (0 if states is None else
                                 states.count("FAILED")),
+            # fleet SLO verdict: worst-of per objective, max burn —
+            # detail the /health JSON carries WITHOUT flipping the 200
+            # (SLOs degrade, supervision decides)
+            "slo": self._slo_rollup(per),
+            "breaker_resets": self._c_breaker_resets.value,
             "replicas": {h["replica_id"]: h for h in per},
         }
         if sup is not None:
@@ -797,7 +910,12 @@ class Router:
         router's own registry, merged into ONE valid exposition: each
         sample gains a `replica="rN"` label (`replica="router"` for
         router-level metrics) and samples are re-grouped per family so
-        a strict parser sees each family exactly once."""
+        a strict parser sees each family exactly once — including the
+        native-histogram `<name>_hist` families whose `_bucket{le=...}`
+        samples must stay under THEIR OWN TYPE line, not the sibling
+        summary's. The SLO rollup gauges refresh first, so a scrape
+        always reads the current fleet burn rates."""
+        self._slo_rollup()
         chunks = [("router", self.metrics.to_prometheus(prefix))]
         chunks += [(eng.replica_id, eng.metrics.to_prometheus(prefix))
                    for eng in self.engines]

@@ -108,7 +108,8 @@ class _Slot:
 
     __slots__ = ("index", "state", "restarts", "restart_failures",
                  "failure_times", "backoff_s", "circuit_open",
-                 "warm_compile_count", "last_error", "restarting_since")
+                 "warm_compile_count", "last_error", "restarting_since",
+                 "via_reset")
 
     def __init__(self, index: int):
         self.index = index
@@ -121,6 +122,11 @@ class _Slot:
         self.warm_compile_count: Optional[int] = None
         self.last_error: Optional[str] = None
         self.restarting_since: Optional[float] = None
+        # this recovery cycle was initiated by an operator breaker
+        # reset (stamped on the fresh engine's `restarted` span — the
+        # dead engine's sink, where `breaker_reset` lands, is dropped
+        # at swap, so provenance must ride the surviving sink)
+        self.via_reset = False
 
     def info(self) -> Dict[str, Any]:
         return {
@@ -243,6 +249,39 @@ class ReplicaSupervisor:
         """Slot states by index (SERVING / RESTARTING / FAILED)."""
         return [s.state for s in self._slots]
 
+    def reset_breaker(self, index: int) -> bool:
+        """Operator override for a breaker-pinned slot: clear slot
+        `index`'s crash-loop history (failure window, circuit flag,
+        consecutive count) and re-enter the normal recovery cycle —
+        RESTARTING, then the usual rebuild → warmup → probe readiness
+        gate on a fresh per-slot thread, so a revived slot still
+        cannot take traffic before proving it can serve (and a slot
+        whose underlying fault persists trips the breaker again
+        instead of flapping). Returns False when the slot is not
+        FAILED (SERVING or mid-RESTARTING — nothing to reset);
+        `Router.reset_breaker` / `POST /admin/reset_breaker` are the
+        operator surfaces over this."""
+        slot = self._slots[int(index)]
+        with self._router._lock:
+            if slot.state != SLOT_FAILED or self._stop.is_set():
+                return False
+            slot.state = SLOT_RESTARTING
+            slot.circuit_open = False
+            slot.failure_times.clear()
+            slot.last_error = None
+            slot.restarting_since = self._clock()
+            slot.via_reset = True
+        # the engine still in the slot is the dead incarnation the
+        # breaker pinned — _restart_slot re-tears it down (idempotent)
+        # before rebuilding, exactly like a detection-driven cycle
+        dead = self._router.engines[slot.index]
+        t = threading.Thread(
+            target=self._restart_slot, args=(slot, dead),
+            name=f"paddle-tpu-restart-{slot.index}", daemon=True)
+        self._restart_threads[slot.index] = t
+        t.start()
+        return True
+
     # ---- the supervisor threads -----------------------------------------
     def _loop(self) -> None:
         """The health-poll thread: detection only. Each detected death
@@ -348,7 +387,9 @@ class ReplicaSupervisor:
                 fresh.trace.span(
                     "restarted", dur=self._clock() - t0,
                     replica=fresh.replica_id, attempts=attempt + 1,
-                    affinity_invalidated=invalidated)
+                    affinity_invalidated=invalidated,
+                    via_breaker_reset=slot.via_reset)
+            slot.via_reset = False
             return
         # stopped mid-restart: the slot stays RESTARTING; the dead
         # engine still in the slot was already torn down and

@@ -45,8 +45,11 @@ from typing import Any, Callable, Dict, List, Optional, Union
 __all__ = ["TraceSink", "FlightRecorder"]
 
 # Chrome-trace lanes for events that are not anchored to a batch slot:
-# requests still queued (no slot yet) and the engine's per-step spans.
+# requests still queued (no slot yet), the engine's per-step spans, and
+# the DEVICE-wall spans a profiler capture window measures (kept on
+# their own lane so host wall and device wall render side by side).
 # Batch slots use tid = slot index (0..max_batch-1), far below these.
+_DEVICE_TID = 9997
 _QUEUE_TID = 9998
 _STEPS_TID = 9999
 
@@ -164,15 +167,18 @@ class TraceSink:
                         if k == tl["trace_id"]]:
                 del self._alias[rid]
 
-    def span(self, name: str, dur: float, **attrs) -> None:
+    def span(self, name: str, dur: float, lane: str = "steps",
+             **attrs) -> None:
         """Record one engine-level span (e.g. ``engine.step``) ending
-        now and lasting `dur` seconds, on the steps lane of the Chrome
-        trace — the sink-side twin of a `MetricsRegistry.timer`
-        observation."""
+        now and lasting `dur` seconds — the sink-side twin of a
+        `MetricsRegistry.timer` observation. `lane` picks the Chrome
+        lane: "steps" (default) or "device" (the device-wall spans a
+        profiler capture window measures, rendered next to the host
+        step spans so the two walls are visually comparable)."""
         t1 = self._clock()
         with self._lock:
             self._spans.append({"kind": name, "t": t1 - dur, "dur": dur,
-                                "attrs": dict(attrs)})
+                                "lane": lane, "attrs": dict(attrs)})
 
     # ---- internal -------------------------------------------------------
     def _resolve_locked(self, ref):
@@ -292,9 +298,11 @@ class TraceSink:
                     out["s"] = "t"
                 events.append(out)
         for s in spans:
-            tids.add(_STEPS_TID)
+            tid = (_DEVICE_TID if s.get("lane") == "device"
+                   else _STEPS_TID)
+            tids.add(tid)
             events.append({"name": s["kind"], "ph": "X", "pid": pid,
-                           "tid": _STEPS_TID, "ts": us(s["t"]),
+                           "tid": tid, "ts": us(s["t"]),
                            "dur": s["dur"] * 1e6,
                            "args": dict(s["attrs"])})
         events.sort(key=lambda e: e["ts"])
@@ -303,6 +311,7 @@ class TraceSink:
         for tid in sorted(tids):
             name = ("queue" if tid == _QUEUE_TID
                     else "engine steps" if tid == _STEPS_TID
+                    else "device steps" if tid == _DEVICE_TID
                     else f"slot {tid}")
             meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                          "tid": tid, "args": {"name": name}})

@@ -412,3 +412,125 @@ class TestSelfHealingE2E:
         assert time.monotonic() - t0 < 30.0
         assert r._supervisor._thread is not None
         assert not r._supervisor._thread.is_alive()
+
+
+class TestBreakerReset:
+    def test_reset_requires_failed_slot(self, setup):
+        """reset_breaker on a SERVING slot is a no-op (False), unknown
+        slots/ids raise LookupError, and a router without a supervisor
+        raises RuntimeError."""
+        injs = [FaultInjector(seed=0), FaultInjector(seed=1)]
+        r = _router(setup, injs)
+        r.warmup()
+        r.start()
+        out = r.reset_breaker(0)
+        assert out == {"slot": 0, "replica": "r0", "reset": False,
+                       "state": SLOT_SERVING}
+        assert r.reset_breaker("r1")["reset"] is False
+        with pytest.raises(LookupError):
+            r.reset_breaker(7)
+        with pytest.raises(LookupError):
+            r.reset_breaker("r7")
+        assert r.health()["breaker_resets"] == 0
+        assert r.shutdown()
+        cfg, params = setup
+        plain = serving.Router(params, cfg, replicas=1, max_batch=1,
+                               block_size=4, max_total_len=48,
+                               max_new_tokens=2, start=False)
+        with pytest.raises(RuntimeError):
+            plain.reset_breaker(0)
+        plain.shutdown()
+
+    def test_reset_revives_breaker_pinned_slot(self, setup):
+        """The PR 12 operator gap closed e2e: a persistent-hang chaos
+        opens the breaker (slot FAILED), the operator heals the fault
+        and calls reset_breaker — the slot re-enters the readiness-
+        gated recovery cycle, rejoins rotation, and serves again; the
+        breaker_resets counter and the breaker_reset trace event record
+        the intervention."""
+        injs = [FaultInjector(seed=0), FaultInjector(seed=1)]
+        chaos = {"on": True}
+
+        def rearm(inj, n, rid):
+            if n > 1 and chaos["on"]:
+                c = inj.stats()["calls"]
+                for k in range(1, 5):
+                    inj.hang_on_step(c + k, 8.0)
+        for inj in injs:
+            inj.on_attach(rearm)
+        r = _router(setup, injs, breaker_threshold=2,
+                    breaker_window_s=300.0)
+        r.warmup()
+        r.start()
+        armed = threading.Event()
+        ready = threading.Event()
+        holder = []
+
+        def on_token(t):
+            if not armed.is_set():
+                armed.set()
+                ready.wait(30)
+                inj = injs[int(holder[0].replica_id[1:])]
+                c = inj.stats()["calls"]
+                for k in range(1, 6):
+                    inj.hang_on_step(c + k, 8.0)
+
+        holder.append(r.submit(PROMPTS[0], on_token=on_token))
+        ready.set()
+        try:
+            holder[0].result(300)
+        except serving.RequestFailed:
+            pass
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            h = r.health()
+            if h["failed_replicas"] >= 1:
+                break
+            time.sleep(0.05)
+        assert h["failed_replicas"] == 1, h
+        failed_rid = next(rid for rid, s in h["supervisor"].items()
+                          if s["state"] == "FAILED")
+        # the operator fixes the underlying fault, then resets
+        chaos["on"] = False
+        for inj in injs:
+            inj.heal()
+        dead_eng = next(e for e in r.engines
+                        if e.replica_id == failed_rid)
+        out = r.reset_breaker(failed_rid)
+        assert out["reset"] is True
+        assert out["state"] == SLOT_RESTARTING
+        # the breaker_reset event lands on the (still-pinned) dead
+        # engine's sink at reset time — read it before the swap drops
+        # that sink from the merged export
+        dead_events = [e.get("name") for e in
+                       dead_eng.trace.to_chrome_trace()["traceEvents"]]
+        assert "breaker_reset" in dead_events
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            h = r.health()
+            if h["serving_replicas"] == 2 and h["replica_restarts"] >= 1:
+                break
+            time.sleep(0.05)
+        assert h["serving_replicas"] == 2, h
+        assert h["failed_replicas"] == 0
+        assert h["circuit_open"] >= 1          # history: it DID open
+        assert h["breaker_resets"] == 1
+        sup = h["supervisor"][failed_rid]
+        assert sup["state"] == SLOT_SERVING
+        assert sup["circuit_open"] is False
+        # the revived slot serves: fresh no-affinity prompts spread by
+        # occupancy, so a small burst must land on it
+        outs = [r.submit(list(map(int, np.random.RandomState(50 + i)
+                                  .randint(1, 200, 4))),
+                         max_new_tokens=MAX_NEW) for i in range(4)]
+        assert all(q.result(300) for q in outs)
+        assert failed_rid in {q.replica_id for q in outs}
+        prom = r.to_prometheus()
+        assert "paddle_tpu_breaker_resets_total" in prom
+        # the revival's provenance survives the swap on the FRESH
+        # engine's `restarted` span in the merged artifact
+        restarted = [e for e in r.to_chrome_trace()["traceEvents"]
+                     if e.get("name") == "restarted"]
+        assert any(e["args"].get("via_breaker_reset")
+                   for e in restarted)
+        assert r.shutdown(drain=False)

@@ -622,3 +622,166 @@ class TestTraceArtifacts:
         summary = tr.summarize(tr.load_events(str(path)))
         assert summary["total"]["terminals"] == {"finished": 1,
                                                 "live": 1}
+
+
+# ---- sampled device-time profiler (PR 13) ------------------------------
+class TestStepProfiler:
+    def test_sampling_cadence_honored(self, setup):
+        """profile_sample_every=N fences exactly every Nth device-call
+        tick — the profiler's tick count matches the flight recorder's
+        and samples == ticks // N."""
+        cfg, params = setup
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=8, chunk=2, profile_sample_every=3)
+        cb.submit(PROMPT)
+        cb.submit(PROMPT2)
+        cb.run()
+        rep = cb.profiler.report()
+        assert rep["ticks"] == cb.flight.seq    # one gate per tick
+        assert rep["ticks"] >= 4
+        assert rep["samples"] == rep["ticks"] // 3
+        # 0 disables: no fences, no samples
+        cb2 = paged.ContinuousBatcher(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=8, chunk=2, profile_sample_every=0)
+        cb2.submit(PROMPT)
+        cb2.run()
+        assert cb2.profiler.report()["samples"] == 0
+
+    def test_zero_recompiles_with_sampling_on(self, setup):
+        """Fencing every single step must not touch the compiled-shape
+        memo: compile_count stays at its warmup value."""
+        cfg, params = setup
+        eng = serving.ServingEngine(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=6, chunk=2, max_prefill_bucket=8,
+            profile_sample_every=1, start=False)
+        eng.warmup()
+        eng.start()
+        warm = eng.batcher.compile_count
+        for p in (PROMPT, PROMPT2, list(range(1, 21))):
+            eng.generate(p, timeout=300)
+        assert eng.batcher.compile_count == warm
+        assert eng.batcher.profiler.report()["samples"] >= 3
+        eng.shutdown()
+
+    def test_per_shape_keys_carry_mode_bucket_impl_qkey(self, setup):
+        """The per-shape histograms key on (mode, bucket, units, impl,
+        weight_dtype, kv_dtype) — decode keys carry the chunk length,
+        prefill keys the ladder bucket, and the resolved impl/qkey ride
+        every row."""
+        cfg, params = setup
+        cb = paged.ContinuousBatcher(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=8, chunk=2, max_prefill_bucket=8,
+            profile_sample_every=1)
+        cb.submit(PROMPT)
+        cb.step()                      # r1 decodes
+        cb.submit(list(range(1, 21)))  # chunks fused onto the decode
+        cb.run()
+        rep = cb.profiler.report()
+        by_mode = {}
+        for row in rep["shapes"]:
+            by_mode.setdefault(row["mode"], []).append(row)
+            assert row["impl"] == cb.attention_impl
+            assert row["weight_dtype"] == "fp"
+            assert row["kv_dtype"] == "fp"
+            assert row["count"] >= 1
+            assert row["device_sum_s"] >= row["host_sum_s"] >= 0.0
+            assert row["device_p99_s"] >= row["device_p50_s"] >= 0.0
+        assert "decode" in by_mode and "prefill" in by_mode
+        assert "fused" in by_mode       # the long prompt fused its chunks
+        assert all(r["bucket"] == 2 for r in by_mode["decode"])
+        assert all(r["bucket"] in cb.prefill_buckets
+                   for r in by_mode["prefill"] + by_mode["fused"])
+        assert all(r["units"] >= 1 for r in by_mode["fused"])
+
+    def test_capture_window_lands_device_wall_in_timelines(
+            self, setup, tmp_path):
+        """engine.capture_profile(steps=K) fences K ticks: the report
+        comes back complete, prefill_chunk events carry device_dur next
+        to their host dur, device.* spans land on the device lane of
+        to_chrome_trace(), and trace_report shows the device columns."""
+        cfg, params = setup
+        eng = serving.ServingEngine(
+            params, cfg, max_batch=2, block_size=4, max_total_len=32,
+            max_new_tokens=6, chunk=2, max_prefill_bucket=8,
+            profile_sample_every=0, start=False)
+        eng.warmup()
+        eng.start()
+        done = threading.Event()
+
+        def traffic():
+            for p in (PROMPT, PROMPT2, PROMPT):
+                eng.generate(p, timeout=300)
+            done.set()
+
+        t = threading.Thread(target=traffic)
+        # arm BEFORE traffic so the first prefill ticks are inside the
+        # window (sampling is off — only the capture fences)
+        eng.batcher.profiler.arm_capture(6)
+        t.start()
+        while eng.batcher.profiler.capture_active() \
+                and not done.wait(0.01):
+            pass
+        t.join(300)
+        report = eng.batcher.profiler.report()
+        assert report["capture"]["complete"], report["capture"]
+        assert report["capture"]["steps_captured"] == 6
+        step0 = report["capture"]["steps"][0]
+        assert {"mode", "device_s", "host_s", "rids"} <= set(step0)
+        chrome = eng.trace.to_chrome_trace()
+        dev = [e for e in chrome["traceEvents"]
+               if str(e.get("name", "")).startswith("device.")]
+        assert dev, "no device spans in the chrome trace"
+        dev_tids = {e["tid"] for e in dev}
+        assert len(dev_tids) == 1
+        lane_names = {m["tid"]: m["args"]["name"]
+                      for m in chrome["traceEvents"]
+                      if m.get("ph") == "M"
+                      and m.get("name") == "thread_name"}
+        assert lane_names[dev_tids.pop()] == "device steps"
+        chunks = [e for e in chrome["traceEvents"]
+                  if e.get("name") == "prefill_chunk"
+                  and "device_dur" in e.get("args", {})]
+        assert chunks, "no prefill chunk carried device_dur"
+        for c in chunks:
+            # a real measured device wall, distinguishable from (and
+            # carried next to) the host-wall span the event renders
+            assert c["args"]["device_dur"] > 0.0
+            assert c["dur"] > 0.0
+        path = tmp_path / "capture_trace.json"
+        path.write_text(json.dumps(chrome))
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "trace_report", REPO / "tools" / "trace_report.py")
+        tr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tr)
+        summary = tr.summarize(tr.load_events(str(path)))
+        assert summary["total"]["device_steps"] >= 1
+        assert summary["total"]["device_step_ms_total"] > 0.0
+        assert any(r["device_ms"] for r in summary["requests"])
+        txt = tr.render(summary)
+        assert "device_ms" in txt and "device steps:" in txt
+        eng.shutdown()
+
+    def test_capture_timeout_on_idle_engine_disarms(self, setup):
+        """A capture armed on an idle engine times out bounded,
+        reports complete=False, AND disarms the window — a leftover
+        armed capture must not silently fence every future tick once
+        traffic resumes (review regression)."""
+        cfg, params = setup
+        eng = serving.ServingEngine(
+            params, cfg, max_batch=1, block_size=4, max_total_len=32,
+            max_new_tokens=2, profile_sample_every=0, start=False)
+        rep = eng.capture_profile(steps=2, timeout=0.2)
+        assert rep["capture"]["complete"] is False
+        assert rep["capture"]["steps_captured"] == 0
+        assert eng.batcher.profiler.capture_active() is False
+        # traffic after the timed-out capture pays zero fences
+        # (sampling is off on this engine: any sample = a leak)
+        eng.start()
+        eng.generate(PROMPT, timeout=300)
+        assert eng.batcher.profiler.report()["samples"] == 0
+        eng.shutdown()

@@ -32,7 +32,19 @@ questions the raw timeline is too granular for:
   * self-healing churn — supervisor `restarting`/`restarted` events
     (replica-scoped spans, no trace_id) counted into the recovery
     totals next to failovers, so a replica that died and was respawned
-    is visible in the same summary as the requests it stranded.
+    is visible in the same summary as the requests it stranded;
+  * device-time attribution — when a profiler capture window ran
+    (`ServingEngine.capture_profile` / `POST /debug/profile`), the
+    fenced `device.*` spans and per-chunk ``device_dur`` annotations
+    land device-wall columns next to the host-wall ones
+    (``device_ms`` per request, device step totals), so a TTFT
+    regression is attributable to the kernel vs host scheduling;
+    artifacts that predate the capture fields render "-" instead of
+    crashing;
+  * SLO breach windows (``--slo``) — `slo_breach` / `slo_recovered`
+    spans from the engine's SLO tracker become per-objective breach
+    windows, each listing the requests whose timelines rode it — the
+    request-correlated view of "which users felt the burn".
 
 Standard library only (no jax import): runs anywhere the JSON landed,
 including the CI bench-smoke job where it ships as a non-blocking
@@ -67,18 +79,38 @@ def summarize(events) -> dict:
         "slot": None, "prefill_ms": 0.0, "chunks": 0, "fused_chunks": 0,
         "pad_tokens": 0, "real_tokens": 0, "cached_tokens": 0,
         "generated": 0, "requeues": 0, "retries": 0, "kv_bytes": 0,
-        "replica": None, "failovers": 0,
+        "replica": None, "failovers": 0, "device_ms": None,
+        "first_ts": None, "last_ts": None,
     })
     steps = {"count": 0, "total_ms": 0.0}
+    # device-wall spans from a profiler capture window (device.decode /
+    # device.fused / device.prefill on the device lane)
+    dev_steps = {"count": 0, "total_ms": 0.0}
     quant = {"weight_dtype": None, "kv_dtype": None}
     # replica-scoped (not request-scoped) churn: supervisor restart
     # events ride the engine sinks' span lane with no trace_id
     restarts = {"restarting": 0, "restarted": 0}
+    # SLO verdict transitions (engine-scoped spans, no trace_id):
+    # paired breach→recovered edges become breach windows below
+    slo_edges = []
     for e in events:
         name, args = e.get("name"), e.get("args", {})
         if name == "engine.step":
             steps["count"] += 1
             steps["total_ms"] += e.get("dur", 0.0) / 1e3
+            continue
+        if isinstance(name, str) and name.startswith("device."):
+            dev_steps["count"] += 1
+            dev_steps["total_ms"] += e.get("dur", 0.0) / 1e3
+            continue
+        if name in ("slo_breach", "slo_recovered"):
+            slo_edges.append({
+                "edge": name, "ts": e.get("ts", 0.0),
+                "objective": args.get("objective"),
+                "replica": args.get("replica_id"),
+                "burn_rate_fast": args.get("burn_rate_fast"),
+                "window_s": args.get("window_s"),
+                "target": args.get("target")})
             continue
         if name in ("restarting", "restarted"):
             restarts[name] += 1
@@ -88,6 +120,10 @@ def summarize(events) -> dict:
             continue
         r = per_req[tid]
         ts = e.get("ts", 0.0)
+        if r["first_ts"] is None or ts < r["first_ts"]:
+            r["first_ts"] = ts
+        if r["last_ts"] is None or ts > r["last_ts"]:
+            r["last_ts"] = ts
         if name == "enqueued":
             r["enqueued_ts"] = ts
             r["prompt_len"] = args.get("prompt_len")
@@ -120,6 +156,11 @@ def summarize(events) -> dict:
             r["cached_tokens"] += args.get("cached_tokens", 0)
             if args.get("fused"):
                 r["fused_chunks"] += 1
+            # device wall rides only on chunks a capture window fenced
+            # (device_dur is seconds; absent on older artifacts)
+            if args.get("device_dur") is not None:
+                r["device_ms"] = (r["device_ms"] or 0.0) \
+                    + args["device_dur"] * 1e3
         elif name == "first_token":
             r["first_token_ts"] = ts
         elif name == "retired":
@@ -149,6 +190,9 @@ def summarize(events) -> dict:
             "decode_ms": delta("first_token_ts", "terminal_ts"),
             "total_ms": delta("enqueued_ts", "terminal_ts"),
             "prefill_ms": round(r["prefill_ms"], 3),
+            "device_ms": (None if r["device_ms"] is None
+                          else round(r["device_ms"], 3)),
+            "first_ts": r["first_ts"], "last_ts": r["last_ts"],
             "chunks": r["chunks"], "fused_chunks": r["fused_chunks"],
             "cached_tokens": r["cached_tokens"],
             "prefilled_tokens": r["real_tokens"],
@@ -176,6 +220,10 @@ def summarize(events) -> dict:
         if cached + real else 0.0,
         "engine_steps": steps["count"],
         "engine_step_ms_total": round(steps["total_ms"], 3),
+        "device_steps": dev_steps["count"],
+        "device_step_ms_total": round(dev_steps["total_ms"], 3),
+        "device_ms_total": round(sum(x["device_ms"] or 0.0
+                                     for x in rows), 3),
         "requeued_events": sum(x["requeues"] for x in rows),
         "retried_events": sum(x["retries"] for x in rows),
         "failover_events": sum(x["failovers"] for x in rows),
@@ -188,7 +236,52 @@ def summarize(events) -> dict:
         "kv_dtype": quant["kv_dtype"],
         "kv_bytes_total": sum(x["kv_bytes"] for x in rows),
     }
-    return {"total": total, "requests": rows}
+    return {"total": total, "requests": rows,
+            "slo": _breach_windows(slo_edges, rows)}
+
+
+def _breach_windows(slo_edges, rows) -> dict:
+    """Pair slo_breach → slo_recovered edges per (objective, replica)
+    into breach windows, each listing the trace ids whose timelines
+    overlap it (the requests that rode the breach). An edge set from
+    an artifact that predates SLO tracking is simply empty."""
+    edges = sorted(slo_edges, key=lambda e: e.get("ts", 0.0))
+    open_w, windows = {}, []
+    for e in edges:
+        key = (e.get("objective"), e.get("replica"))
+        if e["edge"] == "slo_breach":
+            if key not in open_w:
+                w = {"objective": e.get("objective"),
+                     "replica": e.get("replica"),
+                     "start_ms": round(e.get("ts", 0.0) / 1e3, 3),
+                     "end_ms": None,        # None = still open at export
+                     "burn_rate_fast": e.get("burn_rate_fast"),
+                     # the verdict was computed over this trailing
+                     # window — request attribution reaches back by it
+                     "window_s": e.get("window_s"),
+                     "target": e.get("target"), "requests": []}
+                open_w[key] = w
+                windows.append(w)
+        else:
+            w = open_w.pop(key, None)
+            if w is not None:
+                w["end_ms"] = round(e.get("ts", 0.0) / 1e3, 3)
+    for w in windows:
+        # reach back over the fast window that triggered the verdict:
+        # the offending samples predate the breach event by up to it
+        s_us = w["start_ms"] * 1e3 - (w.get("window_s") or 0.0) * 1e6
+        e_us = None if w["end_ms"] is None else w["end_ms"] * 1e3
+        for r in rows:
+            a, b = r.get("first_ts"), r.get("last_ts")
+            if a is None or b is None:
+                continue
+            if (e_us is None or a <= e_us) and b >= s_us:
+                w["requests"].append(r["trace_id"])
+    return {"breach_events": sum(1 for e in edges
+                                 if e["edge"] == "slo_breach"),
+            "recovered_events": sum(1 for e in edges
+                                    if e["edge"] == "slo_recovered"),
+            "breach_windows": windows}
 
 
 def _fmt(v):
@@ -199,8 +292,9 @@ def _fmt(v):
     return str(v)
 
 
-def render(summary: dict) -> str:
-    """The human view: one aggregate block + one row per request."""
+def render(summary: dict, show_slo: bool = False) -> str:
+    """The human view: one aggregate block + one row per request
+    (plus, with `show_slo`, the breach-window section)."""
     t = summary["total"]
     lines = [
         "== serving trace summary ==",
@@ -212,7 +306,9 @@ def render(summary: dict) -> str:
         f"cache-hit tokens: {t['cached_tokens']} "
         f"(hit rate {t['cache_hit_rate']:.1%})",
         f"engine steps: {t['engine_steps']} "
-        f"({t['engine_step_ms_total']:.1f} ms total)",
+        f"({t['engine_step_ms_total']:.1f} ms total)  device steps: "
+        f"{t.get('device_steps', 0)} "
+        f"({t.get('device_step_ms_total', 0.0):.1f} ms device wall)",
         f"recovery: {t['requeued_events']} requeues, "
         f"{t['retried_events']} retries, "
         f"{t['failover_events']} failovers, "
@@ -225,14 +321,33 @@ def render(summary: dict) -> str:
     ]
     cols = ["trace_id", "terminal", "replica", "slot", "prompt_len",
             "generated", "queue_wait_ms", "ttft_ms", "decode_ms",
-            "prefill_ms", "chunks", "fused_chunks", "cached_tokens",
-            "pad_tokens", "requeues", "retries", "failovers", "kv_bytes"]
-    rows = [[_fmt(r[c]) for c in cols] for r in summary["requests"]]
+            "prefill_ms", "device_ms", "chunks", "fused_chunks",
+            "cached_tokens", "pad_tokens", "requeues", "retries",
+            "failovers", "kv_bytes"]
+    # old artifacts may predate a column: .get keeps the report
+    # rendering instead of KeyError-crashing on missing fields
+    rows = [[_fmt(r.get(c)) for c in cols] for r in summary["requests"]]
     widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
               for i, c in enumerate(cols)]
     lines.append("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
     for r in rows:
         lines.append("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    if show_slo:
+        slo = summary.get("slo") or {}
+        wins = slo.get("breach_windows", [])
+        lines += ["", "== SLO breach windows ==",
+                  f"breaches: {slo.get('breach_events', 0)}  "
+                  f"recoveries: {slo.get('recovered_events', 0)}"]
+        if not wins:
+            lines.append("no breach windows in this artifact")
+        for w in wins:
+            end = "open" if w["end_ms"] is None else f"{w['end_ms']:.1f}"
+            lines.append(
+                f"[{w['start_ms']:.1f} ms → {end}] "
+                f"{w['objective']} on {w['replica'] or '-'} "
+                f"(burn {w['burn_rate_fast']}, target {w['target']}) — "
+                f"{len(w['requests'])} requests rode it: "
+                f"{', '.join(w['requests']) or '-'}")
     return "\n".join(lines)
 
 
@@ -242,10 +357,15 @@ def main(argv=None) -> int:
                                   "bench_serving.py --trace")
     ap.add_argument("--json", action="store_true",
                     help="print the summary as one JSON object")
+    ap.add_argument("--slo", action="store_true",
+                    help="append the SLO section: breach windows "
+                         "(slo_breach → slo_recovered spans) and the "
+                         "requests whose timelines rode each one")
     a = ap.parse_args(argv)
     summary = summarize(load_events(a.trace))
     try:
-        print(json.dumps(summary) if a.json else render(summary))
+        print(json.dumps(summary) if a.json
+              else render(summary, show_slo=a.slo))
     except BrokenPipeError:
         pass                 # downstream (e.g. `| head`) closed early
     return 0
