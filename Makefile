@@ -54,6 +54,12 @@ test:           ## tier-1 test suite (CPU)
 # p99-under-load as tracked JSON fields (timing-based, not gated);
 # --load --router runs the same generator through a 2-replica Router
 # (multi-replica goodput scaling, per-replica routing counts).
+# Speculative leg: --speculative runs the shared-prefix workload
+# plain then with self-speculative draft-and-verify decode; FAILS
+# unless spec output is bit-identical to the plain greedy reference,
+# accepted tokens/step > 1, and post-warmup recompiles stay 0 (the
+# spec config rides every memo/warmup key); emits spec_accept_rate /
+# spec_tokens_per_step / decode_tok_s_spec as tracked JSON fields.
 # SLO leg: --slo FAILS unless sampled device timing holds tok/s >=
 # 0.97x the sampling-off legs with zero recompiles, an injected
 # latency fault (4s hangs short of the watchdog) drives an itl_ms_p99
@@ -80,6 +86,8 @@ bench-smoke:    ## tiny serving benches (non-blocking CI job)
 		--n-requests 8 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --slo \
 		--n-requests 8 --max-new 6
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --speculative \
+		--n-requests 6 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --load \
 		--sessions 4 --turns 2 --max-new 4
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --load --router \

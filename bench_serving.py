@@ -141,7 +141,11 @@ import numpy as np
 
 def _make_prompts(rng, n_requests: int, workload: str,
                   prefix_len: int, suffix_len: int):
-    if workload == "prefix-share":
+    if workload in ("prefix-share", "speculative"):
+        # the speculative gate runs the shared-prefix population too:
+        # the accept-rate story is the steady-state serving shape
+        # (system prompt + short user turns), and the prefix cache
+        # must stay warm==cold under spec commits
         common = list(map(int, rng.randint(1, 200, prefix_len)))
         return [common + list(map(int, rng.randint(1, 200, suffix_len)))
                 for _ in range(n_requests)]
@@ -160,7 +164,9 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
            max_prefill_bucket: int, fused_prefill: bool,
            attention_impl: str = "auto", fused_units: int = 1,
            budgets=None, trace: bool = True,
-           profile_sample_every: int = 0) -> dict:
+           profile_sample_every: int = 0,
+           speculative: bool = False, spec_k: int = 4,
+           draft_layers=None) -> dict:
     """One engine lifecycle over `prompts`: warmup (AOT ladder + one
     served request), timed serve, drain. Returns the raw numbers the
     workload-specific JSON assembly picks from. `profile_sample_every`
@@ -175,7 +181,9 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
         max_prefill_bucket=max_prefill_bucket,
         fused_prefill=fused_prefill, fused_units=fused_units,
         attention_impl=attention_impl, trace=trace,
-        profile_sample_every=profile_sample_every, start=False)
+        profile_sample_every=profile_sample_every,
+        speculative=speculative, spec_k=spec_k,
+        draft_layers=draft_layers, start=False)
     # warmup: AOT-compile EVERY prefill shape (group ladder x bucket
     # ladder x cold/cached, + the fused variants) before the loop
     # starts, then serve one request to compile the decode chunk fn
@@ -380,6 +388,60 @@ def _quantized_gates(params, cfg, prompts, budgets, **kw) -> dict:
         out[f"decode_tok_s_{name}"] = (round(leg["decode_tok_s"], 1)
                                        if leg["decode_tok_s"] else None)
     return out
+
+
+def _spec_leg(params, cfg, prompts, **kw) -> dict:
+    """The speculative-decoding gate: the shared-prefix workload runs
+    plain (the greedy token reference) and then self-speculatively.
+    HARD-FAILS unless the spec run's output is BIT-identical to the
+    plain reference (greedy speculation changes the schedule, never
+    the tokens), accepted tokens/step exceeds 1 (speculation actually
+    multiplies decode), and post-warmup recompiles stay 0 on both
+    runs (the spec draft/verify pair is AOT-warmed and the spec
+    config rides every memo key). The draft runs at FULL depth here:
+    on the random-init smoke model a truncated draft's proposals
+    essentially never match the target's greedy choices, so the
+    accept path would be vacuous — truncation (`draft_layers=`) is a
+    quality/cost knob for real checkpoints, exercised for token
+    parity by tests/test_speculative.py."""
+    ref = _serve(params, cfg, prompts, fused_prefill=True, **kw)
+    base_tokens = [q.result() for q in ref["reqs"]]
+    spec = _serve(params, cfg, prompts, fused_prefill=True,
+                  speculative=True, spec_k=4, draft_layers=None, **kw)
+    spec_tokens = [q.result() for q in spec["reqs"]]
+    st = spec["snap"]["speculative"]
+    if spec_tokens != base_tokens:
+        bad = sum(1 for a, b in zip(base_tokens, spec_tokens)
+                  if a != b)
+        raise RuntimeError(
+            f"speculative gate: {bad}/{len(base_tokens)} requests "
+            f"diverged from the plain greedy reference — greedy "
+            f"speculative decoding must be output-identical "
+            f"(accept_rate {st['accept_rate']})")
+    if ref["recompiles"] or spec["recompiles"]:
+        raise RuntimeError(
+            f"speculative gate: post-warmup recompiles (plain "
+            f"{ref['recompiles']}, spec {spec['recompiles']}) — the "
+            f"spec config must ride every memo/warmup key")
+    if not st["tokens_per_step"] > 1.0:
+        raise RuntimeError(
+            f"speculative gate: {st['tokens_per_step']} accepted "
+            f"tokens/step over {st['steps']} verify sweeps — "
+            f"speculation is not multiplying decode (accept_rate "
+            f"{st['accept_rate']})")
+    return {
+        "_ref": ref,
+        "spec_accept_rate": st["accept_rate"],
+        "spec_tokens_per_step": st["tokens_per_step"],
+        "spec_k": st["k"],
+        "spec_draft_layers": st["draft_layers"],
+        "spec_verify_steps": st["steps"],
+        "spec_token_match": 1.0,
+        "spec_recompiles_after_warmup": spec["recompiles"],
+        "tok_s_spec": round(spec["tok_s"], 1),
+        "decode_tok_s_spec": (round(spec["decode_tok_s"], 1)
+                              if spec["decode_tok_s"] else None),
+    }
 
 
 def _chaos_leg(params, cfg, prompts, budgets, culprit_idx: int,
@@ -1158,6 +1220,13 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         # unfused first: the SAME prompts through the PR4 path give the
         # decode_stall_steps / ITL baseline the fused run must beat
         base = _serve(params, cfg, prompts, fused_prefill=False, **kw)
+    spec = None
+    if workload == "speculative":
+        # plain reference first (its numbers double as this
+        # workload's base JSON), then the spec run with the
+        # bit-identical / tokens-per-step / zero-recompile gates
+        spec = _spec_leg(params, cfg, prompts, **kw)
+        r0 = spec.pop("_ref")
     quant = None
     if workload == "quantized":
         # the fp/w8/int8-KV/w8+int8-KV matrix with its warm==cold,
@@ -1262,7 +1331,8 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         r = t1
         r["tok_s"] = (t1["tok_s"] + t2["tok_s"]) / 2
         r["recompiles"] = t1["recompiles"] + t2["recompiles"]
-    elif chaos is not None or routed is not None or slo is not None:
+    elif chaos is not None or routed is not None or slo is not None \
+            or spec is not None:
         r = r0            # the reference leg doubles as the numbers
     else:
         r = _serve(params, cfg, prompts, fused_prefill=True, **kw)
@@ -1379,8 +1449,10 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         result.update(quant)
     if slo is not None:
         result.update(slo)
+    if spec is not None:
+        result.update(spec)
     if workload in ("mixed", "fused", "chaos", "quantized", "router",
-                    "restart", "slo") and r["recompiles"]:
+                    "restart", "slo", "speculative") and r["recompiles"]:
         raise RuntimeError(
             f"bucketed workload recompiled {r['recompiles']} prefill "
             f"shapes after warmup — the bucket ladder no longer covers "
@@ -1442,6 +1514,14 @@ def _cli() -> dict:
                          "fault heals; plus a /debug/profile capture "
                          "window landing device-wall spans in the "
                          "merged trace")
+    ap.add_argument("--speculative", action="store_true",
+                    help="self-speculative decoding gate: the shared-"
+                         "prefix workload runs plain then with draft-"
+                         "and-verify; HARD-FAILS unless spec output "
+                         "is bit-identical to the plain greedy "
+                         "reference, accepted tokens/step > 1, and "
+                         "recompiles stay 0; emits spec_accept_rate "
+                         "and decode_tok_s_spec as tracked fields")
     ap.add_argument("--load", action="store_true",
                     help="closed-loop load generator: Poisson session "
                          "arrivals, multi-turn rounds, shared system "
@@ -1514,10 +1594,12 @@ def _cli() -> dict:
     if load_router:
         a.router = False
     if sum((a.prefix_share, a.bucketed, a.fused, a.chaos,
-            a.quantized, a.router, a.restart, a.slo, a.load)) > 1:
+            a.quantized, a.router, a.restart, a.slo, a.speculative,
+            a.load)) > 1:
         ap.error("--prefix-share, --bucketed, --fused, --chaos, "
-                 "--quantized, --router, --restart, --slo and --load "
-                 "are mutually exclusive (except --load --router)")
+                 "--quantized, --router, --restart, --slo, "
+                 "--speculative and --load are mutually exclusive "
+                 "(except --load --router)")
     workload = ("prefix-share" if a.prefix_share
                 else "mixed" if a.bucketed
                 else "fused" if a.fused
@@ -1526,6 +1608,7 @@ def _cli() -> dict:
                 else "router" if a.router
                 else "restart" if a.restart
                 else "slo" if a.slo
+                else "speculative" if a.speculative
                 else "load" if a.load else "random")
     bucket_cap = a.max_prefill_bucket
     if bucket_cap is None:
@@ -1534,12 +1617,13 @@ def _cli() -> dict:
         # their longest prompts (load's multi-turn histories chunk too)
         bucket_cap = (16 if workload in ("mixed", "fused", "chaos",
                                          "quantized", "router",
-                                         "restart", "slo", "load")
+                                         "restart", "slo", "load",
+                                         "speculative")
                       else 512)
     chunk = (a.chunk if a.chunk is not None
              else 2 if workload in ("fused", "prefix-share", "chaos",
                                     "quantized", "router", "restart",
-                                    "slo")
+                                    "slo", "speculative")
              else 4)
     return main(n_requests=a.n_requests, max_new=a.max_new,
                 max_batch=a.max_batch, block_size=a.block_size,

@@ -33,6 +33,13 @@ HOT_PATHS: Tuple[Tuple[str, str], ...] = (
      r"|_paged_gqa_attention|forward_paged"
      r"|_write_pool|_write_pool_int8"
      r"|_trace_emit|_trace_chunks|_record_tick"
+     # speculative decoding: the draft/verify step helpers run every
+     # spec tick (_step_spec's single coalesced device_get is the
+     # documented per-step sync, like the fused path's); the score
+     # forward/attention are traced but pinned here too so a host
+     # value can't sneak in before tracing catches it
+     r"|_step_spec|_emit_spec|_spec_any|_drain_emitted"
+     r"|_forward_spec|_spec_gqa_attention"
      # sampled device-time attribution: _profile_t0 runs EVERY device
      # call tick (must stay a counter bump), _profile_commit is the
      # documented sample-gate exception (its block_until_ready fence
@@ -55,6 +62,10 @@ HOT_PATHS: Tuple[Tuple[str, str], ...] = (
      r"|record_request|_record|evaluate|pop_transitions)$"),
     ("serving/profiling.py",
      r"^(should_fence|record|arm_capture|capture_active)$"),
+    # speculative-decoding accounting: record_step folds one verify
+    # sweep's counts per spec tick — host ints only by design
+    ("serving/speculative.py",
+     r"^(record_step|accept_rate|tokens_per_step)$"),
     # router/frontend tier: the per-request routing decision, the
     # monitor sweep (terminal fan-in + failover) and the HTTP token
     # bridge run once per request or per tick with the event loop /

@@ -463,6 +463,125 @@ def _paged_gqa_attention(q, k_pool, v_pool, table, positions, valid=None,
     return o.reshape(B, P, H, hd).astype(q.dtype)
 
 
+def _spec_gqa_attention(q, pk, pv, table, base_len, sk, sv, row_idx,
+                        k_scale=None, v_scale=None):
+    """The speculative score path's attention: q [B, P, H, hd] over the
+    committed pool history PLUS an in-register draft/verify suffix
+    slab. The pool is READ-ONLY here — visibility for pool keys is
+    j < base_len[b] (the committed length; nothing speculative has
+    been written), and suffix slab row s (this step's tokens plus
+    previously drafted ones, sk/sv [B, S, KV, hd]) is visible to query
+    p iff s <= row_idx[p] (row_idx [P] = each query's absolute slab
+    row). Together a query at committed position base_len + r sees
+    exactly the base_len + r + 1 keys plain write-then-gather decode
+    would — same key set and values (slab rows pass through the pool
+    dtype), softmax over the concatenated score axis.
+
+    k_scale/v_scale mark an int8 pool: dequantized after the gather
+    (the XLA reference formulation). Slab rows stay full precision —
+    the committed codes a LATER step reads go through the normal
+    quantize-on-commit path, so spec-vs-plain parity under int8 KV is
+    a documented match-rate floor, not bitwise (README
+    "Speculative decoding")."""
+    B, P, H, hd = q.shape
+    N, bs, KV, _ = pk.shape
+    M = table.shape[1]
+    S = sk.shape[1]
+    tb = jnp.clip(table, 0)
+    if k_scale is not None:
+        k = kvq.dequantize(pk[tb],
+                           k_scale[tb][:, :, None, None, None])
+        v = kvq.dequantize(pv[tb],
+                           v_scale[tb][:, :, None, None, None])
+        k = k.reshape(B, M * bs, KV, hd)
+        v = v.reshape(B, M * bs, KV, hd)
+    else:
+        k = pk[tb].reshape(B, M * bs, KV, hd)
+        v = pv[tb].reshape(B, M * bs, KV, hd)
+    rep = H // KV
+    qg = q.reshape(B, P, KV, rep, hd)
+    sp = jnp.einsum("bpkrd,btkd->bkrpt", qg, k,
+                    preferred_element_type=jnp.float32) / math.sqrt(hd)
+    vis_p = (jnp.arange(M * bs)[None, :] < base_len[:, None]
+             )[:, None, None, None, :]
+    sp = jnp.where(vis_p, sp, -1e30)
+    ss = jnp.einsum("bpkrd,bskd->bkrps", qg, sk.astype(q.dtype),
+                    preferred_element_type=jnp.float32) / math.sqrt(hd)
+    vis_s = (jnp.arange(S)[None, :] <= row_idx[:, None]
+             )[None, None, None, :, :]
+    ss = jnp.where(vis_s, ss, -1e30)
+    p = jax.nn.softmax(jnp.concatenate([sp, ss], axis=-1), axis=-1)
+    o = jnp.einsum("bkrpt,btkd->bpkrd", p[..., :M * bs], v,
+                   preferred_element_type=jnp.float32) \
+        + jnp.einsum("bkrps,bskd->bpkrd", p[..., M * bs:],
+                     sv.astype(q.dtype),
+                     preferred_element_type=jnp.float32)
+    return o.reshape(B, P, H, hd).astype(q.dtype)
+
+
+def _forward_spec(params, layers, tokens, cache, positions, base_len,
+                  slab_k, slab_v, row0, cfg):
+    """The speculative score-path forward: tokens [B, P] at per-request
+    absolute positions, attending to the committed pool (READ-ONLY,
+    visibility < base_len) plus the spec slab (previously drafted rows
+    and this call's own). The new tokens' per-layer K/V land in slab
+    rows [row0, row0 + P) — NEVER the pool: verify-then-commit writes
+    only accepted rows afterwards, so a rejected draft token cannot
+    poison the pool, the prefix cache, or an int8 block's grow-only
+    scale. `layers` may be a truncated stack (the draft's) — the
+    slab's leading dim matches it; embed/norm/head come from the full
+    `params` either way (the self-speculative trick: the target's pool
+    layers 0..d-1 ARE the d-layer draft's cache). Returns
+    (logits [B, P, V], slab_k', slab_v')."""
+    cd = cfg.dtype
+    T_rope = cache.table.shape[1] * cache.k.shape[2]
+    x = jnp.take(params["embed_tokens"], tokens, axis=0).astype(cd)
+    cos, sin = rope_freqs(cfg.head_dim, T_rope, cfg.rope_theta,
+                          jnp.float32)
+    B, P = tokens.shape
+    H, KV, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    row_idx = row0 + jnp.arange(P)
+
+    def body(carry, lp):
+        x, sk_all, sv_all, li = carry
+        pk = lax.dynamic_slice_in_dim(cache.k, li, 1, 0)[0]
+        pv = lax.dynamic_slice_in_dim(cache.v, li, 1, 0)[0]
+        ks = None if cache.k_scale is None else \
+            lax.dynamic_slice_in_dim(cache.k_scale, li, 1, 0)[0]
+        vs = None if cache.v_scale is None else \
+            lax.dynamic_slice_in_dim(cache.v_scale, li, 1, 0)[0]
+        sk = lax.dynamic_slice_in_dim(sk_all, li, 1, 0)[0]
+        sv = lax.dynamic_slice_in_dim(sv_all, li, 1, 0)[0]
+        h = rms_norm_ref(x, lp["input_layernorm"], cfg.rms_norm_eps)
+        q = (h @ _wq(lp, "q_proj", cd)).reshape(B, P, H, hd)
+        k = (h @ _wq(lp, "k_proj", cd)).reshape(B, P, KV, hd)
+        v = (h @ _wq(lp, "v_proj", cd)).reshape(B, P, KV, hd)
+        q, k = apply_rope_half(q, k, cos, sin, positions)
+        # slab rows pass through the slab (== pool compute) dtype so
+        # spec attention sees the same roundtrip a pool write-then-
+        # gather would give plain decode
+        sk = lax.dynamic_update_slice_in_dim(sk, k.astype(sk.dtype),
+                                             row0, axis=1)
+        sv = lax.dynamic_update_slice_in_dim(sv, v.astype(sv.dtype),
+                                             row0, axis=1)
+        a = _spec_gqa_attention(q, pk, pv, cache.table, base_len,
+                                sk, sv, row_idx, ks, vs)
+        a = a.reshape(B, P, H * hd) @ _wq(lp, "o_proj", cd)
+        sk_all = lax.dynamic_update_slice_in_dim(sk_all, sk[None], li, 0)
+        sv_all = lax.dynamic_update_slice_in_dim(sv_all, sv[None], li, 0)
+        x = x + a
+        h = rms_norm_ref(x, lp["post_attention_layernorm"],
+                         cfg.rms_norm_eps)
+        x = x + _mlp_cached(h, lp, cfg)
+        return (x, sk_all, sv_all, li + 1), None
+
+    (x, slab_k, slab_v, _), _ = lax.scan(
+        body, (x, slab_k, slab_v, jnp.int32(0)), layers)
+    logits = _final_head_cached(params, x, cfg)
+    return logits, slab_k, slab_v
+
+
 def _attention_paged(x, lp, cfg, cos, sin, pk, pv, table, positions,
                      valid, is_prefill, attention_impl: str = "xla",
                      pks=None, pvs=None):
@@ -690,6 +809,25 @@ class ContinuousBatcher:
     compiled-shape memo keys on (weight_dtype, kv_dtype) next to the
     attention impl.
 
+    Self-speculative decoding (`speculative=`, `spec_k=`,
+    `draft_layers=`): decode is memory-bound — every plain step sweeps
+    the weights + live KV to emit ONE token per slot. With spec on, a
+    cheap draft (the SAME model truncated to `draft_layers`; the
+    committed pool's layers 0..d-1 ARE its KV cache, so no second
+    weight set or pool exists) proposes `spec_k` tokens, and the
+    target scores all k+1 positions in ONE call — the per-query
+    causal mask is exactly the multi-token-suffix primitive — then
+    accepts the longest prefix matching its own greedy tokens plus
+    one corrected token. Verify-then-commit: scoring never writes the
+    pool (proposal K/V ride an in-register slab); only accepted rows
+    commit, row-sequentially, so rejection never poisons the pool /
+    prefix cache / int8 scales and greedy output is identical to
+    plain decode by construction. Admission pressure keeps using the
+    fused plain-decode tick; `submit(speculative=False)` opts one
+    request out (the engine quarantine's fallback); the spec config
+    rides every memo/warmup key and `warmup_prefill` compiles the
+    draft/verify pair. `spec_stats()` reports acceptance accounting.
+
     Observability (`trace=`, `flight_recorder_cap=`): an optional
     `serving.trace.TraceSink` collects per-request timelines (prepared
     / prefill_chunk / retired events carrying bucket, pad,
@@ -720,6 +858,8 @@ class ContinuousBatcher:
                  attention_impl: str = "auto",
                  weight_dtype: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
+                 speculative: bool = False, spec_k: int = 4,
+                 draft_layers: Optional[int] = None,
                  trace=None, flight_recorder_cap: int = 64,
                  profile_sample_every: int = 64,
                  fault_injector=None, replica_id: str = "r0"):
@@ -770,6 +910,41 @@ class ContinuousBatcher:
         # one's (the zero-post-warmup-recompiles gate covers both)
         self.attention_impl = resolve_attention_impl(attention_impl)
         self._qkey = (self.weight_dtype, self.kv_dtype)
+        # self-speculative decoding (ROADMAP direction 5(b)): a cheap
+        # draft — the SAME model truncated to `draft_layers` (None =
+        # full depth) — proposes spec_k tokens autoregressively off
+        # the committed pool (layer l's KV depends only on layers < l,
+        # so the target's pool layers 0..d-1 ARE the d-layer draft's
+        # cache: no second weight set, no second pool); the target
+        # then scores all k+1 positions in ONE call and accepts the
+        # longest greedy-matching prefix plus one corrected token.
+        # Verify-then-commit: scoring never writes the pool — accepted
+        # rows commit afterwards, row-sequentially, so rejection never
+        # poisons the pool / prefix cache / int8 scales and greedy
+        # output is identical to plain decode by construction.
+        # serving.speculative holds the config/stat types (lazy import
+        # below, like trace/profiling — dependency-free module).
+        from ..serving.speculative import SpecConfig, SpecStats
+        self.speculative = bool(speculative)
+        self._spec_cfg = SpecConfig(spec_k, draft_layers,
+                                    num_layers=cfg.num_hidden_layers)
+        self.spec_k = self._spec_cfg.k
+        self._draft_depth = self._spec_cfg.depth(cfg.num_hidden_layers)
+        # every compiled-shape memo key carries the spec config BEFORE
+        # the trailing qkey (() when spec is off — plain batchers' keys
+        # are byte-identical to before), so a spec batcher's warmed
+        # ladder can never be confused with a plain one's
+        self._skey = (self._spec_cfg.key(cfg.num_hidden_layers)
+                      if self.speculative else ())
+        self.spec = SpecStats()
+        self._spec_cache: Dict[Tuple, Any] = {}
+        self._spec_draft_fn = None
+        self._spec_verify_fn = None
+        # per-request spec opt-out (engine quarantine's plain-decode
+        # fallback for victims of a failed spec tick) + the [B] device
+        # mirror of per-slot participation, invalidated on admit/retire
+        self._no_spec: set = set()
+        self._spec_ok_dev = None
         self.max_total = max_total_len
         self.M = -(-max_total_len // block_size)
         self.max_new = max_new_tokens
@@ -896,17 +1071,24 @@ class ContinuousBatcher:
         self._just_finished: List[int] = []
 
     def submit(self, tokens, stop_token_id: Optional[int] = None,
-               max_new_tokens: Optional[int] = None) -> int:
+               max_new_tokens: Optional[int] = None,
+               speculative: Optional[bool] = None) -> int:
         """Queue a request. `stop_token_id` finishes THIS request early
         when emitted (in addition to the batcher-wide eos); the slot's
         blocks return to the pool on finish. `max_new_tokens` caps this
         request's budget (must be <= the batcher-wide max — the block
-        table width is sized for it)."""
+        table width is sized for it). `speculative=False` opts THIS
+        request out of the spec pipeline (its verify rows ride along
+        with acceptance forced to 0, i.e. plain greedy decode — the
+        engine's quarantine fallback for victims of a failed spec
+        tick); None inherits the batcher default."""
         toks = list(map(int, tokens))
         mn = self.validate(len(toks), max_new_tokens)
         rid = self._next_rid
         self._next_rid += 1
         stop = -1 if stop_token_id is None else int(stop_token_id)
+        if speculative is False:
+            self._no_spec.add(rid)
         self.queue.append((rid, toks, stop, mn))
         self.outputs[rid] = []
         self._delivered[rid] = 0
@@ -1032,12 +1214,14 @@ class ContinuousBatcher:
     @property
     def compile_count(self) -> int:
         """EVERY compiled device-step shape: the prefill/fused ladder
-        plus the plain decode chunk executable. The zero-post-warmup-
-        recompiles gate reads this one — a decode-only stretch after a
-        fused stretch must not compile either (the chunk fn used to
-        slip through `prefill_compile_count`, compiling lazily on the
-        first standalone-decode step)."""
-        return self.prefill_compile_count + len(self._chunk_cache)
+        plus the plain decode chunk executable plus the speculative
+        draft/verify pair. The zero-post-warmup-recompiles gate reads
+        this one — a decode-only stretch after a fused stretch must
+        not compile either (the chunk fn used to slip through
+        `prefill_compile_count`, compiling lazily on the first
+        standalone-decode step)."""
+        return (self.prefill_compile_count + len(self._chunk_cache)
+                + len(self._spec_cache))
 
     def prefix_stats(self) -> Dict[str, Any]:
         """Prefix-cache counters for the serving metrics surface:
@@ -1083,6 +1267,7 @@ class ContinuousBatcher:
             if entry[0] == rid:
                 del self.queue[i]
                 self._delivered.pop(rid, None)
+                self._no_spec.discard(rid)
                 return True
         for i, (rec, _done) in enumerate(self._pending):
             if rec.rid == rid:
@@ -1093,6 +1278,7 @@ class ContinuousBatcher:
                 self._rollback([rec])
                 del self._pending[i]
                 self._delivered.pop(rid, None)
+                self._no_spec.discard(rid)
                 self._requeue_poisoned(rec)
                 return True
         for slot in range(self.B):
@@ -1293,7 +1479,8 @@ class ContinuousBatcher:
         the whole ladder without running a single FLOP; steady-state
         admission dispatches straight to a compiled executable and never
         retraces."""
-        key = (G, Pb, cold, self.attention_impl) + self._qkey
+        key = (G, Pb, cold, self.attention_impl) + self._skey \
+            + self._qkey
         exe = self._prefill_cache.get(key)
         if exe is None:
             fn = self._prefill_fns.get(cold)
@@ -1370,6 +1557,11 @@ class ContinuousBatcher:
         # (incl. a decode-only stretch after a fused stretch) — warm it
         # regardless of ladder/fusion configuration
         self._chunk_exe()
+        if self.speculative:
+            # the spec draft/verify pair runs every non-fused decode
+            # tick — warm both so a spec stretch never retraces
+            self._spec_draft_exe()
+            self._spec_verify_exe()
         return self.compile_count - n0
 
     def _prepare_admission(self, slot: int, rid: int, toks: List[int],
@@ -1604,6 +1796,7 @@ class ContinuousBatcher:
         self.budget[rec.slot] = rec.mn - 1
         self.stop[rec.slot] = rec.stop
         self._dev_state = None        # host slot state diverged from device
+        self._spec_ok_dev = None      # slot occupancy changed
         self.outputs[rec.rid].append(first)
         if ((self.eos is not None and first == self.eos)
                 or first == rec.stop or self.budget[rec.slot] <= 0):
@@ -1654,7 +1847,7 @@ class ContinuousBatcher:
             group_pad=Gp, cold=cold, final=final,
             stalls_decode=any(self.active),
             compile_hit=(Gp, bucket, cold, self.attention_impl)
-            + self._qkey in self._prefill_cache)
+            + self._skey + self._qkey in self._prefill_cache)
         self._gate("prefill", unit_rids)
         t0 = time.perf_counter()
         self._apply_cow([e[0] for e in entries if e[1] == 0])
@@ -1845,8 +2038,8 @@ class ContinuousBatcher:
                 "fused", units=unit_rids, decode_rids=decode_rids,
                 bucket=bucket, group_pad=Gp, rows=len(groups) * Gp,
                 compile_hit=(len(groups) * Gp, bucket,
-                             self.attention_impl) + self._qkey
-                in self._fused_cache)
+                             self.attention_impl) + self._skey
+                + self._qkey in self._fused_cache)
             self._gate("fused",
                        decode_rids + [r for u in unit_rids for r in u])
             t0 = time.perf_counter()
@@ -1934,6 +2127,8 @@ class ContinuousBatcher:
         self.slot_tokens[slot] = None
         self.stop[slot] = -1
         self._dev_state = None        # host slot state diverged from device
+        self._spec_ok_dev = None      # slot occupancy changed
+        self._no_spec.discard(rid)
 
     def _drain_queue(self) -> None:
         """Prepare queued requests into the pending-prefill pipeline
@@ -2047,7 +2242,8 @@ class ContinuousBatcher:
         chunk fn compiled lazily on the first standalone-decode step,
         and a decode-only stretch AFTER a fused stretch (whose steps
         all ran `_fused_exe`) paid a post-warmup compile."""
-        key = (self.chunk, self.attention_impl) + self._qkey
+        key = (self.chunk, self.attention_impl) + self._skey \
+            + self._qkey
         exe = self._chunk_cache.get(key)
         if exe is None:
             if self._chunk_fn is None:
@@ -2128,7 +2324,7 @@ class ContinuousBatcher:
         row count of the call: units x per-unit group pad for a
         multi-unit step, so (units, group) pairs with the same product
         share one executable."""
-        key = (Gp, Pb, self.attention_impl) + self._qkey
+        key = (Gp, Pb, self.attention_impl) + self._skey + self._qkey
         exe = self._fused_cache.get(key)
         if exe is None:
             if self._fused_fn is None:
@@ -2151,6 +2347,285 @@ class ContinuousBatcher:
             self._fused_cache[key] = exe
         return exe
 
+    # -- self-speculative decoding (draft k tokens, verify in one call,
+    #    commit only the accepted rows) ------------------------------------
+    def _spec_key(self, phase: str) -> Tuple:
+        """Memo key for the spec `phase` ("draft" | "verify")
+        executable — spec geometry + backend + quantization config."""
+        return (phase, self.spec_k, self._draft_depth,
+                self.attention_impl) + self._qkey
+
+    def spec_stats(self) -> Dict[str, Any]:
+        """Speculative-decoding accounting: config + the SpecStats
+        counters (steps / drafted / accepted / emitted, accept_rate,
+        tokens_per_step). `enabled` False (and config only) when the
+        batcher decodes plain."""
+        d: Dict[str, Any] = {"enabled": self.speculative}
+        d.update(self._spec_cfg.as_dict(self.cfg.num_hidden_layers))
+        d.update(self.spec.as_dict())
+        return d
+
+    def _build_spec_draft(self):
+        """The traced draft: spec_k autoregressive proposals per slot
+        off the truncated layer stack, reading the committed pool
+        READ-ONLY (layers 0..depth-1 of the target's pool ARE the
+        draft's cache) with its own proposals riding the spec slab.
+        Returns drafts [B, spec_k] (proposal j+1 per step j)."""
+        cfg, K, depth, B = self.cfg, self.spec_k, self._draft_depth, \
+            self.B
+        maxpos = self.M * self.bs - 1
+
+        def draft(params, k, v, ks, vs, table, lengths, tok, active):
+            cache = PagedKVCache(k, v, table, lengths, ks, vs)
+            layers = jax.tree_util.tree_map(lambda x: x[:depth],
+                                            params["layers"])
+            KVh, hd = cfg.num_key_value_heads, cfg.head_dim
+            sk = jnp.zeros((depth, B, K, KVh, hd), cfg.dtype)
+            sv = jnp.zeros_like(sk)
+
+            def step(carry, j):
+                tok, sk, sv = carry
+                pos = jnp.minimum(lengths[:, None] + j, maxpos)
+                logits, sk, sv = _forward_spec(
+                    params, layers, tok[:, None], cache, pos, lengths,
+                    sk, sv, j, cfg)
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                nxt = jnp.where(active, nxt, tok)
+                return (nxt, sk, sv), nxt
+
+            _, drafts = lax.scan(step, (tok, sk, sv),
+                                 jnp.arange(K, dtype=jnp.int32))
+            return drafts.T                              # [B, K]
+
+        return jax.jit(draft)
+
+    def _spec_draft_exe(self):
+        """Memoized COMPILED draft step, AOT-lowered like the prefill
+        shapes so `warmup_prefill` covers it."""
+        key = self._spec_key("draft")
+        exe = self._spec_cache.get(key)
+        if exe is None:
+            if self._spec_draft_fn is None:
+                self._spec_draft_fn = self._build_spec_draft()
+            sds, i32 = jax.ShapeDtypeStruct, jnp.int32
+            pstruct = jax.tree_util.tree_map(
+                lambda x: sds(jnp.shape(x), x.dtype), self.params)
+            B = self.B
+            exe = self._spec_draft_fn.lower(
+                pstruct, sds(self.cache.k.shape, self.cache.k.dtype),
+                sds(self.cache.v.shape, self.cache.v.dtype),
+                self._scale_aval(self.cache.k_scale),
+                self._scale_aval(self.cache.v_scale),
+                sds((B, self.M), i32), sds((B,), i32), sds((B,), i32),
+                sds((B,), jnp.bool_)).compile()
+            self._spec_cache[key] = exe
+        return exe
+
+    def _build_spec_verify(self):
+        """The traced verify: score all spec_k+1 positions (cur_tok +
+        the draft's proposals) in ONE full-depth pass over the
+        read-only pool + spec slab, accept the longest prefix of
+        proposals matching the target's own greedy tokens plus one
+        corrected token (truncated by per-slot budget and eos/stop —
+        the `_emit_one` stopping rule, vectorized over rows), then
+        COMMIT: only the accepted rows' slab K/V reach the pool,
+        written one row at a time in order so the int8 pool's
+        grow-only per-block scales evolve exactly as sequential
+        decode's would. Greedy output is identical to plain decode by
+        construction — speculation changes the schedule, not the
+        tokens."""
+        cfg, K, B = self.cfg, self.spec_k, self.B
+        P = K + 1
+        eos = -1 if self.eos is None else int(self.eos)
+        maxpos = self.M * self.bs - 1
+
+        def verify(params, k, v, ks, vs, table, lengths, tok, drafts,
+                   active, budget, stop, spec_ok):
+            cache = PagedKVCache(k, v, table, lengths, ks, vs)
+            toks_in = jnp.concatenate([tok[:, None], drafts], axis=1)
+            pos = jnp.minimum(
+                lengths[:, None] + jnp.arange(P)[None, :], maxpos)
+            KVh, hd = cfg.num_key_value_heads, cfg.head_dim
+            sk = jnp.zeros((cfg.num_hidden_layers, B, P, KVh, hd),
+                           cfg.dtype)
+            sv = jnp.zeros_like(sk)
+            logits, sk, sv = _forward_spec(
+                params, params["layers"], toks_in, cache, pos, lengths,
+                sk, sv, jnp.int32(0), cfg)
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, P]
+            # accept proposal i+1 while it equals the target's greedy
+            # token at the previous position (longest matching prefix)
+            match = (drafts == g[:, :K]).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1,
+                            dtype=jnp.int32)
+            n_acc = jnp.where(spec_ok, n_acc, 0)
+            # emit g_0..g_{n_acc}, truncated at the budget and at the
+            # first eos/stop emitted (tokens after an end never emit)
+            idx = jnp.arange(P)[None, :]
+            is_end = (g == eos) | (g == stop[:, None])
+            ends_before = jnp.cumsum(is_end.astype(jnp.int32), axis=1) \
+                - is_end.astype(jnp.int32)
+            emit = (idx <= n_acc[:, None]) & (idx < budget[:, None]) \
+                & (ends_before == 0) & active[:, None]
+            n_emit = jnp.sum(emit, axis=1, dtype=jnp.int32)
+            # verify-then-commit: ONLY accepted rows reach the pool —
+            # row-sequential writes keep int8 scale growth identical
+            # to plain decode's token-by-token commits
+            ks2, vs2 = ks, vs
+            for r in range(P):
+                posr, valr = pos[:, r:r + 1], emit[:, r:r + 1]
+                kr, vr = sk[:, :, r:r + 1], sv[:, :, r:r + 1]
+                if ks is None:
+                    k = jax.vmap(_write_pool,
+                                 in_axes=(0, None, None, 0, None))(
+                        k, table, posr, kr, valr)
+                    v = jax.vmap(_write_pool,
+                                 in_axes=(0, None, None, 0, None))(
+                        v, table, posr, vr, valr)
+                else:
+                    k, ks2, _ = jax.vmap(
+                        _write_pool_int8,
+                        in_axes=(0, 0, None, None, 0, None))(
+                        k, ks2, table, posr, kr, valr)
+                    v, vs2, _ = jax.vmap(
+                        _write_pool_int8,
+                        in_axes=(0, 0, None, None, 0, None))(
+                        v, vs2, table, posr, vr, valr)
+            last = jnp.take_along_axis(
+                g, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+            last = jnp.where(active & (n_emit > 0), last, tok)
+            budget2 = budget - n_emit
+            active2 = active & (budget2 > 0) & (last != eos) \
+                & (last != stop)
+            return (k, v, ks2, vs2, lengths + n_emit, last, budget2,
+                    active2, jnp.where(emit, g, 0), n_emit, n_acc)
+
+        return jax.jit(verify)
+
+    def _spec_verify_exe(self):
+        """Memoized COMPILED verify step (AOT-lowered, warmup-covered)."""
+        key = self._spec_key("verify")
+        exe = self._spec_cache.get(key)
+        if exe is None:
+            if self._spec_verify_fn is None:
+                self._spec_verify_fn = self._build_spec_verify()
+            sds, i32 = jax.ShapeDtypeStruct, jnp.int32
+            pstruct = jax.tree_util.tree_map(
+                lambda x: sds(jnp.shape(x), x.dtype), self.params)
+            B = self.B
+            exe = self._spec_verify_fn.lower(
+                pstruct, sds(self.cache.k.shape, self.cache.k.dtype),
+                sds(self.cache.v.shape, self.cache.v.dtype),
+                self._scale_aval(self.cache.k_scale),
+                self._scale_aval(self.cache.v_scale),
+                sds((B, self.M), i32), sds((B,), i32), sds((B,), i32),
+                sds((B, self.spec_k), i32), sds((B,), jnp.bool_),
+                sds((B,), i32), sds((B,), i32),
+                sds((B,), jnp.bool_)).compile()
+            self._spec_cache[key] = exe
+        return exe
+
+    def _step_spec(self):
+        """One speculative decode tick: the draft proposes spec_k
+        tokens per active slot off the truncated stack, the target
+        verifies all k+1 positions in one call and commits only the
+        accepted rows. Returns (out_toks [B, k+1], n_emit [B]) as host
+        arrays — ONE host sync per tick, like the fused path."""
+        decode_rids = [self.slot_req[s] for s in range(self.B)
+                       if self.active[s]]
+        if self._dev_state is None:
+            self._dev_state = self._upload_slot_state()
+        active, budget, stop = self._dev_state
+        if self._spec_ok_dev is None:
+            # per-slot spec participation (quarantine fallback: opted-
+            # out victims decode plain through the same verify call) —
+            # refreshed only when admit/retire changes slot occupancy
+            self._spec_ok_dev = jnp.asarray(
+                [self.slot_req[s] is not None
+                 and self.slot_req[s] not in self._no_spec
+                 for s in range(self.B)])
+        c = self.cache
+        self._record_tick(
+            "spec_draft", rids=decode_rids, k=self.spec_k,
+            compile_hit=self._spec_key("draft") in self._spec_cache)
+        self._gate("spec_draft", decode_rids)
+        t0 = time.perf_counter()
+        t_prof = self._profile_t0()
+        drafts = self._spec_draft_exe()(
+            self.params, c.k, c.v, c.k_scale, c.v_scale, c.table,
+            c.lengths, self.cur_tok, active)
+        self._profile_commit(t_prof, drafts, mode="spec_draft",
+                             bucket=self.spec_k, units=0,
+                             rids=decode_rids)
+        draft_s = time.perf_counter() - t0
+        self._record_tick(
+            "spec_verify", rids=decode_rids, k=self.spec_k,
+            compile_hit=self._spec_key("verify") in self._spec_cache)
+        self._gate("spec_verify", decode_rids)
+        t1 = time.perf_counter()
+        t_prof = self._profile_t0()
+        (pk, pv, ks, vs, lengths, last, budget, active2, out, n_emit,
+         n_acc) = self._spec_verify_exe()(
+            self.params, c.k, c.v, c.k_scale, c.v_scale, c.table,
+            c.lengths, self.cur_tok, drafts, active, budget, stop,
+            self._spec_ok_dev)
+        dev_s = self._profile_commit(
+            t_prof, (pk, out, n_emit), mode="spec_verify",
+            bucket=self.spec_k, units=0, rids=decode_rids)
+        # one host sync serves tokens, counts AND acceptance — and,
+        # dispatch being async, surfaces any device-side failure HERE,
+        # before the batcher state commits below
+        out, n_emit, n_acc = jax.device_get((out, n_emit, n_acc))  # ptlint: disable=SYNC001 — single per-step sync, token + acceptance readbacks coalesced
+        verify_s = time.perf_counter() - t1
+        self.cache = PagedKVCache(pk, pv, c.table, lengths, ks, vs)
+        self.cur_tok = last
+        self._dev_state = (active2, budget, stop)
+        spec_slots = sum(1 for s in range(self.B) if self.active[s]
+                         and self.slot_req[s] not in self._no_spec)
+        self.spec.record_step(drafted=self.spec_k * spec_slots,
+                              accepted=int(n_acc.sum()),
+                              emitted=int(n_emit.sum()),
+                              slots=len(decode_rids))
+        if self._trace is not None:
+            self._trace.span("spec_draft", dur=draft_s, k=self.spec_k,
+                             slots=len(decode_rids),
+                             replica_id=self.replica_id)
+            for s in range(self.B):
+                if self.active[s]:
+                    extra = {} if dev_s is None \
+                        else {"device_dur": round(dev_s, 6)}
+                    self._trace_emit(
+                        self.slot_req[s], "spec_verify", dur=verify_s,
+                        accepted=int(n_acc[s]), emitted=int(n_emit[s]),
+                        k=self.spec_k, **extra)
+        return out, n_emit
+
+    def _spec_any(self) -> bool:
+        """True when at least one ACTIVE slot participates in the
+        spec pipeline — with every active request opted out (the
+        quarantine fallback), the plain chunk step is strictly better
+        (one device call, `chunk` tokens per slot) than a vacuous
+        draft+verify pair emitting one."""
+        return any(self.active[s] and self.slot_req[s] not in
+                   self._no_spec for s in range(self.B))
+
+    def _emit_spec(self, decoding, out, n_emit) -> None:
+        """Deliver one spec tick's emitted tokens (the host mirror of
+        the device stopping rule) and retire finished slots."""
+        for slot in decoding:
+            rid = self.slot_req[slot]
+            for j in range(int(n_emit[slot])):
+                self.outputs[rid].append(int(out[slot, j]))
+                self.budget[slot] -= 1
+            o = self.outputs[rid]
+            done = (self.budget[slot] <= 0
+                    or (self.eos is not None and o
+                        and o[-1] == self.eos)
+                    or (self.stop[slot] >= 0 and o
+                        and o[-1] == self.stop[slot]))
+            if done:
+                self._retire(slot)
+
     def step(self):
         """Admit what fits, then run ONE device chunk — fused with up to
         one admission-prefill unit when slots are decoding, plain decode
@@ -2167,6 +2642,18 @@ class ContinuousBatcher:
             # must not read this chunk's token rows — they were inactive
             # (masked) rows during the call itself
             decoding = [s for s in range(self.B) if self.active[s]]
+            if self.speculative and not self._fuse_now() \
+                    and self._spec_any():
+                # speculative tick: draft + verify emit up to spec_k+1
+                # tokens per slot. Admission pressure still rides the
+                # PR 5 fused path (the `_fuse_now` tick above runs a
+                # plain chunk + piggybacked prefill — greedy tokens
+                # are schedule-invariant, so mixing the two step kinds
+                # never changes output)
+                out, n_emit = self._step_spec()
+                self._emit_spec(decoding, out, n_emit)
+                self._admit()
+                return self._drain_emitted()
             if self._fuse_now():
                 toks = self._step_fused()
             else:
@@ -2174,7 +2661,7 @@ class ContinuousBatcher:
                 self._record_tick(
                     "decode", rids=decode_rids,
                     compile_hit=(self.chunk, self.attention_impl)
-                    + self._qkey in self._chunk_cache)
+                    + self._skey + self._qkey in self._chunk_cache)
                 self._gate("decode", decode_rids)
                 if self._dev_state is None:
                     self._dev_state = self._upload_slot_state()
@@ -2215,6 +2702,12 @@ class ContinuousBatcher:
                 if done:
                     self._retire(slot)
             self._admit()
+        return self._drain_emitted()
+
+    def _drain_emitted(self):
+        """The step() return contract: (emitted rid -> new tokens,
+        finished rids) off the delivery bookkeeping — shared by the
+        chunk, fused and speculative step kinds."""
         emitted: Dict[int, List[int]] = {}
         for rid, n in list(self._delivered.items()):
             out = self.outputs.get(rid)

@@ -48,7 +48,13 @@ gauges, `slo_breaches_total` counters, fleet rollup in the Router),
 `profiling` (sampled device-time attribution: every Nth step fenced
 with block_until_ready into per-shape device-wall histograms, plus
 on-demand capture windows whose device spans land in the trace
-timelines).
+timelines), `speculative` (self-speculative decoding config +
+acceptance accounting: the draft-and-verify pipeline behind
+`ServingEngine(speculative=True, spec_k=, draft_layers=)` — a
+truncated-layer draft proposes k tokens, the target verifies all k+1
+positions in one paged call and commits only accepted rows, so greedy
+output is provably identical to plain decode while tokens/step
+multiplies).
 """
 from __future__ import annotations
 
@@ -61,6 +67,7 @@ from .request import (  # noqa: F401
 )
 from .profiling import StepProfiler  # noqa: F401
 from .scheduler import AdmissionQueue, QueueFullError  # noqa: F401
+from .speculative import SpecConfig, SpecStats  # noqa: F401
 from .slo import SloTracker, DEFAULT_OBJECTIVES  # noqa: F401
 from .trace import TraceSink, FlightRecorder  # noqa: F401
 
@@ -72,6 +79,7 @@ __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "TraceSink", "FlightRecorder",
     "SloTracker", "StepProfiler",
+    "SpecConfig", "SpecStats",
     "FaultInjector", "InjectedFault",
     "PrefixCacheIndex", "RefcountingBlockAllocator",
     "ContinuousBatcher", "PagedKVCache",

@@ -52,6 +52,15 @@ state, as JSON — automatically when a device step raises
 `MetricsRegistry.to_prometheus()` renders the same metrics snapshot()
 reads in the Prometheus text format.
 
+Speculative decoding (serving.speculative / nlp.paged): with
+`speculative=True` the batcher drafts `spec_k` tokens off a truncated
+layer stack and the target verifies all k+1 positions in one paged
+call, committing only accepted rows — greedy output identical to
+plain decode, tokens/step multiplied. A FAILED spec tick quarantines
+normally and its surviving requests re-admit opted out of the spec
+pipeline (plain decode). Acceptance accounting rides
+`snapshot()["speculative"]` and the spec_* gauges.
+
 SLOs & device-time attribution (serving.slo / serving.profiling): an
 in-process `SloTracker` watches declarative latency/goodput/error
 objectives over dual rolling windows — burn rates and OK/WARN/BREACH
@@ -133,6 +142,8 @@ class ServingEngine:
                  attention_impl: str = "auto",
                  weight_dtype: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
+                 speculative: bool = False, spec_k: int = 4,
+                 draft_layers: Optional[int] = None,
                  warmup: bool = False,
                  trace: bool = True, flight_recorder_cap: int = 64,
                  flight_dump_path: Optional[str] = None,
@@ -178,6 +189,8 @@ class ServingEngine:
             fused_prefill=fused_prefill, fused_units=fused_units,
             attention_impl=attention_impl,
             weight_dtype=weight_dtype, kv_dtype=kv_dtype,
+            speculative=speculative, spec_k=spec_k,
+            draft_layers=draft_layers,
             trace=self.trace,
             flight_recorder_cap=flight_recorder_cap,
             profile_sample_every=profile_sample_every,
@@ -191,6 +204,7 @@ class ServingEngine:
         self.attention_impl = self.batcher.attention_impl
         self.weight_dtype = self.batcher.weight_dtype
         self.kv_dtype = self.batcher.kv_dtype
+        self.speculative = self.batcher.speculative
         self.metrics = metrics or MetricsRegistry()
         self._clock = clock
         self._idle_poll_s = idle_poll_s
@@ -296,6 +310,12 @@ class ServingEngine:
         self._g_weight_bytes = m.gauge("weight_bytes")
         self._g_kv_pool_bytes.set(self.batcher.kv_pool_bytes())
         self._g_weight_bytes.set(self.batcher.weight_bytes())
+        # speculative-decoding surface: acceptance accounting per
+        # verify sweep (flat zeros with spec off — exposition stable)
+        self._g_spec_steps = m.gauge("spec_steps")
+        self._g_spec_accept = m.gauge("spec_accept_rate")
+        self._g_spec_tps = m.gauge("spec_tokens_per_step")
+        self._g_spec_accepted = m.gauge("spec_accepted_tokens")
         # fault-tolerance surface: the counters health() aggregates
         self._c_step_faults = m.counter("step_faults")
         self._c_quarantines = m.counter("quarantines")
@@ -555,6 +575,9 @@ class ServingEngine:
                 "kv_block_bytes": b.kv_block_bytes(),
                 "kv_bytes_per_token": b.kv_bytes_per_token(),
             }
+            # speculative decoding: resolved config + acceptance
+            # accounting (enabled False and zeros when decoding plain)
+            snap["speculative"] = b.spec_stats()
             # operators must notice missing forensics: the last failed
             # flight-dump disk write (None when every write landed)
             snap["last_flight_dump_error"] = self._last_dump_error
@@ -925,7 +948,12 @@ class ServingEngine:
             rid = b.submit(self._effective(req),
                            stop_token_id=req.stop_token_id,
                            max_new_tokens=req.max_new_tokens
-                           - len(req.tokens))
+                           - len(req.tokens),
+                           # quarantine's plain-decode fallback: a
+                           # request that rode a failed spec tick
+                           # re-admits opted out of the spec pipeline
+                           speculative=False if req.spec_opt_out
+                           else None)
             req.request_id = rid
             req.state = RequestState.PREFILL
             if self.trace is not None and req.trace_id is not None:
@@ -1081,8 +1109,14 @@ class ServingEngine:
         if mode == "fused":
             suspects = list(rec.get("decode_rids", [])) + \
                 [r for u in rec.get("units", []) for r in u]
-        else:                       # "decode" | "prefill" both use rids
+        else:       # "decode" | "prefill" | "spec_*" all carry rids
             suspects = list(rec.get("rids", []))
+        # a FAILED speculative tick indicts the spec pipeline for the
+        # requests riding it: every survivor (requeued victim or
+        # retried culprit) falls back to plain decode on re-admission
+        # — the draft/verify pair must not get a second chance to
+        # poison the same request's recovery
+        spec_tick = str(mode or "").startswith("spec")
         with self._lock:
             self._c_step_faults.inc()
             self._c_quarantines.inc()
@@ -1126,6 +1160,8 @@ class ServingEngine:
                 b.abort(rid)
                 b.release(rid)
                 self._last_emit.pop(rid, None)
+                if spec_tick:
+                    req.spec_opt_out = True
                 if rid in culprits:
                     self._retry_or_fail_locked(req, culprits[rid],
                                                convicted)
@@ -1137,7 +1173,8 @@ class ServingEngine:
                 if self.trace is not None and req.trace_id is not None:
                     self.trace.emit(req.trace_id, "requeued",
                                     reason="quarantine_victim",
-                                    tokens_kept=len(req.tokens))
+                                    tokens_kept=len(req.tokens),
+                                    spec_fallback=spec_tick)
             self.queue.requeue(victims)
             self._update_gauges_locked()
             self._work.notify_all()
@@ -1294,6 +1331,11 @@ class ServingEngine:
         self._g_fused_units.set(self.batcher.fused_unit_count)
         self._g_decode_stalls.set(self.batcher.decode_stall_steps)
         self._g_kv_cached_bytes.set(self.batcher.kv_cached_bytes())
+        sp = self.batcher.spec
+        self._g_spec_steps.set(sp.steps)
+        self._g_spec_accept.set(sp.accept_rate())
+        self._g_spec_tps.set(sp.tokens_per_step())
+        self._g_spec_accepted.set(sp.accepted)
         if pc.get("enabled"):
             self._g_pc_hit_tokens.set(pc["hit_tokens"])
             self._g_pc_hit_rate.set(pc["hit_rate"])

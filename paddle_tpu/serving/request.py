@@ -122,6 +122,11 @@ class GenerationRequest:
         self.error: Optional[BaseException] = None
         self.finish_reason: Optional[str] = None
         self.retries = 0          # transient-culprit re-admissions used
+        # quarantine's plain-decode fallback: set when this request
+        # rode a FAILED speculative tick — its re-admissions opt out
+        # of the spec pipeline (the convicted spec step must not get a
+        # second chance to poison the same request's recovery)
+        self.spec_opt_out = False
 
         # engine-stamped timeline (engine clock, typically time.monotonic)
         self.request_id: Optional[int] = None       # batcher rid once admitted

@@ -77,12 +77,19 @@ __all__ = ["Router", "NoReplicaAvailable", "default_policy"]
 # AFFINITY_BLOCK_CAP so a long warm prefix cannot justify an unbounded
 # queue), and a DEGRADED replica pays DEGRADED_PENALTY — larger than
 # the affinity cap, so a healthy cold replica always outranks a
-# degraded warm one.
+# degraded warm one. A replica whose SLO verdict is WARN/BREACH pays
+# SLO_WARN_PENALTY/SLO_BREACH_PENALTY — sized BETWEEN the occupancy
+# weights and DEGRADED_PENALTY, so the policy steers load away from a
+# burning replica before supervision has to act, but a breaching
+# replica still outranks a DEGRADED one (SLOs degrade, health
+# decides) and still serves when it is the only one left.
 QUEUE_PENALTY = 0.5
 UTIL_PENALTY = 2.0
 AFFINITY_BLOCK_SCORE = 1.0
 AFFINITY_BLOCK_CAP = 8
 DEGRADED_PENALTY = 16.0
+SLO_WARN_PENALTY = 4.0
+SLO_BREACH_PENALTY = 10.0
 
 _HEALTH_ORDER = {"HEALTHY": 0, "DEGRADED": 1, "UNHEALTHY": 2}
 
@@ -98,15 +105,24 @@ def default_policy(view: Dict[str, Any]) -> float:
     """Score one replica for one request (higher = better). `view` is
     the merged `engine.load()` + `engine.health()["status"]` dict plus
     `affinity_blocks`/`affinity_tokens` from the router's prefix index
-    (UNHEALTHY replicas never reach the policy — the router
-    hard-excludes them first). The default trades occupancy against
-    prefix warmth: an affinity block outweighs up to two queued
-    requests, a DEGRADED state outweighs the whole affinity cap.
-    Replace with any callable of the same shape via
+    and `slo_verdict` (the replica's worst-of SLO verdict, "OK" when
+    SLO tracking is off; UNHEALTHY replicas never reach the policy —
+    the router hard-excludes them first). The default trades occupancy
+    against prefix warmth: an affinity block outweighs up to two
+    queued requests, a DEGRADED state outweighs the whole affinity
+    cap, and a WARN/BREACH SLO verdict sits between the two — the
+    policy sheds load off a burning replica before it degrades, yet a
+    breaching replica still beats a DEGRADED one and still serves
+    alone. Replace with any callable of the same shape via
     `Router(policy=...)`."""
     score = 0.0
     if view["status"] == "DEGRADED":
         score -= DEGRADED_PENALTY
+    verdict = view.get("slo_verdict") or "OK"
+    if verdict == "BREACH":
+        score -= SLO_BREACH_PENALTY
+    elif verdict == "WARN":
+        score -= SLO_WARN_PENALTY
     score -= QUEUE_PENALTY * (view["queue_depth"] + view["in_flight"]
                               + view["parked_retries"])
     score -= UTIL_PENALTY * view["kv_utilization"]
@@ -261,16 +277,21 @@ class Router:
     predicate is pluggable via `failover_on`). Backpressure: when every
     replica refuses admission, `submit()` raises `NoReplicaAvailable`.
 
-    `auto_restart=True` (router-built replicas only) attaches a
+    `auto_restart=True` attaches a
     `serving.supervisor.ReplicaSupervisor`: an UNHEALTHY replica is
     torn down and respawned in its slot behind a readiness gate, with
     backoff + a crash-loop circuit breaker — knobs via
-    `restart_opts={...}` (see `ReplicaSupervisor`). Requests stranded
-    mid-restart ride the normal cross-replica failover.
+    `restart_opts={...}` (see `ReplicaSupervisor`). The rebuild recipe
+    is the router's retained params/cfg/per-replica overrides for
+    router-built replicas, or `engine_factory=` (a callable
+    `i -> unstarted engine stamped replica_id=f"r{{i}}"`) — the hook
+    that lets prebuilt `engines=` replicas respawn too. Requests
+    stranded mid-restart ride the normal cross-replica failover.
     """
 
     def __init__(self, params=None, cfg=None, *, replicas: int = 2,
                  engines: Optional[Sequence] = None,
+                 engine_factory: Optional[Callable[[int], Any]] = None,
                  policy: Optional[Callable[[Dict], float]] = None,
                  failover: bool = True,
                  max_failovers: Optional[int] = None,
@@ -292,11 +313,29 @@ class Router:
         self._engine_kwargs = dict(engine_kwargs)
         self._per_replica = (list(per_replica)
                              if per_replica is not None else None)
+        # the PR 12 gap closed: `engine_factory(i)` is a pluggable
+        # rebuild recipe — an UNSTARTED engine for slot i (it must
+        # stamp replica_id=f"r{i}"; _build_replica enforces it).
+        # Prebuilt engines= replicas can respawn through it, and when
+        # given it also builds the initial fleet (engines=None,
+        # params/cfg not required).
+        self._engine_factory = engine_factory
+        if engine_factory is not None and (engine_kwargs
+                                           or per_replica is not None):
+            # the factory IS the whole recipe — kwargs/overrides would
+            # be silently dropped (it never reads them), so a fleet
+            # "configured" that way must fail loudly at construction
+            raise ValueError(
+                "engine kwargs / per_replica do not apply with "
+                "engine_factory= — fold the configuration into the "
+                "factory itself")
         if engines is None:
-            if params is None or cfg is None:
+            if (params is None or cfg is None) \
+                    and engine_factory is None:
                 raise ValueError(
-                    "Router needs either prebuilt engines= or "
-                    "params+cfg to build replicas from")
+                    "Router needs prebuilt engines=, an "
+                    "engine_factory=, or params+cfg to build "
+                    "replicas from")
             if replicas < 1:
                 raise ValueError("replicas must be >= 1")
             engines = [self._build_replica(i)
@@ -306,11 +345,12 @@ class Router:
                 raise ValueError(
                     "engine kwargs only apply when the Router builds "
                     "the replicas itself (engines= was given)")
-            if auto_restart:
+            if auto_restart and engine_factory is None:
                 raise ValueError(
-                    "auto_restart needs the Router to own the rebuild "
-                    "recipe — pass params+cfg (+ engine kwargs), not "
-                    "prebuilt engines=")
+                    "auto_restart needs a rebuild recipe — pass "
+                    "params+cfg (+ engine kwargs) instead of prebuilt "
+                    "engines=, or give the prebuilt replicas an "
+                    "engine_factory= to respawn through")
         self.engines: List = list(engines)
         if not self.engines:
             raise ValueError("Router needs at least one replica")
@@ -385,7 +425,20 @@ class Router:
         params/cfg/engine kwargs + per-replica overrides — used for the
         initial build AND every supervisor respawn, so a respawned
         replica is configured exactly like the one it replaces
-        (including its chaos injector, replica_id and metrics names)."""
+        (including its chaos injector, replica_id and metrics names).
+        With an `engine_factory=` the factory IS the recipe (the
+        prebuilt-engines respawn path); it must return an unstarted
+        engine stamped replica_id=f"r{i}" — a mismatched id would
+        corrupt per-replica metrics/trace attribution across the swap,
+        so it raises here instead."""
+        if self._engine_factory is not None:
+            eng = self._engine_factory(i)
+            if getattr(eng, "replica_id", None) != f"r{i}":
+                raise ValueError(
+                    f"engine_factory({i}) must stamp replica_id="
+                    f"'r{i}', got {getattr(eng, 'replica_id', None)!r}"
+                    f" — slot attribution would break across respawns")
+            return eng
         from .engine import ServingEngine         # lazy: pulls nlp tree
         kw = dict(self._engine_kwargs)
         if self._per_replica is not None and self._per_replica[i]:
@@ -566,7 +619,8 @@ class Router:
                 # warming / probing) or a breaker-pinned FAILED slot is
                 # never offered to the policy
                 continue
-            status = eng.health()["status"]
+            h = eng.health()
+            status = h["status"]
             if status == "UNHEALTHY":
                 continue
             view = eng.load()
@@ -574,6 +628,12 @@ class Router:
                 continue
             view["status"] = status
             view["replica"] = i
+            # SLO-aware routing: the replica's worst-of verdict rides
+            # the policy view ("OK" when tracking is off or the engine
+            # predates it) — evaluate() is cached per eval_every_s, so
+            # this costs a dict read per candidate, not window math
+            view["slo_verdict"] = (h.get("slo") or {}).get(
+                "verdict", "OK")
             view["affinity_tokens"] = aff.get(i, 0)
             view["affinity_blocks"] = aff.get(i, 0) // self._affinity.bs
             out.append((float(self.policy(view)), i, view))

@@ -1,0 +1,131 @@
+"""paddle_tpu.serving.speculative — self-speculative decoding: config
+validation + acceptance accounting for the draft-and-verify pipeline.
+
+The device math lives in `nlp.paged` (`ContinuousBatcher(speculative=
+True, spec_k=, draft_layers=)`); this module is the dependency-free
+host half (stdlib only, like `serving.trace` / `serving.faults`), so
+the batcher can hold the config and stats without pulling the serving
+engine.
+
+How self-speculation works (and why it needs no second weight set):
+serving decode is memory-bound — every step sweeps the full weight
+stack plus the live KV pool to emit ONE token per request. A draft
+model proposing k tokens lets the target *verify* all k+1 positions in
+one sweep instead; greedy verification accepts the longest prefix of
+draft tokens that match the target's own greedy choices, plus one
+corrected token, so the output is **provably identical to plain greedy
+decoding** — speculation changes the schedule, never the tokens. The
+draft here is the SAME model with a truncated layer stack
+(`draft_layers=d`): because layer l's KV depends only on layers < l,
+the target's committed pool layers 0..d-1 ARE the d-layer draft's KV
+cache — the draft reads them for free and no second weight set or
+cache exists.
+
+The verify-then-commit invariant: neither the draft nor the verify's
+scoring pass writes the KV pool. Proposed tokens' per-layer K/V ride
+an in-register slab; after acceptance is known (on device, same
+compiled call) only the accepted rows are committed — written one row
+at a time in order, so the int8 pool's grow-only per-block scales
+evolve exactly as sequential decode's would. A rejected draft token
+therefore never poisons the pool, the prefix cache, or a quantized
+block's scale.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+__all__ = ["SpecConfig", "SpecStats"]
+
+
+class SpecConfig:
+    """Validated self-speculative decoding configuration.
+
+    `k` is the draft length (tokens proposed per verify sweep; the
+    verify scores k+1 positions and emits between 1 and k+1 tokens).
+    `draft_layers` is the truncated draft depth — None drafts at full
+    depth (the draft IS the target: acceptance ~100%, useful for
+    parity tests and for benches on random-init models whose truncated
+    drafts never agree with the target)."""
+
+    def __init__(self, k: int = 4, draft_layers: Optional[int] = None,
+                 *, num_layers: Optional[int] = None):
+        self.k = int(k)
+        if self.k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {k}")
+        if draft_layers is None:
+            self.draft_layers = None
+        else:
+            self.draft_layers = int(draft_layers)
+            if self.draft_layers < 1:
+                raise ValueError(
+                    f"draft_layers must be >= 1, got {draft_layers}")
+            if num_layers is not None and self.draft_layers > num_layers:
+                raise ValueError(
+                    f"draft_layers {self.draft_layers} exceeds the "
+                    f"model's {num_layers} layers")
+
+    def depth(self, num_layers: int) -> int:
+        """The draft's resolved layer count (None -> full depth)."""
+        return num_layers if self.draft_layers is None \
+            else self.draft_layers
+
+    def key(self, num_layers: int) -> tuple:
+        """The spec-config element of every compiled-shape memo key:
+        a spec batcher's executables must never be confused with a
+        plain one's (zero post-warmup recompiles is gated per config)."""
+        return ("spec", self.k, self.depth(num_layers))
+
+    def as_dict(self, num_layers: Optional[int] = None) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"k": self.k,
+                             "draft_layers": self.draft_layers}
+        if num_layers is not None:
+            d["draft_depth"] = self.depth(num_layers)
+        return d
+
+
+class SpecStats:
+    """Host-side acceptance accounting for the spec pipeline (updated
+    once per verify step from already-host values — no device syncs).
+
+    `drafted` counts draft proposals, `accepted` the proposals the
+    target's greedy verification kept, `emitted` the tokens actually
+    landed per verify sweep (accepted prefix + the corrected token,
+    truncated by budget / eos) — `tokens_per_step` > 1 is the whole
+    point of speculation, `accept_rate` is the draft-quality signal."""
+
+    def __init__(self):
+        self.steps = 0          # verify sweeps executed
+        self.slot_sweeps = 0    # (sweep, active slot) pairs
+        self.drafted = 0        # draft tokens proposed
+        self.accepted = 0       # draft tokens the target accepted
+        self.emitted = 0        # tokens emitted by verify sweeps
+
+    def record_step(self, drafted: int, accepted: int, emitted: int,
+                    slots: int = 1) -> None:
+        """Fold one verify sweep's counts in (host ints only);
+        `slots` = active slots the sweep decoded."""
+        self.steps += 1
+        self.slot_sweeps += int(slots)
+        self.drafted += int(drafted)
+        self.accepted += int(accepted)
+        self.emitted += int(emitted)
+
+    def accept_rate(self) -> float:
+        """Accepted / drafted (0.0 before any draft ran)."""
+        return self.accepted / self.drafted if self.drafted else 0.0
+
+    def tokens_per_step(self) -> float:
+        """Tokens emitted per (sweep, slot) — directly comparable to
+        plain decode's 1.0 per slot per step; the >1 multiplier the
+        bench's --speculative gate asserts."""
+        return self.emitted / self.slot_sweeps if self.slot_sweeps \
+            else 0.0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "steps": self.steps, "slot_sweeps": self.slot_sweeps,
+            "drafted": self.drafted,
+            "accepted": self.accepted, "emitted": self.emitted,
+            "accept_rate": round(self.accept_rate(), 4),
+            "tokens_per_step": round(self.tokens_per_step(), 4),
+        }

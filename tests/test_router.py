@@ -72,10 +72,12 @@ class _StubEngine:
     plus a submit() that records what the router sent it."""
 
     def __init__(self, replica_id, status="HEALTHY", queue_depth=0,
-                 in_flight=0, util=0.0, accepting=True, full=False):
+                 in_flight=0, util=0.0, accepting=True, full=False,
+                 slo=None):
         self.replica_id = replica_id
         self.trace = None
         self._status = status
+        self._slo = slo            # worst-of SLO verdict ("OK"/...)
         self._load = {"replica_id": replica_id, "queue_depth": queue_depth,
                       "in_flight": in_flight, "parked_retries": 0,
                       "kv_utilization": util, "accepting": accepting}
@@ -83,7 +85,10 @@ class _StubEngine:
         self.submitted = []
 
     def health(self):
-        return {"status": self._status, "replica_id": self.replica_id}
+        h = {"status": self._status, "replica_id": self.replica_id}
+        if self._slo is not None:
+            h["slo"] = {"verdict": self._slo}
+        return h
 
     def load(self):
         return dict(self._load)
@@ -171,6 +176,55 @@ class TestRoutingPolicy:
                                        affinity_tokens=32))
         assert warm > idle > busy
         assert idle > degraded      # health outweighs full affinity cap
+
+    def test_slo_breach_penalized_but_still_serves(self):
+        """SLO-aware routing (PR 13 follow-on): a BREACHing replica
+        loses to a busier OK one (the policy sheds load off the burn
+        before supervision acts), but still serves when alone."""
+        burning_idle = _StubEngine("r0", slo="BREACH")
+        healthy_busy = _StubEngine("r1", queue_depth=4, in_flight=2,
+                                   slo="OK")
+        r = Router(engines=[burning_idle, healthy_busy],
+                   affinity_block_size=4, start=True)
+        # SLO_BREACH_PENALTY 10 > 6 requests * QUEUE_PENALTY 0.5
+        assert self._route_once(r, [1, 2, 3, 4]) == "r1"
+        r.shutdown(drain=False)
+        alone = Router(engines=[_StubEngine("r0", slo="BREACH")],
+                       affinity_block_size=4, start=True)
+        assert self._route_once(alone, [1, 2, 3, 4]) == "r0"
+        alone.shutdown(drain=False)
+
+    def test_slo_warn_between_occupancy_and_degraded(self):
+        """The penalty ladder: WARN > a small queue, BREACH > WARN,
+        DEGRADED > BREACH — and a replica without SLO tracking scores
+        as OK (no penalty)."""
+        from paddle_tpu.serving.router import (
+            SLO_WARN_PENALTY, SLO_BREACH_PENALTY, DEGRADED_PENALTY,
+            QUEUE_PENALTY)
+        assert QUEUE_PENALTY * 4 < SLO_WARN_PENALTY \
+            < SLO_BREACH_PENALTY < DEGRADED_PENALTY
+        base = {"status": "HEALTHY", "queue_depth": 0, "in_flight": 0,
+                "parked_retries": 0, "kv_utilization": 0.0,
+                "affinity_blocks": 0, "affinity_tokens": 0}
+        ok = default_policy(dict(base, slo_verdict="OK"))
+        untracked = default_policy(dict(base))
+        warn = default_policy(dict(base, slo_verdict="WARN"))
+        breach = default_policy(dict(base, slo_verdict="BREACH"))
+        degraded = default_policy(dict(base, status="DEGRADED",
+                                       slo_verdict="OK"))
+        busy = default_policy(dict(base, queue_depth=4))
+        assert ok == untracked
+        assert ok > busy > warn > breach > degraded
+
+    def test_views_carry_slo_verdict(self):
+        """_views feeds the policy the replica's worst-of verdict
+        ("OK" when the stub reports no slo dict)."""
+        stubs = [_StubEngine("r0", slo="WARN"), _StubEngine("r1")]
+        r = Router(engines=stubs, affinity_block_size=4, start=False)
+        views = {i: v for _, i, v in r._views([1, 2, 3, 4], ())}
+        assert views[0]["slo_verdict"] == "WARN"
+        assert views[1]["slo_verdict"] == "OK"
+        r.shutdown(drain=False)
 
     def test_affinity_index_bound_and_repoint(self):
         idx = _AffinityIndex(block_size=2, cap=4)

@@ -157,6 +157,7 @@ class TestUnits:
         assert eng.health()["ready"] is False
 
     def test_auto_restart_rejects_prebuilt_engines(self):
+        # without an engine_factory there is no rebuild recipe
         with pytest.raises(ValueError):
             Router(engines=[object()], auto_restart=True)
 
@@ -172,6 +173,149 @@ class TestUnits:
                                     "fault_streak_engine_unhealthy")
         assert _default_failover_on(req, err, "watchdog_hung_step")
         assert not _default_failover_on(req, err, "decode_step_raised")
+
+
+def _stub_engine_cls():
+    class _Stub:
+        """Minimal router-shaped engine for factory plumbing units."""
+
+        def __init__(self, rid):
+            self.replica_id = rid
+            self.trace = None
+
+        def health(self):
+            return {"status": "HEALTHY", "replica_id": self.replica_id}
+
+        def load(self):
+            return {"replica_id": self.replica_id, "queue_depth": 0,
+                    "in_flight": 0, "parked_retries": 0,
+                    "kv_utilization": 0.0, "accepting": True}
+
+        def start(self):
+            return self
+
+        def shutdown(self, drain=True, timeout=None):
+            return True
+
+    return _Stub
+
+
+class TestEngineFactory:
+    def test_prebuilt_engines_accept_factory_for_auto_restart(self):
+        """The PR 12 gap: prebuilt engines= + auto_restart raises
+        without a rebuild recipe, but an engine_factory= IS one."""
+        Stub = _stub_engine_cls()
+        with pytest.raises(ValueError):
+            Router(engines=[Stub("r0")], auto_restart=True)
+        r = Router(engines=[Stub("r0")], auto_restart=True,
+                   engine_factory=lambda i: Stub(f"r{i}"), start=False)
+        assert r._supervisor is not None
+        r.shutdown(drain=False)
+
+    def test_factory_replica_id_enforced(self):
+        """A factory engine with the wrong replica_id would corrupt
+        per-slot metrics/trace attribution across the swap — rejected
+        at build time."""
+        Stub = _stub_engine_cls()
+        r = Router(engines=[Stub("r0")],
+                   engine_factory=lambda i: Stub("nope"), start=False)
+        with pytest.raises(ValueError):
+            r._build_replica(0)
+        r.shutdown(drain=False)
+
+    def test_factory_rejects_engine_kwargs(self):
+        """engine kwargs / per_replica would be silently dropped by a
+        factory build (the factory never reads them) — loud failure
+        at construction instead."""
+        Stub = _stub_engine_cls()
+        with pytest.raises(ValueError):
+            Router(engine_factory=lambda i: Stub(f"r{i}"), replicas=1,
+                   max_batch=2, start=False)
+        with pytest.raises(ValueError):
+            Router(engines=[Stub("r0")],
+                   engine_factory=lambda i: Stub(f"r{i}"),
+                   per_replica=[{}], start=False)
+
+    def test_factory_builds_initial_fleet(self):
+        """engines=None + engine_factory builds the fleet through the
+        factory (params/cfg not required)."""
+        Stub = _stub_engine_cls()
+        calls = []
+
+        def fact(i):
+            calls.append(i)
+            return Stub(f"r{i}")
+
+        r = Router(engine_factory=fact, replicas=2, start=False)
+        assert calls == [0, 1]
+        assert [e.replica_id for e in r.engines] == ["r0", "r1"]
+        r.shutdown(drain=False)
+
+    def test_prebuilt_respawn_through_factory(self, setup):
+        """E2e: a prebuilt replica killed by the watchdog respawns
+        THROUGH the factory, passes the readiness gate, rejoins and
+        serves — the respawn that used to be impossible for
+        engines=."""
+        cfg, params = setup
+        injs = [FaultInjector(seed=0), FaultInjector(seed=1)]
+        factory_calls = []
+
+        def build(i):
+            return serving.ServingEngine(
+                params, cfg, max_batch=2, block_size=4,
+                max_total_len=48, max_new_tokens=MAX_NEW, chunk=3,
+                max_queue_depth=32, max_prefill_bucket=16,
+                watchdog_s=2.0, fault_injector=injs[i],
+                replica_id=f"r{i}", start=False)
+
+        def fact(i):
+            factory_calls.append(i)
+            return build(i)
+
+        r = Router(engines=[build(0), build(1)], auto_restart=True,
+                   engine_factory=fact,
+                   restart_opts={"backoff_s": 0.05, "poll_s": 0.02,
+                                 "probe_timeout_s": 120.0},
+                   start=False)
+        r.warmup()
+        r.start()
+        armed = threading.Event()
+        ready = threading.Event()
+        reqs = []
+
+        def on_token(t):
+            if not armed.is_set():
+                armed.set()
+                ready.wait(30)
+                inj = injs[int(reqs[0].replica_id[1:])]
+                c = inj.stats()["calls"]
+                for k in range(1, 6):
+                    inj.hang_on_step(c + k, 8.0)
+
+        reqs.append(r.submit(PROMPTS[0], on_token=on_token))
+        for p in PROMPTS[1:3]:
+            reqs.append(r.submit(p))
+        ready.set()
+        outs = [q.result(300) for q in reqs]
+        assert all(outs) and armed.is_set()
+        for inj in injs:
+            inj.heal()           # the respawn probe must run clean
+        deadline = time.monotonic() + 240
+        h = r.health()
+        while time.monotonic() < deadline:
+            h = r.health()
+            if h["serving_replicas"] == 2 \
+                    and h["replica_restarts"] >= 1:
+                break
+            time.sleep(0.05)
+        assert h["replica_restarts"] >= 1, h
+        assert h["serving_replicas"] == 2
+        assert factory_calls, "respawn bypassed the engine_factory"
+        dead = factory_calls[0]
+        assert h["supervisor"][f"r{dead}"]["state"] == "SERVING"
+        post = r.submit(list(PROMPTS[3]), max_new_tokens=2)
+        assert post.result(300)
+        assert r.shutdown()
 
 
 class TestSelfHealingE2E:

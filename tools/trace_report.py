@@ -80,6 +80,7 @@ def summarize(events) -> dict:
         "pad_tokens": 0, "real_tokens": 0, "cached_tokens": 0,
         "generated": 0, "requeues": 0, "retries": 0, "kv_bytes": 0,
         "replica": None, "failovers": 0, "device_ms": None,
+        "spec_steps": 0, "spec_accepted": 0, "spec_emitted": 0,
         "first_ts": None, "last_ts": None,
     })
     steps = {"count": 0, "total_ms": 0.0}
@@ -90,6 +91,10 @@ def summarize(events) -> dict:
     # replica-scoped (not request-scoped) churn: supervisor restart
     # events ride the engine sinks' span lane with no trace_id
     restarts = {"restarting": 0, "restarted": 0}
+    # speculative decoding: spec_draft spans are engine-scoped (one
+    # per tick), spec_verify events are per-request with accepted
+    # counts — the accepted-per-step column comes from the latter
+    spec_draft_spans = 0
     # SLO verdict transitions (engine-scoped spans, no trace_id):
     # paired breach→recovered edges become breach windows below
     slo_edges = []
@@ -114,6 +119,9 @@ def summarize(events) -> dict:
             continue
         if name in ("restarting", "restarted"):
             restarts[name] += 1
+            continue
+        if name == "spec_draft":
+            spec_draft_spans += 1
             continue
         tid = args.get("trace_id")
         if tid is None:
@@ -163,6 +171,15 @@ def summarize(events) -> dict:
                     + args["device_dur"] * 1e3
         elif name == "first_token":
             r["first_token_ts"] = ts
+        elif name == "spec_verify":
+            r["spec_steps"] += 1
+            r["spec_accepted"] += args.get("accepted", 0)
+            r["spec_emitted"] += args.get("emitted", 0)
+            # a capture window's fenced spec ticks carry device wall
+            # exactly like fenced prefill chunks do
+            if args.get("device_dur") is not None:
+                r["device_ms"] = (r["device_ms"] or 0.0) \
+                    + args["device_dur"] * 1e3
         elif name == "retired":
             r["generated"] = args.get("generated", 0)
         elif name == "requeued":
@@ -199,6 +216,13 @@ def summarize(events) -> dict:
             "pad_tokens": r["pad_tokens"],
             "requeues": r["requeues"], "retries": r["retries"],
             "kv_bytes": r["kv_bytes"],
+            "spec_steps": r["spec_steps"],
+            "spec_accepted": r["spec_accepted"],
+            # accepted DRAFT tokens per verify sweep (the emitted
+            # count adds the corrected token on top — the engine's
+            # tokens_per_step); None when it never rode a spec tick
+            "acc_per_step": (round(r["spec_accepted"] / r["spec_steps"],
+                                   2) if r["spec_steps"] else None),
         })
     # (len, str) sorts t2 before t10 — ids are a prefix plus a
     # monotonic sequence number, so length order IS numeric order
@@ -229,6 +253,18 @@ def summarize(events) -> dict:
         "failover_events": sum(x["failovers"] for x in rows),
         "restart_events": restarts["restarted"],
         "restarting_events": restarts["restarting"],
+        "spec_draft_spans": spec_draft_spans,
+        "spec_verify_steps": sum(x["spec_steps"] for x in rows),
+        "spec_accepted_tokens": sum(x["spec_accepted"] for x in rows),
+        # per (sweep, request): accepted DRAFT tokens, and total
+        # tokens landed (accepted + the corrected one — comparable to
+        # plain decode's 1.0); both 0.0 for a plain-decode artifact
+        "accepted_per_step": round(
+            sum(x["spec_accepted"] for x in rows)
+            / max(1, sum(x["spec_steps"] for x in rows)), 4),
+        "spec_tokens_per_step": round(
+            sum(r["spec_emitted"] for r in per_req.values())
+            / max(1, sum(x["spec_steps"] for x in rows)), 4),
         "replicas": dict(sorted(Counter(
             x["replica"] for x in rows
             if x["replica"] is not None).items())),
@@ -313,6 +349,10 @@ def render(summary: dict, show_slo: bool = False) -> str:
         f"{t['retried_events']} retries, "
         f"{t['failover_events']} failovers, "
         f"{t['restart_events']} restarts",
+        f"speculative: {t.get('spec_verify_steps', 0)} verify steps, "
+        f"{t.get('spec_accepted_tokens', 0)} accepted "
+        f"({t.get('accepted_per_step', 0.0)} accepted/step, "
+        f"{t.get('spec_tokens_per_step', 0.0)} tokens/step)",
         f"replicas: {t['replicas'] or '-'}",
         f"quantization: weights {t['weight_dtype'] or '-'}, "
         f"kv {t['kv_dtype'] or '-'}  kv bytes admitted: "
@@ -323,7 +363,7 @@ def render(summary: dict, show_slo: bool = False) -> str:
             "generated", "queue_wait_ms", "ttft_ms", "decode_ms",
             "prefill_ms", "device_ms", "chunks", "fused_chunks",
             "cached_tokens", "pad_tokens", "requeues", "retries",
-            "failovers", "kv_bytes"]
+            "failovers", "acc_per_step", "kv_bytes"]
     # old artifacts may predate a column: .get keeps the report
     # rendering instead of KeyError-crashing on missing fields
     rows = [[_fmt(r.get(c)) for c in cols] for r in summary["requests"]]
