@@ -3,13 +3,20 @@
 
 PY ?= python
 
-.PHONY: lint test baseline lint-all bench-smoke
+.PHONY: lint test baseline lint-all lint-hot-report bench-smoke
 
+# --format github under Actions so findings annotate the PR diff;
+# --time-budget keeps the gate honest about staying per-push fast
+# (the call-graph engine must never turn lint into a coffee break)
 lint:           ## ratcheted static analysis (fails on non-baselined findings)
-	$(PY) tools/ptlint.py --format json
+	$(PY) tools/ptlint.py --time-budget 10 \
+		--format $(if $(GITHUB_ACTIONS),github,json)
 
 lint-all:       ## every finding, baseline ignored (burn-down worklist)
 	$(PY) tools/ptlint.py --no-baseline
+
+lint-hot-report: ## derived SYNC001 hot set + dead seed roots (non-blocking)
+	$(PY) tools/ptlint.py --hot-report
 
 baseline:       ## rewrite tools/ptlint_baseline.json (should only shrink)
 	$(PY) tools/ptlint.py --update-baseline

@@ -45,16 +45,35 @@ def load(path: str) -> Dict[str, int]:
 
 
 def save(path: str, findings: Iterable[Finding]) -> Dict[str, int]:
-    """Write the baseline covering exactly `findings`; returns the map."""
+    """Write the baseline covering exactly `findings`; returns the map.
+
+    A hand-written "justifications" map (fingerprint -> one-line reason
+    a finding was ratcheted instead of fixed) survives the rewrite,
+    pruned to fingerprints that still exist — deferring a finding
+    without saying why is what the map is there to prevent."""
     counts: Dict[str, int] = {}
     for f in findings:
         counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+    justifications: Dict[str, str] = {}
+    if os.path.exists(path):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                prev = json.load(fh)
+            justifications = {
+                k: str(v)
+                for k, v in (prev.get("justifications") or {}).items()
+                if k in counts}
+        except (ValueError, OSError):
+            pass        # unreadable old file: start clean
     payload = {
         "version": BASELINE_VERSION,
         "comment": ("ptlint violation ratchet — regenerate with "
                     "`python -m paddle_tpu.analysis --update-baseline` "
-                    "(should only ever shrink)"),
+                    "(should only ever shrink); ratcheted entries get a "
+                    "one-line reason in \"justifications\""),
         "fingerprints": {k: counts[k] for k in sorted(counts)},
+        "justifications": {k: justifications[k]
+                           for k in sorted(justifications)},
     }
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w", encoding="utf-8") as fh:

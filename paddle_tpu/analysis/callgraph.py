@@ -1,0 +1,367 @@
+"""paddle_tpu.analysis.callgraph — project call graph + thread model.
+
+The engine under GUARD001 (cross-thread race detection) and the SYNC001
+hot-path closure: every top-level function and method in the analyzed
+tree becomes a node, and edges are resolved through
+
+  * plain calls — `helper()`, `module.fn()`, `ClassName.method()` —
+    expanded through each file's import aliases (`ModuleAliases`);
+  * `self.method()` calls inside a class;
+  * `self.attr.method()` calls resolved through the constructor-
+    assignment type map (`self.queue = AdmissionQueue()` makes
+    `self.queue.pop()` an edge to `AdmissionQueue.pop`) — the same map
+    LOCK001 uses for cross-class lock-order edges;
+  * function REFERENCES passed as call arguments (`pop(fits=self._fits)`,
+    `sorted(key=self._key)`): the callback runs on the caller's thread,
+    usually inside the caller's locks, so a conservative caller→callee
+    edge is the right model.
+
+Thread entry points are discovered where the serving tier actually
+spawns them: `threading.Thread(target=...)`, `threading.Timer`,
+executor `.submit(fn, ...)` (receiver typed ThreadPoolExecutor or named
+like one), `asyncio.run_coroutine_threadsafe` and
+`loop.call_soon_threadsafe` (work crossing onto the event-loop thread).
+Each discovered target is a `ThreadRoot`; `reachable()` gives the
+cycle-safe transitive closure of any root set, which is how GUARD001
+decides "this method runs on the engine thread" and how SYNC001 turns
+seed roots into the full derived hot set.
+
+Like the rest of the analysis package this imports neither jax nor
+numpy. The graph is built once per run and cached on the Project
+(`build_callgraph`), shared by every rule that needs it.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from collections import deque
+from typing import Dict, Iterable, List, NamedTuple, Optional, Set, Tuple
+
+from .core import FileContext, Project
+
+__all__ = [
+    "FnKey", "ThreadRoot", "ClassIndex", "CallGraph", "build_callgraph",
+    "fn_label",
+]
+
+# (module name, enclosing class or None, function name)
+FnKey = Tuple[str, Optional[str], str]
+
+EXECUTOR_CTORS = {
+    "concurrent.futures.ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor",
+    "concurrent.futures.Executor",
+    "futures.ThreadPoolExecutor",
+}
+# `.submit(fn)` receivers that LOOK like executors when untyped
+EXECUTOR_NAME_RE = re.compile(r"(executor|thread_?pool)s?$", re.I)
+
+
+def fn_label(key: FnKey) -> str:
+    """Human-readable 'Class.method' / 'module.fn' for messages."""
+    module, cls, name = key
+    if cls:
+        return f"{cls}.{name}"
+    return f"{module.rsplit('.', 1)[-1]}.{name}"
+
+
+class ThreadRoot(NamedTuple):
+    """A function the project hands to another thread to run."""
+
+    key: FnKey
+    kind: str        # Thread(target=) | Timer | executor.submit | ...
+    path: str        # relpath of the spawn site
+    line: int
+
+
+class ClassIndex:
+    """Project-wide class registry (first definition of a name wins),
+    exposing the constructor-assignment type map — shared by LOCK001's
+    cross-class lock edges and GUARD001's cross-class field accesses.
+
+    Inheritance is part of the model: `bases` maps each class to its
+    in-tree base classes, `chain()` is the method/attr lookup order
+    (so `self.helper()` resolves into a base class and the hot-path
+    closure follows it), and `canonical()` collapses an inheritance
+    component to one representative — instances share storage across
+    the chain, so GUARD001 keys guarded fields per component, not per
+    lexical class."""
+
+    def __init__(self, project: Project):
+        self.classes: Dict[str, Tuple[FileContext, ast.ClassDef]] = {}
+        for ctx in project.files:
+            if ctx.tree is None:
+                continue
+            for node in ctx.tree.body:
+                if isinstance(node, ast.ClassDef) \
+                        and node.name not in self.classes:
+                    self.classes[node.name] = (ctx, node)
+        self.bases: Dict[str, List[str]] = {}
+        for cname, (ctx, node) in self.classes.items():
+            resolved: List[str] = []
+            for b in node.bases:
+                name = ctx.aliases.resolve(b) \
+                    if isinstance(b, (ast.Name, ast.Attribute)) else None
+                tail = name.rsplit(".", 1)[-1] if name else None
+                if tail and tail != cname and tail in self.classes:
+                    resolved.append(tail)
+            self.bases[cname] = resolved
+        # union-find over base edges, lexicographically-smallest root
+        # for determinism
+        parent = {c: c for c in self.classes}
+
+        def find(c: str) -> str:
+            while parent[c] != c:
+                parent[c] = parent[parent[c]]
+                c = parent[c]
+            return c
+
+        for cname, bs in self.bases.items():
+            for b in bs:
+                ra, rb = sorted((find(cname), find(b)))
+                if ra != rb:
+                    parent[rb] = ra
+        self._canon = {c: find(c) for c in self.classes}
+
+    def chain(self, cls: str) -> List[str]:
+        """`cls` followed by its transitive in-tree bases (DFS
+        pre-order, cycle-safe): the lookup order for inherited methods
+        and constructor-typed attrs."""
+        out: List[str] = []
+        stack = [cls]
+        while stack:
+            c = stack.pop(0)
+            if c in out:
+                continue
+            out.append(c)
+            stack = self.bases.get(c, []) + stack
+        return out
+
+    def canonical(self, cls: str) -> str:
+        """Representative of `cls`'s inheritance component — one
+        storage key per field name across a base/derived chain."""
+        return self._canon.get(cls, cls)
+
+    def attr_ctor(self, cls: str, attr: str) -> Optional[str]:
+        """Resolved ctor dotted name of `self.<attr>` in class `cls`,
+        searching up the base chain (assignments in a base `__init__`
+        type the attr for every subclass)."""
+        for c in self.chain(cls):
+            entry = self.classes.get(c)
+            if entry is None:
+                continue
+            ctor = entry[0].aliases.attr_types.get(c, {}).get(attr)
+            if ctor is not None:
+                return ctor
+        return None
+
+    def attr_class(self, cls: str, attr: str) -> Optional[str]:
+        """The analyzed class `self.<attr>` holds an instance of, if
+        its constructor is defined in the analyzed tree."""
+        ctor = self.attr_ctor(cls, attr)
+        if ctor is None:
+            return None
+        tail = ctor.rsplit(".", 1)[-1]
+        return tail if tail in self.classes else None
+
+
+class CallGraph:
+    """Intra-package call graph + discovered thread entry points."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.class_index = ClassIndex(project)
+        # key -> (defining file, def node)
+        self.functions: Dict[FnKey, Tuple[FileContext, ast.AST]] = {}
+        self.edges: Dict[FnKey, Set[FnKey]] = {}
+        self.thread_roots: List[ThreadRoot] = []
+        self._by_dotted: Dict[str, FnKey] = {}
+        self._collect_functions()
+        self._build_edges()
+
+    # ---- node collection -------------------------------------------------
+    def _collect_functions(self) -> None:
+        for ctx in self.project.files:
+            if ctx.tree is None:
+                continue
+            mod = ctx.module_name
+            for top in ctx.tree.body:
+                if isinstance(top, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._register((mod, None, top.name), ctx, top)
+                elif isinstance(top, ast.ClassDef):
+                    for meth in top.body:
+                        if isinstance(meth, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            self._register((mod, top.name, meth.name),
+                                           ctx, meth)
+
+    def _register(self, key: FnKey, ctx: FileContext, node: ast.AST) -> None:
+        if key in self.functions:      # @property/@setter pairs: first wins
+            return
+        self.functions[key] = (ctx, node)
+        mod, cls, name = key
+        dotted = f"{mod}.{cls}.{name}" if cls else f"{mod}.{name}"
+        self._by_dotted.setdefault(dotted, key)
+
+    def method(self, cls: str, name: str) -> Optional[FnKey]:
+        """FnKey of `cls.name`, searching up the in-tree base chain —
+        `self.helper()` resolves into the base class that defines it,
+        so inherited helpers stay on the hot-path closure and in
+        GUARD001's thread attribution."""
+        for c in self.class_index.chain(cls):
+            entry = self.class_index.classes.get(c)
+            if entry is None:
+                continue
+            key: FnKey = (entry[0].module_name, c, name)
+            if key in self.functions:
+                return key
+        return None
+
+    # ---- reference resolution --------------------------------------------
+    def resolve_ref(self, ctx: FileContext, cls: Optional[str],
+                    node: ast.AST) -> Optional[FnKey]:
+        """FnKey a Name/Attribute callable reference denotes, or None.
+
+        Handles `name`, `mod.fn`, `ClassName.method`, `ClassName(...)`
+        (-> __init__), `self.method`, and `self.attr.method` through the
+        constructor-assignment type map."""
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                return self.method(cls, node.attr) if cls else None
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self" and cls:
+                owner = self.class_index.attr_class(cls, base.attr)
+                return self.method(owner, node.attr) if owner else None
+        if isinstance(node, ast.Name):
+            key: FnKey = (ctx.module_name, None, node.id)
+            if key in self.functions:
+                return key
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            return None
+        resolved = ctx.aliases.resolve(node)
+        if not resolved:
+            return None
+        hit = self._by_dotted.get(resolved)
+        if hit is not None:
+            return hit
+        hit = self._by_dotted.get(resolved + ".__init__")  # constructor
+        if hit is not None:
+            return hit
+        parts = resolved.split(".")
+        if len(parts) == 2 and parts[0] in self.class_index.classes:
+            return self.method(parts[0], parts[1])     # ClassName.method
+        return None
+
+    # ---- edges + thread roots --------------------------------------------
+    def _build_edges(self) -> None:
+        for key, (ctx, fn) in self.functions.items():
+            cls = key[1]
+            out = self.edges.setdefault(key, set())
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                spawn = self._thread_spawn_targets(ctx, cls, node)
+                if spawn is not None:
+                    kind, refs = spawn
+                    for ref in refs:
+                        target = self.resolve_ref(ctx, cls, ref)
+                        if target is not None:
+                            self.thread_roots.append(ThreadRoot(
+                                target, kind, ctx.relpath, node.lineno))
+                    continue
+                callee = self.resolve_ref(ctx, cls, node.func)
+                if callee is not None and callee != key:
+                    out.add(callee)
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if isinstance(arg, (ast.Name, ast.Attribute)):
+                        ref = self.resolve_ref(ctx, cls, arg)
+                        if ref is not None and ref != key:
+                            out.add(ref)
+
+    def _thread_spawn_targets(
+            self, ctx: FileContext, cls: Optional[str],
+            call: ast.Call) -> Optional[Tuple[str, List[ast.AST]]]:
+        """(kind, callable refs) when `call` hands work to another
+        thread; None for ordinary calls."""
+        func = call.func
+        resolved = ctx.aliases.resolve(func)
+        if resolved == "threading.Thread":
+            return ("Thread(target=)",
+                    [kw.value for kw in call.keywords if kw.arg == "target"])
+        if resolved == "threading.Timer":
+            refs = [kw.value for kw in call.keywords
+                    if kw.arg == "function"]
+            if len(call.args) >= 2:
+                refs.append(call.args[1])
+            return ("Timer", refs)
+        is_attr = isinstance(func, ast.Attribute)
+        if resolved == "asyncio.run_coroutine_threadsafe" or (
+                is_attr and func.attr == "run_coroutine_threadsafe"):
+            refs: List[ast.AST] = []
+            if call.args:
+                first = call.args[0]
+                refs.append(first.func if isinstance(first, ast.Call)
+                            else first)
+            return ("run_coroutine_threadsafe", refs)
+        if is_attr and func.attr == "call_soon_threadsafe" and call.args:
+            return ("call_soon_threadsafe", [call.args[0]])
+        if is_attr and func.attr == "submit" and call.args \
+                and self._is_executor(ctx, cls, func.value):
+            return ("executor.submit", [call.args[0]])
+        return None
+
+    def _is_executor(self, ctx: FileContext, cls: Optional[str],
+                     recv: ast.AST) -> bool:
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self" and cls:
+            ctor = self.class_index.attr_ctor(cls, recv.attr)
+            if ctor is not None:
+                return ctor in EXECUTOR_CTORS
+            return bool(EXECUTOR_NAME_RE.search(recv.attr))
+        if isinstance(recv, ast.Name):
+            return bool(EXECUTOR_NAME_RE.search(recv.id))
+        return False
+
+    # ---- closure ---------------------------------------------------------
+    def reachable(self, roots: Iterable[FnKey]) -> Set[FnKey]:
+        """Transitive closure of `roots` over call edges (cycle-safe)."""
+        seen: Set[FnKey] = set()
+        stack = [r for r in roots if r in self.functions]
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            stack.extend(self.edges.get(key, ()))
+        return seen
+
+    def closure_provenance(
+            self, roots: Iterable[FnKey]) -> Dict[FnKey, FnKey]:
+        """Map every reachable function to the root that (first, in BFS
+        order) reaches it; roots map to themselves."""
+        prov: Dict[FnKey, FnKey] = {r: r for r in roots
+                                    if r in self.functions}
+        queue = deque(prov)
+        while queue:
+            key = queue.popleft()
+            for nxt in sorted(self.edges.get(key, ()),
+                              key=lambda k: (k[0], k[1] or "", k[2])):
+                if nxt not in prov:
+                    prov[nxt] = prov[key]
+                    queue.append(nxt)
+        return prov
+
+
+def build_callgraph(project: Project) -> CallGraph:
+    """The per-run CallGraph, built once and cached on the Project so
+    SYNC001, GUARD001 and LOCK001 share one graph."""
+    cache = getattr(project, "cache", None)
+    if cache is None:
+        return CallGraph(project)
+    if "callgraph" not in cache:
+        cache["callgraph"] = CallGraph(project)
+    return cache["callgraph"]

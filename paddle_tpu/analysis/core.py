@@ -200,19 +200,41 @@ class ModuleAliases:
         for meth in cls.body:
             if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
+            # pass 1: local names assigned from a constructor anywhere
+            # in this method, so `trace = TraceSink(...);
+            # self._trace = trace` types `_trace` (the
+            # normalize-an-optional-arg idiom, often inside an `if`)
+            local_ctors: Dict[str, str] = {}
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    val = node.value
+                    if isinstance(val, ast.BoolOp) and val.values:
+                        val = val.values[-1]
+                    if isinstance(val, ast.Call):
+                        ctor = self.resolve(val.func)
+                        if ctor is not None:
+                            local_ctors.setdefault(node.targets[0].id,
+                                                   ctor)
             for node in ast.walk(meth):
                 if not (isinstance(node, ast.Assign)
                         and len(node.targets) == 1):
                     continue
                 tgt = node.targets[0]
-                if not (isinstance(tgt, ast.Attribute)
-                        and isinstance(tgt.value, ast.Name)
-                        and tgt.value.id == "self"):
-                    continue
                 val = node.value
                 # `self.x = Ctor(...)` and `self.x = y or Ctor(...)`
                 if isinstance(val, ast.BoolOp) and val.values:
                     val = val.values[-1]
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                if isinstance(val, ast.Name):
+                    ctor = local_ctors.get(val.id)
+                    if ctor is not None:
+                        types.setdefault(tgt.attr, ctor)
+                    continue
                 if not isinstance(val, ast.Call):
                     continue
                 ctor = self.resolve(val.func)
@@ -244,6 +266,9 @@ class Project:
         self.files = files
         self.by_module: Dict[str, FileContext] = {
             f.module_name: f for f in files}
+        # per-run scratch shared across rules (the call graph lives
+        # here so SYNC001/GUARD001/LOCK001 build it once, not thrice)
+        self.cache: Dict[str, object] = {}
 
     def module(self, name: str) -> Optional[FileContext]:
         return self.by_module.get(name)

@@ -12,6 +12,7 @@ from typing import Dict, List
 from ..core import Rule
 from .api import PublicDocstringRule
 from .broad_except import BroadExceptRule
+from .guard import GuardedFieldRule
 from .locks import LockDisciplineRule
 from .sync import HostSyncRule
 from .trace import TraceSideEffectRule
@@ -20,6 +21,7 @@ ALL_RULES: List[Rule] = [
     TraceSideEffectRule(),
     HostSyncRule(),
     LockDisciplineRule(),
+    GuardedFieldRule(),
     BroadExceptRule(),
     PublicDocstringRule(),
 ]
@@ -27,5 +29,5 @@ ALL_RULES: List[Rule] = [
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}
 
 __all__ = ["ALL_RULES", "RULES_BY_ID", "TraceSideEffectRule",
-           "HostSyncRule", "LockDisciplineRule", "BroadExceptRule",
-           "PublicDocstringRule"]
+           "HostSyncRule", "LockDisciplineRule", "GuardedFieldRule",
+           "BroadExceptRule", "PublicDocstringRule"]

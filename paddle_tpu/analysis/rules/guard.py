@@ -1,0 +1,325 @@
+"""GUARD001 — cross-thread access to lock-guarded fields.
+
+LOCK001 polices HOW locks are held (with-blocks, no blocking calls,
+global acquisition order); this rule polices WHETHER shared state is
+under a lock at all. The serving tier is genuinely multi-threaded —
+engine loop, watchdog, router monitor, per-slot supervisor restart
+threads, the frontend's asyncio loop — and a counter bumped off-lock in
+one of them is a data race that no test reliably catches.
+
+Inference, per class:
+
+  * a field is GUARDED when any of the class's own methods writes it
+    (assignment, augmented assignment, subscript store, or a mutating
+    method call like `.append()`) while lexically holding one of the
+    class's own locks (`with self._lock:` — lock identity via LOCK001's
+    `qualify_lock`, so `threading.Condition(self._lock)` aliases to the
+    wrapped lock);
+  * every other access (read or write) to a guarded field — including
+    cross-class accesses `self.queue._items` resolved through the
+    constructor-assignment type map — is a RACE when the accessing
+    method can run on a different thread than some other access site
+    and the guard lock is not held.
+
+Thread attribution rides the call graph (`analysis.callgraph`): each
+discovered thread entry point (Thread target, Timer, executor submit,
+run_coroutine_threadsafe) tags its transitive callees with that
+thread's context; public methods and functions are tagged "caller"
+(any consumer thread). A field whose access sites all live in ONE
+context is thread-confined de facto and never flagged; `__init__`/
+`__new__`/`__del__`/`__repr__` are exempt (construction happens-before
+publication, repr is best-effort debugging).
+
+The serving tier's `*_locked` naming convention is part of the model:
+a method whose name ends in `_locked` documents "caller must hold my
+class's lock", so its body is checked as if the class's own guard
+locks were held (`_health_locked`, `_sweep_locked`, ... are called
+only from `with self._lock:` regions). The convention is a contract
+the callers are trusted on — misuse shows up at the CALL site the
+moment the caller's own unlocked accesses get flagged.
+
+Suppression grammar, for the documented lock-free channels (the token
+bridge, SpecStats, trace sinks):
+
+    self._stats = SpecStats()   # ptlint: thread-confined — engine-thread only
+    n = self._emitted           # ptlint: guarded-by(_lock) — caller holds it
+
+`# ptlint: thread-confined` on the field's defining assignment in
+`__init__` exempts the FIELD class-wide; on any access line it exempts
+that line. `# ptlint: guarded-by(name)` declares an access protected by
+a lock the caller already holds and exempts that line. Both accept a
+standalone comment line applying to the next code line, and the plain
+`# ptlint: disable=GUARD001` escape hatch works as for every rule.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Iterator, List, NamedTuple, Optional, \
+    Set, Tuple
+
+from ..callgraph import CallGraph, ClassIndex, FnKey, build_callgraph, \
+    fn_label
+from ..core import FileContext, Finding, Project, Rule
+from .locks import lock_attr_id, qualify_lock
+from .trace import MUTATING_METHODS
+
+# methods whose accesses never race: construction/destruction
+# happen-before publication, __repr__ is best-effort debugging
+EXEMPT_METHODS = {"__init__", "__new__", "__del__", "__repr__"}
+
+_ANNOT_RE = re.compile(
+    r"#\s*ptlint:\s*(thread-confined"
+    r"|guarded-by\(\s*([A-Za-z_][\w.\-]*)\s*\))")
+
+
+def parse_guard_annotations(
+        lines: List[str]) -> Dict[int, Tuple[str, Optional[str]]]:
+    """1-based line -> ('confined', None) | ('guarded-by', lock name).
+    Standalone comment lines carry to the next code line, like
+    `# ptlint: disable=` does."""
+    out: Dict[int, Tuple[str, Optional[str]]] = {}
+    pending: Optional[Tuple[str, Optional[str]]] = None
+    for i, text in enumerate(lines, start=1):
+        stripped = text.strip()
+        match = _ANNOT_RE.search(text)
+        ann: Optional[Tuple[str, Optional[str]]] = None
+        if match:
+            ann = (("confined", None) if match.group(1) == "thread-confined"
+                   else ("guarded-by", match.group(2)))
+        if stripped.startswith("#") or not stripped:
+            if ann:
+                pending = ann
+            continue
+        here = ann or pending
+        pending = None
+        if here:
+            out[i] = here
+    return out
+
+
+class _Access(NamedTuple):
+    """One read/write of a (possibly) guarded field."""
+
+    owner: str               # class the field belongs to
+    field: str
+    write: bool
+    held: FrozenSet[str]     # qualified lock ids lexically held
+    method_key: FnKey        # method the access happens in
+    same_class: bool         # self.field vs self.attr.field
+    ctx: FileContext
+    node: ast.AST
+
+
+def _qualify_any_lock(expr: ast.AST, ctx: FileContext, cls: Optional[str],
+                      cindex: ClassIndex) -> Optional[str]:
+    """`qualify_lock` extended to `with self.attr._lock:` — the lock of
+    a typed sub-object, qualified against the OWNING class."""
+    lock = qualify_lock(expr, ctx, cls)
+    if lock is not None:
+        return lock
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Attribute) \
+            and isinstance(expr.value.value, ast.Name) \
+            and expr.value.value.id == "self" and cls is not None:
+        owner = cindex.attr_class(cls, expr.value.attr)
+        if owner is None:
+            return None
+        return lock_attr_id(cindex.classes[owner][0], owner, expr.attr)
+    return None
+
+
+def _canon_lock(lock: str, cindex: ClassIndex) -> str:
+    """'Derived._lock' -> 'Base._lock' when the classes share an
+    inheritance chain (same instance storage, same actual lock)."""
+    head, dot, tail = lock.partition(".")
+    if dot and head in cindex.classes:
+        return cindex.canonical(head) + dot + tail
+    return lock
+
+
+class GuardedFieldRule(Rule):
+    """GUARD001: unlocked access to a field the class elsewhere writes
+    under its lock, from a method another thread can run."""
+
+    id = "GUARD001"
+    severity = "error"
+    description = ("cross-thread access to a lock-guarded field without "
+                   "the lock held (static race)")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        graph = build_callgraph(project)
+        cindex = graph.class_index
+        contexts = self._thread_contexts(graph)
+        annotations: Dict[int, Dict[int, Tuple[str, Optional[str]]]] = {}
+
+        def annot(ctx: FileContext) -> Dict[int, Tuple[str, Optional[str]]]:
+            key = id(ctx)
+            if key not in annotations:
+                annotations[key] = parse_guard_annotations(ctx.lines)
+            return annotations[key]
+
+        accesses: List[_Access] = []
+        guards: Dict[Tuple[str, str], Set[str]] = {}
+        confined: Set[Tuple[str, str]] = set()
+        for cname, (ctx, clsnode) in cindex.classes.items():
+            file_ann = annot(ctx)
+            for meth in clsnode.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                mkey: FnKey = (ctx.module_name, cname, meth.name)
+                for acc in self._walk_accesses(ctx, cname, mkey, meth,
+                                               cindex):
+                    accesses.append(acc)
+                    ann = file_ann.get(acc.node.lineno)
+                    if ann is not None and ann[0] == "confined" \
+                            and acc.write and acc.same_class \
+                            and meth.name == "__init__":
+                        confined.add((acc.owner, acc.field))
+                    if acc.write and acc.same_class and acc.held:
+                        own = {l for l in acc.held
+                               if l.startswith(acc.owner + ".")}
+                        if own:
+                            guards.setdefault(
+                                (acc.owner, acc.field), set()).update(own)
+
+        # group every access to a guarded, non-confined field
+        per_field: Dict[Tuple[str, str], List[_Access]] = {}
+        for acc in accesses:
+            fkey = (acc.owner, acc.field)
+            if fkey in guards and fkey not in confined \
+                    and acc.method_key[2] not in EXEMPT_METHODS:
+                per_field.setdefault(fkey, []).append(acc)
+
+        for fkey in sorted(per_field):
+            sites = per_field[fkey]
+            union: Set[str] = set()
+            for acc in sites:
+                union |= contexts.get(acc.method_key, set())
+            if len(union) < 2:
+                continue            # single thread context: confined
+            glocks = guards[fkey]
+            for acc in sites:
+                if acc.held & glocks:
+                    continue        # under the guard lock: clean
+                if acc.method_key[2].endswith("_locked") and any(
+                        l.startswith(
+                            cindex.canonical(acc.method_key[1]) + ".")
+                        for l in glocks):
+                    continue        # caller-holds-lock convention
+                site_ctxs = contexts.get(acc.method_key, set())
+                if not site_ctxs:
+                    continue        # unreachable from any root
+                ann = annot(acc.ctx).get(acc.node.lineno)
+                if ann is not None:
+                    continue        # guarded-by(...) / thread-confined
+                owner, field = fkey
+                verb = "written" if acc.write else "read"
+                yield acc.ctx.finding(
+                    self, acc.node,
+                    f"field '{field}' of {owner} is guarded by "
+                    f"{'/'.join(sorted(glocks))} (written under it "
+                    f"elsewhere) but {verb} without the lock in "
+                    f"'{fn_label(acc.method_key)}' "
+                    f"[runs on: {', '.join(sorted(site_ctxs))}; field "
+                    f"touched from: {', '.join(sorted(union))}] — hold "
+                    f"the lock, or annotate "
+                    f"`# ptlint: guarded-by(...)` / "
+                    f"`# ptlint: thread-confined` if this channel is "
+                    f"deliberately lock-free")
+
+    # ---- thread attribution ----------------------------------------------
+    def _thread_contexts(
+            self, graph: CallGraph) -> Dict[FnKey, Set[str]]:
+        """FnKey -> the set of thread contexts that can run it."""
+        contexts: Dict[FnKey, Set[str]] = {}
+        for root in graph.thread_roots:
+            tag = f"thread:{fn_label(root.key)}"
+            for key in graph.reachable([root.key]):
+                contexts.setdefault(key, set()).add(tag)
+        external = [key for key in graph.functions
+                    if self._is_external(key)]
+        for key in graph.reachable(external):
+            contexts.setdefault(key, set()).add("caller")
+        return contexts
+
+    @staticmethod
+    def _is_external(key: FnKey) -> bool:
+        """Callable from outside the package on the caller's thread:
+        public names plus dunders (except construction/destruction)."""
+        name = key[2]
+        if not name.startswith("_"):
+            return True
+        return (name.startswith("__") and name.endswith("__")
+                and name not in EXEMPT_METHODS)
+
+    # ---- per-method lexical walk -----------------------------------------
+    def _walk_accesses(self, ctx: FileContext, cls: str, mkey: FnKey,
+                       meth: ast.AST,
+                       cindex: ClassIndex) -> Iterator[_Access]:
+        skip: Set[int] = set()   # Attribute nodes already accounted for
+
+        def classify(node: ast.Attribute) -> Optional[Tuple[str, str, bool]]:
+            """(owner class, field, same_class) for self.f / self.a.f."""
+            base = node.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                return (cls, node.attr, True)
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self":
+                owner = cindex.attr_class(cls, base.attr)
+                if owner is not None:
+                    return (owner, node.attr, False)
+            return None
+
+        def emit(node: ast.Attribute, write: bool,
+                 held: FrozenSet[str]) -> Iterator[_Access]:
+            hit = classify(node)
+            if hit is not None:
+                owner, field, same = hit
+                # canonicalize across inheritance chains: Base and
+                # Derived share instance storage, so their accesses to
+                # one field (and holds of one lock attr) must group
+                # under one key
+                yield _Access(cindex.canonical(owner), field, write,
+                              frozenset(_canon_lock(l, cindex)
+                                        for l in held),
+                              mkey, same, ctx, node)
+
+        def visit(node: ast.AST,
+                  held: FrozenSet[str]) -> Iterator[_Access]:
+            if isinstance(node, ast.With):
+                inner = held
+                for item in node.items:
+                    lock = _qualify_any_lock(item.context_expr, ctx, cls,
+                                             cindex)
+                    if lock is not None:
+                        inner = inner | {lock}
+                    yield from visit(item.context_expr, held)
+                for stmt in node.body:
+                    yield from visit(stmt, inner)
+                return
+            if isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Attribute):
+                    skip.add(id(func))   # method lookup, not a field read
+                    if func.attr in MUTATING_METHODS \
+                            and isinstance(func.value, ast.Attribute):
+                        # self.f.append(...) mutates f: count as write
+                        skip.add(id(func.value))
+                        yield from emit(func.value, True, held)
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)) \
+                    and isinstance(node.value, ast.Attribute):
+                # self.f[k] = v writes through f
+                skip.add(id(node.value))
+                yield from emit(node.value, True, held)
+            elif isinstance(node, ast.Attribute) and id(node) not in skip:
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                yield from emit(node, write, held)
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, held)
+
+        for stmt in ast.iter_child_nodes(meth):
+            yield from visit(stmt, frozenset())

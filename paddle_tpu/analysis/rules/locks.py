@@ -46,6 +46,7 @@ import ast
 import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
+from ..callgraph import build_callgraph
 from ..core import FileContext, Finding, Project, Rule, dotted
 
 LOCK_NAME_RE = re.compile(r"(^|_)(lock|mutex|mtx)$", re.I)
@@ -77,17 +78,11 @@ class _ClassLockIndex:
     """Per-project view: which locks each class's methods acquire."""
 
     def __init__(self, project: Project):
-        # class name -> FileContext (first definition wins)
-        self.class_files: Dict[str, Tuple[FileContext, ast.ClassDef]] = {}
+        # class name -> FileContext (first definition wins) — the same
+        # registry the call graph resolves typed attrs through
+        self.class_files = build_callgraph(project).class_index.classes
         # class name -> method name -> set of qualified lock ids
         self.method_locks: Dict[str, Dict[str, Set[str]]] = {}
-        for ctx in project.files:
-            if ctx.tree is None:
-                continue
-            for node in ctx.tree.body:
-                if isinstance(node, ast.ClassDef) \
-                        and node.name not in self.class_files:
-                    self.class_files[node.name] = (ctx, node)
         for cname, (ctx, cls) in self.class_files.items():
             per_method: Dict[str, Set[str]] = {}
             for meth in cls.body:
@@ -114,19 +109,28 @@ def _self_attr(node: ast.AST) -> Optional[str]:
     return None
 
 
+def lock_attr_id(ctx: FileContext, cls: str, attr: str) -> Optional[str]:
+    """Qualified lock id of `cls.<attr>` defined in `ctx`, or None when
+    the attr is not a lock. The single source of lock identity —
+    Condition-wrap aliasing (`threading.Condition(self._lock)` IS
+    `_lock`), ctor types, lock-looking names — shared by qualify_lock
+    and GUARD001's cross-class `with self.attr._lock:` resolution."""
+    aliases = ctx.aliases
+    attr = aliases.cond_wraps.get(cls, {}).get(attr, attr)
+    ctor = aliases.attr_types.get(cls, {}).get(attr)
+    if (ctor in LOCK_CTORS) or LOCK_NAME_RE.search(attr):
+        return f"{cls}.{attr}"
+    return None
+
+
 def qualify_lock(expr: ast.AST, ctx: FileContext,
                  cls: Optional[str]) -> Optional[str]:
     """Canonical id of the lock `expr` denotes, or None if not a lock.
     `self._work` in ServingEngine (a Condition over `_lock`) qualifies
     to 'ServingEngine._lock'."""
     attr = _self_attr(expr)
-    aliases = ctx.aliases
     if attr is not None and cls is not None:
-        attr = aliases.cond_wraps.get(cls, {}).get(attr, attr)
-        ctor = aliases.attr_types.get(cls, {}).get(attr)
-        if (ctor in LOCK_CTORS) or LOCK_NAME_RE.search(attr):
-            return f"{cls}.{attr}"
-        return None
+        return lock_attr_id(ctx, cls, attr)
     if isinstance(expr, ast.Name) and LOCK_NAME_RE.search(expr.id):
         return f"{ctx.module_name}.{expr.id}"
     return None

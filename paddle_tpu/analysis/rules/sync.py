@@ -6,9 +6,11 @@ serving decode loop that turns an async pipeline into lock-step
 ping-pong (the Ragged Paged Attention serving stack lives and dies by
 keeping the decode loop free of these). The rule polices
 
-  * the named hot paths — `step()`-shaped functions in
-    `paddle_tpu/nlp/paged.py` and `paddle_tpu/serving/engine.py` — where
-    a sync is a per-chunk cost paid on every scheduler tick, and
+  * the decode hot path — SEED ROOTS (`step()`-shaped entry points,
+    below) plus every function they transitively call inside the
+    package, derived from the call graph (`analysis.callgraph`), so a
+    new step helper is covered the day it's written without anyone
+    extending a hand-maintained list, and
   * every traced function (where `int(tracer)` is an outright error
     that only surfaces at trace time).
 
@@ -16,79 +18,94 @@ Flagged: `.item()`, `np.asarray`/`np.array`/`jax.device_get` calls,
 `int`/`float`/`bool` whose argument mentions a jax value, and per-step
 `jnp.asarray(self.<state>)` host→device re-uploads (cache a device
 mirror instead — see ContinuousBatcher's device-state mirrors).
+
+`HOT_ROOTS` entries are (relpath suffix, name regexes). A root pattern
+that matches no function is DEAD — reported by `ptlint --hot-report`
+(run non-blocking in CI) so renames can't silently shrink coverage.
+Before the call-graph closure existed this list named every hot helper
+by hand (~60 entries grown PR over PR); the closure derives those, and
+`tests/test_analysis.py::test_sync_derived_hot_set_superset_of_old_list`
+pins the old hand list as a floor so the refactor can never lose
+coverage.
 """
 from __future__ import annotations
 
 import ast
 import re
-from typing import Iterator, List, Tuple
+from typing import Dict, Iterator, List, Tuple
 
+from ..callgraph import FnKey, build_callgraph, fn_label
 from ..core import FileContext, Finding, Project, Rule, dotted
 from .trace import find_traced_functions
 
-# (relpath suffix, function-name regex) pairs that form the decode hot path
-HOT_PATHS: Tuple[Tuple[str, str], ...] = (
+# (relpath suffix, function-name regexes): the decode hot path's SEED
+# ROOTS. Everything these transitively call inside the package is hot
+# automatically — list entry points and compiled-step bodies here, not
+# their helpers.
+HOT_ROOTS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    # the batcher's scheduler ticks: plain, fused, speculative — plus
+    # forward_paged, which jit-traced model code calls without a
+    # host-side call edge the graph could follow
     ("nlp/paged.py",
-     r"^(step|run|_step_fused|_prefill_pending|_run_standalone_unit"
-     r"|_paged_gqa_attention|forward_paged"
-     r"|_write_pool|_write_pool_int8"
-     r"|_trace_emit|_trace_chunks|_record_tick"
-     # speculative decoding: the draft/verify step helpers run every
-     # spec tick (_step_spec's single coalesced device_get is the
-     # documented per-step sync, like the fused path's); the score
-     # forward/attention are traced but pinned here too so a host
-     # value can't sneak in before tracing catches it
-     r"|_step_spec|_emit_spec|_spec_any|_drain_emitted"
-     r"|_forward_spec|_spec_gqa_attention"
-     # sampled device-time attribution: _profile_t0 runs EVERY device
-     # call tick (must stay a counter bump), _profile_commit is the
-     # documented sample-gate exception (its block_until_ready fence
-     # runs one step in profile_sample_every, never unfenced)
-     r"|_profile_t0|_profile_commit)$"),
+     ("step", "run", "_step_fused", "_step_spec", "_forward_spec",
+      "forward_paged", "_prefill_pending", "_run_standalone_unit")),
+    # the kernel + impl pick: entered from traced code / engine setup
     ("nlp/ragged_attention.py",
-     r"^(ragged_paged_attention|_rpa_kernel|resolve_attention_impl)$"),
-    # int8 paged-KV math: quantize/rescale/dequantize run inside every
-    # compiled decode and prefill step when kv_dtype="int8" — a host
-    # sync hiding in them would tax every token
+     ("ragged_paged_attention", "_rpa_kernel", "resolve_attention_impl")),
+    # int8 paged-KV math runs inside every compiled step when
+    # kv_dtype="int8"; called from traced bodies, so rooted explicitly
     ("quantization/kv.py",
-     r"^(quantize|dequantize|rescale_codes|scale_of)$"),
-    ("serving/engine.py", r"^(_loop|_dispatch|step|load|_slo_eval)$"),
-    # SLO engine + step profiler: record_* runs per dispatched token
-    # batch / admission, should_fence per device-call tick, evaluate
-    # per health poll — all host-side window math by design; a device
-    # value leaking into an SLO sample would sync every dispatch
-    ("serving/slo.py",
-     r"^(record_ttft|record_itl|record_queue_wait|record_tokens"
-     r"|record_request|_record|evaluate|pop_transitions)$"),
-    ("serving/profiling.py",
-     r"^(should_fence|record|arm_capture|capture_active)$"),
-    # speculative-decoding accounting: record_step folds one verify
-    # sweep's counts per spec tick — host ints only by design
-    ("serving/speculative.py",
-     r"^(record_step|accept_rate|tokens_per_step)$"),
-    # router/frontend tier: the per-request routing decision, the
-    # monitor sweep (terminal fan-in + failover) and the HTTP token
-    # bridge run once per request or per tick with the event loop /
-    # router lock held — these modules are host-only today, and a
-    # device value leaking into them would tax every routed request,
-    # so the rule pins them hot from day one
-    ("serving/router.py",
-     r"^(submit|_place|_views|_bridge|_monitor_loop|_sweep_locked"
-     r"|_handle_terminal|_failover)$"),
-    ("serving/frontend.py",
-     r"^(_handle|_generate|_stream_sse|_submit|_read_request)$"),
-    # replica supervisor: the health-poll loop runs every poll tick and
-    # slot_serving() runs per candidate per routing decision — both
-    # host-only by design; a device value leaking into the lifecycle
-    # state machine would stall routing and restarts alike
-    ("serving/supervisor.py",
-     r"^(_loop|_restart_slot|_probe|slot_serving|info)$"),
-    # trace emission helpers run once per scheduler tick / dispatched
-    # token batch with tracing always on — a device sync hiding in an
-    # event attr would tax EVERY step, so they are hot paths too
-    ("serving/trace.py",
-     r"^(emit|finish|start|alias|span|now|record)$"),
+     ("quantize", "dequantize", "rescale_codes", "scale_of")),
+    # the engine thread's tick and the per-request dispatch fan-out
+    ("serving/engine.py", ("_loop", "_dispatch", "load")),
+    # router/frontend tier: per-request routing, the monitor sweep and
+    # the HTTP handlers are entry points on their own threads
+    ("serving/router.py", ("submit", "_monitor_loop", "_bridge")),
+    ("serving/frontend.py", ("_handle", "_generate", "_stream_sse")),
+    # supervisor health-poll loop + the per-routing-decision probe
+    ("serving/supervisor.py", ("_loop", "_restart_slot", "slot_serving",
+                               "info")),
+    # per-tick accessors the graph cannot derive: they are invoked
+    # through handles the type map can't follow (capture windows armed
+    # over HTTP, spec stats read through as_dict plumbing, trace spans
+    # opened on request handles) — pinned as roots so a host sync in
+    # them still taxes no step
+    ("serving/profiling.py", ("arm_capture", "capture_active")),
+    ("serving/speculative.py", ("accept_rate", "tokens_per_step")),
+    ("serving/trace.py", ("start", "finish", "alias", "now")),
 )
+
+def derive_hot_paths(project: Project):
+    """(hot, dead): `hot` maps id(def node) -> (ctx, node, reason) for
+    every function on the derived decode hot path; `dead` lists
+    (suffix, pattern) root entries matching no function. Cached on the
+    Project — the rule and `--hot-report` share one derivation."""
+    cache = getattr(project, "cache", {})
+    if "sync_hot_paths" in cache:
+        return cache["sync_hot_paths"]
+    graph = build_callgraph(project)
+    roots: Dict[FnKey, None] = {}
+    dead: List[Tuple[str, str]] = []
+    for suffix, patterns in HOT_ROOTS:
+        for pattern in patterns:
+            rx = re.compile(pattern)
+            matched = False
+            for key, (ctx, _node) in graph.functions.items():
+                if ctx.relpath.endswith(suffix) and rx.fullmatch(key[2]):
+                    matched = True
+                    roots.setdefault(key)
+            if not matched:
+                dead.append((suffix, pattern))
+    prov = graph.closure_provenance(roots)
+    hot: Dict[int, Tuple[FileContext, ast.AST, str]] = {}
+    for key, root in prov.items():
+        ctx, node = graph.functions[key]
+        reason = ("decode hot path" if key == root
+                  else f"decode hot path (via {fn_label(root)})")
+        hot[id(node)] = (ctx, node, reason)
+    result = (hot, dead)
+    cache["sync_hot_paths"] = result
+    return result
 
 HOST_COPY_CALLS = {
     "numpy.asarray", "numpy.array", "np.asarray", "np.array",
@@ -117,26 +134,24 @@ class HostSyncRule(Rule):
                    "np.asarray) in a decode hot path or traced function")
 
     def run(self, project: Project) -> Iterator[Finding]:
+        derived, _dead = derive_hot_paths(project)
         for ctx in project.files:
             if ctx.tree is None:
                 continue
-            hot = self._hot_functions(ctx)
+            hot = self._hot_functions(ctx, derived)
             classified = {id(fn) for fn, _ in hot}
             for fn, where in hot:
                 yield from self._check_fn(ctx, fn, where, classified)
 
-    def _hot_functions(self, ctx: FileContext) -> List[Tuple[ast.AST, str]]:
+    def _hot_functions(self, ctx: FileContext,
+                       derived) -> List[Tuple[ast.AST, str]]:
         hot: List[Tuple[ast.AST, str]] = []
         seen = set()
-        patterns = [re.compile(rx) for suffix, rx in HOT_PATHS
-                    if ctx.relpath.endswith(suffix)]
-        if patterns:
-            for node in ast.walk(ctx.tree):
-                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                        and any(p.match(node.name) for p in patterns) \
-                        and id(node) not in seen:
-                    seen.add(id(node))
-                    hot.append((node, "decode hot path"))
+        for hot_ctx, node, reason in derived.values():
+            if hot_ctx is ctx and id(node) not in seen:
+                seen.add(id(node))
+                hot.append((node, reason))
+        hot.sort(key=lambda pair: getattr(pair[0], "lineno", 0))
         for fn, why in find_traced_functions(ctx):
             if id(fn) not in seen:
                 seen.add(id(fn))

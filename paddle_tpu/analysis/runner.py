@@ -5,7 +5,15 @@
 
 Exit codes: 0 clean (nothing beyond the baseline), 1 new findings,
 2 usage/internal error. `--format json` emits one machine-readable
-object (findings, baselined counts, stale entries) for CI.
+object (findings, baselined counts, stale entries) for CI;
+`--format github` emits GitHub workflow-command annotation lines
+(`::error file=...`) so findings land inline on PR diffs.
+`--hot-report` prints the derived SYNC001 hot set plus DEAD seed-root
+patterns (entries matching no function — renames that silently lost
+coverage); it always exits 0, for non-blocking CI output.
+`--time-budget S` fails the run loudly when analysis wall time exceeds
+S seconds — the lint gate must stay fast enough to run per-push, so a
+call-graph blowup is a build failure, not a slow creep.
 """
 from __future__ import annotations
 
@@ -13,11 +21,13 @@ import argparse
 import json
 import os
 import sys
+import time
 from typing import List, Optional, Sequence
 
 from . import baseline as baseline_mod
 from .core import Finding, load_project, run_rules
 from .rules import ALL_RULES, RULES_BY_ID
+from .rules.sync import derive_hot_paths
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -25,13 +35,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
         prog="ptlint",
         description=("paddle_tpu static analysis: trace-safety (TRACE001), "
                      "host-sync (SYNC001), lock-discipline (LOCK001), "
-                     "broad-except (EXC001), API docstrings (API001)"))
+                     "cross-thread races (GUARD001), broad-except "
+                     "(EXC001), API docstrings (API001)"))
     p.add_argument("paths", nargs="*", default=None,
                    help="files/dirs to check (default: paddle_tpu/)")
     p.add_argument("--root", default=".",
                    help="path findings are reported relative to "
                         "(default: cwd; baseline fingerprints depend on it)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default="text")
     p.add_argument("--select", default=None, metavar="RULES",
                    help="comma-separated rule ids to run (default: all)")
     p.add_argument("--baseline", default=None, metavar="FILE",
@@ -43,6 +55,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="rewrite the baseline to exactly the current "
                         "findings (burn-down: should only shrink)")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--hot-report", action="store_true",
+                   help="print the derived SYNC001 hot set and any DEAD "
+                        "seed-root patterns, then exit 0 (non-blocking "
+                        "CI output)")
+    p.add_argument("--time-budget", type=float, default=None,
+                   metavar="SECONDS",
+                   help="fail (exit 1) when analysis wall time exceeds "
+                        "this many seconds — keeps the lint gate fast")
     return p
 
 
@@ -58,6 +78,50 @@ def _select_rules(spec: Optional[str]):
                 f"(known: {', '.join(sorted(RULES_BY_ID))})")
         rules.append(RULES_BY_ID[rid])
     return rules
+
+
+def _print_github(new: List[Finding], parse_errors: List[Finding],
+                  out) -> None:
+    """GitHub Actions workflow-command annotations: one ::error line
+    per finding, so the lint job marks the exact PR diff lines."""
+    for f in parse_errors + new:
+        msg = f.message.replace("\n", " ")
+        print(f"::error file={f.path},line={f.line},col={f.col},"
+              f"title=ptlint {f.rule}::{msg}", file=out)
+    print(f"ptlint: {len(new) + len(parse_errors)} new finding(s)",
+          file=out)
+
+
+def _print_hot_report(project, parse_errors: List[Finding], out) -> None:
+    """The derived SYNC001 hot set (with root provenance) and any dead
+    seed-root patterns. Informational: exit code is always 0 — but a
+    file that failed to parse contributes NO functions, so the report
+    leads with the gap instead of presenting a silently shrunken set
+    (the blocking lint job fails on the parse error itself)."""
+    for f in parse_errors:
+        print(f"WARNING: {f.location}: {f.message} — file excluded "
+              f"from the call graph, derived hot set is incomplete",
+              file=out)
+    hot, dead = derive_hot_paths(project)
+    by_file = {}
+    for ctx, node, reason in hot.values():
+        by_file.setdefault(ctx.relpath, []).append((node.name, reason))
+    total = sum(len(v) for v in by_file.values())
+    print(f"SYNC001 derived hot set: {total} function(s) in "
+          f"{len(by_file)} file(s)", file=out)
+    for rel in sorted(by_file):
+        print(f"  {rel}", file=out)
+        for name, reason in sorted(by_file[rel]):
+            print(f"    {name}  [{reason}]", file=out)
+    if dead:
+        print(f"DEAD hot-path roots ({len(dead)}): these patterns match "
+              f"no function — a rename silently dropped coverage, fix "
+              f"or delete the entry in analysis/rules/sync.py HOT_ROOTS",
+              file=out)
+        for suffix, pattern in dead:
+            print(f"  {suffix} :: {pattern}", file=out)
+    else:
+        print("dead hot-path roots: none", file=out)
 
 
 def _print_text(new: List[Finding], baselined: List[Finding],
@@ -101,7 +165,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 2
         paths = [default]
 
+    t0 = time.monotonic()
     project, parse_errors = load_project(paths, root)
+    if args.hot_report:
+        _print_hot_report(project, parse_errors, out)
+        return 0
     findings = run_rules(project, rules)
 
     baseline_path = args.baseline or os.path.join(
@@ -122,16 +190,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         result = baseline_mod.apply(findings, base)
 
     failed = bool(result.new) or bool(parse_errors)
+    elapsed = time.monotonic() - t0
+    over_budget = (args.time_budget is not None
+                   and elapsed > args.time_budget)
+    if over_budget:
+        failed = True
     if args.format == "json":
         json.dump({
             "new": [f.to_dict() for f in parse_errors + result.new],
             "baselined": len(result.baselined),
             "stale_baseline": result.stale,
             "checked_files": len(project.files),
+            "elapsed_s": round(elapsed, 3),
+            "time_budget_exceeded": over_budget,
             "exit": 1 if failed else 0,
         }, out, indent=2)
         out.write("\n")
+    elif args.format == "github":
+        _print_github(result.new, parse_errors, out)
     else:
         _print_text(result.new, result.baselined, result.stale,
                     parse_errors, out)
+    if over_budget:
+        print(f"ptlint: TIME BUDGET EXCEEDED — analysis took "
+              f"{elapsed:.1f}s (budget {args.time_budget:.1f}s). The "
+              f"lint gate must stay fast enough to run per-push; find "
+              f"what blew up the call graph (see --hot-report) before "
+              f"merging.", file=sys.stderr)
     return 1 if failed else 0

@@ -1330,12 +1330,15 @@ class ContinuousBatcher:
         """Host slot lists → device arrays. Deliberately OUTSIDE step()'s
         hot path: it runs only when admission/retirement invalidated the
         mirror, so lock-step decode pays zero host→device uploads."""
+        # ptlint: disable=SYNC001 — this IS the cached-mirror refresh
+        # the rule asks for: it uploads only when admission/retirement
+        # invalidated `_dev_state`, never per decode step
         return (jnp.asarray(self.active),
-                jnp.asarray(self.budget, jnp.int32),
-                jnp.asarray(self.stop, jnp.int32))
+                jnp.asarray(self.budget, jnp.int32),  # ptlint: disable=SYNC001 — mirror refresh (see above)
+                jnp.asarray(self.stop, jnp.int32))  # ptlint: disable=SYNC001 — mirror refresh (see above)
 
     # -- observability (host-side bookkeeping ONLY: no device values,
-    #    no syncs — SYNC001's HOT_PATHS covers these helpers) -------------
+    #    no syncs — SYNC001's derived hot set covers them) ----------------
     def _trace_emit(self, rid: int, kind: str, dur=None, **attrs) -> None:
         """Emit one per-request trace event (no-op without a sink).
         Every attr must already be a plain host value — a jax array
@@ -1829,6 +1832,8 @@ class ContinuousBatcher:
     def _finish_unit(self, entries, firsts) -> None:
         """Commit a unit whose FINAL chunk just computed: one readback
         of every first token at once, then activate each record."""
+        # ptlint: disable=SYNC001 — the unit's single coalesced
+        # readback (docstring): one sync per prefill unit, not per token
         firsts = np.asarray(firsts)
         for entry, first in zip(entries, firsts):
             self._commit(entry[0], int(first))

@@ -19,7 +19,7 @@ did), so a block's codes always dequantize under the single scale its
 pool slot stores. Empty blocks carry scale 0 and all-zero codes, which
 dequantize to exact zeros — the same contents a fresh fp pool holds.
 
-Hot path: pure jnp, no host syncs — SYNC001's HOT_PATHS covers these
+Hot path: pure jnp, no host syncs — SYNC001 roots these helpers
 helpers (they run inside every compiled decode/prefill step when
 ``kv_dtype="int8"``).
 """

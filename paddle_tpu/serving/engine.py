@@ -508,6 +508,9 @@ class ServingEngine:
             # one shared budget: drain may have spent part (or all) of it
             budget = (None if deadline is None
                       else max(0.0, deadline - self._clock()))
+            # ptlint: guarded-by(_wedged-latch) — one-way bool set under
+            # the lock, read lock-free: a stale False only costs a
+            # longer (still bounded) join
             if self._wedged:
                 # the engine thread is presumed wedged inside a device
                 # call that may never return — a bounded join instead
@@ -523,14 +526,14 @@ class ServingEngine:
                 return False
         else:
             # never started: no other thread owns the batcher
-            self._cancel_pending_locked_caller()
+            self._cancel_pending_taking_lock()
         return clean
 
-    def _cancel_pending_locked_caller(self) -> None:
+    def _cancel_pending_taking_lock(self) -> None:
         with self._work:
-            self._cancel_pending()
+            self._cancel_pending_locked()
 
-    def _cancel_pending(self) -> None:
+    def _cancel_pending_locked(self) -> None:
         """Cancel everything queued + parked + in flight (lock held)."""
         for _, req in self._parked:
             self._finish_locked(req, RequestState.CANCELLED,
@@ -779,7 +782,7 @@ class ServingEngine:
                 if self._stop:
                     # exit path owns the batcher: cancel whatever is
                     # left so no consumer stays blocked on its channel
-                    self._cancel_pending()
+                    self._cancel_pending_locked()
                     return
                 self._reap_queued_locked()
                 self._reap_running_locked()
@@ -816,6 +819,8 @@ class ServingEngine:
             # per-request fate; errors re-raise in culprits' result()
             except Exception as e:        # device-step boundary
                 self._step_t0 = None
+                # ptlint: guarded-by(_wedged-latch) — one-way latch;
+                # loop re-checks under the lock at the next tick top
                 if self._wedged:
                     continue  # watchdog already failed the stranded set
                 # forensics FIRST: the dump captures the queue/pool
@@ -843,6 +848,8 @@ class ServingEngine:
             self._step_t0 = None
             self._fault_streak = 0
             self._flight_seq = self.batcher.flight.seq
+            # ptlint: guarded-by(_wedged-latch) — one-way latch; a stale
+            # False just dispatches tokens to already-failed handles
             if self._wedged:
                 continue      # stranded set already failed; don't dispatch
             self._dispatch(emitted, finished, step_dt=timer.elapsed)
@@ -991,14 +998,21 @@ class ServingEngine:
             # with the histogram (and the XPlane RecordEvent spans)
             self.trace.span("engine.step", dur=step_dt, tokens=ntok)
         for rid, toks in emitted.items():
+            # ptlint: thread-confined — the token bridge: emission runs
+            # lock-free on the engine thread so submit()/cancel() stay
+            # responsive; rid-keyed dict ops are GIL-atomic and a
+            # concurrent cancel only turns this get() into a skip
             req = self._running.get(rid)
             if req is None:
                 continue                  # aborted in between
+            # ptlint: thread-confined — token bridge (see above): only
+            # the engine thread writes ITL timestamps per live rid
             last = self._last_emit.get(rid)
             if last is not None:
                 self._h_itl.observe(now - last)
                 if self._slo is not None:
                     self._slo.record_itl(now - last)
+            # ptlint: thread-confined — token bridge (see above)
             self._last_emit[rid] = now
             traced = self.trace is not None and req.trace_id is not None
             ndelivered = 0
@@ -1143,6 +1157,7 @@ class ServingEngine:
                 culprits[rid] = pe
             finally:
                 self._step_t0 = None
+            # ptlint: guarded-by(_wedged-latch) — one-way latch read
             if self._wedged:
                 # a hung probe tripped the watchdog: every handle is
                 # already failed — no recovery left to run
@@ -1229,6 +1244,8 @@ class ServingEngine:
         poll = max(0.005, min(0.05, self._watchdog_s / 4.0))
         while not self._wd_stop.wait(poll):
             t0 = self._step_t0
+            # ptlint: guarded-by(_wedged-latch) — the watchdog is the
+            # ONLY writer of _wedged; its own stale read is impossible
             if t0 is None or self._wedged:
                 continue
             # compile-vs-hang: on a never-warmed engine ANY step may be
@@ -1239,6 +1256,8 @@ class ServingEngine:
             # exactly the unwarmed window; a warmed engine gets no
             # grace (every serving-path executable already compiled).
             deadline = self._watchdog_s
+            # ptlint: guarded-by(_warmed-latch) — one-way warmup latch;
+            # a stale False only extends the compile grace one poll
             if not self._warmed:
                 deadline *= self._wd_grace
             stuck = self._clock() - t0

@@ -226,12 +226,14 @@ class StepProfiler:
         with self._lock:
             shapes = [{**self.key_fields(k), **v.summary()}
                       for k, v in self._shapes.items()]
+            ticks, samples = self._tick, self.samples
+            dropped = self.dropped_keys
         shapes.sort(key=lambda r: -r["device_sum_s"])
         return {
             "sample_every": self.sample_every,
-            "ticks": self._tick,
-            "samples": self.samples,
-            "dropped_keys": self.dropped_keys,
+            "ticks": ticks,
+            "samples": samples,
+            "dropped_keys": dropped,
             "shapes": shapes,
             "capture": self.capture_report(),
         }

@@ -246,7 +246,7 @@ class SloTracker:
         # floor: burning means delivering LESS than promised
         return target / value if value > 0 else float("inf")
 
-    def _verdict(self, name: str, burn_fast: float,
+    def _verdict_locked(self, name: str, burn_fast: float,
                  burn_slow: float) -> str:
         prev = self._state[name]
         if burn_fast >= self.breach_burn:
@@ -282,7 +282,7 @@ class SloTracker:
                 vs = self._value(name, self.slow_window_s, now)
                 bf = self._burn(name, vf)
                 bs = self._burn(name, vs)
-                verdict = self._verdict(name, bf, bs)
+                verdict = self._verdict_locked(name, bf, bs)
                 prev = self._state[name]
                 if verdict == "BREACH" and prev != "BREACH":
                     self.breaches_total += 1

@@ -341,7 +341,8 @@ class FlightRecorder:
     @property
     def cap(self) -> int:
         """Ring capacity: the last `cap` step records are retained."""
-        return self._ring.maxlen
+        with self._lock:
+            return self._ring.maxlen
 
     @property
     def seq(self) -> int:
