@@ -67,6 +67,15 @@ test:           ## tier-1 test suite (CPU)
 # accepted tokens/step > 1, and post-warmup recompiles stay 0 (the
 # spec config rides every memo/warmup key); emits spec_accept_rate /
 # spec_tokens_per_step / decode_tok_s_spec as tracked JSON fields.
+# Disaggregated leg: --disagg serves the mixed workload through a
+# monolithic reference engine, then through Router(disaggregated=True)
+# with one prefill-role and one decode-role replica (per-request
+# KVSnapshot export/import), fp AND w8+int8-KV; FAILS unless the
+# disaggregated streams are bit-identical to the monolithic run, the
+# decode replica ran ZERO prefill chunks, every past-the-boundary
+# request migrated exactly once, the int8 leg holds the documented
+# fp-match floor, recompiles stay 0 on both replicas and both pools
+# drain clean; emits migration count/bytes and handoff latency.
 # SLO leg: --slo FAILS unless sampled device timing holds tok/s >=
 # 0.97x the sampling-off legs with zero recompiles, an injected
 # latency fault (4s hangs short of the watchdog) drives an itl_ms_p99
@@ -94,6 +103,8 @@ bench-smoke:    ## tiny serving benches (non-blocking CI job)
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --slo \
 		--n-requests 8 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --speculative \
+		--n-requests 6 --max-new 6
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --disagg \
 		--n-requests 6 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --load \
 		--sessions 4 --turns 2 --max-new 4

@@ -150,7 +150,7 @@ def _make_prompts(rng, n_requests: int, workload: str,
         return [common + list(map(int, rng.randint(1, 200, suffix_len)))
                 for _ in range(n_requests)]
     if workload in ("mixed", "fused", "chaos", "quantized", "router",
-                    "restart", "slo"):
+                    "restart", "slo", "disagg"):
         # lengths spanning the whole ladder, incl. past the largest
         # bucket (chunked prefill) — every request a different length
         return [list(map(int, rng.randint(1, 200, int(L))))
@@ -863,6 +863,149 @@ def _restart_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
     }
 
 
+def _disagg_leg(params, cfg, prompts, budgets, *, weight_dtype,
+                kv_dtype, **kw) -> dict:
+    """One quantization configuration through the disaggregated
+    prefill/decode topology: a monolithic single-engine reference
+    first, then the SAME workload through `Router(disaggregated=True)`
+    with one prefill-role and one decode-role replica. Every request
+    prefills on replica 0, surrenders at the first step boundary with
+    its KV chain exported as a `KVSnapshot`, and resumes on replica 1
+    via `import_kv`. HARD-FAILS unless the disaggregated streams are
+    bit-identical to the monolithic reference, the decode replica ran
+    ZERO prefill chunks (all of its KV arrived by snapshot import),
+    every request migrated exactly once, post-warmup recompiles stay 0
+    on BOTH replicas, and both pools drain clean."""
+    import time as _t
+
+    from paddle_tpu import serving
+
+    ekw = dict(max_batch=kw["max_batch"], block_size=kw["block_size"],
+               max_total_len=64, max_new_tokens=kw["max_new"],
+               chunk=kw["chunk"], max_queue_depth=2 * len(prompts),
+               prefix_cache=kw["prefix_cache"],
+               max_prefill_bucket=kw["max_prefill_bucket"],
+               attention_impl=kw["attention_impl"],
+               fused_units=kw["fused_units"],
+               weight_dtype=weight_dtype, kv_dtype=kv_dtype)
+    leg = f"{weight_dtype}/{kv_dtype}"
+
+    # monolithic reference: the same engine config, both roles in one
+    # process — its tokens are the bit-identity bar for the hop
+    eng = serving.ServingEngine(params, cfg, start=False, **ekw)
+    eng.warmup()
+    eng.start()
+    refs = [eng.submit(p, max_new_tokens=mn)
+            for p, mn in zip(prompts, budgets)]
+    if not eng.drain(timeout=600):
+        raise RuntimeError(
+            f"disagg leg {leg}: monolithic reference drain timed out")
+    base = [r.result() for r in refs]
+    eng.shutdown()
+
+    router = serving.Router(
+        params, cfg, replicas=2, disaggregated=True,
+        per_replica=[{"role": "prefill"}, {"role": "decode"}],
+        start=False, **ekw)
+    warmed = router.warmup()
+    router.start()
+    compiles_warm = [e.batcher.compile_count for e in router.engines]
+    t0 = _t.perf_counter()
+    reqs = [router.submit(p, max_new_tokens=mn, timeout_s=120.0)
+            for p, mn in zip(prompts, budgets)]
+    toks = [r.result(timeout=600) for r in reqs]
+    wall = _t.perf_counter() - t0
+    recompiles = sum(e.batcher.compile_count - c0
+                     for e, c0 in zip(router.engines, compiles_warm))
+    pre, dec = router.engines
+    health = router.health()
+    snap = router.snapshot()
+    leaked = sum(e.batcher.alloc.stats()["blocks_in_use"]
+                 for e in router.engines)
+    router.shutdown(drain=False)
+
+    if toks != base:
+        bad = [i for i, (a, b) in enumerate(zip(toks, base)) if a != b]
+        raise RuntimeError(
+            f"disagg leg {leg}: streams {bad} diverged from the "
+            f"monolithic reference — the KV hop is not bit-exact")
+    if dec.batcher.prefill_chunk_calls:
+        raise RuntimeError(
+            f"disagg leg {leg}: decode replica ran "
+            f"{dec.batcher.prefill_chunk_calls} prefill chunks — KV "
+            f"arrived by re-prefill, not by snapshot import")
+    # a prefill-role engine surrenders at the first step boundary
+    # after the first token, by which point the fused step has already
+    # run one decode chunk — so a request holds min(budget, 1 + chunk)
+    # tokens at surrender and only budgets past that ever migrate
+    # (short requests legitimately finish on the prefill replica)
+    expect = sum(1 for b in budgets if b > 1 + kw["chunk"])
+    if dec.batcher.imported_kv != expect \
+            or health["migrations"] != expect:
+        raise RuntimeError(
+            f"disagg leg {leg}: {dec.batcher.imported_kv} imports / "
+            f"{health['migrations']} migrations, expected {expect} "
+            f"(budgets past the surrender boundary) — some hop fell "
+            f"back to re-prefill or double-migrated")
+    if recompiles:
+        raise RuntimeError(
+            f"disagg leg {leg}: {recompiles} post-warmup recompiles "
+            f"across replicas — imports left the warmed ladder")
+    if leaked:
+        raise RuntimeError(
+            f"disagg leg {leg}: {leaked} KV blocks still in use after "
+            f"drain — the export/import hop leaked pool blocks")
+    handoffs = [e["handoff_s"] for e in snap["migration_log"]]
+    ntok = sum(len(t) for t in toks)
+    return {
+        "tokens": toks,
+        "tok_s": ntok / wall,
+        "shapes_warmed": warmed,
+        "migrations": health["migrations"],
+        "migration_bytes": health["migration_bytes"],
+        "handoff_ms_mean": (round(1e3 * sum(handoffs) / len(handoffs), 3)
+                            if handoffs else None),
+        "handoff_ms_max": (round(1e3 * max(handoffs), 3)
+                           if handoffs else None),
+        "prefill_chunks_prefill_replica": pre.batcher.prefill_chunk_calls,
+        "recompiles": recompiles,
+    }
+
+
+def _disagg_gates(params, cfg, prompts, budgets, **kw) -> dict:
+    """The --disagg matrix: the fp leg and the w8+int8-KV leg, each
+    individually hard-gated (bit-identity vs its own monolithic
+    reference, zero decode-replica prefill chunks, one migration per
+    request, zero recompiles), plus the cross-leg accuracy gate — the
+    quantized disaggregated output must match the fp reference at
+    least as well as the documented quantization floor (the snapshot
+    hop must not add divergence on top of int8 rounding)."""
+    fp = _disagg_leg(params, cfg, prompts, budgets,
+                     weight_dtype="fp", kv_dtype="fp", **kw)
+    q = _disagg_leg(params, cfg, prompts, budgets,
+                    weight_dtype="int8", kv_dtype="int8", **kw)
+    m = _prefix_match(fp["tokens"], q["tokens"])
+    if m < QUANT_MATCH_FLOOR:
+        raise RuntimeError(
+            f"disagg gate: int8 disaggregated output matches only "
+            f"{m:.3f} of the fp run (documented floor "
+            f"{QUANT_MATCH_FLOOR}) — the snapshot hop amplified "
+            f"quantization error")
+    return {
+        "disagg_replicas": 2,
+        "disagg_tok_s": round(fp["tok_s"], 1),
+        "disagg_tok_s_int8": round(q["tok_s"], 1),
+        "disagg_shapes_warmed": fp["shapes_warmed"],
+        "disagg_migrations": fp["migrations"],
+        "disagg_migration_bytes": fp["migration_bytes"],
+        "disagg_migration_bytes_int8": q["migration_bytes"],
+        "disagg_handoff_ms_mean": fp["handoff_ms_mean"],
+        "disagg_handoff_ms_max": fp["handoff_ms_max"],
+        "disagg_token_match_int8": round(m, 4),
+        "disagg_recompiles_after_warmup": 0,      # each leg hard-gated
+    }
+
+
 def _slo_breach_leg(params, cfg, prompts, budgets, **kw) -> dict:
     """The SLO-engine gate, e2e over the whole surface: a 1-replica
     Router + HttpFrontend serve the mixed workload while a seeded
@@ -1207,7 +1350,7 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
 
     base = None
     if workload in ("fused", "prefix-share", "chaos", "quantized",
-                    "router", "restart", "slo"):
+                    "router", "restart", "slo", "disagg"):
         # staggered per-request budgets so slots retire at DIFFERENT
         # steps — equal budgets would march the whole batch in lockstep
         # waves and no admission would ever land mid-decode. The fused
@@ -1233,6 +1376,15 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         # recompile, gather-bytes and divergence gates; the plain
         # fp _serve below still provides the base JSON numbers
         quant = _quantized_gates(
+            params, cfg, prompts, kw["budgets"],
+            **{k: v for k, v in kw.items() if k != "budgets"})
+    disagg = None
+    if workload == "disagg":
+        # the disaggregated prefill/decode matrix (fp + w8/int8-KV)
+        # with its bit-identity / zero-decode-prefill / one-migration-
+        # per-request / zero-recompile gates; the plain fp _serve
+        # below still provides the base JSON numbers
+        disagg = _disagg_gates(
             params, cfg, prompts, kw["budgets"],
             **{k: v for k, v in kw.items() if k != "budgets"})
     slo = None
@@ -1447,12 +1599,15 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         result.update(routed)
     if quant is not None:
         result.update(quant)
+    if disagg is not None:
+        result.update(disagg)
     if slo is not None:
         result.update(slo)
     if spec is not None:
         result.update(spec)
     if workload in ("mixed", "fused", "chaos", "quantized", "router",
-                    "restart", "slo", "speculative") and r["recompiles"]:
+                    "restart", "slo", "speculative", "disagg") \
+            and r["recompiles"]:
         raise RuntimeError(
             f"bucketed workload recompiled {r['recompiles']} prefill "
             f"shapes after warmup — the bucket ladder no longer covers "
@@ -1546,6 +1701,21 @@ def _cli() -> dict:
                          "int8 KV gather bytes > 0.55x fp, or "
                          "quantized-vs-fp greedy divergence below the "
                          "documented floor")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated prefill/decode gate: the "
+                         "mixed workload through a monolithic "
+                         "reference engine, then through "
+                         "Router(disaggregated=True) with one "
+                         "prefill-role and one decode-role replica "
+                         "(KVSnapshot export/import per request), fp "
+                         "AND w8+int8-KV; HARD-FAILS unless the "
+                         "disaggregated streams are bit-identical to "
+                         "the monolithic run, the decode replica ran "
+                         "zero prefill chunks, every request migrated "
+                         "exactly once, the int8 leg holds the "
+                         "documented fp-match floor and recompiles "
+                         "stay 0 on both replicas; emits migration "
+                         "count/bytes and handoff latency")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="serve with the prefix cache disabled")
     ap.add_argument("--attention-impl", default="auto",
@@ -1595,11 +1765,11 @@ def _cli() -> dict:
         a.router = False
     if sum((a.prefix_share, a.bucketed, a.fused, a.chaos,
             a.quantized, a.router, a.restart, a.slo, a.speculative,
-            a.load)) > 1:
+            a.disagg, a.load)) > 1:
         ap.error("--prefix-share, --bucketed, --fused, --chaos, "
                  "--quantized, --router, --restart, --slo, "
-                 "--speculative and --load are mutually exclusive "
-                 "(except --load --router)")
+                 "--speculative, --disagg and --load are mutually "
+                 "exclusive (except --load --router)")
     workload = ("prefix-share" if a.prefix_share
                 else "mixed" if a.bucketed
                 else "fused" if a.fused
@@ -1609,6 +1779,7 @@ def _cli() -> dict:
                 else "restart" if a.restart
                 else "slo" if a.slo
                 else "speculative" if a.speculative
+                else "disagg" if a.disagg
                 else "load" if a.load else "random")
     bucket_cap = a.max_prefill_bucket
     if bucket_cap is None:
@@ -1618,12 +1789,12 @@ def _cli() -> dict:
         bucket_cap = (16 if workload in ("mixed", "fused", "chaos",
                                          "quantized", "router",
                                          "restart", "slo", "load",
-                                         "speculative")
+                                         "speculative", "disagg")
                       else 512)
     chunk = (a.chunk if a.chunk is not None
              else 2 if workload in ("fused", "prefix-share", "chaos",
                                     "quantized", "router", "restart",
-                                    "slo", "speculative")
+                                    "slo", "speculative", "disagg")
              else 4)
     return main(n_requests=a.n_requests, max_new=a.max_new,
                 max_batch=a.max_batch, block_size=a.block_size,

@@ -48,7 +48,11 @@ HOT_ROOTS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     # host-side call edge the graph could follow
     ("nlp/paged.py",
      ("step", "run", "_step_fused", "_step_spec", "_forward_spec",
-      "forward_paged", "_prefill_pending", "_run_standalone_unit")),
+      "forward_paged", "_prefill_pending", "_run_standalone_unit",
+      # the KV migration hop: export coalesces one device_get while
+      # the source engine's loop is paused on it; import scatters into
+      # the destination pool between its steps — both on serving ticks
+      "export_kv", "import_kv")),
     # the kernel + impl pick: entered from traced code / engine setup
     ("nlp/ragged_attention.py",
      ("ragged_paged_attention", "_rpa_kernel", "resolve_attention_impl")),
@@ -57,14 +61,19 @@ HOT_ROOTS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("quantization/kv.py",
      ("quantize", "dequantize", "rescale_codes", "scale_of")),
     # the engine thread's tick and the per-request dispatch fan-out
-    ("serving/engine.py", ("_loop", "_dispatch", "load")),
+    ("serving/engine.py", ("_loop", "_dispatch", "load",
+                           # KV handoff surfaces: called from the
+                           # router's monitor thread / supervisor
+                           # restart thread while engines keep stepping
+                           "submit_import", "drain_export")),
     # router/frontend tier: per-request routing, the monitor sweep and
     # the HTTP handlers are entry points on their own threads
-    ("serving/router.py", ("submit", "_monitor_loop", "_bridge")),
+    ("serving/router.py", ("submit", "_monitor_loop", "_bridge",
+                           "_migrate")),
     ("serving/frontend.py", ("_handle", "_generate", "_stream_sse")),
     # supervisor health-poll loop + the per-routing-decision probe
-    ("serving/supervisor.py", ("_loop", "_restart_slot", "slot_serving",
-                               "info")),
+    ("serving/supervisor.py", ("_loop", "_restart_slot", "restart_slot",
+                               "slot_serving", "info")),
     # per-tick accessors the graph cannot derive: they are invoked
     # through handles the type map can't follow (capture windows armed
     # over HTTP, spec stats read through as_dict plumbing, trace spans

@@ -999,6 +999,14 @@ class ContinuousBatcher:
         # observed real chunk lengths (len -> count): the data a
         # workload-specific bucket ladder is fitted from (bucket_tuner)
         self.prefill_suffix_hist: Dict[int, int] = {}
+        # KV-transfer accounting (serving/kvtransfer.py): snapshots
+        # exported/imported through this batcher plus a host count of
+        # prefill rows actually computed — the disaggregated bench's
+        # "decode replica ran ZERO prefill chunks" gate reads these
+        self.exported_kv = 0
+        self.imported_kv = 0
+        self.imported_kv_bytes = 0
+        self.prefill_chunk_calls = 0
         # observability: `trace` is an optional serving.trace.TraceSink
         # (per-request timelines — prefill chunk / retire events emit
         # through it, keyed by rid); the flight recorder is ALWAYS on —
@@ -1325,6 +1333,220 @@ class ContinuousBatcher:
                              reason="poisoned_sibling")
         self.queue[:0] = [(v.rid, v.toks, v.stop, v.mn) for v in victims]
 
+    # -- KV transfer (serving/kvtransfer.py holds the container) ----------
+    def kv_fingerprint(self) -> Dict[str, Any]:
+        """Model/pool-shape identity a KVSnapshot must match to be
+        importable here — kvtransfer.check_compatible compares these
+        key-for-key so a cross-topology mistake (different model,
+        kv_dtype or block size) fails at the handoff boundary instead
+        of scattering misinterpreted codes into the pool."""
+        return {
+            "num_layers": int(self.cfg.num_hidden_layers),
+            "num_key_value_heads": int(self.cfg.num_key_value_heads),
+            "head_dim": int(self.cfg.head_dim),
+            "block_size": self.bs,
+            "kv_dtype": self.kv_dtype,
+            "pool_dtype": str(self.cache.k.dtype),
+        }
+
+    def export_kv(self, rid: int):
+        """Snapshot an in-flight request's paged KV into a portable
+        host container (serving.kvtransfer.KVSnapshot): ONE coalesced
+        device_get over exactly the blocks its chain has written —
+        never the whole pool — plus the matching int8 scale entries
+        and the host bookkeeping (tokens, remaining budget, stop id)
+        an `import_kv` needs to resume decode elsewhere.
+
+        Only an ACTIVE decode slot is exportable: queued/pending
+        requests have no KV worth moving (re-submitting the prompt is
+        strictly cheaper), and finished ones have released their
+        blocks — ValueError for both. Migration boundary, not the
+        decode hot path: the device pull below IS the transfer."""
+        slot = None
+        for s in range(self.B):
+            if self.active[s] and self.slot_req[s] == rid:
+                slot = s
+                break
+        if slot is None:
+            raise ValueError(
+                f"request {rid} holds no active decode slot — only "
+                f"in-flight decode state is exportable")
+        gen = list(self.outputs.get(rid, []))
+        prompt = list(self.slot_tokens[slot] or [])
+        # the last emitted token's KV is not written yet (decode writes
+        # token t's KV while producing t+1) — the same arithmetic
+        # _retire uses when registering the prefix
+        written = len(prompt) + len(gen) - 1
+        # ptlint: disable=SYNC001 — one guard readback at the migration boundary, never per step
+        if written != int(self.cache.lengths[slot]):
+            raise RuntimeError(
+                f"slot {slot} device length diverged from host "
+                f"bookkeeping — mid-commit state is not exportable")
+        nw = -(-written // self.bs)
+        chain = list(self.slot_blocks[slot][:nw])
+        idx = jnp.asarray(chain)
+        pulls = [self.cache.k[:, idx], self.cache.v[:, idx]]
+        if self.cache.k_scale is not None:
+            pulls += [self.cache.k_scale[:, idx],
+                      self.cache.v_scale[:, idx]]
+        # ptlint: disable=SYNC001 — the coalesced chain gather IS the export
+        host = jax.device_get(tuple(pulls))
+        ks, vs = (host[2], host[3]) if len(host) == 4 else (None, None)
+        from ..serving.kvtransfer import KVSnapshot
+        snap = KVSnapshot(
+            k=host[0], v=host[1], k_scale=ks, v_scale=vs,
+            tokens=prompt + gen, prompt_len=len(prompt),
+            budget=int(self.budget[slot]),
+            stop_token_id=int(self.stop[slot]),
+            tail_valid=written - (nw - 1) * self.bs,
+            fingerprint=self.kv_fingerprint(),
+            src_blocks=chain, src_replica=self.replica_id)
+        self.exported_kv += 1
+        self._trace_emit(rid, "exported", slot=slot, blocks=nw,
+                         bytes=snap.nbytes, tokens=len(snap.tokens))
+        return snap
+
+    def import_blocks_needed(self, snap) -> int:
+        """Pool blocks `import_kv(snap)` will draw — the head-of-line
+        check an engine's import queue runs before popping. Matches the
+        source batcher's own sizing: written + the unwritten last token
+        + the remaining budget is exactly P + max_new there."""
+        return -(-(len(snap.tokens) + int(snap.budget)) // self.bs)
+
+    def import_kv(self, snap, speculative: bool = False,
+                  on_rid=None) -> int:
+        """Adopt a KVSnapshot: allocate a fresh chain, scatter the
+        block codes AND their int8 scales (transferred entries keep
+        their exact scales; the unwritten tail blocks get the 0.0
+        never-written sentinel, exactly like _prepare_admission's
+        fresh-block reset — grow-only rescale discipline intact),
+        register the written full blocks in the prefix index so
+        siblings hit, and activate a slot that resumes decode at
+        len(tokens) with ZERO prefill chunks. Host-side .at[].set pool
+        edits only — no compiled-shape memo key moves, so post-warmup
+        recompiles stay 0. Returns the new rid; its outputs list is
+        pre-seeded with the snapshot's generated tokens and
+        `_delivered` already covers them, so nothing re-emits.
+
+        `speculative=False` (default) opts the imported request out of
+        the spec pipeline: the draft state did not travel, and plain
+        greedy decode keeps cross-hop bitwise parity unconditionally
+        (spec is greedy-identical by construction, so True is safe too
+        — the default just removes the reasoning burden).
+
+        `on_rid` (optional) is called with the assigned rid before any
+        trace event fires — the engine uses it to alias the rid onto
+        the request's trace timeline.
+
+        Raises ValueError on fingerprint/shape mismatch and
+        RuntimeError when no slot or blocks are free — callers gate on
+        `free_slots()` / `import_blocks_needed()` first."""
+        from ..serving import kvtransfer
+        problems = kvtransfer.check_compatible(snap.fingerprint,
+                                               self.kv_fingerprint())
+        if problems:
+            raise ValueError(
+                "KV snapshot incompatible with this batcher: "
+                + "; ".join(problems))
+        toks = [int(t) for t in snap.tokens]
+        P = int(snap.prompt_len)
+        gen = toks[P:]
+        budget = int(snap.budget)
+        if not gen:
+            raise ValueError(
+                "snapshot carries no generated token — export happens "
+                "at or after the first decode commit")
+        if budget < 1:
+            raise ValueError(
+                "snapshot budget exhausted — the source should have "
+                "retired this request, nothing to resume")
+        written = len(toks) - 1
+        nw = -(-written // self.bs)
+        if nw != int(snap.k.shape[1]):
+            raise ValueError(
+                f"snapshot carries {int(snap.k.shape[1])} blocks but "
+                f"its {written} written tokens span {nw}")
+        total = written + 1 + budget      # == P + max_new at the source
+        if total > self.max_total:
+            raise ValueError(
+                f"resumed request needs {total} total tokens, over "
+                f"this batcher's max_total_len {self.max_total}")
+        need = -(-total // self.bs)
+        reserved = {e[0].slot for e in self._pending}
+        slot = None
+        for s in range(self.B):
+            if not self.active[s] and s not in reserved:
+                slot = s
+                break
+        if slot is None:
+            raise RuntimeError("no free batch slot for KV import")
+        if need > self.alloc.free_blocks:
+            raise RuntimeError(
+                f"KV import needs {need} blocks, pool has "
+                f"{self.alloc.free_blocks} free")
+        fresh = self.alloc.allocate(need)
+        # scatter the chain's codes into the fresh blocks — the same
+        # host-side .at[].set idiom as _apply_cow, nothing traced
+        hk, hv = snap.k, snap.v
+        idx = jnp.asarray(fresh[:nw])
+        cache = self.cache._replace(
+            k=self.cache.k.at[:, idx].set(
+                jnp.asarray(hk, self.cache.k.dtype)),
+            v=self.cache.v.at[:, idx].set(
+                jnp.asarray(hv, self.cache.v.dtype)))
+        if cache.k_scale is not None:
+            # fingerprint equality guarantees the snapshot carries
+            # scales whenever the local pool is quantized
+            hks, hvs = snap.k_scale, snap.v_scale
+            sks = jnp.zeros((cache.k_scale.shape[0], need), jnp.float32)
+            sks = sks.at[:, :nw].set(jnp.asarray(hks, jnp.float32))
+            svs = jnp.zeros((cache.v_scale.shape[0], need), jnp.float32)
+            svs = svs.at[:, :nw].set(jnp.asarray(hvs, jnp.float32))
+            fidx = jnp.asarray(fresh)
+            cache = cache._replace(
+                k_scale=cache.k_scale.at[:, fidx].set(sks),
+                v_scale=cache.v_scale.at[:, fidx].set(svs))
+        row = fresh + [0] * (self.M - need)
+        self.cache = cache._replace(
+            table=cache.table.at[slot].set(jnp.asarray(row, jnp.int32)),
+            lengths=cache.lengths.at[slot].set(written))
+        rid = self._next_rid
+        self._next_rid += 1
+        if on_rid is not None:
+            # caller hook fired the moment the rid exists — the engine
+            # aliases rid→trace timeline here so the "imported" emit
+            # below lands on the request's timeline instead of
+            # auto-opening a phantom rid lane
+            on_rid(rid)
+        self.outputs[rid] = list(gen)
+        self._delivered[rid] = len(gen)
+        self.active[slot] = True
+        self.slot_req[slot] = rid
+        self.slot_blocks[slot] = list(fresh)
+        self.slot_tokens[slot] = toks[:P]
+        self.budget[slot] = budget
+        self.stop[slot] = int(snap.stop_token_id)
+        self.cur_tok = self.cur_tok.at[slot].set(gen[-1])
+        self._dev_state = None           # slot occupancy changed
+        self._spec_ok_dev = None
+        if not speculative:
+            self._no_spec.add(rid)
+        if self._pcache is not None:
+            # the written prefix's full blocks (prompt AND generated,
+            # like _retire's registration) become visible to siblings
+            # immediately; their KV is already written, so mark_cached
+            # now — the post-_commit discipline, not the prepared one
+            n_full = written // self.bs
+            if n_full:
+                self.alloc.mark_cached(self._pcache.insert(
+                    toks[:n_full * self.bs], fresh[:n_full]))
+        self.imported_kv += 1
+        self.imported_kv_bytes += snap.nbytes
+        self._trace_emit(rid, "imported", slot=slot, blocks=need,
+                         bytes=snap.nbytes, resumed_tokens=len(gen),
+                         src_replica=snap.src_replica)
+        return rid
+
     # -- internals --------------------------------------------------------
     def _upload_slot_state(self):
         """Host slot lists → device arrays. Deliberately OUTSIDE step()'s
@@ -1357,6 +1579,7 @@ class ContinuousBatcher:
         fenced this call: the chunk's DEVICE wall next to its host
         wall, so a capture window's timelines attribute regressions to
         the kernel vs host scheduling."""
+        self.prefill_chunk_calls += len(items)
         if self._trace is None:
             return
         for rec, start, end in items:

@@ -38,7 +38,13 @@ occupancy + prefix-affinity policy, cross-replica failover via
 resume-from-`prompt + tokens`), `supervisor` (self-healing replica
 lifecycle: auto-restart with a readiness gate, exponential backoff
 and a crash-loop circuit breaker — `Router(auto_restart=True)`),
-`frontend` (stdlib asyncio HTTP: `POST /v1/generate`,
+`kvtransfer` (portable per-request KV-block snapshots: the
+dependency-free `KVSnapshot` container behind
+`ContinuousBatcher.export_kv`/`import_kv` — disaggregated
+prefill/decode handoff via `Router(disaggregated=True)` +
+`ServingEngine(role="prefill"|"decode")`, warm failover, and
+supervisor drain-export-respawn-resume), `frontend` (stdlib asyncio
+HTTP: `POST /v1/generate`,
 `POST /v1/stream` SSE, `GET /health`, `GET /metrics` with
 per-replica labels, `POST /admin/reset_breaker`,
 `POST /debug/profile`), `slo` (the SLO engine: declarative
@@ -69,6 +75,7 @@ from .profiling import StepProfiler  # noqa: F401
 from .scheduler import AdmissionQueue, QueueFullError  # noqa: F401
 from .speculative import SpecConfig, SpecStats  # noqa: F401
 from .slo import SloTracker, DEFAULT_OBJECTIVES  # noqa: F401
+from .kvtransfer import KVSnapshot  # noqa: F401
 from .trace import TraceSink, FlightRecorder  # noqa: F401
 
 __all__ = [
@@ -80,6 +87,7 @@ __all__ = [
     "TraceSink", "FlightRecorder",
     "SloTracker", "StepProfiler",
     "SpecConfig", "SpecStats",
+    "KVSnapshot",
     "FaultInjector", "InjectedFault",
     "PrefixCacheIndex", "RefcountingBlockAllocator",
     "ContinuousBatcher", "PagedKVCache",

@@ -80,6 +80,7 @@ import threading
 import time
 from typing import Dict, Iterator, List, Optional
 
+from .kvtransfer import KVSnapshot, check_compatible
 from .metrics import LATENCY_BUCKETS, MetricsRegistry
 from .request import GenerationRequest, RequestState
 from .scheduler import AdmissionQueue, QueueFullError
@@ -159,6 +160,7 @@ class ServingEngine:
                  slo_opts: Optional[Dict] = None,
                  profile_sample_every: int = 64,
                  replica_id: str = "r0",
+                 role: str = "both",
                  clock=time.monotonic):
         # multi-replica attribution: every snapshot, health report,
         # flight dump and batcher-side `prepared` trace event carries
@@ -166,6 +168,27 @@ class ServingEngine:
         # the replica that produced them (default "r0": a standalone
         # engine IS replica zero)
         self.replica_id = str(replica_id)
+        # disaggregated serving (ROADMAP direction 2): a "prefill"-role
+        # engine finishes every request at prefill-complete (first
+        # token) and surrenders its KV as a portable snapshot on
+        # `req.kv_snapshot` (reason "prefill_complete") for a decode
+        # replica to adopt via submit_import(); a "decode"-role engine
+        # serves normally but advertises itself as the adoption target
+        # a disaggregated Router migrates to. "both" (the default) is
+        # the monolithic behavior — role steers ROUTER placement, the
+        # engine itself accepts plain submits in every role (probes
+        # and standalone use keep working).
+        role = str(role)
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'both', "
+                f"got {role!r}")
+        self.role = role
+        if role == "prefill":
+            # surrender happens at the first committed token — a spec
+            # draft/verify pipeline would never complete a sweep before
+            # the handoff, so keep the warmup ladder spec-free
+            speculative = False
         # observability: per-request timelines (always-on-cheap unless
         # trace=False) + the batcher's step flight recorder; a step
         # failure dumps the ring + allocator/queue state to JSON
@@ -241,6 +264,15 @@ class ServingEngine:
         self._wd_grace = max(1.0, float(watchdog_compile_grace))
         self._health_window_s = float(health_window_s)
         self._parked: List[List] = []       # [ready_time, request]
+        # pending KV-snapshot adoptions: (snapshot, request) in arrival
+        # order — the engine thread activates them via import_kv ahead
+        # of fresh admissions (_process_imports_locked)
+        self._imports: List = []
+        # drain-and-export rendezvous (supervisor teardown): a caller's
+        # box list the engine thread fills with (snapshot, request)
+        # pairs for every exportable in-flight request, then clears the
+        # reference (None = no drain order pending)
+        self._drain_export_box: Optional[List] = None
         self._wedged = False
         self._warmed = False                # warmup() ran (AOT ladder)
         # livelock fuse tripped: the engine declared itself UNHEALTHY
@@ -323,6 +355,15 @@ class ServingEngine:
         self._c_retried = m.counter("requests_retried")
         self._c_watchdog = m.counter("watchdog_trips")
         self._c_dump_errors = m.counter("flight_dump_errors")
+        # KV-transfer surface (serving/kvtransfer.py): snapshots
+        # exported (prefill-role handoffs, drain-and-export, failover
+        # attachment) and imported (adoptions activated), plus
+        # quarantine innocents restored slot-in-place instead of
+        # requeued through re-prefill
+        self._c_kv_exports = m.counter("kv_exports")
+        self._c_kv_imports = m.counter("kv_imports")
+        self._c_restored = m.counter("requests_restored")
+        self._c_handoffs = m.counter("prefill_handoffs")
 
         # SLO engine: declarative objectives over dual rolling windows
         # (serving.slo) — fed from the same observations the
@@ -442,6 +483,71 @@ class ServingEngine:
             self._work.notify_all()
         return req
 
+    def submit_import(self, snapshot: KVSnapshot,
+                      req: Optional[GenerationRequest] = None
+                      ) -> GenerationRequest:
+        """Queue a portable KV snapshot for adoption: the engine thread
+        activates it via `ContinuousBatcher.import_kv` — fresh blocks,
+        scattered codes AND int8 scales, prefix index registered —
+        ahead of cold admissions, and decode resumes at
+        `len(snapshot.tokens)` with ZERO prefill chunks.
+
+        `req` is the handle to resume; its `tokens` must already hold
+        exactly the snapshot's generated tokens (a live handle that
+        streamed them does; a router-side fresh handle pre-seeds them).
+        None builds a new handle whose `tokens` are pre-seeded — they
+        appear in result(), only NEW tokens stream. Fail-fast like
+        submit(): fingerprint mismatch, misaligned handle tokens and a
+        chain the pool can NEVER hold raise ValueError here, not after
+        queueing. EngineStopped after shutdown began."""
+        b = self.batcher
+        problems = check_compatible(snapshot.fingerprint,
+                                    b.kv_fingerprint())
+        if problems:
+            self._c_rejected.inc()
+            raise ValueError("KV snapshot incompatible with this "
+                             "engine: " + "; ".join(problems))
+        if b.import_blocks_needed(snapshot) > b.alloc.num_blocks:
+            self._c_rejected.inc()
+            raise ValueError(
+                f"snapshot needs {b.import_blocks_needed(snapshot)} KV "
+                f"blocks but the pool holds {b.alloc.num_blocks}")
+        gen = list(snapshot.tokens[snapshot.prompt_len:])
+        fresh_handle = req is None
+        if fresh_handle:
+            req = GenerationRequest(
+                list(snapshot.tokens[:snapshot.prompt_len]),
+                max_new_tokens=len(gen) + int(snapshot.budget),
+                stop_token_id=(None if snapshot.stop_token_id < 0
+                               else snapshot.stop_token_id))
+            req.tokens = list(gen)
+        elif len(req.tokens) != len(gen):
+            self._c_rejected.inc()
+            raise ValueError(
+                f"handle carries {len(req.tokens)} streamed tokens but "
+                f"the snapshot generated {len(gen)} — resume would "
+                f"misalign the stream")
+        with self._work:
+            if self._stop or not self._accepting:
+                raise EngineStopped("engine is shutting down")
+            now = self._clock()
+            if req.submit_time is None:
+                req.submit_time = now
+                if req.timeout_s is not None:
+                    req.deadline = now + req.timeout_s
+                self._c_submitted.inc()
+            if self.trace is not None:
+                if req.trace_id is None:
+                    req.trace_id = self.trace.start()
+                self.trace.emit(req.trace_id, "import_enqueued",
+                                blocks=snapshot.n_blocks,
+                                bytes=snapshot.nbytes,
+                                resumed_tokens=len(gen),
+                                src_replica=snapshot.src_replica)
+            self._imports.append((snapshot, req))
+            self._work.notify_all()
+        return req
+
     def generate(self, prompt, timeout: Optional[float] = None,
                  **kw) -> List[int]:
         """Blocking one-shot: submit + wait for the full output. On
@@ -467,21 +573,59 @@ class ServingEngine:
     def is_idle(self) -> bool:
         with self._lock:
             return (not self._running and not len(self.queue)
-                    and not self._parked)
+                    and not self._parked and not self._imports)
 
     def drain(self, timeout: Optional[float] = None) -> bool:
-        """Block until queue + parked retries + in-flight are empty;
-        False on timeout. Returns promptly after a watchdog trip (the
-        stranded set is already failed — nothing will ever drain)."""
+        """Block until queue + parked retries + pending imports +
+        in-flight are empty; False on timeout. Returns promptly after
+        a watchdog trip (the stranded set is already failed — nothing
+        will ever drain)."""
         deadline = None if timeout is None else self._clock() + timeout
         with self._work:
-            while self._running or len(self.queue) or self._parked:
+            while (self._running or len(self.queue) or self._parked
+                   or self._imports):
                 rem = self._idle_poll_s if deadline is None else \
                     min(self._idle_poll_s, deadline - self._clock())
                 if rem <= 0:
                     return False
                 self._work.wait(rem)
         return True
+
+    def drain_export(self, timeout: float = 2.0) -> List:
+        """Stop admissions and hand every in-flight request's KV out as
+        (snapshot, request) pairs — the supervisor's pre-teardown move,
+        so a respawned replica resumes them via submit_import() without
+        re-prefill. The engine thread runs the export (it owns the
+        batcher); this caller blocks until it does or `timeout` passes.
+
+        Returned pairs keep their handles OPEN (still streaming to the
+        consumer) — the caller MUST either re-import them or fail them.
+        Requests with nothing exportable (still in prefill, export
+        failed) and everything queued/parked fail here with reason
+        "drained_for_restart" — a replica-indicting reason the Router's
+        failover predicate re-places via warm re-prefill. Returns []
+        when the loop is not running / wedged / broken (nothing can
+        export — callers fall back to the cold path)."""
+        box: List = []
+        with self._work:
+            if (self._thread is None or self._wedged
+                    or self._broken is not None or self._stop):
+                return []
+            self._accepting = False
+            self._drain_export_box = box
+            self._work.notify_all()
+            deadline = self._clock() + timeout
+            # the engine thread performs the whole drain under ONE lock
+            # hold, so the box is either untouched or complete — on
+            # timeout (thread stuck in a device call) withdraw the
+            # order; the caller proceeds cold
+            while self._drain_export_box is not None:
+                rem = deadline - self._clock()
+                if rem <= 0:
+                    self._drain_export_box = None
+                    return []
+                self._work.wait(min(self._idle_poll_s, rem))
+        return box
 
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> bool:
@@ -534,11 +678,16 @@ class ServingEngine:
             self._cancel_pending_locked()
 
     def _cancel_pending_locked(self) -> None:
-        """Cancel everything queued + parked + in flight (lock held)."""
+        """Cancel everything queued + parked + pending imports + in
+        flight (lock held)."""
         for _, req in self._parked:
             self._finish_locked(req, RequestState.CANCELLED,
                                 "engine_shutdown")
         self._parked.clear()
+        for _snap, req in self._imports:
+            self._finish_locked(req, RequestState.CANCELLED,
+                                "engine_shutdown")
+        self._imports.clear()
         for req in self.queue.clear():
             self._finish_locked(req, RequestState.CANCELLED,
                                 "engine_shutdown")
@@ -598,9 +747,11 @@ class ServingEngine:
             stats = self._alloc_stats
             return {
                 "replica_id": self.replica_id,
+                "role": self.role,
                 "queue_depth": len(self.queue),
                 "in_flight": len(self._running),
                 "parked_retries": len(self._parked),
+                "pending_imports": len(self._imports),
                 "kv_utilization": (stats["blocks_in_use"]
                                    / stats["capacity_blocks"]),
                 "accepting": self._accepting and not self._stop
@@ -631,6 +782,7 @@ class ServingEngine:
         return {
             "status": status,
             "replica_id": self.replica_id,
+            "role": self.role,
             # readiness: warmed (no cold-compile TTFT cliffs left),
             # loop live, and not declared dead — the supervisor's
             # readiness gate requires this True (plus a served probe)
@@ -642,6 +794,7 @@ class ServingEngine:
             "step_faults": self._c_step_faults.value,
             "quarantines": self._c_quarantines.value,
             "requests_requeued": self._c_requeued.value,
+            "requests_restored": self._c_restored.value,
             "requests_retried": self._c_retried.value,
             "requests_failed": self._c_failed.value,
             "watchdog_trips": self._c_watchdog.value,
@@ -784,12 +937,18 @@ class ServingEngine:
                     # left so no consumer stays blocked on its channel
                     self._cancel_pending_locked()
                     return
+                if self._drain_export_box is not None:
+                    # supervisor teardown: hand the in-flight set's KV
+                    # out as snapshots before anything else reshapes it
+                    self._drain_export_locked()
                 self._reap_queued_locked()
                 self._reap_running_locked()
                 self._release_parked_locked()
+                self._process_imports_locked()
                 self._admit_locked()
                 self._update_gauges_locked()
-                if not self._running and not len(self.queue):
+                if (not self._running and not len(self.queue)
+                        and not self._imports):
                     if self._parked:
                         # a backoff retry is the only pending work:
                         # sleep just until the earliest one is ready
@@ -983,6 +1142,106 @@ class ServingEngine:
                 self._c_admitted.inc()
             self._running[rid] = req
 
+    def _process_imports_locked(self) -> None:
+        """Activate pending KV-snapshot adoptions (engine thread, lock
+        held) — BEFORE fresh admissions: an import resumes a request
+        that already streamed tokens, so it outranks cold work.
+        Head-of-line in arrival order: when the head does not fit
+        (slot/blocks) the whole line waits — fairness over packing,
+        same discipline as the admission queue."""
+        b = self.batcher
+        now = self._clock()
+        while self._imports:
+            snap, req = self._imports[0]
+            if req.cancel_requested or self._expired(req, now):
+                self._imports.pop(0)
+                state = (RequestState.CANCELLED if req.cancel_requested
+                         else RequestState.TIMED_OUT)
+                self._finish_locked(req, state, "reaped_pending_import")
+                continue
+            if (b.free_slots() <= 0
+                    or b.import_blocks_needed(snap)
+                    > b.alloc.free_blocks):
+                break
+            self._imports.pop(0)
+            on_rid = None
+            if self.trace is not None and req.trace_id is not None:
+                tid = req.trace_id
+                # alias the rid the instant import_kv assigns it, so
+                # the batcher's own "imported" emit (fired inside
+                # import_kv, before control returns here) resolves to
+                # the request's timeline instead of a phantom rid lane
+                on_rid = lambda r: self.trace.alias(r, tid)
+            try:
+                rid = b.import_kv(snap, on_rid=on_rid)
+            # ptlint: disable=EXC001 — per-request boundary: a bad
+            # snapshot fails ONLY this request; the error is attached
+            # to the handle and re-raised in its result()
+            except Exception as e:
+                self._finish_locked(req, RequestState.FAILED,
+                                    "kv_import_failed", error=e)
+                continue
+            req.request_id = rid
+            req.state = RequestState.DECODING
+            # no engine-level "imported" emit: the batcher's own (fired
+            # inside import_kv, resolved through the on_rid alias)
+            # already carries slot/blocks/bytes/resumed_tokens
+            if req.admit_time is None:
+                req.admit_time = now
+                req.admitted_index = self._admit_seq
+                self._admit_seq += 1
+                self._c_admitted.inc()
+            self._c_kv_imports.inc()
+            self._running[rid] = req
+
+    def _drain_export_locked(self) -> None:
+        """Engine-thread half of drain_export() (lock held): export
+        every in-flight request's KV into the caller's box as a
+        (snapshot, request) pair — the handle stays OPEN for the
+        caller to resume via submit_import() on the respawned engine —
+        and fail everything that cannot travel (prefill not committed,
+        export raised, queued/parked) with "drained_for_restart" so
+        the Router's failover re-places it warm via re-prefill. Runs
+        under ONE lock hold: the box is either untouched or complete
+        when drain_export()'s wait wakes."""
+        box = self._drain_export_box
+        b = self.batcher
+        for rid, req in list(self._running.items()):
+            snap = None
+            if not req.cancel_requested:
+                try:
+                    snap = b.export_kv(rid)
+                # ptlint: disable=EXC001 — per-request boundary: an
+                # export failure downgrades THIS request to the warm
+                # re-prefill path, nothing else
+                except Exception:
+                    snap = None
+            b.abort(rid)
+            b.release(rid)
+            self._last_emit.pop(rid, None)
+            if snap is not None:
+                self._c_kv_exports.inc()
+                box.append((snap, req))
+            else:
+                self._finish_locked(req, RequestState.FAILED,
+                                    "drained_for_restart")
+        self._running.clear()
+        # pending adoptions already carry their snapshots — pass them
+        # through to the respawned engine untouched
+        for snap, req in self._imports:
+            box.append((snap, req))
+        self._imports.clear()
+        for _, req in self._parked:
+            self._finish_locked(req, RequestState.FAILED,
+                                "drained_for_restart")
+        self._parked.clear()
+        for req in self.queue.clear():
+            self._finish_locked(req, RequestState.FAILED,
+                                "drained_for_restart")
+        self._drain_export_box = None
+        self._update_gauges_locked()
+        self._work.notify_all()
+
     def _dispatch(self, emitted: Dict[int, List[int]],
                   finished: List[int],
                   step_dt: Optional[float] = None) -> None:
@@ -997,6 +1256,11 @@ class ServingEngine:
             # same duration, so the Chrome trace's steps lane lines up
             # with the histogram (and the XPlane RecordEvent spans)
             self.trace.span("engine.step", dur=step_dt, tokens=ntok)
+        # prefill-role surrender: requests that produced their first
+        # token(s) this step but did NOT finish hand their KV over as
+        # a snapshot (reason "prefill_complete") — collected in the
+        # emit loop, exported after it
+        handoffs: List[int] = []
         for rid, toks in emitted.items():
             # ptlint: thread-confined — the token bridge: emission runs
             # lock-free on the engine thread so submit()/cancel() stay
@@ -1053,6 +1317,10 @@ class ServingEngine:
                 if traced:
                     self.trace.emit(req.trace_id, "decode_emit",
                                     n=len(toks))
+                if self.role == "prefill" and rid not in finished:
+                    handoffs.append(rid)
+        for rid in handoffs:
+            self._surrender(rid)
         with self._work:
             for rid in finished:
                 self.batcher.release(rid)    # tokens already delivered
@@ -1063,6 +1331,45 @@ class ServingEngine:
                                     self._finish_reason(req))
             self._update_gauges_locked()
             self._work.notify_all()
+
+    def _surrender(self, rid: int) -> None:
+        """Prefill-role handoff (engine thread): the request committed
+        its first token(s) — prefill is done, decode belongs to a
+        decode replica. Export its KV, attach the snapshot to the
+        handle and FINISH it with reason "prefill_complete"; a
+        disaggregated Router migrates the snapshot to a decode replica
+        and the client stream continues seamlessly. When the export
+        itself fails the snapshot stays None and the Router falls back
+        to warm re-prefill from `prompt + tokens` — same terminal
+        reason, one fallback ladder."""
+        with self._work:
+            req = self._running.get(rid)
+        if req is None:
+            return
+        snap = None
+        try:
+            snap = self.batcher.export_kv(rid)
+        # ptlint: disable=EXC001 — per-request boundary: an export
+        # failure downgrades THIS handoff to the re-prefill path
+        except Exception:
+            snap = None
+        self.batcher.abort(rid)
+        self.batcher.release(rid)
+        with self._work:
+            self._running.pop(rid, None)
+            self._last_emit.pop(rid, None)
+            req.kv_snapshot = snap
+            self._c_handoffs.inc()
+            if snap is not None:
+                self._c_kv_exports.inc()
+            if self.trace is not None and req.trace_id is not None:
+                self.trace.emit(
+                    req.trace_id, "prefill_complete",
+                    exported=snap is not None,
+                    bytes=0 if snap is None else snap.nbytes,
+                    tokens_kept=len(req.tokens))
+            self._finish_locked(req, RequestState.FINISHED,
+                                "prefill_complete")
 
     def _finish_reason(self, req: GenerationRequest) -> str:
         last = req.tokens[-1] if req.tokens else None
@@ -1171,7 +1478,22 @@ class ServingEngine:
             order = sorted(self._running.items(),
                            key=lambda kv: kv[1].admitted_index or 0)
             victims: List[GenerationRequest] = []
+            restorable: List = []        # (request, snapshot) innocents
             for rid, req in order:
+                snap = None
+                if rid not in culprits and not req.cancel_requested:
+                    # slot-in-place recovery (PR 8 follow-on): the
+                    # failed call committed NOTHING (commits happen
+                    # after the device call returns), so an innocent's
+                    # slot state is intact — export its KV now and
+                    # re-import below instead of requeueing it through
+                    # a full re-prefill of `prompt + tokens`
+                    try:
+                        snap = b.export_kv(rid)
+                    # ptlint: disable=EXC001 — per-request boundary: an
+                    # unexportable innocent degrades to the requeue path
+                    except Exception:
+                        snap = None
                 b.abort(rid)
                 b.release(rid)
                 self._last_emit.pop(rid, None)
@@ -1180,9 +1502,33 @@ class ServingEngine:
                 if rid in culprits:
                     self._retry_or_fail_locked(req, culprits[rid],
                                                convicted)
+                elif snap is not None:
+                    restorable.append((req, snap))
                 else:
                     victims.append(req)
             self._running.clear()
+            for req, snap in restorable:
+                try:
+                    rid2 = b.import_kv(snap)
+                # ptlint: disable=EXC001 — per-request boundary: a
+                # failed re-import falls back to the requeue path —
+                # nothing lost, just cold
+                except Exception:
+                    victims.append(req)
+                    continue
+                req.request_id = rid2
+                self._running[rid2] = req
+                self._c_kv_exports.inc()
+                self._c_kv_imports.inc()
+                self._c_restored.inc()
+                if self.trace is not None and req.trace_id is not None:
+                    self.trace.alias(rid2, req.trace_id)
+                    self.trace.emit(req.trace_id, "restored",
+                                    reason="quarantine_victim",
+                                    rid=rid2,
+                                    tokens_kept=len(req.tokens),
+                                    re_prefill=0,
+                                    spec_fallback=spec_tick)
             for req in victims:
                 self._c_requeued.inc()
                 if self.trace is not None and req.trace_id is not None:
@@ -1321,11 +1667,24 @@ class ServingEngine:
     def _fail_all_running(self, error: BaseException) -> None:
         """The conservative step-failure fallback (quarantine off, no
         tick recorded, or the consecutive-failure fuse blew): every
-        in-flight request fails with the step error attached."""
+        in-flight request fails with the step error attached. The
+        failed call committed nothing, so each request's KV is still
+        exportable — attach a snapshot to the handle (`kv_snapshot`)
+        on the way down: a Router failing the request over to another
+        replica imports it there instead of re-prefilling (falling
+        back to warm re-prefill when the export didn't land)."""
         with self._work:
             self._c_step_faults.inc()
             self._last_fault_t = self._clock()
             for rid, req in list(self._running.items()):
+                try:
+                    req.kv_snapshot = self.batcher.export_kv(rid)
+                    self._c_kv_exports.inc()
+                # ptlint: disable=EXC001 — per-request boundary: a
+                # failed export just means this victim re-prefills on
+                # the survivor replica
+                except Exception:
+                    req.kv_snapshot = None
                 self.batcher.abort(rid)
                 self.batcher.release(rid)
                 self._finish_locked(req, RequestState.FAILED,

@@ -127,6 +127,13 @@ class GenerationRequest:
         # of the spec pipeline (the convicted spec step must not get a
         # second chance to poison the same request's recovery)
         self.spec_opt_out = False
+        # portable KV attached at a handoff boundary
+        # (serving.kvtransfer.KVSnapshot, or None): a prefill-role
+        # engine surrenders the request's KV here at "prefill_complete"
+        # and a failing engine attaches it on the way down — the Router
+        # imports it at the destination instead of re-prefilling,
+        # falling back to warm re-prefill when it is None
+        self.kv_snapshot = None
 
         # engine-stamped timeline (engine clock, typically time.monotonic)
         self.request_id: Optional[int] = None       # batcher rid once admitted

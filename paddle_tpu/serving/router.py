@@ -39,6 +39,16 @@ circuit breaker that pins a flapping slot FAILED. Affinity entries
 pointing at the respawned slot are invalidated at swap (its KV pool
 is empty) and re-learn from routed traffic.
 
+Disaggregated serving (ROADMAP direction 2): `disaggregated=True`
+routes admission to prefill-capable replicas and, when a prefill-role
+replica finishes a request at "prefill_complete", migrates its
+surrendered `serving.kvtransfer.KVSnapshot` to the decode-capable
+replica the policy picks — imported with zero prefill chunks, the
+stream strictly append-only across the hop, warm re-prefill as the
+fallback rung. The same snapshot primitive rides failover: a replica
+that died exporting its requests' KV (supervisor drain / respawn
+failure) hands each survivor a warm resume instead of a re-prefill.
+
 Lock order (LOCK001): `Router._lock` → `ServingEngine._lock` →
 `AdmissionQueue._lock` — the router may call into an engine while
 holding its own lock; no engine code path ever calls back into the
@@ -92,6 +102,13 @@ SLO_WARN_PENALTY = 4.0
 SLO_BREACH_PENALTY = 10.0
 
 _HEALTH_ORDER = {"HEALTHY": 0, "DEGRADED": 1, "UNHEALTHY": 2}
+
+# role capability sets for disaggregated placement: admission may land
+# on any prefill-capable replica, a KV migration may land on any
+# decode-capable one. "both" replicas qualify for either side, so a
+# mixed fleet (dedicated prefill + general-purpose) still routes.
+_PREFILL_ROLES = ("prefill", "both")
+_DECODE_ROLES = ("decode", "both")
 
 
 class NoReplicaAvailable(QueueFullError):
@@ -246,13 +263,19 @@ def _default_failover_on(req: GenerationRequest,
     when the failure indicts the REPLICA, not the request — the
     hung-step watchdog's `HungStepError` terminals (stranded in-flight
     work and quarantine-requeued victims failed when the engine thread
-    wedged), and the fault-streak fuse's `fault_streak_engine_unhealthy`
+    wedged), the fault-streak fuse's `fault_streak_engine_unhealthy`
     (queued/parked requests the broken replica never served — the
-    replica died, not the request). Convicted quarantine culprits,
-    exhausted retries and on_token failures stay terminal: a request
-    that poisons one replica would poison the next."""
+    replica died, not the request), and the restart pipeline's
+    `drained_for_restart` / `respawn_failed` (the supervisor tore the
+    replica down under the request, or could not resume its exported
+    KV on the respawned engine — either way the replica ended it, and
+    when a `kv_snapshot` rode down with the failure the failover
+    re-places it warm). Convicted quarantine culprits, exhausted
+    retries and on_token failures stay terminal: a request that
+    poisons one replica would poison the next."""
     if reason in ("watchdog_hung_step", "watchdog_engine_unhealthy",
-                  "fault_streak_engine_unhealthy"):
+                  "fault_streak_engine_unhealthy",
+                  "drained_for_restart", "respawn_failed"):
         return True
     return isinstance(error, HungStepError)
 
@@ -276,6 +299,17 @@ class Router:
     replica onto a healthy one (resume from `prompt + tokens`; the
     predicate is pluggable via `failover_on`). Backpressure: when every
     replica refuses admission, `submit()` raises `NoReplicaAvailable`.
+
+    `disaggregated=True` splits prefill from decode (ROADMAP direction
+    2): admission routes to prefill-capable replicas
+    (`role="prefill"`/"both"), and when a prefill-role replica finishes
+    a request at "prefill_complete" the monitor migrates its exported
+    `KVSnapshot` to the decode-capable replica the policy picks —
+    imported there with zero prefill chunks, the client stream staying
+    strictly append-only across the hop. A lost snapshot falls back to
+    warm re-prefill on the decode side (the migrate→re-prefill ladder);
+    the fleet must contain at least one prefill-capable and one
+    decode-capable replica.
 
     `auto_restart=True` attaches a
     `serving.supervisor.ReplicaSupervisor`: an UNHEALTHY replica is
@@ -302,6 +336,7 @@ class Router:
                  metrics: Optional[MetricsRegistry] = None,
                  start: bool = True,
                  per_replica: Optional[Sequence[Optional[Dict]]] = None,
+                 disaggregated: bool = False,
                  auto_restart: bool = False,
                  restart_opts: Optional[Dict] = None,
                  clock: Callable[[], float] = time.monotonic,
@@ -354,6 +389,15 @@ class Router:
         self.engines: List = list(engines)
         if not self.engines:
             raise ValueError("Router needs at least one replica")
+        self._disaggregated = bool(disaggregated)
+        if self._disaggregated:
+            roles = [getattr(e, "role", "both") for e in self.engines]
+            if not any(r in _PREFILL_ROLES for r in roles) \
+                    or not any(r in _DECODE_ROLES for r in roles):
+                raise ValueError(
+                    "disaggregated=True needs at least one "
+                    "prefill-capable and one decode-capable replica "
+                    f"(roles: {roles})")
         self.policy = policy or default_policy
         self._failover_enabled = bool(failover)
         self._max_failovers = (len(self.engines) - 1
@@ -402,6 +446,16 @@ class Router:
         # operator recovery surface: FAILED slots revived without a
         # process restart (POST /admin/reset_breaker)
         self._c_breaker_resets = m.counter("breaker_resets")
+        # disaggregated / KV-transfer surface: `migrations` counts
+        # every router-placed KVSnapshot import (prefill→decode
+        # handoffs AND warm failovers), `migration_bytes` the KV
+        # payload those moved; `handoff_s` times the prefill-complete
+        # → decode-resumed gap (monitor-tick latency included — that
+        # IS the handoff cost the client sees)
+        self._c_migrations = m.counter("migrations")
+        self._c_migration_bytes = m.counter("migration_bytes")
+        self._h_handoff = m.histogram("handoff_s")
+        self._migration_log: List[Dict] = []        # bounded forensics
         # fleet-wide SLO rollup: worst-of verdicts / max burn rates
         # exported with replica="router" next to the per-replica
         # series; the router's slo_breaches counter accumulates
@@ -569,8 +623,9 @@ class Router:
             # the placement otherwise (a failed placement discards the
             # handle, so the early stamp can't leak a live PREFILL)
             outer.state = RequestState.PREFILL
-            inner, idx = self._place(outer, on_token, exclude=(),
-                                     tokens_kept=0)
+            inner, idx = self._place(
+                outer, on_token, exclude=(), tokens_kept=0,
+                roles=_PREFILL_ROLES if self._disaggregated else None)
             ent = _Routed(outer, inner, idx, on_token,
                           inner.max_new_tokens)
             outer.max_new_tokens = inner.max_new_tokens
@@ -605,14 +660,21 @@ class Router:
 
     # ---- routing ---------------------------------------------------------
     def _views(self, eff: Sequence[int],
-               exclude: Sequence[int]) -> List[Tuple[float, int, Dict]]:
+               exclude: Sequence[int],
+               roles: Optional[Sequence[str]] = None,
+               ) -> List[Tuple[float, int, Dict]]:
         """Policy-scored candidate replicas for a prompt, best first.
-        UNHEALTHY / non-accepting / excluded replicas never appear."""
+        UNHEALTHY / non-accepting / excluded replicas never appear;
+        `roles` (disaggregated placement) restricts candidates to
+        replicas whose `engine.role` is in the set."""
         aff = self._affinity.match(eff)
         out: List[Tuple[float, int, Dict]] = []
         sup = self._supervisor
         for i, eng in enumerate(self.engines):
             if i in exclude:
+                continue
+            if roles is not None \
+                    and getattr(eng, "role", "both") not in roles:
                 continue
             if sup is not None and not sup.slot_serving(i):
                 # readiness gate: a RESTARTING slot (fresh engine still
@@ -643,38 +705,66 @@ class Router:
 
     def _place(self, outer: GenerationRequest, user_on_token,
                exclude: Sequence[int],
-               tokens_kept: int) -> Tuple[GenerationRequest, int]:
+               tokens_kept: int,
+               roles: Optional[Sequence[str]] = None,
+               snapshot=None) -> Tuple[GenerationRequest, int]:
         """Build the replica-side request for `outer`'s remaining work
         and submit it to the best-scoring replica that accepts
         (head-of-policy refusals fall through to the next candidate).
-        Called under the router lock. Raises NoReplicaAvailable when
-        nobody accepts."""
+        With `snapshot` the placement imports the request's exported
+        KV instead of enqueuing a prefill (`engine.submit_import`) —
+        the inner request is pre-seeded with the already-streamed
+        tokens, so the bridge only ever forwards NEW ones. Called
+        under the router lock. Raises NoReplicaAvailable when nobody
+        accepts."""
         eff = outer.prompt + outer.tokens
         remaining_new = (None if outer.max_new_tokens is None
                          else outer.max_new_tokens - len(outer.tokens))
         remaining_t = (None if outer.deadline is None
                        else max(0.001, outer.deadline - self._clock()))
-        candidates = self._views(eff, exclude)
+        candidates = self._views(eff, exclude, roles=roles)
         last_err: Optional[BaseException] = None
         for score, i, view in candidates:
             eng = self.engines[i]
-            inner = GenerationRequest(
-                eff, priority=outer.priority,
-                max_new_tokens=remaining_new,
-                stop_token_id=outer.stop_token_id,
-                timeout_s=remaining_t,
-                on_token=self._bridge(outer, user_on_token))
-            try:
-                eng.submit(inner)
-            except (QueueFullError, EngineStopped) as e:
-                # queue-full backpressure or a replica that stopped
-                # accepting between the view and the submit: fall
-                # through to the next candidate. Anything else — a
-                # ValueError for a request that can NEVER fit, or a
-                # genuine engine bug — propagates: rewriting it as
-                # backpressure would 429 a broken service
-                last_err = e
-                continue
+            if snapshot is not None:
+                gen = snapshot.tokens[snapshot.prompt_len:]
+                inner = GenerationRequest(
+                    snapshot.tokens[:snapshot.prompt_len],
+                    priority=outer.priority,
+                    max_new_tokens=len(gen) + int(snapshot.budget),
+                    stop_token_id=outer.stop_token_id,
+                    timeout_s=remaining_t,
+                    on_token=self._bridge(outer, user_on_token))
+                # pre-seed the streamed suffix directly (not through
+                # _deliver — these tokens already reached the client)
+                inner.tokens = list(gen)
+                try:
+                    eng.submit_import(snapshot, inner)
+                except (QueueFullError, EngineStopped, ValueError) as e:
+                    # ValueError joins the fall-through set ONLY here:
+                    # a fingerprint/pool mismatch indicts this replica
+                    # for this snapshot (heterogeneous fleet), not the
+                    # request — another candidate may still import it
+                    last_err = e
+                    continue
+            else:
+                inner = GenerationRequest(
+                    eff, priority=outer.priority,
+                    max_new_tokens=remaining_new,
+                    stop_token_id=outer.stop_token_id,
+                    timeout_s=remaining_t,
+                    on_token=self._bridge(outer, user_on_token))
+                try:
+                    eng.submit(inner)
+                except (QueueFullError, EngineStopped) as e:
+                    # queue-full backpressure or a replica that stopped
+                    # accepting between the view and the submit: fall
+                    # through to the next candidate. Anything else — a
+                    # ValueError for a request that can NEVER fit, or a
+                    # genuine engine bug — propagates: rewriting it as
+                    # backpressure would 429 a broken service
+                    last_err = e
+                    continue
             self._affinity.observe(eff, i)
             # the outer handle advertises its CURRENT serving replica
             # (updated on failover) — the frontend's SSE events and the
@@ -763,6 +853,19 @@ class Router:
         when the request failed over and lives on elsewhere."""
         inner, outer = ent.inner, ent.outer
         now = self._clock()
+        if self._disaggregated \
+                and inner.state is RequestState.FINISHED \
+                and inner.finish_reason == "prefill_complete" \
+                and not outer.cancel_requested:
+            # the disaggregated handoff: a prefill-role replica
+            # finished its half and surrendered the KV — migrate to a
+            # decode-capable replica (snapshot import, or warm
+            # re-prefill when the export failed)
+            if self._migrate(ent):
+                return False
+            outer._finish(RequestState.FAILED, "migration_failed",
+                          error=inner.error, now=now)
+            return True
         if inner.state is RequestState.FAILED and self._failover_enabled \
                 and not outer.cancel_requested \
                 and self._failover_on(inner, inner.error,
@@ -775,33 +878,120 @@ class Router:
                       error=inner.error, now=now)
         return True
 
+    def _migrate(self, ent: _Routed) -> bool:
+        """Move `ent`'s prefill-complete request to a decode-capable
+        replica: import the surrendered `KVSnapshot` when the prefill
+        replica exported one (zero prefill chunks at the destination),
+        else fall back to warm re-prefill from `prompt + tokens` — the
+        migrate→re-prefill ladder. Returns False only when no decode
+        replica accepts either form (the caller fails the outer)."""
+        inner, outer = ent.inner, ent.outer
+        from_idx = ent.idx
+        from_id = self.engines[from_idx].replica_id
+        t0 = (inner.finish_time if inner.finish_time is not None
+              else self._clock())
+        kept = len(outer.tokens)
+        snap = getattr(inner, "kv_snapshot", None)
+        inner2 = None
+        idx = from_idx
+        via = "kv_import"
+        if snap is not None:
+            try:
+                inner2, idx = self._place(outer, ent.user_on_token,
+                                          exclude=(from_idx,),
+                                          tokens_kept=kept,
+                                          roles=_DECODE_ROLES,
+                                          snapshot=snap)
+            except NoReplicaAvailable:
+                inner2 = None
+        if inner2 is None:
+            via = "reprefill"
+            try:
+                inner2, idx = self._place(outer, ent.user_on_token,
+                                          exclude=(from_idx,),
+                                          tokens_kept=kept,
+                                          roles=_DECODE_ROLES)
+            except NoReplicaAvailable:
+                return False
+        inner.kv_snapshot = None          # drop the host payload
+        ent.inner = inner2
+        ent.idx = idx
+        wall = max(0.0, self._clock() - t0)
+        moved = snap.nbytes if (via == "kv_import") else 0
+        blocks = snap.n_blocks if (via == "kv_import") else 0
+        self._c_migrations.inc()
+        if moved:
+            self._c_migration_bytes.inc(moved)
+        self._h_handoff.observe(wall)
+        to_eng = self.engines[idx]
+        entry = {"router_rid": outer.request_id,
+                 "from_replica": from_id,
+                 "to_replica": to_eng.replica_id,
+                 "via": via, "bytes": moved, "blocks": blocks,
+                 "tokens_kept": kept,
+                 "handoff_s": round(wall, 6)}
+        self._migration_log.append(entry)
+        del self._migration_log[:-64]      # bounded forensics ring
+        if to_eng.trace is not None:
+            # span on the DESTINATION sink (it owns the request now);
+            # dur is the client-visible prefill-complete→resumed gap
+            to_eng.trace.span("migrated", dur=wall, **entry)
+            if inner2.trace_id is not None:
+                to_eng.trace.emit(inner2.trace_id, "migrated", **entry)
+        return True
+
     def _failover(self, ent: _Routed) -> bool:
-        """Re-admit `ent`'s request on a different healthy replica,
-        resuming from `prompt + tokens` (nothing re-emits: the outer
-        channel already holds every streamed token, and the resumed
-        decode continues from exactly that suffix). Returns False when
-        no replica accepts — the caller then finishes the outer with
-        the original error."""
+        """Re-admit `ent`'s request on a different healthy replica.
+        When the dying replica attached an exported `kv_snapshot` to
+        the failed inner (drain/teardown paths), the re-placement
+        imports it — the survivor resumes decode with zero prefill
+        chunks; otherwise it resumes from `prompt + tokens` (warm
+        re-prefill). Either way nothing re-emits: the outer channel
+        already holds every streamed token, and the resumed decode
+        continues from exactly that suffix. Returns False when no
+        replica accepts — the caller then finishes the outer with the
+        original error."""
         outer = ent.outer
         from_idx = ent.idx
         from_id = self.engines[from_idx].replica_id
         kept = len(outer.tokens)
-        try:
-            inner, idx = self._place(outer, ent.user_on_token,
-                                     exclude=(from_idx,),
-                                     tokens_kept=kept)
-        except NoReplicaAvailable:
-            return False
+        roles = _DECODE_ROLES if self._disaggregated else None
+        snap = getattr(ent.inner, "kv_snapshot", None)
+        via = "reprefill"
+        inner = None
+        if snap is not None:
+            try:
+                inner, idx = self._place(outer, ent.user_on_token,
+                                         exclude=(from_idx,),
+                                         tokens_kept=kept,
+                                         roles=roles, snapshot=snap)
+                via = "kv_import"
+            except NoReplicaAvailable:
+                inner = None
+        if inner is None:
+            try:
+                inner, idx = self._place(outer, ent.user_on_token,
+                                         exclude=(from_idx,),
+                                         tokens_kept=kept, roles=roles)
+            except NoReplicaAvailable:
+                return False
+        ent.inner.kv_snapshot = None       # drop the host payload
         ent.inner = inner
         ent.idx = idx
         ent.failovers += 1
         outer.router_failovers = ent.failovers
         self._c_failovers.inc()
+        if via == "kv_import":
+            # a warm failover IS a migration: same primitive, same
+            # accounting (the handoff histogram stays disagg-only —
+            # failover latency is already visible in the failover log)
+            self._c_migrations.inc()
+            self._c_migration_bytes.inc(snap.nbytes)
         to_eng = self.engines[idx]
         entry = {"router_rid": outer.request_id,
                  "from_replica": from_id,
                  "to_replica": to_eng.replica_id,
-                 "tokens_kept": kept,
+                 "tokens_kept": kept, "via": via,
                  "failover_n": ent.failovers}
         self._failover_log.append(entry)
         del self._failover_log[:-64]       # bounded forensics ring
@@ -929,6 +1119,8 @@ class Router:
                 if h["status"] != "UNHEALTHY"
                 and (states is None or states[i] == "SERVING")),
             "failovers": self._c_failovers.value,
+            "migrations": self._c_migrations.value,
+            "migration_bytes": self._c_migration_bytes.value,
             "requests_routed": self._c_routed.value,
             "requests_rejected": self._c_rejected.value,
             "replica_restarts": self._c_restarts.value,
@@ -956,6 +1148,8 @@ class Router:
             snap = {
                 "router": self.metrics.snapshot(),
                 "failover_log": [dict(e) for e in self._failover_log],
+                "migration_log": [dict(e) for e in self._migration_log],
+                "disaggregated": self._disaggregated,
                 "affinity_indexed_blocks": len(self._affinity),
                 "supervisor": (None if self._supervisor is None
                                else self._supervisor.info()),

@@ -48,6 +48,19 @@ swap time — last-writer-wins re-pointing must not keep steering
 prefix siblings to a cold replica; the index re-learns from the
 traffic the policy routes there afterwards.
 
+KV preservation (the PR 12 "slot-in-place KV-pool preservation" gap):
+before tearing the old engine down, the cycle drains-and-exports its
+active requests' KV (`engine.drain_export` →
+`serving.kvtransfer.KVSnapshot` pairs) and, once the fresh engine
+passes the readiness gate, resumes each one warm via `submit_import`
+— zero re-prefilled tokens across the restart. A wedged engine that
+cannot drain yields no pairs (its requests ride the normal cold
+failover); a drained request the cycle cannot resume (breaker trip,
+stop, import failure) FAILS with reason "respawn_failed" and its
+snapshot attached, so the router's failover re-places it warm on a
+surviving replica. `restart_slot(i)` exposes the same cycle as a
+planned restart (rolling maintenance without losing in-flight work).
+
 Lock discipline (LOCK001): the supervisor thread acquires
 `Router._lock` only for the state flips and the engine swap — never
 while tearing down, constructing, warming or probing an engine (all
@@ -69,7 +82,9 @@ import random
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .request import RequestState
 
 __all__ = ["ReplicaSupervisor", "SLOT_SERVING", "SLOT_RESTARTING",
            "SLOT_FAILED", "compute_backoff"]
@@ -282,6 +297,30 @@ class ReplicaSupervisor:
         t.start()
         return True
 
+    def restart_slot(self, index: int) -> bool:
+        """Planned restart of a SERVING slot (rolling maintenance):
+        flips it RESTARTING and runs the normal recovery cycle on a
+        per-slot thread — but because the engine is still healthy, the
+        drain-export step actually succeeds, so its in-flight requests
+        resume WARM on the respawned engine (zero re-prefilled
+        tokens). Returns False when the slot is not SERVING (already
+        restarting, breaker-pinned — use `reset_breaker` — or the
+        supervisor is stopping)."""
+        slot = self._slots[int(index)]
+        with self._router._lock:
+            if slot.state != SLOT_SERVING or self._stop.is_set():
+                return False
+            slot.state = SLOT_RESTARTING
+            slot.restarting_since = self._clock()
+            slot.last_error = None
+        eng = self._router.engines[slot.index]
+        t = threading.Thread(
+            target=self._restart_slot, args=(slot, eng),
+            name=f"paddle-tpu-restart-{slot.index}", daemon=True)
+        self._restart_threads[slot.index] = t
+        t.start()
+        return True
+
     # ---- the supervisor threads -----------------------------------------
     def _loop(self) -> None:
         """The health-poll thread: detection only. Each detected death
@@ -323,6 +362,19 @@ class ReplicaSupervisor:
             # trace still exports
             dead.trace.span("restarting", dur=0.0,
                             replica=dead.replica_id)
+        # drain-and-export BEFORE teardown: active requests surrender
+        # their KV so the respawned slot resumes them without
+        # re-prefill. A wedged engine thread cannot drain —
+        # drain_export times out to [] and those requests ride the
+        # normal cold failover instead.
+        pairs: List[Tuple[Any, Any]] = []
+        try:
+            pairs = dead.drain_export(timeout=self._teardown_timeout_s)
+        # ptlint: disable=EXC001 — export is best-effort: a dying
+        # engine that cannot even drain must still be torn down and
+        # respawned; its requests fail over cold
+        except Exception:
+            pairs = []
         self._teardown(dead)
         attempt = 0
         while not self._stop.is_set():
@@ -346,6 +398,7 @@ class ReplicaSupervisor:
                     # respawn failure — charging it would pollute the
                     # crash-loop accounting and could even pin the
                     # slot FAILED in the final scraped snapshot
+                    self._fail_exported(pairs)
                     return
                 slot.restart_failures += 1
                 slot.failure_times.append(self._clock())
@@ -358,6 +411,7 @@ class ReplicaSupervisor:
                         slot.backoff_s = 0.0
                     r._c_circuit_open.inc()
                     r._g_restart_backoff[slot.index].set(0.0)
+                    self._fail_exported(pairs)
                     return
                 attempt += 1
                 with self._rng_lock:     # concurrent slots share rng
@@ -383,17 +437,39 @@ class ReplicaSupervisor:
                 slot.restarting_since = None
             r._c_restarts.inc()
             r._g_restart_backoff[slot.index].set(0.0)
+            # warm resume: the drained requests re-enter decode on the
+            # fresh engine via KV import — zero re-prefilled tokens
+            # across the restart. Their router entries still point at
+            # this slot index, so the bridge keeps streaming into the
+            # same outer handles.
+            resumed = 0
+            for snap, req in pairs:
+                if req.done or req.cancel_requested:
+                    continue
+                try:
+                    fresh.submit_import(snap, req)
+                    resumed += 1
+                # ptlint: disable=EXC001 — per-request resume boundary:
+                # one unresumable snapshot must not strand the rest;
+                # the failed request rides failover with its KV attached
+                except Exception as e:
+                    req.kv_snapshot = snap
+                    req._finish(RequestState.FAILED, "respawn_failed",
+                                error=e, now=self._clock())
             if fresh.trace is not None:
                 fresh.trace.span(
                     "restarted", dur=self._clock() - t0,
                     replica=fresh.replica_id, attempts=attempt + 1,
                     affinity_invalidated=invalidated,
+                    resumed_from_snapshot=resumed,
                     via_breaker_reset=slot.via_reset)
             slot.via_reset = False
             return
         # stopped mid-restart: the slot stays RESTARTING; the dead
         # engine still in the slot was already torn down and
-        # Router.shutdown re-tears it idempotently
+        # Router.shutdown re-tears it idempotently — but the drained
+        # requests must not hang on a box nobody will resume
+        self._fail_exported(pairs)
 
     def _probe(self, eng) -> None:
         """The readiness probe: one synthetic generation through the
@@ -437,6 +513,19 @@ class ReplicaSupervisor:
         # engine thread is a daemon; the process reclaims it)
         except Exception:
             pass
+
+    def _fail_exported(self, pairs: List[Tuple[Any, Any]]) -> None:
+        """Fail every drained-but-never-resumed request with its
+        snapshot ATTACHED: "respawn_failed" is in the router's default
+        failover predicate, so each one re-places warm (KV import) on
+        a surviving replica instead of hanging on a box this cycle
+        will never service."""
+        for snap, req in pairs:
+            if req.done:
+                continue
+            req.kv_snapshot = snap
+            req._finish(RequestState.FAILED, "respawn_failed",
+                        now=self._clock())
 
     def _breaker_tripped(self, slot: _Slot, consecutive: int) -> bool:
         """Crash-loop circuit breaker: True when `breaker_threshold`

@@ -202,12 +202,13 @@ class TestQuarantine:
         assert eng.batcher.alloc.stats()["blocks_in_use"] == 0
         h = eng.health()
         assert h["status"] == "DEGRADED"
-        assert h["quarantines"] >= 1 and h["requests_requeued"] >= 1
-        # victims' timelines show the requeue; the culprit's terminal
-        # carries the injected error
-        requeued = [r for i, r in enumerate(reqs) if i != 1
-                    and "requeued" in _kinds(eng.trace.timeline(r.trace_id))]
-        assert requeued, "no innocent timeline recorded its requeue"
+        # slot-in-place recovery: the failed call committed nothing, so
+        # innocents keep their KV via export/import ("restored") instead
+        # of requeueing through a full re-prefill
+        assert h["quarantines"] >= 1 and h["requests_restored"] >= 1
+        restored = [r for i, r in enumerate(reqs) if i != 1
+                    and "restored" in _kinds(eng.trace.timeline(r.trace_id))]
+        assert restored, "no innocent timeline recorded its restore"
         tl = eng.trace.timeline(culprit.trace_id)
         assert _kinds(tl)[-1] == "failed"
         assert "injected fault" in tl["events"][-1]["attrs"]["error"]

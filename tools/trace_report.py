@@ -29,6 +29,11 @@ questions the raw timeline is too granular for:
     artifact), a per-replica request breakdown in the totals, and a
     `failovers` churn column so the cross-replica recovery path reads
     like the in-replica requeue one;
+  * KV migration — disaggregated prefill→decode handoffs (`migrated`
+    events: a per-request migrations count and handoff latency column,
+    plus aggregate count/bytes and warm-vs-reprefill split), and
+    slot-in-place quarantine restores (`restored` events) counted into
+    the recovery totals next to requeues;
   * self-healing churn — supervisor `restarting`/`restarted` events
     (replica-scoped spans, no trace_id) counted into the recovery
     totals next to failovers, so a replica that died and was respawned
@@ -80,6 +85,7 @@ def summarize(events) -> dict:
         "pad_tokens": 0, "real_tokens": 0, "cached_tokens": 0,
         "generated": 0, "requeues": 0, "retries": 0, "kv_bytes": 0,
         "replica": None, "failovers": 0, "device_ms": None,
+        "migrations": 0, "handoff_ms": None, "restored": 0,
         "spec_steps": 0, "spec_accepted": 0, "spec_emitted": 0,
         "first_ts": None, "last_ts": None,
     })
@@ -91,6 +97,10 @@ def summarize(events) -> dict:
     # replica-scoped (not request-scoped) churn: supervisor restart
     # events ride the engine sinks' span lane with no trace_id
     restarts = {"restarting": 0, "restarted": 0}
+    # KV migration spans (router handoffs, destination sink, no
+    # trace_id — the per-request twin is counted into the rows below):
+    # count + payload bytes + the warm/re-prefill split
+    migration = {"count": 0, "bytes": 0, "kv_import": 0, "reprefill": 0}
     # speculative decoding: spec_draft spans are engine-scoped (one
     # per tick), spec_verify events are per-request with accepted
     # counts — the accepted-per-step column comes from the latter
@@ -120,6 +130,16 @@ def summarize(events) -> dict:
         if name in ("restarting", "restarted"):
             restarts[name] += 1
             continue
+        if name == "migrated" and args.get("trace_id") is None:
+            # the router's destination-sink span (the per-request
+            # "migrated" event carries a trace_id and lands in the
+            # rows; this aggregate-only twin must not double-count it)
+            migration["count"] += 1
+            migration["bytes"] += args.get("bytes", 0)
+            via = args.get("via")
+            if via in migration:
+                migration[via] += 1
+            continue
         if name == "spec_draft":
             spec_draft_spans += 1
             continue
@@ -144,6 +164,16 @@ def summarize(events) -> dict:
             # cross-replica recovery: the request resumed elsewhere
             r["failovers"] += 1
             r["replica"] = args.get("to_replica", r["replica"])
+        elif name == "migrated":
+            # disaggregated handoff: prefill KV imported (or warm
+            # re-prefilled) at the decode replica this event rode
+            r["migrations"] += 1
+            r["replica"] = args.get("to_replica", r["replica"])
+            if args.get("handoff_s") is not None:
+                r["handoff_ms"] = (r["handoff_ms"] or 0.0) \
+                    + args["handoff_s"] * 1e3
+        elif name == "restored":
+            r["restored"] += 1
         elif name == "prepared":
             r["slot"] = args.get("slot")
             r["replica"] = args.get("replica_id", r["replica"])
@@ -215,6 +245,10 @@ def summarize(events) -> dict:
             "prefilled_tokens": r["real_tokens"],
             "pad_tokens": r["pad_tokens"],
             "requeues": r["requeues"], "retries": r["retries"],
+            "restored": r["restored"],
+            "migrations": r["migrations"],
+            "handoff_ms": (None if r["handoff_ms"] is None
+                           else round(r["handoff_ms"], 3)),
             "kv_bytes": r["kv_bytes"],
             "spec_steps": r["spec_steps"],
             "spec_accepted": r["spec_accepted"],
@@ -250,9 +284,14 @@ def summarize(events) -> dict:
                                      for x in rows), 3),
         "requeued_events": sum(x["requeues"] for x in rows),
         "retried_events": sum(x["retries"] for x in rows),
+        "restored_events": sum(x["restored"] for x in rows),
         "failover_events": sum(x["failovers"] for x in rows),
         "restart_events": restarts["restarted"],
         "restarting_events": restarts["restarting"],
+        "migration_events": migration["count"],
+        "migration_bytes": migration["bytes"],
+        "migrations_kv_import": migration["kv_import"],
+        "migrations_reprefill": migration["reprefill"],
         "spec_draft_spans": spec_draft_spans,
         "spec_verify_steps": sum(x["spec_steps"] for x in rows),
         "spec_accepted_tokens": sum(x["spec_accepted"] for x in rows),
@@ -347,8 +386,13 @@ def render(summary: dict, show_slo: bool = False) -> str:
         f"({t.get('device_step_ms_total', 0.0):.1f} ms device wall)",
         f"recovery: {t['requeued_events']} requeues, "
         f"{t['retried_events']} retries, "
+        f"{t.get('restored_events', 0)} restored, "
         f"{t['failover_events']} failovers, "
         f"{t['restart_events']} restarts",
+        f"migrations: {t.get('migration_events', 0)} "
+        f"({t.get('migrations_kv_import', 0)} kv_import, "
+        f"{t.get('migrations_reprefill', 0)} reprefill)  "
+        f"bytes moved: {t.get('migration_bytes', 0)}",
         f"speculative: {t.get('spec_verify_steps', 0)} verify steps, "
         f"{t.get('spec_accepted_tokens', 0)} accepted "
         f"({t.get('accepted_per_step', 0.0)} accepted/step, "
@@ -363,7 +407,8 @@ def render(summary: dict, show_slo: bool = False) -> str:
             "generated", "queue_wait_ms", "ttft_ms", "decode_ms",
             "prefill_ms", "device_ms", "chunks", "fused_chunks",
             "cached_tokens", "pad_tokens", "requeues", "retries",
-            "failovers", "acc_per_step", "kv_bytes"]
+            "failovers", "migrations", "handoff_ms",
+            "acc_per_step", "kv_bytes"]
     # old artifacts may predate a column: .get keeps the report
     # rendering instead of KeyError-crashing on missing fields
     rows = [[_fmt(r.get(c)) for c in cols] for r in summary["requests"]]
