@@ -3,14 +3,19 @@
 
 PY ?= python
 
-.PHONY: lint test baseline lint-all lint-hot-report bench-smoke
+.PHONY: lint lint-fast test baseline lint-all lint-hot-report bench-smoke
 
 # --format github under Actions so findings annotate the PR diff;
 # --time-budget keeps the gate honest about staying per-push fast
-# (the call-graph engine must never turn lint into a coffee break)
+# (the call-graph engine must never turn lint into a coffee break);
+# --fail-dead-roots keeps the SYNC001 seed-root list from rotting (a
+# root pattern matching zero functions fails the build, not a report)
 lint:           ## ratcheted static analysis (fails on non-baselined findings)
-	$(PY) tools/ptlint.py --time-budget 10 \
+	$(PY) tools/ptlint.py --time-budget 10 --fail-dead-roots \
 		--format $(if $(GITHUB_ACTIONS),github,json)
+
+lint-fast:      ## pre-commit loop: findings scoped to git-changed files
+	$(PY) tools/ptlint.py --changed-only --time-budget 10
 
 lint-all:       ## every finding, baseline ignored (burn-down worklist)
 	$(PY) tools/ptlint.py --no-baseline

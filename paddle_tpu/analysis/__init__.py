@@ -4,8 +4,12 @@ A rule-engine over Python ASTs that knows what a TPU-native framework
 cannot tolerate: Python side effects under `jax.jit` tracing
 (TRACE001), implicit host↔device syncs in the serving decode hot path
 (SYNC001), lock-discipline violations in the threaded serving layer
-(LOCK001), broad `except Exception` that swallows device errors
-(EXC001), and undocumented public API re-exports (API001).
+(LOCK001), cross-thread races on lock-guarded fields (GUARD001),
+unsound compiled-shape memo keys — stale-executable / spurious-
+recompile / drifted-warmup-check hazards (KEY001), event-loop stalls
+from blocking calls in `async def` handlers (ASYNC001), broad
+`except Exception` that swallows device errors (EXC001), and
+undocumented public API re-exports (API001).
 
 Run it:
 

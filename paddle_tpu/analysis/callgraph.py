@@ -327,6 +327,52 @@ class CallGraph:
         return False
 
     # ---- closure ---------------------------------------------------------
+    def component_attr_reads(
+            self, roots: Iterable[FnKey],
+            owner_cls: str) -> Dict[str, List[Tuple[FnKey, ast.Attribute]]]:
+        """`self.<attr>` reads reachable from `roots`, restricted to
+        methods of `owner_cls`'s inheritance component.
+
+        The traced-closure query under KEY001: seed it with a memo
+        cache's builder methods (`_build_*`/`_forward_*`) and every
+        attr in the result is config the lowered executable baked in —
+        the set the memo key must cover. Method lookups
+        (`self.helper()`'s `helper`) are not reads; Store/Del contexts
+        are excluded; functions outside the component (module-level
+        helpers taking explicit args) contribute nothing, since `self`
+        does not exist there.
+
+        Returns {attr: [(method key, Attribute node), ...]} with read
+        sites in deterministic (module, class, name, lineno) order."""
+        canon = self.class_index.canonical(owner_cls)
+        reads: Dict[str, List[Tuple[FnKey, ast.Attribute]]] = {}
+        keys = sorted(self.reachable(roots),
+                      key=lambda k: (k[0], k[1] or "", k[2]))
+        for key in keys:
+            cls = key[1]
+            if cls is None or self.class_index.canonical(cls) != canon:
+                continue
+            _ctx, fn = self.functions[key]
+            lookups: Set[int] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute):
+                    # `self.helper(...)`: the outer Attribute is a
+                    # method lookup, but `self.attr.method(...)`'s
+                    # inner `self.attr` IS a read of attr
+                    lookups.add(id(node.func))
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute) \
+                        and id(node) not in lookups \
+                        and isinstance(node.ctx, ast.Load) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self":
+                    reads.setdefault(node.attr, []).append((key, node))
+        for sites in reads.values():
+            sites.sort(key=lambda s: (s[0][0], s[0][1] or "", s[0][2],
+                                      s[1].lineno))
+        return reads
+
     def reachable(self, roots: Iterable[FnKey]) -> Set[FnKey]:
         """Transitive closure of `roots` over call edges (cycle-safe)."""
         seen: Set[FnKey] = set()

@@ -99,6 +99,26 @@ def parse_suppressions(lines: List[str]) -> Dict[int, Set[str]]:
     return sup
 
 
+# In-process AST memo keyed on (relpath, source text): a library caller
+# (tests, the pre-commit loop's repeated `analyze_source`/`load_project`
+# runs) re-parses nothing that hasn't changed. Deliberately NOT an
+# on-disk pickle cache — unpickling a pickled AST measures *slower*
+# than `ast.parse` on this tree, so persistence would be a pessimation.
+_PARSE_MEMO: Dict[Tuple[str, str], ast.Module] = {}
+_PARSE_MEMO_MAX = 512
+
+
+def _parse_cached(relpath: str, source: str, filename: str) -> ast.Module:
+    key = (relpath, source)
+    tree = _PARSE_MEMO.get(key)
+    if tree is None:
+        tree = ast.parse(source, filename=filename)
+        if len(_PARSE_MEMO) >= _PARSE_MEMO_MAX:
+            _PARSE_MEMO.clear()
+        _PARSE_MEMO[key] = tree
+    return tree
+
+
 class FileContext:
     """One parsed source file. `tree` is None when the file failed to
     parse (the loader emits a PARSE finding instead of crashing)."""
@@ -112,7 +132,7 @@ class FileContext:
         self.tree: Optional[ast.Module] = None
         self.parse_error: Optional[SyntaxError] = None
         try:
-            self.tree = ast.parse(source, filename=path)
+            self.tree = _parse_cached(relpath, source, path)
         except SyntaxError as e:
             self.parse_error = e
         self.aliases = ModuleAliases(self)
@@ -269,6 +289,16 @@ class Project:
         # per-run scratch shared across rules (the call graph lives
         # here so SYNC001/GUARD001/LOCK001 build it once, not thrice)
         self.cache: Dict[str, object] = {}
+        # `--changed-only`: when set, per-file rules may skip emission
+        # work for files outside this relpath set. Whole-program
+        # derivation (call graph, hot-path set, memo-key components)
+        # always sees every file — only *where findings can land* is
+        # narrowed, so a cross-file hazard whose anchor line is in a
+        # touched file still fires.
+        self.focus: Optional[Set[str]] = None
+
+    def focused(self, relpath: str) -> bool:
+        return self.focus is None or relpath in self.focus
 
     def module(self, name: str) -> Optional[FileContext]:
         return self.by_module.get(name)
@@ -330,6 +360,8 @@ def run_rules(project: Project, rules: Iterable[Rule]) -> List[Finding]:
     by_path = {f.relpath: f for f in project.files}
     for rule in rules:
         for finding in rule.run(project):
+            if not project.focused(finding.path):
+                continue
             ctx = by_path.get(finding.path)
             if ctx is not None and ctx.suppressed(finding.line, finding.rule):
                 continue

@@ -11,9 +11,11 @@ from typing import Dict, List
 
 from ..core import Rule
 from .api import PublicDocstringRule
+from .async_block import AsyncBlockingRule
 from .broad_except import BroadExceptRule
 from .guard import GuardedFieldRule
 from .locks import LockDisciplineRule
+from .memo import MemoKeyRule
 from .sync import HostSyncRule
 from .trace import TraceSideEffectRule
 
@@ -22,6 +24,8 @@ ALL_RULES: List[Rule] = [
     HostSyncRule(),
     LockDisciplineRule(),
     GuardedFieldRule(),
+    MemoKeyRule(),
+    AsyncBlockingRule(),
     BroadExceptRule(),
     PublicDocstringRule(),
 ]
@@ -30,4 +34,5 @@ RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}
 
 __all__ = ["ALL_RULES", "RULES_BY_ID", "TraceSideEffectRule",
            "HostSyncRule", "LockDisciplineRule", "GuardedFieldRule",
+           "MemoKeyRule", "AsyncBlockingRule",
            "BroadExceptRule", "PublicDocstringRule"]

@@ -1,0 +1,233 @@
+"""ASYNC001 — blocking calls inside `async def` bodies.
+
+The HTTP frontend runs every handler as a coroutine on ONE event-loop
+thread: a single blocking call inside an `async def` stalls every
+concurrent request behind it (the "one slow client never stalls
+another" promise dies silently — latency, not an exception). Flagged
+inside async bodies:
+
+  * `time.sleep(...)` — the loop-blocking twin of `asyncio.sleep`;
+  * bare `Future.result()` — blocks the loop thread on another
+    thread's work (any `.result()` call: the pattern, not the type);
+  * lock `acquire()` — synchronous lock waits belong on an executor;
+  * calls on router/engine receivers (`self.router.submit(...)`,
+    a local bound via `getattr(self.router, ...)`) — serving-tier
+    work crosses into lock-holding, device-touching code;
+  * calls that the call graph resolves to an in-package sync function
+    whose transitive closure contains one of the above (the
+    `self._submit` -> `router.submit` shape).
+
+NOT flagged: anything routed through `loop.run_in_executor(...)`
+(arguments and callback bodies), directly awaited calls, and sync
+functions' own bodies (`shutdown()` may block — it runs on the
+caller's thread). Deliberately loop-side fast paths (a queue push
+behind short locks) take the standard inline escape hatch:
+
+    req = self._submit(kw)   # ptlint: disable=ASYNC001 — queue push, short locks
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..callgraph import CallGraph, FnKey, build_callgraph, fn_label
+from ..core import FileContext, Finding, Project, Rule, dotted
+
+# receivers whose method calls are serving-tier work: self.router.X(),
+# engine.X(), self._engines[i].X() ... matched on the receiver's last
+# name component
+RECEIVER_RE = re.compile(r"(?:^|_)(?:router|engine)s?$", re.I)
+
+
+def _recv_name(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _own_body_nodes(fn: ast.AST) -> List[ast.AST]:
+    """Every node lexically in `fn`'s own body — nested defs and
+    lambdas excluded (they run when called, not here)."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _router_locals(nodes: List[ast.AST]) -> Set[str]:
+    """Locals bound from `getattr(self.router, "x", ...)`-shaped
+    expressions: calling them is calling the router."""
+    out: Set[str] = set()
+    for node in nodes:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Name) \
+                and node.value.func.id == "getattr" and node.value.args:
+            recv = _recv_name(node.value.args[0])
+            if recv and RECEIVER_RE.search(recv):
+                out.add(node.targets[0].id)
+    return out
+
+
+def _blocking_reason(call: ast.Call, resolve,
+                     router_locals: Set[str]) -> Optional[str]:
+    """Why this call blocks the calling thread, or None."""
+    func = call.func
+    if resolve(func) == "time.sleep":
+        return "time.sleep() parks the thread (asyncio.sleep is free)"
+    if isinstance(func, ast.Attribute):
+        if func.attr == "result":
+            return (f"{dotted(func) or '<future>.result'}() blocks "
+                    f"until another thread finishes")
+        if func.attr == "acquire":
+            return (f"{dotted(func) or '<lock>.acquire'}() is a "
+                    f"synchronous lock wait")
+        recv = _recv_name(func.value)
+        if recv and RECEIVER_RE.search(recv):
+            return (f"{dotted(func) or func.attr}() is serving-tier "
+                    f"work (locks, queues, possibly device calls)")
+    elif isinstance(func, ast.Name) and func.id in router_locals:
+        return (f"{func.id}() was bound from getattr on the "
+                f"router/engine — calling it is calling the router")
+    return None
+
+
+class AsyncBlockingRule(Rule):
+    """ASYNC001: event-loop stalls — blocking primitives and
+    router/engine work called directly from `async def` bodies."""
+
+    id = "ASYNC001"
+    severity = "error"
+    description = ("blocking call (time.sleep / Future.result / "
+                   "lock.acquire / router-engine work) inside an "
+                   "async def — stalls every request on the event "
+                   "loop; route it through loop.run_in_executor")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        async_defs: List[Tuple[FileContext, Optional[str],
+                               ast.AsyncFunctionDef]] = []
+        for ctx in project.files:
+            if ctx.tree is None or not project.focused(ctx.relpath):
+                continue
+            for cls, fn in self._functions_with_class(ctx.tree):
+                if isinstance(fn, ast.AsyncFunctionDef):
+                    async_defs.append((ctx, cls, fn))
+        if not async_defs:
+            return
+        graph = build_callgraph(project)
+        blocking_memo: Dict[FnKey, Optional[Tuple[str, str, int]]] = {}
+        for ctx, cls, fn in async_defs:
+            yield from self._check_async(ctx, cls, fn, graph,
+                                         blocking_memo)
+
+    @staticmethod
+    def _functions_with_class(tree: ast.Module):
+        """(enclosing top-level class or None, def node) for every
+        function def in the file, nested ones included."""
+        out = []
+
+        def walk(node: ast.AST, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    out.append((cls, child))
+                    walk(child, cls)
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, child.name)
+                else:
+                    walk(child, cls)
+
+        walk(tree, None)
+        return out
+
+    def _check_async(self, ctx: FileContext, cls: Optional[str],
+                     fn: ast.AsyncFunctionDef, graph: CallGraph,
+                     blocking_memo) -> Iterator[Finding]:
+        nodes = _own_body_nodes(fn)
+        router_locals = _router_locals(nodes)
+        resolve = ctx.aliases.resolve
+        awaited: Set[int] = set()
+        executor_args: Set[int] = set()
+        for node in nodes:
+            if isinstance(node, ast.Await) \
+                    and isinstance(node.value, ast.Call):
+                awaited.add(id(node.value))
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "run_in_executor":
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    for sub in ast.walk(arg):
+                        executor_args.add(id(sub))
+        for node in nodes:
+            if not isinstance(node, ast.Call) or id(node) in awaited \
+                    or id(node) in executor_args:
+                continue
+            reason = _blocking_reason(node, resolve, router_locals)
+            if reason is not None:
+                yield ctx.finding(
+                    self, node,
+                    f"blocking call in `async def {fn.name}`: {reason} "
+                    f"— every concurrent request stalls behind it; "
+                    f"route it through loop.run_in_executor (or "
+                    f"justify with `# ptlint: disable=ASYNC001 — "
+                    f"reason` if it provably returns fast)")
+                continue
+            target = graph.resolve_ref(ctx, cls, node.func)
+            if target is None:
+                continue
+            _tctx, tfn = graph.functions[target]
+            if isinstance(tfn, ast.AsyncFunctionDef):
+                continue            # un-awaited coroutine: not a stall
+            hit = self._closure_blocking(graph, target, blocking_memo)
+            if hit is not None:
+                where, desc, line = hit
+                yield ctx.finding(
+                    self, node,
+                    f"`async def {fn.name}` calls "
+                    f"'{fn_label(target)}', which blocks: {desc} "
+                    f"(in '{where}', line {line}) — the event loop "
+                    f"stalls for every request; route the call "
+                    f"through loop.run_in_executor")
+
+    def _closure_blocking(self, graph: CallGraph, root: FnKey,
+                          memo) -> Optional[Tuple[str, str, int]]:
+        """First blocking primitive in `root`'s transitive closure:
+        (function label, description, line) or None."""
+        for key in sorted(graph.reachable([root]),
+                          key=lambda k: (k != root, k[0], k[1] or "",
+                                         k[2])):
+            if key not in memo:
+                memo[key] = self._direct_blocking(graph, key)
+            if memo[key] is not None:
+                return memo[key]
+        return None
+
+    @staticmethod
+    def _direct_blocking(graph: CallGraph,
+                         key: FnKey) -> Optional[Tuple[str, str, int]]:
+        ctx, fn = graph.functions[key]
+        if isinstance(fn, ast.AsyncFunctionDef):
+            return None             # coroutines don't block callers
+        nodes = _own_body_nodes(fn)
+        router_locals = _router_locals(nodes)
+        best: Optional[Tuple[str, str, int]] = None
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            reason = _blocking_reason(node, ctx.aliases.resolve,
+                                      router_locals)
+            if reason is not None and (best is None
+                                       or node.lineno < best[2]):
+                best = (fn_label(key), reason, node.lineno)
+        return best

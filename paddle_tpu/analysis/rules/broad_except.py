@@ -72,7 +72,7 @@ class BroadExceptRule(Rule):
 
     def run(self, project: Project) -> Iterator[Finding]:
         for ctx in project.files:
-            if ctx.tree is None:
+            if ctx.tree is None or not project.focused(ctx.relpath):
                 continue
             resolve = ctx.aliases.resolve
             for node in ast.walk(ctx.tree):

@@ -143,9 +143,11 @@ class HostSyncRule(Rule):
                    "np.asarray) in a decode hot path or traced function")
 
     def run(self, project: Project) -> Iterator[Finding]:
+        # hot-set derivation is whole-program (the call graph sees every
+        # file); only per-file emission honors `--changed-only` focus
         derived, _dead = derive_hot_paths(project)
         for ctx in project.files:
-            if ctx.tree is None:
+            if ctx.tree is None or not project.focused(ctx.relpath):
                 continue
             hot = self._hot_functions(ctx, derived)
             classified = {id(fn) for fn, _ in hot}

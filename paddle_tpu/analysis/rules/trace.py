@@ -63,8 +63,15 @@ def find_traced_functions(ctx: FileContext) -> List[Tuple[ast.AST, str]]:
     `g = jax.jit(f)` resolves `f` LEXICALLY: among same-named defs the
     one whose enclosing function scope is an ancestor of the call wins
     (an `LLMEngine.run` method is not confused with a nested `def run`
-    handed to jax.jit inside another method)."""
+    handed to jax.jit inside another method).
+
+    Memoized per FileContext: TRACE001 and SYNC001 both need this walk
+    — it runs once per file per load, not once per rule."""
+    cached = getattr(ctx, "_traced_fns", None)
+    if cached is not None:
+        return cached
     if ctx.tree is None:
+        ctx._traced_fns = []
         return []
     resolve = ctx.aliases.resolve
     # name -> [(def node, ancestor-fn chain)] for bare-name-visible defs
@@ -118,6 +125,7 @@ def find_traced_functions(ctx: FileContext) -> List[Tuple[ast.AST, str]]:
                 kind = ("wrapped by" if target in TRACING_WRAPPERS
                         else "body of")
                 mark(best[0], f"{kind} {target}")
+    ctx._traced_fns = traced
     return traced
 
 
@@ -188,7 +196,7 @@ class TraceSideEffectRule(Rule):
 
     def run(self, project: Project) -> Iterator[Finding]:
         for ctx in project.files:
-            if ctx.tree is None:
+            if ctx.tree is None or not project.focused(ctx.relpath):
                 continue
             for fn, why in find_traced_functions(ctx):
                 yield from self._check_fn(ctx, fn, why)

@@ -14,15 +14,25 @@ coverage); it always exits 0, for non-blocking CI output.
 `--time-budget S` fails the run loudly when analysis wall time exceeds
 S seconds — the lint gate must stay fast enough to run per-push, so a
 call-graph blowup is a build failure, not a slow creep.
+`--changed-only` scopes a run to the files git reports as changed
+(staged, unstaged, or untracked): whole-program rules still load every
+file and build the full call graph — soundness needs the whole tree —
+but findings only land in touched files and the per-file rules skip
+untouched ones, so the pre-commit loop stays fast as the tree grows.
+`--fail-dead-roots` turns the (otherwise informational) dead seed-root
+report into a gate: exit 1 when any HOT_ROOTS pattern matches no
+function, so a newly added root that never matched — or a rename that
+silently dropped coverage — fails the build instead of rotting.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Set
 
 from . import baseline as baseline_mod
 from .core import Finding, load_project, run_rules
@@ -63,7 +73,50 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    metavar="SECONDS",
                    help="fail (exit 1) when analysis wall time exceeds "
                         "this many seconds — keeps the lint gate fast")
+    p.add_argument("--changed-only", action="store_true",
+                   help="only report findings in files git sees as "
+                        "changed (staged/unstaged/untracked); "
+                        "whole-program rules still see the full tree")
+    p.add_argument("--fail-dead-roots", action="store_true",
+                   help="exit 1 when any SYNC001 HOT_ROOTS pattern "
+                        "matches no function (gates what --hot-report "
+                        "only prints)")
     return p
+
+
+def _git_changed_files(root: str) -> Optional[Set[str]]:
+    """Relpaths (vs `root`, '/'-separated) of working-tree changes:
+    staged, unstaged, and untracked, plus both sides of renames.
+    None when git is unavailable or `root` is not a work tree — the
+    caller falls back to a full run rather than silently passing."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], cwd=root,
+            capture_output=True, text=True, timeout=30)
+        proc = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=root,
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if top.returncode != 0 or proc.returncode != 0:
+        return None
+    toplevel = top.stdout.strip()
+    changed: Set[str] = set()
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        # `R  old -> new`: both sides matter (the old module's callers
+        # may now reference nothing)
+        for part in path.split(" -> "):
+            part = part.strip().strip('"')
+            if not part:
+                continue
+            # porcelain paths are relative to the repo TOPLEVEL, which
+            # need not be --root — normalize through absolute paths
+            apath = os.path.join(toplevel, part)
+            changed.add(os.path.relpath(apath, root).replace(os.sep, "/"))
+    return changed
 
 
 def _select_rules(spec: Optional[str]):
@@ -170,7 +223,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.hot_report:
         _print_hot_report(project, parse_errors, out)
         return 0
+    if args.changed_only:
+        changed = _git_changed_files(root)
+        if changed is None:
+            print("ptlint: --changed-only: git unavailable or not a "
+                  "work tree — falling back to a full run",
+                  file=sys.stderr)
+        else:
+            project.focus = changed
+            parse_errors = [f for f in parse_errors if f.path in changed]
     findings = run_rules(project, rules)
+    dead_roots = []
+    if args.fail_dead_roots:
+        _hot, dead_roots = derive_hot_paths(project)
+        for suffix, pattern in dead_roots:
+            print(f"ptlint: DEAD hot-path root: {suffix} :: {pattern} "
+                  f"— the pattern matches no function; fix or delete "
+                  f"the HOT_ROOTS entry in analysis/rules/sync.py",
+                  file=sys.stderr)
 
     baseline_path = args.baseline or os.path.join(
         root, baseline_mod.DEFAULT_BASELINE)
@@ -189,7 +259,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 2
         result = baseline_mod.apply(findings, base)
 
-    failed = bool(result.new) or bool(parse_errors)
+    failed = bool(result.new) or bool(parse_errors) or bool(dead_roots)
     elapsed = time.monotonic() - t0
     over_budget = (args.time_budget is not None
                    and elapsed > args.time_budget)
@@ -201,6 +271,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "baselined": len(result.baselined),
             "stale_baseline": result.stale,
             "checked_files": len(project.files),
+            "focused_files": (None if project.focus is None
+                              else len([f for f in project.files
+                                        if f.relpath in project.focus])),
+            "dead_hot_roots": [f"{s} :: {p}" for s, p in dead_roots],
             "elapsed_s": round(elapsed, 3),
             "time_budget_exceeded": over_budget,
             "exit": 1 if failed else 0,

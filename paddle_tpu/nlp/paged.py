@@ -891,6 +891,7 @@ class ContinuousBatcher:
                 k.endswith(":scale") for k in params["layers"]):
             params = quantize_for_serving(params, bits=8)
         self.kv_dtype = kvq.resolve_kv_dtype(kv_dtype)
+        # ptlint: memo-invariant(weights and model config never change for a live batcher)
         self.params, self.cfg = params, cfg
         # chaos harness: an optional serving.faults.FaultInjector
         # consulted at every device-call boundary (_gate) — fail /
@@ -902,13 +903,16 @@ class ContinuousBatcher:
         if fault_injector is not None and hasattr(fault_injector,
                                                   "attach"):
             fault_injector.attach(replica_id)
+        # ptlint: memo-invariant(pool geometry is fixed at construction)
         self.B, self.bs = max_batch, block_size
         # resolved once: every traced fn closes over the concrete
         # backend and every compiled-shape memo keys on it — and on the
         # resolved (weight_dtype, kv_dtype) pair, so the warmup ladder
         # a quantized batcher compiles can never be confused with an fp
         # one's (the zero-post-warmup-recompiles gate covers both)
+        # ptlint: trace-config
         self.attention_impl = resolve_attention_impl(attention_impl)
+        # ptlint: trace-config
         self._qkey = (self.weight_dtype, self.kv_dtype)
         # self-speculative decoding (ROADMAP direction 5(b)): a cheap
         # draft — the SAME model truncated to `draft_layers` (None =
@@ -934,6 +938,7 @@ class ContinuousBatcher:
         # the trailing qkey (() when spec is off — plain batchers' keys
         # are byte-identical to before), so a spec batcher's warmed
         # ladder can never be confused with a plain one's
+        # ptlint: trace-config
         self._skey = (self._spec_cfg.key(cfg.num_hidden_layers)
                       if self.speculative else ())
         self.spec = SpecStats()
@@ -946,9 +951,12 @@ class ContinuousBatcher:
         self._no_spec: set = set()
         self._spec_ok_dev = None
         self.max_total = max_total_len
+        # ptlint: memo-invariant(pool geometry is fixed at construction)
         self.M = -(-max_total_len // block_size)
         self.max_new = max_new_tokens
+        # ptlint: memo-invariant(eos id is fixed at construction)
         self.eos = eos_token_id
+        # ptlint: memo-invariant(decode chunk length is fixed at construction)
         self.chunk = chunk
         # prefill bucket ladder: suffixes pad to the smallest bucket that
         # fits and longer ones split into largest-bucket chunks, so every
@@ -2579,9 +2587,13 @@ class ContinuousBatcher:
     #    commit only the accepted rows) ------------------------------------
     def _spec_key(self, phase: str) -> Tuple:
         """Memo key for the spec `phase` ("draft" | "verify")
-        executable — spec geometry + backend + quantization config."""
+        executable — spec geometry + backend + quantization config.
+        Carries `_skey` like every other compiled-shape memo key, so a
+        batcher whose spec config changes shape (k, draft depth) via
+        the full spec tuple can never serve another config's
+        executable (KEY001 enforces the convention)."""
         return (phase, self.spec_k, self._draft_depth,
-                self.attention_impl) + self._qkey
+                self.attention_impl) + self._skey + self._qkey
 
     def spec_stats(self) -> Dict[str, Any]:
         """Speculative-decoding accounting: config + the SpecStats

@@ -346,6 +346,7 @@ class HttpFrontend:
 
     async def _generate(self, writer, body: bytes) -> None:
         try:
+            # ptlint: disable=ASYNC001 — queue push behind short locks (see _submit)
             req = self._submit(self._parse_submit(body))
         except _HttpError as e:
             writer.write(_json_body(e.status, {"error": e.message}))
@@ -370,6 +371,7 @@ class HttpFrontend:
 
     async def _stream_sse(self, writer, body: bytes) -> None:
         try:
+            # ptlint: disable=ASYNC001 — queue push behind short locks (see _submit)
             req = self._submit(self._parse_submit(body))
         except _HttpError as e:
             writer.write(_json_body(e.status, {"error": e.message}))
@@ -421,6 +423,7 @@ class HttpFrontend:
             else "done"))
 
     async def _health(self, writer) -> None:
+        # ptlint: disable=ASYNC001 — point-in-time snapshot under short locks
         h = self.router.health()
         serving = h.get("serving_replicas",
                         0 if h.get("status") == "UNHEALTHY" else 1)
@@ -437,7 +440,13 @@ class HttpFrontend:
         writer.write(_json_body(503, h, extra=extra))
 
     async def _metrics(self, writer) -> None:
-        text = self.router.to_prometheus()
+        # rendering fans out across every replica's counters (and for a
+        # Router, walks each slot's engine under its lock) — heavy
+        # enough to stall concurrent token streams if it ran on the
+        # event loop, so it renders on the default executor instead
+        loop = asyncio.get_running_loop()
+        text = await loop.run_in_executor(None,
+                                          self.router.to_prometheus)
         body = text.encode()
         writer.write(_headers(200, "text/plain; version=0.0.4",
                               len(body)) + body)
@@ -469,6 +478,7 @@ class HttpFrontend:
         try:
             # blocking-safe: state flips under short locks plus a
             # thread spawn — no engine rebuild happens on this call
+            # ptlint: disable=ASYNC001 — short-lock state flip, no engine rebuild
             out = reset(slot)
         except LookupError as e:
             writer.write(_json_body(404, {"error": str(e)}))

@@ -7,6 +7,7 @@ These tests exercise the AST engine only — no jax tracing happens, so
 the file is cheap even inside the tier-1 budget."""
 import json
 import os
+import re
 import subprocess
 import sys
 import textwrap
@@ -19,6 +20,7 @@ from paddle_tpu.analysis import (
 )
 from paddle_tpu.analysis.callgraph import build_callgraph
 from paddle_tpu.analysis.core import FileContext, Project
+from paddle_tpu.analysis.rules.memo import discover_memo_caches
 from paddle_tpu.analysis.rules.sync import derive_hot_paths
 from paddle_tpu.analysis.runner import main as ptlint_main
 
@@ -1145,3 +1147,564 @@ def test_module_entrypoint_exits_zero():
         cwd=REPO, capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ---------------------------------------------------------------------------
+# KEY001 — memo-key soundness
+# ---------------------------------------------------------------------------
+
+# the paged idiom in miniature: one key helper feeding get/set/member
+# sites, a _build_* closure that bakes `self.cfg` into the lowered
+# program, and the two declared-mandatory config tuples
+_MEMO_OK = """
+    import jax
+
+    class Batcher:
+        def __init__(self, cfg, impl, wq, kq):
+            self.cfg = cfg
+            # ptlint: trace-config
+            self.impl = impl
+            # ptlint: trace-config
+            self._qkey = (wq, kq)
+            self._step_cache = {}
+
+        def _key(self, n):
+            return (n, self.cfg, self.impl) + self._qkey
+
+        def _build_step(self):
+            cfg = self.cfg
+
+            def step(x):
+                return x * cfg.scale
+
+            return jax.jit(step)
+
+        def _step_exe(self, n):
+            key = self._key(n)
+            exe = self._step_cache.get(key)
+            if exe is None:
+                exe = self._build_step()
+                self._step_cache[key] = exe
+            return exe
+
+        def warmed(self, n):
+            return self._key(n) in self._step_cache
+"""
+
+
+def test_key_clean_paged_idiom():
+    assert run_src(_MEMO_OK, "KEY001") == []
+
+
+def test_key_mutation_deleting_qkey_yields_exactly_one_finding():
+    """The teeth test: drop `+ self._qkey` from the key helper (the
+    PR 9 bug shape) — exactly one finding, of the stale-executable
+    kind, because `_qkey` is declared trace-config (key-mandatory)."""
+    mutated = _MEMO_OK.replace(
+        "return (n, self.cfg, self.impl) + self._qkey",
+        "return (n, self.cfg, self.impl)")
+    assert mutated != _MEMO_OK
+    fs = run_src(mutated, "KEY001")
+    assert len(fs) == 1, [f.message for f in fs]
+    assert "_qkey" in fs[0].message and "STALE" in fs[0].message
+
+
+def test_key_mutation_deleting_impl_yields_exactly_one_finding():
+    mutated = _MEMO_OK.replace(
+        "return (n, self.cfg, self.impl) + self._qkey",
+        "return (n, self.cfg) + self._qkey")
+    fs = run_src(mutated, "KEY001")
+    assert len(fs) == 1
+    assert "impl" in fs[0].message and "trace-config" in fs[0].message
+
+
+def test_key_missing_config_read_under_trace():
+    """Finding kind 1: the builder bakes `self.depth` in, the key
+    doesn't carry it — a depth change serves a stale executable."""
+    fs = run_src("""
+        import jax
+
+        class B:
+            def __init__(self, cfg, depth):
+                self.cfg = cfg
+                self.depth = depth
+                self._c_cache = {}
+
+            def _build_c(self):
+                c, d = self.cfg, self.depth
+
+                def f(x):
+                    return x * c.scale + d
+
+                return jax.jit(f)
+
+            def _c_exe(self, n):
+                key = (n, self.cfg)
+                exe = self._c_cache.get(key)
+                if exe is None:
+                    exe = self._build_c()
+                    self._c_cache[key] = exe
+                return exe
+    """, "KEY001")
+    assert len(fs) == 1, [f.message for f in fs]
+    assert "depth" in fs[0].message and "STALE" in fs[0].message
+
+
+def test_key_spurious_element_never_read():
+    """Finding kind 2: `self.tag` rides the key but nothing traced
+    reads it — every distinct tag recompiles an identical program."""
+    fs = run_src("""
+        import jax
+
+        class B:
+            def __init__(self, cfg, tag):
+                self.cfg = cfg
+                self.tag = tag
+                self._c_cache = {}
+
+            def _build_c(self):
+                c = self.cfg
+
+                def f(x):
+                    return x * c.scale
+
+                return jax.jit(f)
+
+            def _c_exe(self, n):
+                key = (n, self.cfg, self.tag)
+                exe = self._c_cache.get(key)
+                if exe is None:
+                    exe = self._build_c()
+                    self._c_cache[key] = exe
+                return exe
+    """, "KEY001")
+    assert len(fs) == 1, [f.message for f in fs]
+    assert "tag" in fs[0].message and "never read" in fs[0].message
+
+
+def test_key_membership_check_drift():
+    """Finding kind 3: the warmup `in`-check forgot an element the
+    `.get` key carries — the PR 9/14 warmup-assertion bug shape."""
+    fs = run_src("""
+        import jax
+
+        class B:
+            def __init__(self, cfg, depth):
+                self.cfg = cfg
+                self.depth = depth
+                self._c_cache = {}
+
+            def _build_c(self):
+                c, d = self.cfg, self.depth
+
+                def f(x):
+                    return x * c.scale + d
+
+                return jax.jit(f)
+
+            def _c_exe(self, n):
+                key = (n, self.cfg, self.depth)
+                exe = self._c_cache.get(key)
+                if exe is None:
+                    exe = self._build_c()
+                    self._c_cache[key] = exe
+                return exe
+
+            def warmed(self, n):
+                return (n, self.cfg) in self._c_cache
+    """, "KEY001")
+    assert len(fs) == 1, [f.message for f in fs]
+    assert "membership check" in fs[0].message
+    assert "not term-identical" in fs[0].message
+
+
+def test_key_wildcard_locals_do_not_drift():
+    """Shape locals named differently at different sites (`n` vs `m`)
+    and different constant tags are NOT drift — only attr structure."""
+    fs = run_src("""
+        import jax
+
+        class B:
+            def __init__(self, cfg):
+                self.cfg = cfg
+                self._c_cache = {}
+
+            def _build_c(self):
+                c = self.cfg
+
+                def f(x):
+                    return x * c.scale
+
+                return jax.jit(f)
+
+            def _c_exe(self, n, phase):
+                key = (n, "draft", self.cfg)
+                exe = self._c_cache.get(key)
+                if exe is None:
+                    exe = self._build_c()
+                    self._c_cache[key] = exe
+                return exe
+
+            def warmed(self, m):
+                return (m, "verify", self.cfg) in self._c_cache
+    """, "KEY001")
+    assert fs == [], [f.message for f in fs]
+
+
+def test_key_memo_invariant_class_wide_suppression():
+    """`# ptlint: memo-invariant(...)` on the __init__ assignment
+    exempts the attr's keyless reads component-wide."""
+    fs = run_src("""
+        import jax
+
+        class B:
+            def __init__(self, cfg, eos):
+                self.cfg = cfg
+                # ptlint: memo-invariant(eos id fixed at construction)
+                self.eos = eos
+                self._c_cache = {}
+
+            def _build_c(self):
+                c, e = self.cfg, self.eos
+
+                def f(x):
+                    return x * c.scale + e
+
+                return jax.jit(f)
+
+            def _c_exe(self, n):
+                key = (n, self.cfg)
+                exe = self._c_cache.get(key)
+                if exe is None:
+                    exe = self._build_c()
+                    self._c_cache[key] = exe
+                return exe
+    """, "KEY001")
+    assert fs == [], [f.message for f in fs]
+
+
+def test_key_memo_invariant_per_read_line_suppression():
+    """The per-read form: annotating the read line inside the builder
+    exempts that site without declaring the attr class-wide."""
+    fs = run_src("""
+        import jax
+
+        class B:
+            def __init__(self, cfg, eos):
+                self.cfg = cfg
+                self.eos = eos
+                self._c_cache = {}
+
+            def _build_c(self):
+                c = self.cfg
+                e = self.eos  # ptlint: memo-invariant(fixed at ctor)
+
+                def f(x):
+                    return x * c.scale + e
+
+                return jax.jit(f)
+
+            def _c_exe(self, n):
+                key = (n, self.cfg)
+                exe = self._c_cache.get(key)
+                if exe is None:
+                    exe = self._build_c()
+                    self._c_cache[key] = exe
+                return exe
+    """, "KEY001")
+    assert fs == [], [f.message for f in fs]
+
+
+def test_key_inheritance_through_base_chain():
+    """The builder lives on the base class, the memo method on the
+    derived one — the component walk still derives the traced reads."""
+    fs = run_src("""
+        import jax
+
+        class Base:
+            def __init__(self, cfg, gamma):
+                self.cfg = cfg
+                self.gamma = gamma
+                self._c_cache = {}
+
+            def _build_c(self):
+                c, g = self.cfg, self.gamma
+
+                def f(x):
+                    return x * c.scale + g
+
+                return jax.jit(f)
+
+        class Derived(Base):
+            def _c_exe(self, n):
+                key = (n, self.cfg)
+                exe = self._c_cache.get(key)
+                if exe is None:
+                    exe = self._build_c()
+                    self._c_cache[key] = exe
+                return exe
+    """, "KEY001")
+    assert len(fs) == 1, [f.message for f in fs]
+    assert "gamma" in fs[0].message and "STALE" in fs[0].message
+
+
+def test_key_disable_comment_works():
+    mutated = _MEMO_OK.replace(
+        "            exe = self._step_cache.get(key)",
+        "            # ptlint: disable=KEY001 — fixture justification\n"
+        "            exe = self._step_cache.get(key)").replace(
+        "return (n, self.cfg, self.impl) + self._qkey",
+        "return (n, self.cfg, self.impl)")
+    assert run_src(mutated, "KEY001") == []
+
+
+def test_key_bookkeeping_dicts_not_policed():
+    """A dict that only stores (a metrics gauge, a result log) is not
+    the memo idiom — no get/member pairing, no findings."""
+    fs = run_src("""
+        class B:
+            def __init__(self, cfg):
+                self.cfg = cfg
+                self._log_cache = {}
+
+            def record(self, n, v):
+                self._log_cache[(n, self.cfg)] = v
+    """, "KEY001")
+    assert fs == []
+
+
+def test_key001_discovers_every_paged_cache():
+    """Coverage floor, same idiom as the SYNC001 superset pin: every
+    `self._*_cache` attribute in nlp/paged.py must be discovered (and
+    qualify as a memo cache) — a refactor that renames a cache out of
+    the rule's sight fails here, not three PRs later."""
+    project = real_tree()
+    graph = build_callgraph(project)
+    caches = discover_memo_caches(graph)
+    qualified = set()
+    for (_canon, name), entry in caches.items():
+        kinds = {s.kind for s in entry["sites"]}
+        if "set" in kinds and ({"get", "member"} & kinds):
+            qualified.add(name)
+    src = open(os.path.join(REPO, "paddle_tpu", "nlp", "paged.py"),
+               encoding="utf-8").read()
+    in_source = set(re.findall(r"self\.(_\w+_cache)\b", src))
+    # the four compiled-shape caches the rule was built for are the
+    # floor — pinned by name so a silent discovery regression is loud
+    assert {"_prefill_cache", "_fused_cache", "_chunk_cache",
+            "_spec_cache"} <= in_source
+    assert in_source <= qualified, (
+        f"caches in paged.py not discovered by KEY001: "
+        f"{sorted(in_source - qualified)}")
+
+
+# ---------------------------------------------------------------------------
+# ASYNC001 — blocking calls in async bodies
+# ---------------------------------------------------------------------------
+
+def test_async_time_sleep_flagged():
+    fs = run_src("""
+        import time
+
+        async def handler():
+            time.sleep(1)
+    """, "ASYNC001")
+    assert len(fs) == 1 and "time.sleep" in fs[0].message
+
+
+def test_async_future_result_and_acquire_flagged():
+    fs = run_src("""
+        async def handler(fut, lock):
+            fut.result()
+            lock.acquire()
+    """, "ASYNC001")
+    assert len(fs) == 2
+    assert any("result" in f.message for f in fs)
+    assert any("acquire" in f.message for f in fs)
+
+
+def test_async_router_call_flagged():
+    fs = run_src("""
+        class Frontend:
+            def __init__(self, router):
+                self.router = router
+
+            async def handle(self, prompt):
+                return self.router.submit(prompt)
+    """, "ASYNC001")
+    assert len(fs) == 1 and "serving-tier" in fs[0].message
+
+
+def test_async_getattr_bound_router_local_flagged():
+    fs = run_src("""
+        class Frontend:
+            def __init__(self, router):
+                self.router = router
+
+            async def handle(self, slot):
+                reset = getattr(self.router, "reset_breaker", None)
+                return reset(slot)
+    """, "ASYNC001")
+    assert len(fs) == 1 and "getattr" in fs[0].message
+
+
+def test_async_callgraph_resolved_blocking_helper():
+    """The `self._submit` -> `router.submit` shape: the async body
+    calls a sync helper whose closure blocks — flagged at the call."""
+    fs = run_src("""
+        class Frontend:
+            def __init__(self, router):
+                self.router = router
+
+            def _submit(self, prompt):
+                return self.router.submit(prompt)
+
+            async def handle(self, prompt):
+                return self._submit(prompt)
+    """, "ASYNC001")
+    assert len(fs) == 1
+    assert "_submit" in fs[0].message
+    assert "run_in_executor" in fs[0].message
+
+
+def test_async_negatives():
+    """awaited calls, run_in_executor-routed work, sync functions'
+    own bodies, and nested sync defs are all fine."""
+    fs = run_src("""
+        import asyncio
+        import time
+
+        class Frontend:
+            def __init__(self, router):
+                self.router = router
+
+            async def handle(self, prompt):
+                loop = asyncio.get_running_loop()
+                text = await loop.run_in_executor(
+                    None, lambda: self.router.to_prometheus())
+                data = await self.read(prompt)
+                return text, data
+
+            async def read(self, prompt):
+                await asyncio.sleep(0.01)
+                return prompt
+
+            def shutdown(self, fut):
+                # sync: blocks the CALLER's thread, not the loop
+                time.sleep(0.1)
+                return fut.result()
+
+            async def spawn(self):
+                def worker():
+                    return self.router.submit("x")
+                return worker
+    """, "ASYNC001")
+    assert fs == [], [f.message for f in fs]
+
+
+def test_async_disable_comment_works():
+    fs = run_src("""
+        class Frontend:
+            def __init__(self, router):
+                self.router = router
+
+            async def health(self):
+                # ptlint: disable=ASYNC001 — short-lock snapshot
+                return self.router.health()
+    """, "ASYNC001")
+    assert fs == []
+
+
+def test_real_frontend_async_clean():
+    """serving/frontend.py is burned down: the real fixes + inline
+    justifications hold (a new blocking call in a handler fails)."""
+    fs = [f for f in run_rules(real_tree(), ALL_RULES)
+          if f.rule == "ASYNC001"]
+    assert fs == [], [f"{f.location} {f.message}" for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# --changed-only / --fail-dead-roots / parse memo
+# ---------------------------------------------------------------------------
+
+_BROAD_EXCEPT = "try:\n    work()\nexcept Exception:\n    pass\n"
+
+
+def _git(args, cwd):
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+                   + args, cwd=cwd, check=True, capture_output=True)
+
+
+def test_cli_changed_only_scopes_to_git_diff(tmp_path, capsys):
+    """a.py (committed, has a finding) is invisible; b.py (untracked,
+    same finding) reports — the pre-commit loop only sees the diff."""
+    (tmp_path / "a.py").write_text(_BROAD_EXCEPT)
+    _git(["init", "-q"], tmp_path)
+    _git(["add", "a.py"], tmp_path)
+    _git(["commit", "-qm", "seed"], tmp_path)
+    (tmp_path / "b.py").write_text(_BROAD_EXCEPT)
+    rc = ptlint_main([str(tmp_path), "--root", str(tmp_path),
+                      "--no-baseline", "--changed-only",
+                      "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["path"] for f in data["new"]} == {"b.py"}
+    assert data["focused_files"] == 1
+    # full run still sees both — the scoping is opt-in
+    rc = ptlint_main([str(tmp_path), "--root", str(tmp_path),
+                      "--no-baseline", "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert {f["path"] for f in data["new"]} == {"a.py", "b.py"}
+
+
+def test_cli_changed_only_clean_tree_reports_nothing(tmp_path, capsys):
+    (tmp_path / "a.py").write_text(_BROAD_EXCEPT)
+    _git(["init", "-q"], tmp_path)
+    _git(["add", "a.py"], tmp_path)
+    _git(["commit", "-qm", "seed"], tmp_path)
+    rc = ptlint_main([str(tmp_path), "--root", str(tmp_path),
+                      "--no-baseline", "--changed-only",
+                      "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0 and data["new"] == [] and data["focused_files"] == 0
+
+
+def test_cli_fail_dead_roots_gates(tmp_path, capsys):
+    """On a tree with none of the hot-root files, every HOT_ROOTS
+    pattern is dead: the flag turns that into exit 1 (without it the
+    same run passes — the report alone never gated)."""
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    args = [str(tmp_path / "ok.py"), "--root", str(tmp_path),
+            "--no-baseline"]
+    assert ptlint_main(args) == 0
+    capsys.readouterr()
+    rc = ptlint_main(args + ["--fail-dead-roots"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "DEAD hot-path root" in captured.err
+
+
+def test_focus_scopes_run_rules():
+    bad = textwrap.dedent("""
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+    """)
+    a = FileContext("a.py", bad, "a.py")
+    b = FileContext("b.py", bad, "b.py")
+    project = Project([a, b])
+    assert {f.path for f in run_rules(project, ALL_RULES)} == \
+        {"a.py", "b.py"}
+    project.focus = {"b.py"}
+    assert {f.path for f in run_rules(project, ALL_RULES)} == {"b.py"}
+
+
+def test_parse_memo_reuses_tree_for_unchanged_source():
+    src = "def f():\n    return 1\n"
+    a = FileContext("m.py", src, "m.py")
+    b = FileContext("m.py", src, "m.py")
+    assert a.tree is b.tree
+    c = FileContext("m.py", src + "\nx = 2\n", "m.py")
+    assert c.tree is not a.tree

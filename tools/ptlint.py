@@ -9,6 +9,12 @@ sub-second lints. Same flags, same exit codes:
     python tools/ptlint.py                     # check paddle_tpu/
     python tools/ptlint.py --format json       # CI
     python tools/ptlint.py --update-baseline   # burn down the ratchet
+    python tools/ptlint.py --changed-only      # pre-commit: only the
+                                               # files git sees as
+                                               # changed can report
+    python tools/ptlint.py --fail-dead-roots   # gate: no HOT_ROOTS
+                                               # pattern may match zero
+                                               # functions
 """
 from __future__ import annotations
 
