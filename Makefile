@@ -61,6 +61,13 @@ test:           ## tier-1 test suite (CPU)
 # FAILS unless the dead slot is respawned through the supervisor's
 # readiness gate, rejoins rotation, serves a post-restart request, and
 # recompiles stay 0 on every engine incarnation (breaker shut).
+# TP leg: --tp forces 4 host devices at module import and serves the
+# mixed workload single-device then through a TP=4 mesh engine
+# (Megatron-sharded weights + head-sharded KV pool, serving/tp.py);
+# FAILS unless TP output is bit-identical to single-device, recompiles
+# stay 0 on both engines, and a TP=2-sharded replica pair survives the
+# --restart chaos shape (failover + supervisor respawn of the sharded
+# slot through its readiness gate).
 # Load legs: --load is the closed-loop generator (Poisson arrivals,
 # multi-turn sessions, shared system prompts) emitting goodput and
 # p99-under-load as tracked JSON fields (timing-based, not gated);
@@ -105,6 +112,8 @@ bench-smoke:    ## tiny serving benches (non-blocking CI job)
 		--n-requests 8 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --restart \
 		--n-requests 8 --max-new 6
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --tp \
+		--n-requests 6 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --slo \
 		--n-requests 8 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --speculative \

@@ -103,6 +103,21 @@ synthetic probe), rejoins rotation, serves a post-restart request,
 and recompiles stay 0 on every engine incarnation with the crash-loop
 breaker shut.
 
+TP (`--tp`): the tensor-parallel gate, under 4 forced host devices
+(`--xla_force_host_platform_device_count=4`, appended to XLA_FLAGS at
+module import when the flag is on argv — before jax binds a backend).
+The mixed workload runs through a single-device reference engine,
+then through a `mesh=MeshConfig(tp=4)` engine whose weights are
+Megatron-sharded and whose paged-KV pool is sharded on the head axis
+(serving.tp). HARD-FAILS unless the TP output is bit-identical to
+single-device, post-warmup recompiles stay 0 on both engines (the
+mesh key rides every compiled-shape memo), and a TP=2-sharded
+replica pair survives the `--restart` chaos shape — hang → failover
+→ supervisor respawn of the SHARDED slot through its readiness gate
+→ rejoin → serve — under the same bit-identity and zero-recompile
+bars. The JSON line carries tp_mesh / tp_kv_pool_bytes_per_device /
+tp_recompiles_after_warmup plus the restart_* fields.
+
 Load (`--load`): the closed-loop load generator (ROADMAP direction-3
 follow-on): Poisson session arrivals, multi-turn sessions (each turn
 extends the previous prompt + generated tokens — the prefix-cache
@@ -132,9 +147,18 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--tp" in sys.argv:
+    # the tensor-parallel gate needs a 4-device mesh on a CPU host;
+    # forcing host devices only works BEFORE jax binds its backend, so
+    # this must happen at module import — every jax import in this
+    # file is lazy behind it
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4")
 
 import numpy as np
 
@@ -150,7 +174,7 @@ def _make_prompts(rng, n_requests: int, workload: str,
         return [common + list(map(int, rng.randint(1, 200, suffix_len)))
                 for _ in range(n_requests)]
     if workload in ("mixed", "fused", "chaos", "quantized", "router",
-                    "restart", "slo", "disagg"):
+                    "restart", "slo", "disagg", "tp"):
         # lengths spanning the whole ladder, incl. past the largest
         # bucket (chunked prefill) — every request a different length
         return [list(map(int, rng.randint(1, 200, int(L))))
@@ -166,7 +190,7 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
            budgets=None, trace: bool = True,
            profile_sample_every: int = 0,
            speculative: bool = False, spec_k: int = 4,
-           draft_layers=None) -> dict:
+           draft_layers=None, mesh=None) -> dict:
     """One engine lifecycle over `prompts`: warmup (AOT ladder + one
     served request), timed serve, drain. Returns the raw numbers the
     workload-specific JSON assembly picks from. `profile_sample_every`
@@ -183,7 +207,7 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
         attention_impl=attention_impl, trace=trace,
         profile_sample_every=profile_sample_every,
         speculative=speculative, spec_k=spec_k,
-        draft_layers=draft_layers, start=False)
+        draft_layers=draft_layers, mesh=mesh, start=False)
     # warmup: AOT-compile EVERY prefill shape (group ladder x bucket
     # ladder x cold/cached, + the fused variants) before the loop
     # starts, then serve one request to compile the decode chunk fn
@@ -725,7 +749,8 @@ def _router_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
     }
 
 
-def _restart_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
+def _restart_leg(params, cfg, prompts, budgets, base_tokens, *,
+                 mesh=None, **kw) -> dict:
     """The self-healing gate (`--restart`), e2e over HTTP: like the
     `--router` leg, a seeded chaos hang kills the victim's replica
     mid-stream and every stranded SSE stream must fail over to the
@@ -741,6 +766,14 @@ def _restart_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
     from paddle_tpu.serving.faults import FaultInjector
 
     injs = [FaultInjector(seed=0), FaultInjector(seed=1)]
+    per_replica = [{"fault_injector": injs[0]},
+                   {"fault_injector": injs[1]}]
+    if mesh is not None:
+        # the --tp leg reruns this chaos shape with BOTH slots sharded:
+        # the supervisor replays these per-replica kwargs on respawn,
+        # so the rebuilt slot re-derives its mesh + shardings too
+        for slot_kw in per_replica:
+            slot_kw["mesh"] = mesh
     router = serving.Router(
         params, cfg, replicas=2, max_batch=kw["max_batch"],
         block_size=kw["block_size"], max_total_len=64,
@@ -755,8 +788,7 @@ def _restart_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
         # contention alone (the injected hang below is 8s — far past
         # any honest step)
         fused_units=kw["fused_units"], watchdog_s=2.0,
-        per_replica=[{"fault_injector": injs[0]},
-                     {"fault_injector": injs[1]}],
+        per_replica=per_replica,
         auto_restart=True,
         # leftover hang rules from the arm spread can poison the first
         # respawn probes (the injector follows the slot) — threshold 5
@@ -861,6 +893,79 @@ def _restart_leg(params, cfg, prompts, budgets, base_tokens, **kw) -> dict:
         "restart_injector_attachments": [
             inj.stats()["attachments"] for inj in injs],
     }
+
+
+def _tp_leg(params, cfg, prompts, budgets, **kw) -> dict:
+    """The tensor-parallel gate (`--tp`), under 4 forced host devices:
+    the mixed workload through a single-device reference engine, then
+    the SAME workload through a `mesh=MeshConfig(tp=4)` engine whose
+    weights are Megatron-sharded and whose paged-KV pool is sharded on
+    the head axis (serving.tp). HARD-FAILS unless the TP output is
+    bit-identical to single-device, post-warmup recompiles stay 0 on
+    BOTH engines (the mesh key rides every compiled-shape memo, so the
+    warmup ladder covers the sharded shapes), and a TP=2-sharded
+    replica pair survives the `--restart` chaos shape — hang →
+    failover → supervisor respawn of the SHARDED slot through its
+    readiness gate → rejoin → serve — under the same bit-identity and
+    zero-recompile bars."""
+    import jax
+
+    from paddle_tpu.serving.tp import MeshConfig
+
+    if len(jax.devices()) < 4:
+        raise RuntimeError(
+            f"tp gate: only {len(jax.devices())} devices visible — "
+            f"--tp must be on argv at interpreter start so the module "
+            f"top can force 4 host devices via XLA_FLAGS before jax "
+            f"binds its backend")
+
+    ref = _serve(params, cfg, prompts, fused_prefill=True,
+                 budgets=budgets, **kw)
+    base_tokens = [q.result() for q in ref["reqs"]]
+    tp = _serve(params, cfg, prompts, fused_prefill=True,
+                budgets=budgets, mesh=MeshConfig(tp=4), **kw)
+    tp_tokens = [q.result() for q in tp["reqs"]]
+    if tp_tokens != base_tokens:
+        bad = sum(a != b for a, b in zip(tp_tokens, base_tokens))
+        raise RuntimeError(
+            f"tp gate: {bad}/{len(prompts)} requests diverged between "
+            f"the TP=4 mesh engine and single-device — greedy sharded "
+            f"decode must be bit-identical (a mismatch means a wrong "
+            f"sharding spec or a silently resharded intermediate)")
+    if ref["recompiles"] or tp["recompiles"]:
+        raise RuntimeError(
+            f"tp gate: post-warmup recompiles (single-device "
+            f"{ref['recompiles']}, tp=4 {tp['recompiles']}) — the "
+            f"warmup ladder no longer covers the sharded shapes (mesh "
+            f"key missing from a memo?)")
+
+    # the self-healing half at TP=2 × 2 replicas (4 devices, host
+    # shards overlap freely): chaos hang, SSE failover, supervisor
+    # respawn of a sharded slot, rejoin, post-restart serve
+    chaos = _restart_leg(params, cfg, prompts, budgets, base_tokens,
+                         mesh=MeshConfig(tp=2), **kw)
+
+    snap_tp = tp["snap"]["tp"]
+    result = {
+        "metric": "serving_offline_tok_s",
+        "value": round(tp["tok_s"], 1),
+        "unit": "tokens/s",
+        "workload": "tp",
+        "attention_impl": tp["attention_impl"],
+        "n_requests": len(prompts),
+        "tp_mesh": snap_tp["mesh"],
+        "tp_kv_pool_bytes_per_device":
+            snap_tp["kv_pool_bytes_per_device"],
+        "tp_weight_bytes_per_device":
+            snap_tp.get("weight_bytes_per_device"),
+        "tok_s_single_device": round(ref["tok_s"], 1),
+        "tp_bit_identical": True,
+        "tp_shapes_warmed": tp["warmed"],
+        "tp_recompiles_after_warmup": tp["recompiles"],
+        "tp_restart_mesh": MeshConfig(tp=2).describe(),
+    }
+    result.update(chaos)
+    return result
 
 
 def _disagg_leg(params, cfg, prompts, budgets, *, weight_dtype,
@@ -1350,7 +1455,7 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
 
     base = None
     if workload in ("fused", "prefix-share", "chaos", "quantized",
-                    "router", "restart", "slo", "disagg"):
+                    "router", "restart", "slo", "disagg", "tp"):
         # staggered per-request budgets so slots retire at DIFFERENT
         # steps — equal budgets would march the whole batch in lockstep
         # waves and no admission would ever land mid-decode. The fused
@@ -1359,6 +1464,18 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         # requests visibly piggyback (fused prefill_chunk events next
         # to their cached_tokens skip)
         kw["budgets"] = [1 + (i % max_new) for i in range(len(prompts))]
+    if workload == "tp":
+        # TP=4 splits on the kv-head axis and the bench default model
+        # has 2 kv heads — the tp gate gets its own 4-kv-head tiny
+        # config (same layers/geometry otherwise) and assembles its
+        # own JSON line, gates included
+        cfg = llama.LlamaConfig.tiny(use_flash=False,
+                                     num_hidden_layers=2,
+                                     num_key_value_heads=4)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        return _tp_leg(params, cfg, prompts, kw["budgets"],
+                       **{k: v for k, v in kw.items()
+                          if k != "budgets"})
     if workload == "fused":
         # unfused first: the SAME prompts through the PR4 path give the
         # decode_stall_steps / ITL baseline the fused run must beat
@@ -1716,6 +1833,18 @@ def _cli() -> dict:
                          "documented fp-match floor and recompiles "
                          "stay 0 on both replicas; emits migration "
                          "count/bytes and handoff latency")
+    ap.add_argument("--tp", action="store_true",
+                    help="tensor-parallel gate (forces 4 host devices "
+                         "at module import): the mixed workload "
+                         "single-device, then through a TP=4 mesh "
+                         "engine with Megatron-sharded weights and a "
+                         "head-sharded paged-KV pool; HARD-FAILS "
+                         "unless TP output is bit-identical to "
+                         "single-device, post-warmup recompiles stay "
+                         "0 on both engines, and a TP=2-sharded "
+                         "replica pair survives the --restart chaos "
+                         "shape (failover + supervisor respawn of a "
+                         "sharded slot)")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="serve with the prefix cache disabled")
     ap.add_argument("--attention-impl", default="auto",
@@ -1765,11 +1894,11 @@ def _cli() -> dict:
         a.router = False
     if sum((a.prefix_share, a.bucketed, a.fused, a.chaos,
             a.quantized, a.router, a.restart, a.slo, a.speculative,
-            a.disagg, a.load)) > 1:
+            a.disagg, a.load, a.tp)) > 1:
         ap.error("--prefix-share, --bucketed, --fused, --chaos, "
                  "--quantized, --router, --restart, --slo, "
-                 "--speculative, --disagg and --load are mutually "
-                 "exclusive (except --load --router)")
+                 "--speculative, --disagg, --load and --tp are "
+                 "mutually exclusive (except --load --router)")
     workload = ("prefix-share" if a.prefix_share
                 else "mixed" if a.bucketed
                 else "fused" if a.fused
@@ -1780,6 +1909,7 @@ def _cli() -> dict:
                 else "slo" if a.slo
                 else "speculative" if a.speculative
                 else "disagg" if a.disagg
+                else "tp" if a.tp
                 else "load" if a.load else "random")
     bucket_cap = a.max_prefill_bucket
     if bucket_cap is None:
@@ -1789,12 +1919,14 @@ def _cli() -> dict:
         bucket_cap = (16 if workload in ("mixed", "fused", "chaos",
                                          "quantized", "router",
                                          "restart", "slo", "load",
-                                         "speculative", "disagg")
+                                         "speculative", "disagg",
+                                         "tp")
                       else 512)
     chunk = (a.chunk if a.chunk is not None
              else 2 if workload in ("fused", "prefix-share", "chaos",
                                     "quantized", "router", "restart",
-                                    "slo", "speculative", "disagg")
+                                    "slo", "speculative", "disagg",
+                                    "tp")
              else 4)
     return main(n_requests=a.n_requests, max_new=a.max_new,
                 max_batch=a.max_batch, block_size=a.block_size,

@@ -74,6 +74,12 @@ HOT_ROOTS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     # supervisor health-poll loop + the per-routing-decision probe
     ("serving/supervisor.py", ("_loop", "_restart_slot", "restart_slot",
                                "slot_serving", "info")),
+    # tensor-parallel mesh surfaces: shard_info feeds snapshot()/
+    # health()/metrics on their own threads while engines keep
+    # stepping; build_shardings runs during a supervisor respawn
+    # concurrent with the survivor's ticks; key() seeds the _mkey
+    # element every compiled-shape memo key carries
+    ("serving/tp.py", ("shard_info", "build_shardings", "key")),
     # per-tick accessors the graph cannot derive: they are invoked
     # through handles the type map can't follow (capture windows armed
     # over HTTP, spec stats read through as_dict plumbing, trace spans

@@ -839,6 +839,20 @@ class ContinuousBatcher:
     last record. Both are host-side bookkeeping only: no device
     syncs, and the compiled-shape memo keys never see them.
 
+    Tensor-parallel serving (`mesh=`): a serving.tp.MeshConfig shards
+    the weights (every projection output-split — never a contracted
+    dim, so sharded matmuls keep the unsharded summation order), the
+    paged KV pool (head axis) and the w8 scale leaves across a 1-D
+    device mesh; GSPMD partitions the same compiled step programs
+    from sharded avals, the host-side scheduler is untouched, and
+    greedy output is BIT-identical to the single-device batcher. The
+    mesh key rides every compiled-shape memo key after the qkey
+    (() when off — keys stay byte-identical). export_kv gathers the
+    sharded pool to full host blocks and import_kv's scatter
+    preserves the pool sharding, so KV migration (and disaggregated
+    prefill→decode handoff) works across replicas of DIFFERENT mesh
+    shapes — snapshots are mesh-agnostic by construction.
+
     Usage:
         cb = ContinuousBatcher(params, cfg, max_batch=2, block_size=16,
                                max_total_len=256, max_new_tokens=16)
@@ -862,7 +876,8 @@ class ContinuousBatcher:
                  draft_layers: Optional[int] = None,
                  trace=None, flight_recorder_cap: int = 64,
                  profile_sample_every: int = 64,
-                 fault_injector=None, replica_id: str = "r0"):
+                 fault_injector=None, replica_id: str = "r0",
+                 mesh=None):
         # multi-replica attribution: stamped on every `prepared` trace
         # event so a Router's merged trace artifact (and
         # tools/trace_report.py's per-replica grouping) can tell which
@@ -914,6 +929,35 @@ class ContinuousBatcher:
         self.attention_impl = resolve_attention_impl(attention_impl)
         # ptlint: trace-config
         self._qkey = (self.weight_dtype, self.kv_dtype)
+        # tensor-parallel serving (ROADMAP direction 1): `mesh` is a
+        # serving.tp.MeshConfig — projections output-split (never a
+        # contracted dim: bit-identical greedy decode, see tp.py),
+        # the paged KV pool sharded on its head axis, scheduler
+        # state replicated; GSPMD partitions the SAME step programs
+        # from sharded avals, so the host-side scheduler and the AOT
+        # warmup ladder are untouched. Every compiled-shape memo key
+        # carries the mesh key AFTER the qkey (() when mesh is off —
+        # a single-device batcher's keys are byte-identical to a
+        # pre-mesh build's, the _skey convention).
+        # ptlint: trace-config
+        self._mkey = () if mesh is None else mesh.key()
+        self._mesh_cfg = mesh
+        self._mesh = None
+        self._shard_params = None
+        self._shard_pool = None
+        self._shard_repl = None
+        if mesh is not None:
+            if self.attention_impl == "pallas":
+                # the Pallas ragged kernel is a per-device program —
+                # partitioning it needs a shard_map wrapper the mesh
+                # path doesn't have yet (ROADMAP direction 1 follow-on)
+                raise ValueError(
+                    "attention_impl='pallas' is not supported with "
+                    "mesh= yet — use the XLA paged-attention path")
+            from ..serving.tp import build_shardings
+            (self._mesh, self._shard_params, self._shard_pool,
+             self._shard_repl) = build_shardings(mesh, cfg, self.params)
+            self.params = jax.device_put(self.params, self._shard_params)
         # self-speculative decoding (ROADMAP direction 5(b)): a cheap
         # draft — the SAME model truncated to `draft_layers` (None =
         # full depth) — proposes spec_k tokens autoregressively off
@@ -1067,6 +1111,8 @@ class ContinuousBatcher:
         self.cache = PagedKVCache(
             kp, vp, jnp.zeros((max_batch, self.M), jnp.int32),
             jnp.zeros((max_batch,), jnp.int32), ksc, vsc)
+        if self._mesh is not None:
+            self.cache = self._pin_cache_shardings(self.cache)
         self.active = [False] * max_batch
         self.slot_req: List[Optional[int]] = [None] * max_batch
         self.slot_blocks: List[Optional[List[int]]] = [None] * max_batch
@@ -1714,20 +1760,21 @@ class ContinuousBatcher:
         admission dispatches straight to a compiled executable and never
         retraces."""
         key = (G, Pb, cold, self.attention_impl) + self._skey \
-            + self._qkey
+            + self._qkey + self._mkey
         exe = self._prefill_cache.get(key)
         if exe is None:
             fn = self._prefill_fns.get(cold)
             if fn is None:
                 fn = self._build_prefill(cold)
                 self._prefill_fns[cold] = fn
-            sds, i32 = jax.ShapeDtypeStruct, jnp.int32
-            pstruct = jax.tree_util.tree_map(
-                lambda x: sds(jnp.shape(x), x.dtype), self.params)
+            sds, i32 = self._aval, jnp.int32
+            pstruct = self._pstruct()
             exe = fn.lower(
                 pstruct, sds((G, Pb), i32),
-                sds(self.cache.k.shape, self.cache.k.dtype),
-                sds(self.cache.v.shape, self.cache.v.dtype),
+                sds(self.cache.k.shape, self.cache.k.dtype,
+                    self._shard_pool),
+                sds(self.cache.v.shape, self.cache.v.dtype,
+                    self._shard_pool),
                 self._scale_aval(self.cache.k_scale),
                 self._scale_aval(self.cache.v_scale),
                 sds((G, self.M), i32), sds((G, Pb), i32),
@@ -1735,12 +1782,64 @@ class ContinuousBatcher:
             self._prefill_cache[key] = exe
         return exe
 
-    @staticmethod
-    def _scale_aval(scale):
+    # -- mesh-aware AOT lowering avals ------------------------------------
+    def _aval(self, shape, dtype, sharding=None):
+        """ShapeDtypeStruct for AOT lowering. With a serving mesh on,
+        every aval carries a committed sharding (`sharding` None =
+        replicated) so the compiled executable's input layout is
+        pinned; mesh off lowers the plain aval — identical programs,
+        byte-identical memo keys."""
+        if self._mesh is None:
+            return jax.ShapeDtypeStruct(shape, dtype)
+        return jax.ShapeDtypeStruct(
+            shape, dtype,
+            sharding=self._shard_repl if sharding is None else sharding)
+
+    def _pstruct(self):
+        """Param aval tree for lowering — per-leaf TP shardings when
+        the mesh is on (serving.tp's table)."""
+        if self._mesh is None:
+            return jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype),
+                self.params)
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
+                                              sharding=s),
+            self.params, self._shard_params)
+
+    def _cstruct(self):
+        """PagedKVCache aval tree: pools on the head axis, block
+        table / lengths / int8 scale pools replicated."""
+        c = self.cache
+        return PagedKVCache(
+            self._aval(c.k.shape, c.k.dtype, self._shard_pool),
+            self._aval(c.v.shape, c.v.dtype, self._shard_pool),
+            self._aval(c.table.shape, c.table.dtype),
+            self._aval(c.lengths.shape, c.lengths.dtype),
+            self._scale_aval(c.k_scale), self._scale_aval(c.v_scale))
+
+    def _pin_cache_shardings(self, cache: PagedKVCache) -> PagedKVCache:
+        """Pin a fresh cache's leaves to their serving-mesh shardings
+        (the committed layout every compiled step expects; eager pool
+        edits — COW copies, import scatters — preserve it)."""
+        put = jax.device_put
+        return PagedKVCache(
+            put(cache.k, self._shard_pool),
+            put(cache.v, self._shard_pool),
+            put(cache.table, self._shard_repl),
+            put(cache.lengths, self._shard_repl),
+            None if cache.k_scale is None
+            else put(cache.k_scale, self._shard_repl),
+            None if cache.v_scale is None
+            else put(cache.v_scale, self._shard_repl))
+
+    def _scale_aval(self, scale):
         """AOT-lowering aval for a scale pool: None (no leaves — the fp
-        pool's lowered signature is unchanged) or the [L, N] f32 shape."""
+        pool's lowered signature is unchanged) or the [L, N] f32 shape
+        (replicated under a serving mesh — per-(layer, block) scales
+        carry no head axis)."""
         return None if scale is None else \
-            jax.ShapeDtypeStruct(jnp.shape(scale), scale.dtype)
+            self._aval(jnp.shape(scale), scale.dtype)
 
     def warmup_prefill(self, buckets: Optional[Sequence[int]] = None,
                        group_sizes: Optional[Sequence[int]] = None,
@@ -2083,7 +2182,8 @@ class ContinuousBatcher:
             group_pad=Gp, cold=cold, final=final,
             stalls_decode=any(self.active),
             compile_hit=(Gp, bucket, cold, self.attention_impl)
-            + self._skey + self._qkey in self._prefill_cache)
+            + self._skey + self._qkey + self._mkey
+            in self._prefill_cache)
         self._gate("prefill", unit_rids)
         t0 = time.perf_counter()
         self._apply_cow([e[0] for e in entries if e[1] == 0])
@@ -2275,7 +2375,7 @@ class ContinuousBatcher:
                 bucket=bucket, group_pad=Gp, rows=len(groups) * Gp,
                 compile_hit=(len(groups) * Gp, bucket,
                              self.attention_impl) + self._skey
-                + self._qkey in self._fused_cache)
+                + self._qkey + self._mkey in self._fused_cache)
             self._gate("fused",
                        decode_rids + [r for u in unit_rids for r in u])
             t0 = time.perf_counter()
@@ -2479,16 +2579,14 @@ class ContinuousBatcher:
         and a decode-only stretch AFTER a fused stretch (whose steps
         all ran `_fused_exe`) paid a post-warmup compile."""
         key = (self.chunk, self.attention_impl) + self._skey \
-            + self._qkey
+            + self._qkey + self._mkey
         exe = self._chunk_cache.get(key)
         if exe is None:
             if self._chunk_fn is None:
                 self._chunk_fn = self._build_chunk()
-            sds, i32 = jax.ShapeDtypeStruct, jnp.int32
-            pstruct = jax.tree_util.tree_map(
-                lambda x: sds(jnp.shape(x), x.dtype), self.params)
-            cstruct = jax.tree_util.tree_map(
-                lambda x: sds(jnp.shape(x), x.dtype), self.cache)
+            sds, i32 = self._aval, jnp.int32
+            pstruct = self._pstruct()
+            cstruct = self._cstruct()
             B = self.B
             exe = self._chunk_fn.lower(
                 pstruct, cstruct, sds((B,), i32), sds((B,), jnp.bool_),
@@ -2560,19 +2658,21 @@ class ContinuousBatcher:
         row count of the call: units x per-unit group pad for a
         multi-unit step, so (units, group) pairs with the same product
         share one executable."""
-        key = (Gp, Pb, self.attention_impl) + self._skey + self._qkey
+        key = (Gp, Pb, self.attention_impl) + self._skey + self._qkey \
+            + self._mkey
         exe = self._fused_cache.get(key)
         if exe is None:
             if self._fused_fn is None:
                 self._fused_fn = self._build_fused()
-            sds, i32 = jax.ShapeDtypeStruct, jnp.int32
-            pstruct = jax.tree_util.tree_map(
-                lambda x: sds(jnp.shape(x), x.dtype), self.params)
+            sds, i32 = self._aval, jnp.int32
+            pstruct = self._pstruct()
             B = self.B
             exe = self._fused_fn.lower(
                 pstruct,
-                sds(self.cache.k.shape, self.cache.k.dtype),
-                sds(self.cache.v.shape, self.cache.v.dtype),
+                sds(self.cache.k.shape, self.cache.k.dtype,
+                    self._shard_pool),
+                sds(self.cache.v.shape, self.cache.v.dtype,
+                    self._shard_pool),
                 self._scale_aval(self.cache.k_scale),
                 self._scale_aval(self.cache.v_scale),
                 sds((B, self.M), i32), sds((B,), i32), sds((B,), i32),
@@ -2593,7 +2693,8 @@ class ContinuousBatcher:
         the full spec tuple can never serve another config's
         executable (KEY001 enforces the convention)."""
         return (phase, self.spec_k, self._draft_depth,
-                self.attention_impl) + self._skey + self._qkey
+                self.attention_impl) + self._skey + self._qkey \
+            + self._mkey
 
     def spec_stats(self) -> Dict[str, Any]:
         """Speculative-decoding accounting: config + the SpecStats
@@ -2647,13 +2748,15 @@ class ContinuousBatcher:
         if exe is None:
             if self._spec_draft_fn is None:
                 self._spec_draft_fn = self._build_spec_draft()
-            sds, i32 = jax.ShapeDtypeStruct, jnp.int32
-            pstruct = jax.tree_util.tree_map(
-                lambda x: sds(jnp.shape(x), x.dtype), self.params)
+            sds, i32 = self._aval, jnp.int32
+            pstruct = self._pstruct()
             B = self.B
             exe = self._spec_draft_fn.lower(
-                pstruct, sds(self.cache.k.shape, self.cache.k.dtype),
-                sds(self.cache.v.shape, self.cache.v.dtype),
+                pstruct,
+                sds(self.cache.k.shape, self.cache.k.dtype,
+                    self._shard_pool),
+                sds(self.cache.v.shape, self.cache.v.dtype,
+                    self._shard_pool),
                 self._scale_aval(self.cache.k_scale),
                 self._scale_aval(self.cache.v_scale),
                 sds((B, self.M), i32), sds((B,), i32), sds((B,), i32),
@@ -2749,13 +2852,15 @@ class ContinuousBatcher:
         if exe is None:
             if self._spec_verify_fn is None:
                 self._spec_verify_fn = self._build_spec_verify()
-            sds, i32 = jax.ShapeDtypeStruct, jnp.int32
-            pstruct = jax.tree_util.tree_map(
-                lambda x: sds(jnp.shape(x), x.dtype), self.params)
+            sds, i32 = self._aval, jnp.int32
+            pstruct = self._pstruct()
             B = self.B
             exe = self._spec_verify_fn.lower(
-                pstruct, sds(self.cache.k.shape, self.cache.k.dtype),
-                sds(self.cache.v.shape, self.cache.v.dtype),
+                pstruct,
+                sds(self.cache.k.shape, self.cache.k.dtype,
+                    self._shard_pool),
+                sds(self.cache.v.shape, self.cache.v.dtype,
+                    self._shard_pool),
                 self._scale_aval(self.cache.k_scale),
                 self._scale_aval(self.cache.v_scale),
                 sds((B, self.M), i32), sds((B,), i32), sds((B,), i32),
@@ -2901,7 +3006,8 @@ class ContinuousBatcher:
                 self._record_tick(
                     "decode", rids=decode_rids,
                     compile_hit=(self.chunk, self.attention_impl)
-                    + self._skey + self._qkey in self._chunk_cache)
+                    + self._skey + self._qkey + self._mkey
+                    in self._chunk_cache)
                 self._gate("decode", decode_rids)
                 if self._dev_state is None:
                     self._dev_state = self._upload_slot_state()

@@ -78,7 +78,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from .kvtransfer import KVSnapshot, check_compatible
 from .metrics import LATENCY_BUCKETS, MetricsRegistry
@@ -161,6 +161,7 @@ class ServingEngine:
                  profile_sample_every: int = 64,
                  replica_id: str = "r0",
                  role: str = "both",
+                 mesh=None,
                  clock=time.monotonic):
         # multi-replica attribution: every snapshot, health report,
         # flight dump and batcher-side `prepared` trace event carries
@@ -218,7 +219,13 @@ class ServingEngine:
             flight_recorder_cap=flight_recorder_cap,
             profile_sample_every=profile_sample_every,
             fault_injector=fault_injector,
-            replica_id=self.replica_id)
+            replica_id=self.replica_id,
+            mesh=mesh)
+        # tensor-parallel serving (serving/tp.py): the batcher owns the
+        # sharded weights/pool; the engine mirrors the mesh shape into
+        # snapshot()/health()/gauges so a Router's merged forensics can
+        # attribute a multi-chip replica (None = single-device)
+        self.mesh = mesh
         # the RESOLVED backend ("auto" already collapsed to the concrete
         # choice at batcher construction) — bench/snapshot surface.
         # Same for the resolved quantization config: the batcher owns
@@ -268,6 +275,12 @@ class ServingEngine:
         # order — the engine thread activates them via import_kv ahead
         # of fresh admissions (_process_imports_locked)
         self._imports: List = []
+        # shadow-traffic probe feed: a bounded ring of recently COMPLETED
+        # live request shapes (prompt tokens, resolved budget) — the
+        # supervisor's probe_mirror restart gate replays the newest one
+        # through a respawned replica instead of the synthetic prompt
+        self._recent_prompts: List[Tuple[List[int], int]] = []
+        self._recent_prompts_cap = 8
         # drain-and-export rendezvous (supervisor teardown): a caller's
         # box list the engine thread fills with (snapshot, request)
         # pairs for every exportable in-flight request, then clears the
@@ -342,6 +355,24 @@ class ServingEngine:
         self._g_weight_bytes = m.gauge("weight_bytes")
         self._g_kv_pool_bytes.set(self.batcher.kv_pool_bytes())
         self._g_weight_bytes.set(self.batcher.weight_bytes())
+        # tensor-parallel surface: mesh device count + PER-DEVICE pool
+        # bytes (the single-device totals when mesh is off), exported
+        # through to_prometheus() like every gauge so trace_report's
+        # replica column can attribute multi-chip replicas
+        self._g_mesh_devices = m.gauge("mesh_devices")
+        self._g_kv_pool_bytes_dev = m.gauge("kv_pool_bytes_per_device")
+        if mesh is not None:
+            from .tp import shard_info
+            self._mesh_info = shard_info(mesh, self.batcher)
+        else:
+            self._mesh_info = {
+                "mesh": None,
+                "kv_pool_bytes_per_device":
+                    self.batcher.kv_pool_bytes(),
+                "weight_bytes_per_device": self.batcher.weight_bytes()}
+        self._g_mesh_devices.set(1 if mesh is None else int(mesh.tp))
+        self._g_kv_pool_bytes_dev.set(
+            self._mesh_info["kv_pool_bytes_per_device"])
         # speculative-decoding surface: acceptance accounting per
         # verify sweep (flat zeros with spec off — exposition stable)
         self._g_spec_steps = m.gauge("spec_steps")
@@ -730,6 +761,10 @@ class ServingEngine:
             # speculative decoding: resolved config + acceptance
             # accounting (enabled False and zeros when decoding plain)
             snap["speculative"] = b.spec_stats()
+            # tensor-parallel serving: mesh shape + per-device bytes
+            # ("mesh" None for a single-device replica — exposition
+            # stays shape-stable either way)
+            snap["tp"] = dict(self._mesh_info)
             # operators must notice missing forensics: the last failed
             # flight-dump disk write (None when every write landed)
             snap["last_flight_dump_error"] = self._last_dump_error
@@ -758,6 +793,16 @@ class ServingEngine:
                 and not self._wedged and self._broken is None,
             }
 
+    def recent_prompts(self) -> List[Tuple[List[int], int]]:
+        """Recently COMPLETED live request shapes, oldest first:
+        (prompt tokens, resolved max_new budget) per entry, bounded
+        ring. The supervisor's `probe_mirror` restart gate replays the
+        newest through a respawned replica so readiness is proven on
+        REAL traffic's shape (bucket, budget) instead of the synthetic
+        probe prompt's."""
+        with self._lock:
+            return [(list(p), mn) for p, mn in self._recent_prompts]
+
     def health(self) -> Dict:
         """Per-replica health: the signal a multi-replica router polls
         before routing traffic here. `status` is "HEALTHY" (no recent
@@ -783,6 +828,9 @@ class ServingEngine:
             "status": status,
             "replica_id": self.replica_id,
             "role": self.role,
+            # mesh attribution: a multi-chip replica's health rolls up
+            # through the Router with its device footprint attached
+            "mesh": self._mesh_info["mesh"],
             # readiness: warmed (no cold-compile TTFT cliffs left),
             # loop live, and not declared dead — the supervisor's
             # readiness gate requires this True (plus a served probe)
@@ -1389,6 +1437,15 @@ class ServingEngine:
         }[state]
         if not req.done:
             counter.inc()
+            if state is RequestState.FINISHED:
+                # feed the shadow-probe ring: only CLEANLY served
+                # requests are worth replaying through a respawn gate
+                # (a failed shape would gate readiness on a poison)
+                self._recent_prompts.append(
+                    (list(req.prompt),
+                     self.batcher.max_new if req.max_new_tokens is None
+                     else req.max_new_tokens))
+                del self._recent_prompts[:-self._recent_prompts_cap]
             if self._slo is not None and state in (
                     RequestState.FINISHED, RequestState.FAILED,
                     RequestState.TIMED_OUT):

@@ -6,7 +6,16 @@ direction 3): one `HttpFrontend` serves a `Router` (or a bare
 minimal asyncio HTTP/1.1 server built on `asyncio.start_server`. No
 third-party dependencies, by design (the container bakes no web
 framework): the request parser handles exactly what the endpoints
-need — a request line, headers, a Content-Length body.
+need — a request line, headers, a Content-Length or chunked body.
+
+HTTP/1.1 semantics: connections are persistent by default (HTTP/1.0
+clients opt in with ``Connection: keep-alive``) — the handler loops
+requests on one socket until the client sends ``Connection: close``,
+goes away, or a parse error makes further framing unsafe. Fixed-length
+JSON responses carry Content-Length; SSE streams on a keep-alive
+connection are framed with ``Transfer-Encoding: chunked`` and end with
+the zero chunk, so the connection survives a completed stream. Chunked
+REQUEST bodies are decoded too (same byte cap as fixed-length).
 
 Endpoints:
 
@@ -76,20 +85,30 @@ _STATE_HTTP = {RequestState.FINISHED: 200, RequestState.TIMED_OUT: 504,
 
 
 def _headers(status: int, ctype: str, length: Optional[int] = None,
-             extra: str = "") -> bytes:
+             extra: str = "", *, keep: bool = False,
+             chunked: bool = False) -> bytes:
     text = _STATUS_TEXT.get(status, "")
     head = (f"HTTP/1.1 {status} {text}\r\n"
             f"Content-Type: {ctype}\r\n"
-            f"Connection: close\r\n{extra}")
+            f"Connection: {'keep-alive' if keep else 'close'}\r\n"
+            f"{extra}")
+    if chunked:
+        head += "Transfer-Encoding: chunked\r\n"
     if length is not None:
         head += f"Content-Length: {length}\r\n"
     return (head + "\r\n").encode()
 
 
 def _json_body(status: int, payload: Dict[str, Any],
-               extra: str = "") -> bytes:
+               extra: str = "", keep: bool = False) -> bytes:
     body = json.dumps(payload).encode()
-    return _headers(status, "application/json", len(body), extra) + body
+    return _headers(status, "application/json", len(body), extra,
+                    keep=keep) + body
+
+
+def _chunk(data: bytes) -> bytes:
+    """One chunked-transfer frame (hex size line + payload + CRLF)."""
+    return f"{len(data):x}\r\n".encode() + data + b"\r\n"
 
 
 def _sse_event(data: Dict[str, Any], event: Optional[str] = None) -> bytes:
@@ -226,39 +245,63 @@ class HttpFrontend:
     # ---- request handling ------------------------------------------------
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
-        self._active += 1
-        self._idle.clear()
         try:
-            try:
-                method, path, body = await self._read_request(reader)
-            except _HttpError as e:
-                writer.write(_json_body(e.status, {"error": e.message}))
-                await writer.drain()
-                return
-            if self._draining:
-                writer.write(_json_body(
-                    503, {"error": "frontend is draining"}))
-            elif path == "/health" and method == "GET":
-                await self._health(writer)
-            elif path == "/metrics" and method == "GET":
-                await self._metrics(writer)
-            elif path == "/v1/generate" and method == "POST":
-                await self._generate(writer, body)
-            elif path == "/v1/stream" and method == "POST":
-                await self._stream_sse(writer, body)
-            elif path == "/admin/reset_breaker" and method == "POST":
-                await self._reset_breaker(writer, body)
-            elif path == "/debug/profile" and method == "POST":
-                await self._profile(writer, body)
-            elif path in ("/health", "/metrics", "/v1/generate",
-                          "/v1/stream", "/admin/reset_breaker",
-                          "/debug/profile"):
-                writer.write(_json_body(
-                    405, {"error": f"{method} not allowed on {path}"}))
-            else:
-                writer.write(_json_body(
-                    404, {"error": f"no route for {path}"}))
-            await writer.drain()
+            # HTTP/1.1 keep-alive: loop requests on this connection
+            # until the client asks for close, disconnects, or framing
+            # breaks (a parse error leaves the stream position
+            # unknowable — reuse would misparse, so those close).
+            # The in-flight counter covers only the dispatch of each
+            # request, never the idle park between them: a drain must
+            # not wait on a keep-alive connection nobody is using.
+            while True:
+                try:
+                    method, path, body, ka = \
+                        await self._read_request(reader)
+                except _HttpError as e:
+                    writer.write(_json_body(e.status,
+                                            {"error": e.message}))
+                    await writer.drain()
+                    return
+                self._active += 1
+                self._idle.clear()
+                try:
+                    if self._draining:
+                        writer.write(_json_body(
+                            503, {"error": "frontend is draining"}))
+                        await writer.drain()
+                        return
+                    elif path == "/health" and method == "GET":
+                        await self._health(writer, ka)
+                    elif path == "/metrics" and method == "GET":
+                        await self._metrics(writer, ka)
+                    elif path == "/v1/generate" and method == "POST":
+                        await self._generate(writer, body, ka)
+                    elif path == "/v1/stream" and method == "POST":
+                        await self._stream_sse(writer, body, ka)
+                    elif path == "/admin/reset_breaker" \
+                            and method == "POST":
+                        await self._reset_breaker(writer, body, ka)
+                    elif path == "/debug/profile" and method == "POST":
+                        await self._profile(writer, body, ka)
+                    elif path in ("/health", "/metrics", "/v1/generate",
+                                  "/v1/stream", "/admin/reset_breaker",
+                                  "/debug/profile"):
+                        writer.write(_json_body(
+                            405,
+                            {"error": f"{method} not allowed on {path}"},
+                            keep=ka))
+                    else:
+                        writer.write(_json_body(
+                            404, {"error": f"no route for {path}"},
+                            keep=ka))
+                    await writer.drain()
+                finally:
+                    self._active -= 1
+                    if self._active == 0:
+                        self._idle.set()
+                if not ka or writer.transport is None \
+                        or writer.transport.is_closing():
+                    return
         except (ConnectionError, asyncio.IncompleteReadError):
             pass                       # client went away mid-response
         # ptlint: disable=EXC001 — top-level handler boundary: an
@@ -275,11 +318,12 @@ class HttpFrontend:
                 writer.close()
             except RuntimeError:
                 pass
-            self._active -= 1
-            if self._active == 0:
-                self._idle.set()
 
-    async def _read_request(self, reader) -> Tuple[str, str, bytes]:
+    async def _read_request(self, reader) -> Tuple[str, str, bytes, bool]:
+        """One request off the stream → (method, path, body,
+        keep_alive). HTTP/1.1 defaults to keep-alive unless the client
+        sends ``Connection: close``; HTTP/1.0 must opt in. The body is
+        either Content-Length-framed or chunked-decoded."""
         try:
             head = await asyncio.wait_for(
                 reader.readuntil(b"\r\n\r\n"), self._request_timeout_s)
@@ -294,20 +338,63 @@ class HttpFrontend:
         if len(parts) < 3:
             raise _HttpError(400, f"malformed request line: {lines[0]!r}")
         method, path = parts[0].upper(), parts[1].split("?", 1)[0]
-        length = 0
+        version = parts[-1].upper()
+        headers: Dict[str, str] = {}
         for line in lines[1:]:
-            if line.lower().startswith("content-length:"):
-                try:
-                    length = int(line.split(":", 1)[1].strip())
-                except ValueError:
-                    raise _HttpError(400, "bad Content-Length")
+            if ":" in line:
+                k, v = line.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        conn = headers.get("connection", "").lower()
+        ka = (conn != "close" if version == "HTTP/1.1"
+              else conn == "keep-alive")
+        if "chunked" in headers.get("transfer-encoding", "").lower():
+            try:
+                body = await self._read_chunked(reader)
+            except asyncio.TimeoutError:
+                raise _HttpError(408, "timed out reading chunked body")
+            except asyncio.IncompleteReadError:
+                raise _HttpError(400, "truncated chunked body")
+            return method, path, body, ka
+        length = 0
+        if "content-length" in headers:
+            try:
+                length = int(headers["content-length"])
+            except ValueError:
+                raise _HttpError(400, "bad Content-Length")
         if length > _MAX_BODY:
             raise _HttpError(413, f"body over {_MAX_BODY} bytes")
         body = b""
         if length:
             body = await asyncio.wait_for(reader.readexactly(length),
                                           self._request_timeout_s)
-        return method, path, body
+        return method, path, body, ka
+
+    async def _read_chunked(self, reader) -> bytes:
+        """Decode a chunked request body: hex-size-framed chunks up to
+        the zero terminator (trailers skipped), with the same byte cap
+        as fixed-length bodies."""
+        body = b""
+        while True:
+            line = await asyncio.wait_for(reader.readline(),
+                                          self._request_timeout_s)
+            size_s = line.split(b";", 1)[0].strip()
+            if not size_s:
+                raise _HttpError(400, "missing chunk size")
+            try:
+                size = int(size_s, 16)
+            except ValueError:
+                raise _HttpError(400, f"bad chunk size: {size_s!r}")
+            if size == 0:
+                while True:          # optional trailers, then CRLF
+                    t = await asyncio.wait_for(
+                        reader.readline(), self._request_timeout_s)
+                    if t in (b"\r\n", b"\n", b""):
+                        return body
+            if len(body) + size > _MAX_BODY:
+                raise _HttpError(413, f"body over {_MAX_BODY} bytes")
+            chunk = await asyncio.wait_for(
+                reader.readexactly(size + 2), self._request_timeout_s)
+            body += chunk[:-2]
 
     @staticmethod
     def _parse_submit(body: bytes) -> Dict[str, Any]:
@@ -344,12 +431,14 @@ class HttpFrontend:
         except RuntimeError as e:         # router/engine shutting down
             raise _HttpError(503, str(e))
 
-    async def _generate(self, writer, body: bytes) -> None:
+    async def _generate(self, writer, body: bytes,
+                        ka: bool = False) -> None:
         try:
             # ptlint: disable=ASYNC001 — queue push behind short locks (see _submit)
             req = self._submit(self._parse_submit(body))
         except _HttpError as e:
-            writer.write(_json_body(e.status, {"error": e.message}))
+            writer.write(_json_body(e.status, {"error": e.message},
+                                    keep=ka))
             return
         while not req.done:
             if writer.transport is None or writer.transport.is_closing():
@@ -367,21 +456,28 @@ class HttpFrontend:
             "tokens": list(req.tokens),
             "failovers": getattr(req, "router_failovers", 0),
             "error": None if req.error is None else repr(req.error),
-        }))
+        }, keep=ka))
 
-    async def _stream_sse(self, writer, body: bytes) -> None:
+    async def _stream_sse(self, writer, body: bytes,
+                          ka: bool = False) -> None:
         try:
             # ptlint: disable=ASYNC001 — queue push behind short locks (see _submit)
             req = self._submit(self._parse_submit(body))
         except _HttpError as e:
-            writer.write(_json_body(e.status, {"error": e.message}))
+            writer.write(_json_body(e.status, {"error": e.message},
+                                    keep=ka))
             return
+        # keep-alive SSE is chunked-framed so the stream has an
+        # in-band terminator (the zero chunk) and the connection
+        # survives; a close-requested stream is close-delimited
+        frame = _chunk if ka else (lambda b: b)
         writer.write(_headers(200, "text/event-stream",
-                              extra="Cache-Control: no-cache\r\n"))
-        writer.write(_sse_event(
+                              extra="Cache-Control: no-cache\r\n",
+                              keep=ka, chunked=ka))
+        writer.write(frame(_sse_event(
             {"request_id": req.request_id,
              "replica": getattr(req, "replica_id", None)},
-            event="routed"))
+            event="routed")))
         await writer.drain()
         # the bridge: `req.tokens` is append-only (engine-thread
         # writes, this task reads a snapshot length) — each tick ships
@@ -397,7 +493,7 @@ class HttpFrontend:
                 n = len(req.tokens)
                 if n > sent:
                     for t in req.tokens[sent:n]:
-                        writer.write(_sse_event({"token": int(t)}))
+                        writer.write(frame(_sse_event({"token": int(t)})))
                     sent = n
                     await writer.drain()
                     continue
@@ -410,7 +506,7 @@ class HttpFrontend:
             # connection boundary swallow the error
             req.cancel()
             raise
-        writer.write(_sse_event(
+        writer.write(frame(_sse_event(
             {"request_id": req.request_id,
              "replica": getattr(req, "replica_id", None),
              "state": req.state.name,
@@ -420,15 +516,17 @@ class HttpFrontend:
              "error": None if req.error is None else repr(req.error)},
             event="error" if req.state in (RequestState.FAILED,
                                            RequestState.TIMED_OUT)
-            else "done"))
+            else "done")))
+        if ka:
+            writer.write(b"0\r\n\r\n")   # chunked terminator
 
-    async def _health(self, writer) -> None:
+    async def _health(self, writer, ka: bool = False) -> None:
         # ptlint: disable=ASYNC001 — point-in-time snapshot under short locks
         h = self.router.health()
         serving = h.get("serving_replicas",
                         0 if h.get("status") == "UNHEALTHY" else 1)
         if serving:
-            writer.write(_json_body(200, h))
+            writer.write(_json_body(200, h, keep=ka))
             return
         # nobody serves right now — but RESTARTING and FAILED are
         # different outages: a slot behind the supervisor's readiness
@@ -437,9 +535,9 @@ class HttpFrontend:
         # the per-slot supervisor detail either way.
         extra = ("Retry-After: 1\r\n"
                  if h.get("restarting_replicas", 0) else "")
-        writer.write(_json_body(503, h, extra=extra))
+        writer.write(_json_body(503, h, extra=extra, keep=ka))
 
-    async def _metrics(self, writer) -> None:
+    async def _metrics(self, writer, ka: bool = False) -> None:
         # rendering fans out across every replica's counters (and for a
         # Router, walks each slot's engine under its lock) — heavy
         # enough to stall concurrent token streams if it ran on the
@@ -449,9 +547,10 @@ class HttpFrontend:
                                           self.router.to_prometheus)
         body = text.encode()
         writer.write(_headers(200, "text/plain; version=0.0.4",
-                              len(body)) + body)
+                              len(body), keep=ka) + body)
 
-    async def _reset_breaker(self, writer, body: bytes) -> None:
+    async def _reset_breaker(self, writer, body: bytes,
+                             ka: bool = False) -> None:
         """Operator recovery: revive a breaker-pinned FAILED slot —
         `Router.reset_breaker` behind JSON. The slot re-enters the
         readiness-gated recovery cycle; it does NOT serve until the
@@ -460,20 +559,20 @@ class HttpFrontend:
             req = json.loads(body.decode() or "{}")
         except (ValueError, UnicodeDecodeError):
             writer.write(_json_body(400,
-                                    {"error": "body is not valid JSON"}))
+                                    {"error": "body is not valid JSON"}, keep=ka))
             return
         slot = req.get("replica") if req.get("replica") is not None \
             else req.get("slot")
         if slot is None:
             writer.write(_json_body(
                 400, {"error": "pass \"slot\" (index) or \"replica\" "
-                               "(id like \"r1\")"}))
+                               "(id like \"r1\")"}, keep=ka))
             return
         reset = getattr(self.router, "reset_breaker", None)
         if reset is None:
             writer.write(_json_body(
                 400, {"error": "backend has no reset_breaker "
-                               "(bare engine, not a Router)"}))
+                               "(bare engine, not a Router)"}, keep=ka))
             return
         try:
             # blocking-safe: state flips under short locks plus a
@@ -481,10 +580,10 @@ class HttpFrontend:
             # ptlint: disable=ASYNC001 — short-lock state flip, no engine rebuild
             out = reset(slot)
         except LookupError as e:
-            writer.write(_json_body(404, {"error": str(e)}))
+            writer.write(_json_body(404, {"error": str(e)}, keep=ka))
             return
         except RuntimeError as e:        # no supervisor attached
-            writer.write(_json_body(400, {"error": str(e)}))
+            writer.write(_json_body(400, {"error": str(e)}, keep=ka))
             return
         status = 200 if out.get("reset") else 409
         payload = {"ok": bool(out.get("reset")), **out}
@@ -492,9 +591,10 @@ class HttpFrontend:
             payload["error"] = (
                 f"slot {out.get('replica')} is {out.get('state')}, "
                 f"not FAILED — nothing to reset")
-        writer.write(_json_body(status, payload))
+        writer.write(_json_body(status, payload, keep=ka))
 
-    async def _profile(self, writer, body: bytes) -> None:
+    async def _profile(self, writer, body: bytes,
+                       ka: bool = False) -> None:
         """On-demand device-time capture: arm + await the capture
         window WITHOUT blocking the event loop (the wait runs on the
         default executor — token streaming keeps flowing while the
@@ -503,7 +603,7 @@ class HttpFrontend:
             req = json.loads(body.decode() or "{}")
         except (ValueError, UnicodeDecodeError):
             writer.write(_json_body(400,
-                                    {"error": "body is not valid JSON"}))
+                                    {"error": "body is not valid JSON"}, keep=ka))
             return
         try:
             steps = int(req.get("steps", 8))
@@ -511,7 +611,7 @@ class HttpFrontend:
         except (TypeError, ValueError):
             writer.write(_json_body(
                 400, {"error": "steps must be an int, timeout_s a "
-                               "number"}))
+                               "number"}, keep=ka))
             return
         # hard caps: a capture window fences EVERY device call it
         # covers and the wait pins an executor thread — an unbounded
@@ -519,17 +619,17 @@ class HttpFrontend:
         if not 1 <= steps <= 1024 or not 0 < timeout_s <= 300:
             writer.write(_json_body(
                 400, {"error": "steps must be in [1, 1024] and "
-                               "timeout_s in (0, 300]"}))
+                               "timeout_s in (0, 300]"}, keep=ka))
             return
         cap = getattr(self.router, "capture_profile", None)
         if cap is None:
             writer.write(_json_body(
-                400, {"error": "backend has no capture_profile"}))
+                400, {"error": "backend has no capture_profile"}, keep=ka))
             return
         loop = asyncio.get_running_loop()
         report = await loop.run_in_executor(
             None, lambda: cap(steps=steps, timeout=timeout_s))
-        writer.write(_json_body(200, report))
+        writer.write(_json_body(200, report, keep=ka))
 
 
 class _HttpError(Exception):

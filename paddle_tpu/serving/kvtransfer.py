@@ -28,6 +28,14 @@ arrays + plain ints): it can cross process/wire boundaries by pickling
 today, and the block-granular layout is the natural unit for an
 RDMA/ICI transport later (recorded follow-on). This module imports
 neither jax nor paddle_tpu — the batcher owns the device side.
+
+Snapshots are MESH-AGNOSTIC: a tensor-parallel batcher's
+`export_kv` device_get gathers the sharded pool into full host
+arrays (every kv head, not one shard), and `import_kv`'s eager
+scatter onto a committed sharded pool re-distributes them — so the
+fingerprint deliberately excludes mesh layout, and a snapshot
+exported at TP=2 resumes bit-identically on a single-device or TP=4
+replica (serving.tp; covered in tests/test_tp_serving.py).
 """
 from __future__ import annotations
 

@@ -178,6 +178,13 @@ class ReplicaSupervisor:
         — the readiness probe: a synthetic generation the respawned
         engine must complete (after AOT warmup) before the slot
         rejoins rotation (defaults ``[1, 2, 3]`` / 2 / 120.0);
+      * ``probe_mirror`` — shadow-traffic readiness: replay the shape
+        of a recently-served LIVE request (prompt + budget, captured
+        from the dead engine before teardown) instead of the synthetic
+        probe prompt, so the gate exercises the compiled buckets real
+        traffic actually hits; falls back to the synthetic prompt when
+        the dead engine served nothing or cannot be read
+        (default False);
       * ``teardown_timeout_s`` — bound on each dead-engine
         ``shutdown(drain=False)`` (default 2.0);
       * ``seed`` — jitter RNG seed (default 0).
@@ -194,6 +201,7 @@ class ReplicaSupervisor:
                  probe_prompt: Optional[Sequence[int]] = None,
                  probe_new_tokens: int = 2,
                  probe_timeout_s: float = 120.0,
+                 probe_mirror: bool = False,
                  teardown_timeout_s: float = 2.0,
                  seed: int = 0, clock=time.monotonic):
         self._router = router
@@ -207,6 +215,7 @@ class ReplicaSupervisor:
             else [1, 2, 3]
         self._probe_new = int(probe_new_tokens)
         self._probe_timeout_s = float(probe_timeout_s)
+        self._probe_mirror = bool(probe_mirror)
         self._teardown_timeout_s = float(teardown_timeout_s)
         self._rng = random.Random(seed)
         # restart cycles run CONCURRENTLY (one thread per slot) and
@@ -367,6 +376,21 @@ class ReplicaSupervisor:
         # re-prefill. A wedged engine thread cannot drain —
         # drain_export times out to [] and those requests ride the
         # normal cold failover instead.
+        # shadow-traffic mirror: grab the newest live request shape
+        # BEFORE teardown wipes the dead engine (best-effort — a
+        # wedged engine, or one that served nothing, falls back to
+        # the synthetic probe prompt)
+        mirror: Optional[Tuple[List[int], int]] = None
+        if self._probe_mirror:
+            try:
+                served = dead.recent_prompts()
+                if served:
+                    mirror = served[-1]
+            # ptlint: disable=EXC001 — mirror capture is best-effort:
+            # a dying engine that cannot report its traffic must still
+            # be respawned; the synthetic probe covers the gate
+            except Exception:
+                mirror = None
         pairs: List[Tuple[Any, Any]] = []
         try:
             pairs = dead.drain_export(timeout=self._teardown_timeout_s)
@@ -383,7 +407,7 @@ class ReplicaSupervisor:
                 fresh = r._build_replica(slot.index)
                 fresh.warmup()
                 fresh.start()
-                self._probe(fresh)
+                self._probe(fresh, mirror=mirror)
             # ptlint: disable=EXC001 — respawn attempt boundary: ANY
             # failure (constructor, warmup, probe, watchdog trip) is a
             # failed attempt feeding the backoff/breaker machinery —
@@ -471,15 +495,21 @@ class ReplicaSupervisor:
         # requests must not hang on a box nobody will resume
         self._fail_exported(pairs)
 
-    def _probe(self, eng) -> None:
-        """The readiness probe: one synthetic generation through the
-        full admission→prefill→decode→channel path. Polls in short
+    def _probe(self, eng,
+               mirror: Optional[Tuple[List[int], int]] = None) -> None:
+        """The readiness probe: one generation through the full
+        admission→prefill→decode→channel path — the `mirror` shape (a
+        recently-served live prompt + budget, when ``probe_mirror``
+        captured one) or the synthetic probe prompt. Polls in short
         slices so a supervisor stop interrupts it bounded; raises on
         timeout, stop, an empty generation, or a respawned engine that
         is not HEALTHY afterwards (its own watchdog tripping during
         the probe lands here — the persistent-hang shape)."""
-        req = eng.submit(self._probe_prompt,
-                         max_new_tokens=self._probe_new)
+        if mirror is not None:
+            prompt, max_new = list(mirror[0]), int(mirror[1])
+        else:
+            prompt, max_new = self._probe_prompt, self._probe_new
+        req = eng.submit(prompt, max_new_tokens=max_new)
         deadline = self._clock() + self._probe_timeout_s
         while True:
             if self._stop.is_set():

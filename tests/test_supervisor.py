@@ -678,3 +678,50 @@ class TestBreakerReset:
         assert any(e["args"].get("via_breaker_reset")
                    for e in restarted)
         assert r.shutdown(drain=False)
+
+
+class TestProbeMirror:
+    def test_respawn_probe_replays_live_shape(self, setup):
+        """restart_opts={"probe_mirror": True}: the respawn gate
+        replays the shape of the newest LIVE request served by the
+        dead incarnation instead of the synthetic probe prompt — and
+        falls back to the synthetic prompt when the dead engine never
+        served anything."""
+        cfg, params = setup
+        r = Router(params, cfg, replicas=1, max_batch=2, block_size=4,
+                   max_total_len=48, max_new_tokens=MAX_NEW, chunk=3,
+                   max_queue_depth=32, max_prefill_bucket=16,
+                   auto_restart=True,
+                   restart_opts={"backoff_s": 0.05, "poll_s": 0.02,
+                                 "probe_timeout_s": 120.0,
+                                 "probe_mirror": True},
+                   start=False)
+        r.warmup()
+        r.start()
+        sup = r._supervisor
+        assert sup._probe_mirror
+
+        def planned_restart():
+            dead = r.engines[0]
+            assert sup.restart_slot(0)
+            deadline = time.monotonic() + 300
+            while sup.states()[0] != SLOT_SERVING \
+                    or r.engines[0] is dead:
+                assert time.monotonic() < deadline, "respawn stalled"
+                time.sleep(0.02)
+            return r.engines[0]
+
+        # no live traffic yet: mirror capture finds nothing, the gate
+        # falls back to the synthetic probe shape
+        fresh = planned_restart()
+        assert fresh.recent_prompts()[0] == ([1, 2, 3], 2)
+
+        out = r.generate(PROMPTS[2], timeout=300)
+        assert r.engines[0].recent_prompts()[-1] == (PROMPTS[2], MAX_NEW)
+        # now the gate replays the live shape (newest entry — the dead
+        # engine's own synthetic-probe generation is older)
+        fresh = planned_restart()
+        assert fresh.recent_prompts()[0] == (PROMPTS[2], MAX_NEW)
+        # and the respawned sharded-or-not slot still serves correctly
+        assert r.generate(PROMPTS[2], timeout=300) == out
+        assert r.shutdown()
