@@ -117,7 +117,7 @@ bench-smoke:    ## tiny serving benches (non-blocking CI job)
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --slo \
 		--n-requests 8 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --speculative \
-		--n-requests 6 --max-new 6
+		--spec-tree 2,1,1,1 --n-requests 6 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --disagg \
 		--n-requests 6 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --load \

@@ -190,7 +190,9 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
            budgets=None, trace: bool = True,
            profile_sample_every: int = 0,
            speculative: bool = False, spec_k: int = 4,
-           draft_layers=None, mesh=None) -> dict:
+           draft_layers=None, spec_tree=None,
+           spec_draft_w8: bool = False, spec_attention_impl=None,
+           mesh=None) -> dict:
     """One engine lifecycle over `prompts`: warmup (AOT ladder + one
     served request), timed serve, drain. Returns the raw numbers the
     workload-specific JSON assembly picks from. `profile_sample_every`
@@ -207,7 +209,10 @@ def _serve(params, cfg, prompts, *, max_new: int, max_batch: int,
         attention_impl=attention_impl, trace=trace,
         profile_sample_every=profile_sample_every,
         speculative=speculative, spec_k=spec_k,
-        draft_layers=draft_layers, mesh=mesh, start=False)
+        draft_layers=draft_layers, spec_tree=spec_tree,
+        spec_draft_w8=spec_draft_w8,
+        spec_attention_impl=spec_attention_impl,
+        mesh=mesh, start=False)
     # warmup: AOT-compile EVERY prefill shape (group ladder x bucket
     # ladder x cold/cached, + the fused variants) before the loop
     # starts, then serve one request to compile the decode chunk fn
@@ -414,45 +419,73 @@ def _quantized_gates(params, cfg, prompts, budgets, **kw) -> dict:
     return out
 
 
-def _spec_leg(params, cfg, prompts, **kw) -> dict:
+def _spec_leg(params, cfg, prompts, *, spec_tree=(2, 1, 1, 1),
+              **kw) -> dict:
     """The speculative-decoding gate: the shared-prefix workload runs
-    plain (the greedy token reference) and then self-speculatively.
-    HARD-FAILS unless the spec run's output is BIT-identical to the
-    plain reference (greedy speculation changes the schedule, never
-    the tokens), accepted tokens/step exceeds 1 (speculation actually
-    multiplies decode), and post-warmup recompiles stay 0 on both
-    runs (the spec draft/verify pair is AOT-warmed and the spec
-    config rides every memo key). The draft runs at FULL depth here:
-    on the random-init smoke model a truncated draft's proposals
-    essentially never match the target's greedy choices, so the
-    accept path would be vacuous — truncation (`draft_layers=`) is a
-    quality/cost knob for real checkpoints, exercised for token
-    parity by tests/test_speculative.py."""
+    plain (the greedy token reference), then self-speculatively with
+    a chain draft, then with a TREE draft (`--spec-tree`, default
+    [2,1,1,1]). HARD-FAILS unless BOTH spec runs' outputs are
+    BIT-identical to the plain reference (greedy speculation changes
+    the schedule, never the tokens), accepted tokens/step exceeds 1
+    (speculation actually multiplies decode), the tree leg's accepted
+    tokens per sweep >= the chain leg's at equal accepted-path budget
+    (the tree's depth equals the chain's k, and child 0 of every tree
+    node IS the chain's draft token, so the tree's candidate set
+    contains the chain path — acceptance can only dominate), and
+    post-warmup recompiles stay 0 on all runs (the spec config —
+    branching spec included — rides every memo/warmup key). Drafts
+    run at FULL depth here: on the random-init smoke model a
+    truncated draft's proposals essentially never match the target's
+    greedy choices, so the accept path would be vacuous — truncation
+    (`draft_layers=`) is a quality/cost knob for real checkpoints,
+    exercised for token parity by tests/test_speculative.py."""
+    spec_tree = tuple(int(b) for b in spec_tree)
     ref = _serve(params, cfg, prompts, fused_prefill=True, **kw)
     base_tokens = [q.result() for q in ref["reqs"]]
+    # chain leg: k = the tree's depth, so both legs can accept the
+    # same number of draft tokens per verify sweep (the fair
+    # acceptance comparison; the tree spends more verify WIDTH —
+    # that is the trade speculation v2 buys)
+    chain_k = len(spec_tree)
     spec = _serve(params, cfg, prompts, fused_prefill=True,
-                  speculative=True, spec_k=4, draft_layers=None, **kw)
+                  speculative=True, spec_k=chain_k,
+                  draft_layers=None, **kw)
     spec_tokens = [q.result() for q in spec["reqs"]]
     st = spec["snap"]["speculative"]
-    if spec_tokens != base_tokens:
-        bad = sum(1 for a, b in zip(base_tokens, spec_tokens)
-                  if a != b)
-        raise RuntimeError(
-            f"speculative gate: {bad}/{len(base_tokens)} requests "
-            f"diverged from the plain greedy reference — greedy "
-            f"speculative decoding must be output-identical "
-            f"(accept_rate {st['accept_rate']})")
-    if ref["recompiles"] or spec["recompiles"]:
+    tree = _serve(params, cfg, prompts, fused_prefill=True,
+                  speculative=True, spec_tree=list(spec_tree),
+                  draft_layers=None, **kw)
+    tree_tokens = [q.result() for q in tree["reqs"]]
+    tt = tree["snap"]["speculative"]
+    for name, toks, stats in (("chain", spec_tokens, st),
+                              ("tree", tree_tokens, tt)):
+        if toks != base_tokens:
+            bad = sum(1 for a, b in zip(base_tokens, toks) if a != b)
+            raise RuntimeError(
+                f"speculative gate: {name} leg — {bad}/"
+                f"{len(base_tokens)} requests diverged from the plain "
+                f"greedy reference — greedy speculative decoding must "
+                f"be output-identical (accept_rate "
+                f"{stats['accept_rate']})")
+    if ref["recompiles"] or spec["recompiles"] or tree["recompiles"]:
         raise RuntimeError(
             f"speculative gate: post-warmup recompiles (plain "
-            f"{ref['recompiles']}, spec {spec['recompiles']}) — the "
-            f"spec config must ride every memo/warmup key")
+            f"{ref['recompiles']}, chain {spec['recompiles']}, tree "
+            f"{tree['recompiles']}) — the spec config (branching "
+            f"spec included) must ride every memo/warmup key")
     if not st["tokens_per_step"] > 1.0:
         raise RuntimeError(
             f"speculative gate: {st['tokens_per_step']} accepted "
             f"tokens/step over {st['steps']} verify sweeps — "
             f"speculation is not multiplying decode (accept_rate "
             f"{st['accept_rate']})")
+    if tt["accepted_per_sweep"] < st["accepted_per_sweep"]:
+        raise RuntimeError(
+            f"speculative gate: tree accepted/sweep "
+            f"{tt['accepted_per_sweep']} < chain's "
+            f"{st['accepted_per_sweep']} at equal accepted-path "
+            f"budget — the tree's candidate set contains the chain "
+            f"path, so tree acceptance must dominate")
     return {
         "_ref": ref,
         "spec_accept_rate": st["accept_rate"],
@@ -462,9 +495,21 @@ def _spec_leg(params, cfg, prompts, **kw) -> dict:
         "spec_verify_steps": st["steps"],
         "spec_token_match": 1.0,
         "spec_recompiles_after_warmup": spec["recompiles"],
+        "spec_tree": list(spec_tree),
+        "spec_tree_k": tt["k"],
+        "spec_tree_accept_rate": tt["accept_rate"],
+        "spec_tree_tokens_per_step": tt["tokens_per_step"],
+        "spec_tree_accepted_per_sweep": tt["accepted_per_sweep"],
+        "spec_chain_accepted_per_sweep": st["accepted_per_sweep"],
+        "spec_tree_accept_depth_hist": tt["accept_depth_hist"],
+        "spec_tree_token_match": 1.0,
+        "spec_tree_recompiles_after_warmup": tree["recompiles"],
         "tok_s_spec": round(spec["tok_s"], 1),
         "decode_tok_s_spec": (round(spec["decode_tok_s"], 1)
                               if spec["decode_tok_s"] else None),
+        "tok_s_spec_tree": round(tree["tok_s"], 1),
+        "decode_tok_s_spec_tree": (round(tree["decode_tok_s"], 1)
+                                   if tree["decode_tok_s"] else None),
     }
 
 
@@ -1432,6 +1477,7 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
          attention_impl: str = "auto", fused_units: int = 1,
          sessions: int = 6, turns: int = 3, rate_hz: float = 8.0,
          deadline_s: float = 5.0, load_router_replicas: int = 0,
+         spec_tree=(2, 1, 1, 1),
          trace_path=None, trace_overhead: bool = False) -> dict:
     import jax
     from paddle_tpu.nlp import llama
@@ -1485,7 +1531,8 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
         # plain reference first (its numbers double as this
         # workload's base JSON), then the spec run with the
         # bit-identical / tokens-per-step / zero-recompile gates
-        spec = _spec_leg(params, cfg, prompts, **kw)
+        spec = _spec_leg(params, cfg, prompts, spec_tree=spec_tree,
+                         **kw)
         r0 = spec.pop("_ref")
     quant = None
     if workload == "quantized":
@@ -1788,12 +1835,21 @@ def _cli() -> dict:
                          "merged trace")
     ap.add_argument("--speculative", action="store_true",
                     help="self-speculative decoding gate: the shared-"
-                         "prefix workload runs plain then with draft-"
-                         "and-verify; HARD-FAILS unless spec output "
-                         "is bit-identical to the plain greedy "
-                         "reference, accepted tokens/step > 1, and "
-                         "recompiles stay 0; emits spec_accept_rate "
-                         "and decode_tok_s_spec as tracked fields")
+                         "prefix workload runs plain, then with a "
+                         "chain draft, then with a TREE draft (shape "
+                         "from --spec-tree); HARD-FAILS unless both "
+                         "spec outputs are bit-identical to the plain "
+                         "greedy reference, accepted tokens/step > 1, "
+                         "tree accepted/sweep >= chain's, and "
+                         "recompiles stay 0; emits spec_accept_rate, "
+                         "spec_tree_* and decode_tok_s_spec* fields")
+    ap.add_argument("--spec-tree", default="2,1,1,1",
+                    help="branching spec for the --speculative tree "
+                         "leg, comma-separated per-level factors "
+                         "(default 2,1,1,1: two candidates for the "
+                         "first token, chains below — depth equals "
+                         "the chain leg's k so the acceptance "
+                         "comparison is budget-fair)")
     ap.add_argument("--load", action="store_true",
                     help="closed-loop load generator: Poisson session "
                          "arrivals, multi-turn rounds, shared system "
@@ -1939,6 +1995,8 @@ def _cli() -> dict:
                 sessions=a.sessions, turns=a.turns,
                 rate_hz=a.arrival_rate, deadline_s=a.deadline_s,
                 load_router_replicas=2 if load_router else 0,
+                spec_tree=tuple(int(b) for b in
+                                a.spec_tree.split(",") if b.strip()),
                 trace_path=a.trace, trace_overhead=a.trace_overhead)
 
 

@@ -463,30 +463,50 @@ def _paged_gqa_attention(q, k_pool, v_pool, table, positions, valid=None,
     return o.reshape(B, P, H, hd).astype(q.dtype)
 
 
-def _spec_gqa_attention(q, pk, pv, table, base_len, sk, sv, row_idx,
-                        k_scale=None, v_scale=None):
+def _spec_gqa_attention(q, pk, pv, table, base_len, sk, sv, vis,
+                        k_scale=None, v_scale=None, impl: str = "xla"):
     """The speculative score path's attention: q [B, P, H, hd] over the
     committed pool history PLUS an in-register draft/verify suffix
     slab. The pool is READ-ONLY here — visibility for pool keys is
     j < base_len[b] (the committed length; nothing speculative has
     been written), and suffix slab row s (this step's tokens plus
     previously drafted ones, sk/sv [B, S, KV, hd]) is visible to query
-    p iff s <= row_idx[p] (row_idx [P] = each query's absolute slab
-    row). Together a query at committed position base_len + r sees
-    exactly the base_len + r + 1 keys plain write-then-gather decode
-    would — same key set and values (slab rows pass through the pool
-    dtype), softmax over the concatenated score axis.
+    p iff vis[p, s] — the chain's causal triangle, or the packed
+    tree's ancestor-or-self mask (each node sees exactly its
+    root-to-node path). Together a query at committed position
+    base_len + r along its path sees exactly the base_len + r + 1 keys
+    plain write-then-gather decode would — same key set and values
+    (slab rows pass through the pool dtype), softmax over the
+    concatenated score axis.
 
     k_scale/v_scale mark an int8 pool: dequantized after the gather
     (the XLA reference formulation). Slab rows stay full precision —
     the committed codes a LATER step reads go through the normal
     quantize-on-commit path, so spec-vs-plain parity under int8 KV is
     a documented match-rate floor, not bitwise (README
-    "Speculative decoding")."""
+    "Speculative decoding").
+
+    impl="pallas" routes the whole thing through the ragged Pallas
+    kernel's suffix-slab operand (nlp/ragged_attention.py): the pool
+    sweep stays the int8-gathered block-chunk loop and the slab folds
+    into the same online softmax at the grid's extra chunk — instead
+    of this XLA concat formulation, which stays the bit-stable parity
+    reference (and the CPU default)."""
     B, P, H, hd = q.shape
     N, bs, KV, _ = pk.shape
     M = table.shape[1]
     S = sk.shape[1]
+    if impl == "pallas":
+        from .ragged_attention import ragged_paged_attention
+        # pool visibility j < base_len == positions j <= base_len - 1,
+        # every query valid (inactive slots score garbage the caller
+        # discards — same as the XLA formulation below)
+        return ragged_paged_attention(
+            q, pk, pv, table,
+            jnp.broadcast_to((base_len - 1)[:, None], (B, P)),
+            jnp.ones((B, P), bool), k_scale=k_scale, v_scale=v_scale,
+            suffix_k=sk, suffix_v=sv,
+            suffix_vis=jnp.broadcast_to(vis[None], (B, P, S)))
     tb = jnp.clip(table, 0)
     if k_scale is not None:
         k = kvq.dequantize(pk[tb],
@@ -507,9 +527,7 @@ def _spec_gqa_attention(q, pk, pv, table, base_len, sk, sv, row_idx,
     sp = jnp.where(vis_p, sp, -1e30)
     ss = jnp.einsum("bpkrd,bskd->bkrps", qg, sk.astype(q.dtype),
                     preferred_element_type=jnp.float32) / math.sqrt(hd)
-    vis_s = (jnp.arange(S)[None, :] <= row_idx[:, None]
-             )[None, None, None, :, :]
-    ss = jnp.where(vis_s, ss, -1e30)
+    ss = jnp.where(vis[None, None, None, :, :], ss, -1e30)
     p = jax.nn.softmax(jnp.concatenate([sp, ss], axis=-1), axis=-1)
     o = jnp.einsum("bkrpt,btkd->bpkrd", p[..., :M * bs], v,
                    preferred_element_type=jnp.float32) \
@@ -520,7 +538,8 @@ def _spec_gqa_attention(q, pk, pv, table, base_len, sk, sv, row_idx,
 
 
 def _forward_spec(params, layers, tokens, cache, positions, base_len,
-                  slab_k, slab_v, row0, cfg):
+                  slab_k, slab_v, row0, cfg, vis=None,
+                  impl: str = "xla"):
     """The speculative score-path forward: tokens [B, P] at per-request
     absolute positions, attending to the committed pool (READ-ONLY,
     visibility < base_len) plus the spec slab (previously drafted rows
@@ -531,8 +550,13 @@ def _forward_spec(params, layers, tokens, cache, positions, base_len,
     scale. `layers` may be a truncated stack (the draft's) — the
     slab's leading dim matches it; embed/norm/head come from the full
     `params` either way (the self-speculative trick: the target's pool
-    layers 0..d-1 ARE the d-layer draft's cache). Returns
-    (logits [B, P, V], slab_k', slab_v')."""
+    layers 0..d-1 ARE the d-layer draft's cache — and when the batcher
+    built a draft-from-w8 stack, `layers` is that int8 tree while
+    `params` stays the target's). `vis` [P, S] gives each query its
+    visible slab rows (None = the chain causal triangle relative to
+    row0 — the pre-tree behavior); `impl` picks the score-path
+    attention backend ("xla" concat reference | "pallas" suffix-slab
+    kernel). Returns (logits [B, P, V], slab_k', slab_v')."""
     cd = cfg.dtype
     T_rope = cache.table.shape[1] * cache.k.shape[2]
     x = jnp.take(params["embed_tokens"], tokens, axis=0).astype(cd)
@@ -541,7 +565,12 @@ def _forward_spec(params, layers, tokens, cache, positions, base_len,
     B, P = tokens.shape
     H, KV, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                  cfg.head_dim)
-    row_idx = row0 + jnp.arange(P)
+    if vis is None:
+        # chain slab visibility: query p (slab row row0 + p) sees slab
+        # rows <= its own — the causal triangle the tree's ancestor
+        # mask degenerates to at branching [1, 1, ...]
+        vis = jnp.arange(slab_k.shape[2])[None, :] \
+            <= (row0 + jnp.arange(P))[:, None]
 
     def body(carry, lp):
         x, sk_all, sv_all, li = carry
@@ -566,7 +595,7 @@ def _forward_spec(params, layers, tokens, cache, positions, base_len,
         sv = lax.dynamic_update_slice_in_dim(sv, v.astype(sv.dtype),
                                              row0, axis=1)
         a = _spec_gqa_attention(q, pk, pv, cache.table, base_len,
-                                sk, sv, row_idx, ks, vs)
+                                sk, sv, vis, ks, vs, impl=impl)
         a = a.reshape(B, P, H * hd) @ _wq(lp, "o_proj", cd)
         sk_all = lax.dynamic_update_slice_in_dim(sk_all, sk[None], li, 0)
         sv_all = lax.dynamic_update_slice_in_dim(sv_all, sv[None], li, 0)
@@ -874,6 +903,9 @@ class ContinuousBatcher:
                  kv_dtype: Optional[str] = None,
                  speculative: bool = False, spec_k: int = 4,
                  draft_layers: Optional[int] = None,
+                 spec_tree: Optional[Sequence[int]] = None,
+                 spec_draft_w8: bool = False,
+                 spec_attention_impl: Optional[str] = None,
                  trace=None, flight_recorder_cap: int = 64,
                  profile_sample_every: int = 64,
                  fault_injector=None, replica_id: str = "r0",
@@ -972,18 +1004,54 @@ class ContinuousBatcher:
         # output is identical to plain decode by construction.
         # serving.speculative holds the config/stat types (lazy import
         # below, like trace/profiling — dependency-free module).
+        # Speculation v2 widens the draft to a token TREE
+        # (spec_tree=[b0, b1, ...]: b0 candidates for the next token,
+        # b1 children each, ... — spec_k is then DERIVED as the node
+        # count), optionally reads the draft sweep's weights from an
+        # int8 quantization of the truncated stack (spec_draft_w8 —
+        # draft bytes halve, verification still runs the target's own
+        # weights so tokens are unchanged), and can route the verify's
+        # score path through the ragged kernel's suffix-slab operand
+        # (spec_attention_impl="pallas"; None inherits the batcher's
+        # resolved backend, so CPU stays on the XLA concat reference).
         from ..serving.speculative import SpecConfig, SpecStats
         self.speculative = bool(speculative)
+        # ptlint: memo-invariant(frozen at construction; its key() rides _skey)
         self._spec_cfg = SpecConfig(spec_k, draft_layers,
-                                    num_layers=cfg.num_hidden_layers)
+                                    num_layers=cfg.num_hidden_layers,
+                                    tree=spec_tree,
+                                    draft_w8=spec_draft_w8)
         self.spec_k = self._spec_cfg.k
+        self.spec_tree = self._spec_cfg.tree
         self._draft_depth = self._spec_cfg.depth(cfg.num_hidden_layers)
+        # ptlint: memo-invariant(resolved once at construction; rides _skey)
+        self.spec_attention_impl = self.attention_impl \
+            if spec_attention_impl is None \
+            else resolve_attention_impl(spec_attention_impl)
+        if mesh is not None and self.spec_attention_impl == "pallas":
+            raise ValueError(
+                "spec_attention_impl='pallas' is not supported with "
+                "mesh= yet — use the XLA spec score path")
+        # draft-from-w8: quantize the truncated layer stack ONCE at
+        # construction (int8 codes + per-channel scales — the same
+        # weight-only math weight_dtype="int8" serves) so every draft
+        # sweep streams int8 weight bytes. Only built when the target
+        # itself serves fp weights: an int8 target's layers already
+        # ARE the quantized tree and slicing them is free.
+        self._spec_dlayers = None
+        if self.speculative and self._spec_cfg.draft_w8 \
+                and self.weight_dtype == "fp":
+            trunc = jax.tree_util.tree_map(
+                lambda x: x[:self._draft_depth], params["layers"])
+            self._spec_dlayers = quantize_for_serving(
+                {"layers": trunc}, bits=8)["layers"]
         # every compiled-shape memo key carries the spec config BEFORE
         # the trailing qkey (() when spec is off — plain batchers' keys
         # are byte-identical to before), so a spec batcher's warmed
         # ladder can never be confused with a plain one's
         # ptlint: trace-config
-        self._skey = (self._spec_cfg.key(cfg.num_hidden_layers)
+        self._skey = ((self._spec_cfg.key(cfg.num_hidden_layers)
+                       + (self.spec_attention_impl,))
                       if self.speculative else ())
         self.spec = SpecStats()
         self._spec_cache: Dict[Tuple, Any] = {}
@@ -2038,42 +2106,65 @@ class ContinuousBatcher:
 
     def _units(self,
                recs: Sequence[_Admission]) -> List[List[_Admission]]:
-        """Partition a burst into execution units IN ORDER (a later
-        request may share blocks a former one just registered, so units
-        never reorder): consecutive single-chunk records with the same
-        (bucket, phase) batch into one prefill call; a chunked record
-        runs alone (its chunks are sequential by construction)."""
+        """Partition a burst into execution units: single-chunk records
+        with the same (bucket, phase) batch into one prefill call; a
+        chunked record runs alone (its chunks are sequential by
+        construction).
+
+        Group-growing admission (the PR 4 follow-on): a record no
+        longer has to be CONSECUTIVE with its bucket-mates — it joins
+        the EARLIEST open same-key unit with room, provided moving it
+        earlier jumps over no unit whose registered blocks it depends
+        on. The dependency set is the record's shared-prefix chain
+        (matched blocks) plus its COW source: dependencies only point
+        at EARLIER submissions, and later records that depend on THIS
+        one only ever see it move toward them, so the reorder preserves
+        every write-before-read edge and greedy tokens are
+        schedule-invariant (tests/test_fused_step.py pins this).
+
+        A COW record still never shares a unit with the record that
+        registered its source block: the clone reads the POOL (outside
+        the compiled call), so the source's prefill has to complete in
+        an earlier unit first. Matched (non-COW) blocks are safe
+        in-unit — the gather sees the layer's writes inside the
+        computation."""
         units: List[List[_Admission]] = []
-        cur: List[_Admission] = []
-        cur_inserted: set = set()
-        key = None
+        # per unit: the growable key (None = closed chunked unit) and
+        # the pool blocks its records registered
+        keys: List[Optional[Tuple]] = []
+        inserted: List[set] = []
         for rec in recs:
             if len(rec.chunks) > 1:
-                if cur:
-                    units.append(cur)
-                    cur, cur_inserted, key = [], set(), None
                 units.append([rec])
+                keys.append(None)
+                inserted.append(set(rec.inserted))
                 continue
             s, _, b = rec.chunks[0]
             k = (b, s == 0)
-            # a COW record must not share a unit with the record that
-            # registered its source block: the clone reads the POOL
-            # (outside the compiled call), so the source's prefill has
-            # to complete in an earlier unit first. Matched (non-COW)
-            # blocks are safe in-unit — the gather sees the layer's
-            # writes inside the computation.
-            cow_conflict = (rec.cow_src is not None
-                            and rec.cow_src in cur_inserted)
-            if cur and k == key and len(cur) < self.B \
-                    and not cow_conflict:
-                cur.append(rec)
+            deps = set(rec.matched)
+            if rec.cow_src is not None:
+                deps.add(rec.cow_src)
+            # blocks registered AFTER each candidate slot, scanned
+            # back to front: joining unit i is legal iff no unit past
+            # i registered a block this record depends on
+            target = None
+            after: set = set()
+            for i in range(len(units) - 1, -1, -1):
+                if keys[i] == k and len(units[i]) < self.B \
+                        and not (deps & after) \
+                        and not (rec.cow_src is not None
+                                 and rec.cow_src in inserted[i]):
+                    target = i
+                elif deps & after:
+                    break
+                after |= inserted[i]
+            if target is not None:
+                units[target].append(rec)
+                inserted[target].update(rec.inserted)
             else:
-                if cur:
-                    units.append(cur)
-                cur, cur_inserted, key = [rec], set(), k
-            cur_inserted.update(rec.inserted)
-        if cur:
-            units.append(cur)
+                units.append([rec])
+                keys.append(k)
+                inserted.append(set(rec.inserted))
         return units
 
     def _apply_cow(self, unit: Sequence[_Admission]) -> None:
@@ -2153,11 +2244,13 @@ class ContinuousBatcher:
         return entries, items, bucket, items[0][1] == 0, True
 
     def _pop_unit(self):
-        """The next prefill execution unit off the pending pipeline, in
-        order (a later record may share blocks an earlier one
-        registered)."""
+        """The next prefill execution unit off the pending pipeline —
+        group-growing admission means a unit's records need not be a
+        contiguous slice of the pending list, so entries resolve by
+        record identity."""
         unit = self._units([e[0] for e in self._pending])[0]
-        return self._unit_view(unit, self._pending[:len(unit)])
+        entry_of = {id(e[0]): e for e in self._pending}
+        return self._unit_view(unit, [entry_of[id(r)] for r in unit])
 
     def _finish_unit(self, entries, firsts) -> None:
         """Commit a unit whose FINAL chunk just computed: one readback
@@ -2306,12 +2399,14 @@ class ContinuousBatcher:
             self._rollback([rec])
 
     def _pop_fused_units(self):
-        """Select the units ONE fused call carries, in pending order:
-        the head unit always rides; up to `fused_units - 1` more
-        CONSECUTIVE units join when each (a) prefills this step at the
-        head unit's bucket (one compiled shape), and (b) holds no block
-        reference — matched chain or COW source — that an earlier
-        SELECTED unit registered but will not have fully written.
+        """Select the units ONE fused call carries, in unit order (the
+        group-grown `_units` partition, which preserves every
+        dependency edge): the head unit always rides; up to
+        `fused_units - 1` more units join when each (a) prefills this
+        step at the head unit's bucket (one compiled shape), and (b)
+        holds no block reference — matched chain or COW source — that
+        an earlier SELECTED unit registered but will not have fully
+        written.
         In-call pool writes ARE visible to the gather (each layer
         writes every row's KV before gathering), so a later unit may
         chain onto blocks a completing co-selected unit writes this
@@ -2322,16 +2417,16 @@ class ContinuousBatcher:
         list of (pipeline entries, (rec, start, end) items, final) per
         selected unit."""
         units = self._units([e[0] for e in self._pending])
+        entry_of = {id(e[0]): e for e in self._pending}
         groups: List[Tuple[List, List, bool]] = []
         bucket0 = None
-        consumed = 0
         inserted_sel: set = set()    # registered by any selected unit
         unwritten: set = set()       # ... that this call won't write
         for unit in units:
             if len(groups) >= self.fused_units:
                 break
             entries, items, bucket, _cold, final = self._unit_view(
-                unit, self._pending[consumed:consumed + len(unit)])
+                unit, [entry_of[id(r)] for r in unit])
             if bucket0 is None:
                 bucket0 = bucket
             elif bucket != bucket0:
@@ -2345,7 +2440,6 @@ class ContinuousBatcher:
             if (refs | cow_refs) & unwritten or cow_refs & inserted_sel:
                 break
             groups.append((entries, items, final))
-            consumed += len(unit)
             for rec in unit:
                 inserted_sel.update(rec.inserted)
                 if not final:
@@ -2689,12 +2783,14 @@ class ContinuousBatcher:
         """Memo key for the spec `phase` ("draft" | "verify")
         executable — spec geometry + backend + quantization config.
         Carries `_skey` like every other compiled-shape memo key, so a
-        batcher whose spec config changes shape (k, draft depth) via
-        the full spec tuple can never serve another config's
-        executable (KEY001 enforces the convention)."""
+        batcher whose spec config changes shape (k, draft depth, tree
+        branching, draft-w8) via the full spec tuple can never serve
+        another config's executable; the resolved spec score-path
+        backend rides inside `_skey` next to the geometry for the same
+        reason (KEY001 enforces the convention)."""
         return (phase, self.spec_k, self._draft_depth,
-                self.attention_impl) + self._skey + self._qkey \
-            + self._mkey
+                self.attention_impl) \
+            + self._skey + self._qkey + self._mkey
 
     def spec_stats(self) -> Dict[str, Any]:
         """Speculative-decoding accounting: config + the SpecStats
@@ -2707,19 +2803,25 @@ class ContinuousBatcher:
         return d
 
     def _build_spec_draft(self):
-        """The traced draft: spec_k autoregressive proposals per slot
-        off the truncated layer stack, reading the committed pool
+        """The traced chain draft: spec_k autoregressive proposals per
+        slot off the truncated layer stack, reading the committed pool
         READ-ONLY (layers 0..depth-1 of the target's pool ARE the
         draft's cache) with its own proposals riding the spec slab.
-        Returns drafts [B, spec_k] (proposal j+1 per step j)."""
+        `dlayers` is the draft-from-w8 quantized stack (None drafts
+        from the target's own weights, sliced in-trace so XLA fuses
+        the slice — no copy). Returns drafts [B, spec_k] (proposal
+        j+1 per step j)."""
         cfg, K, depth, B = self.cfg, self.spec_k, self._draft_depth, \
             self.B
         maxpos = self.M * self.bs - 1
+        impl = self.spec_attention_impl
 
-        def draft(params, k, v, ks, vs, table, lengths, tok, active):
+        def draft(params, dlayers, k, v, ks, vs, table, lengths, tok,
+                  active):
             cache = PagedKVCache(k, v, table, lengths, ks, vs)
-            layers = jax.tree_util.tree_map(lambda x: x[:depth],
-                                            params["layers"])
+            layers = jax.tree_util.tree_map(
+                lambda x: x[:depth], params["layers"]) \
+                if dlayers is None else dlayers
             KVh, hd = cfg.num_key_value_heads, cfg.head_dim
             sk = jnp.zeros((depth, B, K, KVh, hd), cfg.dtype)
             sv = jnp.zeros_like(sk)
@@ -2729,7 +2831,7 @@ class ContinuousBatcher:
                 pos = jnp.minimum(lengths[:, None] + j, maxpos)
                 logits, sk, sv = _forward_spec(
                     params, layers, tok[:, None], cache, pos, lengths,
-                    sk, sv, j, cfg)
+                    sk, sv, j, cfg, impl=impl)
                 nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
                 nxt = jnp.where(active, nxt, tok)
                 return (nxt, sk, sv), nxt
@@ -2740,19 +2842,88 @@ class ContinuousBatcher:
 
         return jax.jit(draft)
 
+    def _build_spec_tree_draft(self):
+        """The traced TREE draft: level by level, one truncated-stack
+        forward per level scores ALL of the level's nodes at once
+        (each node's slab visibility is its ancestor path, so its
+        logits equal the sequential prefix's) and lax.top_k proposes
+        tree[j] children per node — child 0 is the node's argmax, so
+        the tree always contains the chain draft's path. Level j's
+        nodes land in slab rows [offs[j], offs[j+1]) — contiguous by
+        the packed-level layout; the LAST level's proposals are never
+        forwarded here (the verify computes their K/V). Returns
+        drafts [B, spec_k] in slab-row order (levels concatenated)."""
+        cfg, B, depth = self.cfg, self.B, self._draft_depth
+        sc = self._spec_cfg
+        tree = sc.tree
+        D = len(tree)
+        sizes, offs = sc.level_sizes(), sc.level_offsets()
+        Sd = offs[D]                 # draft slab: root + levels 1..D-1
+        maxpos = self.M * self.bs - 1
+        impl = self.spec_attention_impl
+        A = sc.ancestor_mask()
+        # per-level query visibility: the level's rows of the ancestor
+        # mask, restricted to the draft slab's columns (static consts)
+        vis_lv = [jnp.asarray([row[:Sd] for row in
+                               A[offs[j]:offs[j + 1]]])
+                  for j in range(D)]
+
+        def draft(params, dlayers, k, v, ks, vs, table, lengths, tok,
+                  active):
+            cache = PagedKVCache(k, v, table, lengths, ks, vs)
+            layers = jax.tree_util.tree_map(
+                lambda x: x[:depth], params["layers"]) \
+                if dlayers is None else dlayers
+            KVh, hd = cfg.num_key_value_heads, cfg.head_dim
+            sk = jnp.zeros((depth, B, Sd, KVh, hd), cfg.dtype)
+            sv = jnp.zeros_like(sk)
+            toks = tok[:, None]                    # level 0: the root
+            out_levels = []
+            for j in range(D):
+                w = sizes[j]
+                pos = jnp.broadcast_to(
+                    jnp.minimum(lengths + j, maxpos)[:, None], (B, w))
+                logits, sk, sv = _forward_spec(
+                    params, layers, toks, cache, pos, lengths,
+                    sk, sv, offs[j], cfg, vis=vis_lv[j], impl=impl)
+                # top-b children per node: lax.top_k ties break toward
+                # the lower index, same as argmax — child 0 IS the
+                # greedy continuation, so tree acceptance dominates
+                # the chain's per sweep
+                _, top = lax.top_k(logits, tree[j])  # [B, w, b]
+                nxt = top.reshape(B, w * tree[j]).astype(jnp.int32)
+                nxt = jnp.where(active[:, None], nxt, tok[:, None])
+                out_levels.append(nxt)
+                toks = nxt
+            return jnp.concatenate(out_levels, axis=1)   # [B, spec_k]
+
+        return jax.jit(draft)
+
+    def _spec_dlayers_aval(self):
+        """AOT-lowering aval tree for the draft-from-w8 stack (None —
+        an empty pytree — when drafting from the target's weights)."""
+        if self._spec_dlayers is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda x: self._aval(jnp.shape(x), x.dtype),
+            self._spec_dlayers)
+
     def _spec_draft_exe(self):
-        """Memoized COMPILED draft step, AOT-lowered like the prefill
-        shapes so `warmup_prefill` covers it."""
+        """Memoized COMPILED draft step (chain or tree per the spec
+        config), AOT-lowered like the prefill shapes so
+        `warmup_prefill` covers it."""
         key = self._spec_key("draft")
         exe = self._spec_cache.get(key)
         if exe is None:
             if self._spec_draft_fn is None:
-                self._spec_draft_fn = self._build_spec_draft()
+                self._spec_draft_fn = self._build_spec_tree_draft() \
+                    if self.spec_tree is not None \
+                    else self._build_spec_draft()
             sds, i32 = self._aval, jnp.int32
             pstruct = self._pstruct()
             B = self.B
             exe = self._spec_draft_fn.lower(
-                pstruct,
+                pstruct, self._spec_dlayers_aval(),
                 sds(self.cache.k.shape, self.cache.k.dtype,
                     self._shard_pool),
                 sds(self.cache.v.shape, self.cache.v.dtype,
@@ -2781,6 +2952,7 @@ class ContinuousBatcher:
         P = K + 1
         eos = -1 if self.eos is None else int(self.eos)
         maxpos = self.M * self.bs - 1
+        impl = self.spec_attention_impl
 
         def verify(params, k, v, ks, vs, table, lengths, tok, drafts,
                    active, budget, stop, spec_ok):
@@ -2794,7 +2966,7 @@ class ContinuousBatcher:
             sv = jnp.zeros_like(sk)
             logits, sk, sv = _forward_spec(
                 params, params["layers"], toks_in, cache, pos, lengths,
-                sk, sv, jnp.int32(0), cfg)
+                sk, sv, jnp.int32(0), cfg, impl=impl)
             g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, P]
             # accept proposal i+1 while it equals the target's greedy
             # token at the previous position (longest matching prefix)
@@ -2845,13 +3017,133 @@ class ContinuousBatcher:
 
         return jax.jit(verify)
 
+    def _build_spec_tree_verify(self):
+        """The traced TREE verify: score the whole packed token tree —
+        root + every drafted node, slab visibility = the static
+        ancestor mask — in ONE full-depth pass, then walk the tree
+        level by level following the target's own greedy tokens: at
+        each accepted node, the child whose draft token equals the
+        target's greedy continuation extends the path (top-k children
+        are distinct, so at most one matches — the same longest-
+        matching-prefix rule as the chain, over a wider candidate
+        set). The accepted path's rows — and ONLY those — commit
+        row-sequentially exactly like the chain verify, so greedy
+        output stays bit-identical to plain decode and the int8
+        grow-only scale / prefix-cache invariants hold unchanged.
+        Returns the chain verify's tuple with out/n_emit sized to the
+        path width (tree depth + 1)."""
+        cfg, B = self.cfg, self.B
+        sc = self._spec_cfg
+        tree = sc.tree
+        D = len(tree)
+        offs = sc.level_offsets()
+        S = sc.slab_rows()
+        P_out = D + 1
+        eos = -1 if self.eos is None else int(self.eos)
+        maxpos = self.M * self.bs - 1
+        impl = self.spec_attention_impl
+        A = jnp.asarray(sc.ancestor_mask())                   # [S, S]
+        lv = jnp.asarray(sc.row_levels(), jnp.int32)          # [S]
+
+        def verify(params, k, v, ks, vs, table, lengths, tok, drafts,
+                   active, budget, stop, spec_ok):
+            cache = PagedKVCache(k, v, table, lengths, ks, vs)
+            toks_in = jnp.concatenate([tok[:, None], drafts], axis=1)
+            # every node sits at committed position lengths + level —
+            # siblings share a position; visibility (the ancestor
+            # mask), not position, separates them
+            pos = jnp.minimum(lengths[:, None] + lv[None, :], maxpos)
+            KVh, hd = cfg.num_key_value_heads, cfg.head_dim
+            sk = jnp.zeros((cfg.num_hidden_layers, B, S, KVh, hd),
+                           cfg.dtype)
+            sv = jnp.zeros_like(sk)
+            logits, sk, sv = _forward_spec(
+                params, params["layers"], toks_in, cache, pos, lengths,
+                sk, sv, jnp.int32(0), cfg, vis=A, impl=impl)
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+            # accept walk: cur = the path head's slab row, ci = its
+            # index within its level; a level with no matching child
+            # kills the walk (alive), exactly the chain's cumprod
+            cur = jnp.zeros((B,), jnp.int32)
+            ci = jnp.zeros((B,), jnp.int32)
+            alive = spec_ok
+            n_acc = jnp.zeros((B,), jnp.int32)
+            path_rows = [cur]
+            for j in range(1, D + 1):
+                b = tree[j - 1]
+                crows = offs[j] + ci[:, None] * b \
+                    + jnp.arange(b)[None, :]                   # [B, b]
+                ctoks = jnp.take_along_axis(toks_in, crows, axis=1)
+                tgt = jnp.take_along_axis(g, cur[:, None], axis=1)
+                hit = (ctoks == tgt) & alive[:, None]
+                has = jnp.any(hit, axis=1)
+                pick = jnp.argmax(hit, axis=1).astype(jnp.int32)
+                ci2 = ci * b + pick
+                cur = jnp.where(has, offs[j] + ci2, cur)
+                ci = jnp.where(has, ci2, ci)
+                n_acc = n_acc + has.astype(jnp.int32)
+                alive = has
+                path_rows.append(cur)
+            path = jnp.stack(path_rows, axis=1)            # [B, D+1]
+            # the emitted candidates: the target's greedy token after
+            # each accepted path prefix (rows past n_acc duplicate the
+            # head — masked off by emit below, never written)
+            out_g = jnp.take_along_axis(g, path, axis=1)   # [B, D+1]
+            idx = jnp.arange(P_out)[None, :]
+            is_end = (out_g == eos) | (out_g == stop[:, None])
+            ends_before = jnp.cumsum(is_end.astype(jnp.int32), axis=1) \
+                - is_end.astype(jnp.int32)
+            emit = (idx <= n_acc[:, None]) & (idx < budget[:, None]) \
+                & (ends_before == 0) & active[:, None]
+            n_emit = jnp.sum(emit, axis=1, dtype=jnp.int32)
+            # verify-then-commit, identical to the chain: the accepted
+            # path's positions are sequential (lengths + r), only its
+            # rows' slab K/V reach the pool, one row at a time in
+            # order — int8 scale growth matches sequential decode's
+            pos_path = jnp.minimum(lengths[:, None] + idx, maxpos)
+            ks2, vs2 = ks, vs
+            for r in range(P_out):
+                rowr = path[:, r][None, :, None, None, None]
+                kr = jnp.take_along_axis(sk, rowr, axis=2)
+                vr = jnp.take_along_axis(sv, rowr, axis=2)
+                posr = pos_path[:, r:r + 1]
+                valr = emit[:, r:r + 1]
+                if ks is None:
+                    k = jax.vmap(_write_pool,
+                                 in_axes=(0, None, None, 0, None))(
+                        k, table, posr, kr, valr)
+                    v = jax.vmap(_write_pool,
+                                 in_axes=(0, None, None, 0, None))(
+                        v, table, posr, vr, valr)
+                else:
+                    k, ks2, _ = jax.vmap(
+                        _write_pool_int8,
+                        in_axes=(0, 0, None, None, 0, None))(
+                        k, ks2, table, posr, kr, valr)
+                    v, vs2, _ = jax.vmap(
+                        _write_pool_int8,
+                        in_axes=(0, 0, None, None, 0, None))(
+                        v, vs2, table, posr, vr, valr)
+            last = jnp.take_along_axis(
+                out_g, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+            last = jnp.where(active & (n_emit > 0), last, tok)
+            budget2 = budget - n_emit
+            active2 = active & (budget2 > 0) & (last != eos) \
+                & (last != stop)
+            return (k, v, ks2, vs2, lengths + n_emit, last, budget2,
+                    active2, jnp.where(emit, out_g, 0), n_emit, n_acc)
+
+        return jax.jit(verify)
+
     def _spec_verify_exe(self):
         """Memoized COMPILED verify step (AOT-lowered, warmup-covered)."""
         key = self._spec_key("verify")
         exe = self._spec_cache.get(key)
         if exe is None:
             if self._spec_verify_fn is None:
-                self._spec_verify_fn = self._build_spec_verify()
+                self._spec_verify_fn = self._build_spec_tree_verify() \
+                    if self.spec_tree is not None \
+                    else self._build_spec_verify()
             sds, i32 = self._aval, jnp.int32
             pstruct = self._pstruct()
             B = self.B
@@ -2897,8 +3189,8 @@ class ContinuousBatcher:
         t0 = time.perf_counter()
         t_prof = self._profile_t0()
         drafts = self._spec_draft_exe()(
-            self.params, c.k, c.v, c.k_scale, c.v_scale, c.table,
-            c.lengths, self.cur_tok, active)
+            self.params, self._spec_dlayers, c.k, c.v, c.k_scale,
+            c.v_scale, c.table, c.lengths, self.cur_tok, active)
         self._profile_commit(t_prof, drafts, mode="spec_draft",
                              bucket=self.spec_k, units=0,
                              rids=decode_rids)
@@ -2930,7 +3222,12 @@ class ContinuousBatcher:
         self.spec.record_step(drafted=self.spec_k * spec_slots,
                               accepted=int(n_acc.sum()),
                               emitted=int(n_emit.sum()),
-                              slots=len(decode_rids))
+                              slots=len(decode_rids),
+                              depths=[int(n_acc[s])
+                                      for s in range(self.B)
+                                      if self.active[s]
+                                      and self.slot_req[s]
+                                      not in self._no_spec])
         if self._trace is not None:
             self._trace.span("spec_draft", dur=draft_s, k=self.spec_k,
                              slots=len(decode_rids),

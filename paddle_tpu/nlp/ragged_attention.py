@@ -77,7 +77,8 @@ def resolve_attention_impl(impl: str) -> str:
     return impl
 
 
-def _rpa_kernel(*refs, bs: int, scale: float, quantized: bool):
+def _rpa_kernel(*refs, bs: int, scale: float, quantized: bool,
+                suffix: bool = False, nchunks: int = 0):
     """One (row, query-tile, block-chunk) grid step of the ragged kernel.
 
     Refs (per BlockSpec):
@@ -90,16 +91,32 @@ def _rpa_kernel(*refs, bs: int, scale: float, quantized: bool):
       `quantized` adds ks_ref/vs_ref [N] f32 per-block dequant scales
       to the scalar prefetch: the block's codes dequantize right after
       the pipeline DMA lands them in VMEM — the fused-dequant gather.
+
+    `suffix` adds the speculative verify's in-register suffix slab:
+    sk_ref/sv_ref [1, S, KV, hd] (this row's not-yet-committed K/V —
+    the packed draft chain or tree) and svis_ref [1, Pt, S] int32 (per-
+    query slab visibility: the chain's causal triangle or the tree's
+    ancestor mask). The grid grows ONE extra chunk (c == nchunks, past
+    the table width): the pool sweep stays the int8-gathered block loop
+    unchanged, and the final chunk folds the slab's scores into the
+    same online softmax and finalizes there — every row finalizes at
+    the slab chunk, since slab visibility is independent of the pool
+    chain length.
     """
     import jax.experimental.pallas as pl
 
     if quantized:
         (tab_ref, live_ref, ks_ref, vs_ref, pos_ref, val_ref, q_ref,
-         k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref) = refs
+         k_ref, v_ref, *rest) = refs
     else:
         (tab_ref, live_ref, pos_ref, val_ref, q_ref, k_ref, v_ref,
-         o_ref, acc_ref, m_ref, l_ref) = refs
+         *rest) = refs
         ks_ref = vs_ref = None
+    if suffix:
+        sk_ref, sv_ref, svis_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+        sk_ref = sv_ref = svis_ref = None
     r, t, c = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nlive = live_ref[r, t]
 
@@ -154,6 +171,44 @@ def _rpa_kernel(*refs, bs: int, scale: float, quantized: bool):
             + pv.reshape(P, H, hd)
         m_ref[...] = m_new
 
+    if suffix:
+        # the slab chunk (c == nchunks, past every pool block): fold
+        # the suffix slab's scores into the SAME online softmax. Slab
+        # rows are full precision (verify-then-commit: these K/V have
+        # not been quantized or committed yet), visibility is the
+        # prefetched per-query slab mask AND query validity.
+        @pl.when(c == nchunks)
+        def _suffix_fold():
+            q = q_ref[0].astype(jnp.float32) * scale      # [P, H, hd]
+            k = sk_ref[0].astype(jnp.float32)             # [S, KV, hd]
+            v = sv_ref[0].astype(jnp.float32)
+            P, H, hd = q.shape
+            S, KV, _ = k.shape
+            rep = H // KV
+            qg = q.reshape(P, KV, rep, hd)
+            s = jnp.einsum("pkrd,skd->pkrs", qg, k,
+                           preferred_element_type=jnp.float32)
+            s = s.reshape(P, H, S)
+            vis = (svis_ref[0] != 0) & \
+                  (val_ref[0] != 0)[:, None]              # [P, S]
+            s = jnp.where(vis[:, None, :], s, _NEG_INF)
+            m_prev = m_ref[...]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new[:, :, None])
+            p = jnp.where(vis[:, None, :], p, 0.0)
+            l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1)
+            pv = jnp.einsum("pkrs,skd->pkrd",
+                            p.reshape(P, KV, rep, S), v,
+                            preferred_element_type=jnp.float32)
+            acc_ref[...] = acc_ref[...] * alpha[:, :, None] \
+                + pv.reshape(P, H, hd)
+            m_ref[...] = m_new
+            l = l_ref[...]
+            o = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)[:, :, None]
+            o_ref[0] = o.astype(o_ref.dtype)
+        return
+
     # finalize at the row's last LIVE chunk (c == 0 for an all-padded
     # row: init just zeroed the accumulators, so the row emits zeros)
     @pl.when(c == jnp.maximum(nlive - 1, 0))
@@ -165,6 +220,7 @@ def _rpa_kernel(*refs, bs: int, scale: float, quantized: bool):
 
 def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
                            *, k_scale=None, v_scale=None,
+                           suffix_k=None, suffix_v=None, suffix_vis=None,
                            q_tile: int = 128, interpret=None):
     """Paged GQA attention walking only each request's live block chain.
 
@@ -199,6 +255,18 @@ def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
     fused batch, all-pad bucket tail) touches no blocks at all, and an
     early tile of a long suffix stops at its own last visible block.
 
+    suffix_k/suffix_v [R, S, KV, hd] add the speculative verify's
+    in-register suffix slab (the packed draft chain or tree — K/V that
+    exist ONLY in registers until the accepted path commits) as a
+    kernel operand: the grid grows one chunk past the table width and
+    the final chunk folds the slab's scores into the same online
+    softmax, so the pool sweep stays the int8-gathered block loop
+    instead of falling back to the XLA concat path. suffix_vis
+    [R, P, S] (bool/int) gives each query its visible slab rows — the
+    chain's causal triangle or the tree's ancestor mask; invalid
+    queries still emit zeros. The XLA formulation in
+    `paged._spec_gqa_attention` stays the bit-stable parity reference.
+
     `interpret=None` auto-selects Pallas interpret mode off-TPU — the
     CPU CI parity path. Tolerance vs XLA is tight-but-not-bitwise: the
     online softmax reassociates the reduction.
@@ -232,6 +300,7 @@ def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
     live = ((live_tok + bs - 1) // bs).astype(jnp.int32)
 
     quantized = k_scale is not None
+    suffix = suffix_k is not None
 
     def _tile_map(r, t, c, tab, live, *scales):
         return (r, t)
@@ -242,22 +311,42 @@ def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
     def _kv_map(r, t, c, tab, live, *scales):
         # chunk c of (row r, tile t) reads pool block table[r, c]; DEAD
         # chunks (c >= live[r, t]) re-resolve to the last live block —
-        # an unchanged index, so the pipeline skips the fetch
+        # an unchanged index, so the pipeline skips the fetch (the
+        # suffix grid's extra slab chunk clamps here too)
         j = jnp.minimum(c, jnp.maximum(live[r, t] - 1, 0))
         return (jnp.maximum(tab[r, j], 0), 0, 0, 0)
 
+    def _suffix_map(r, t, c, tab, live, *scales):
+        # the row's whole slab, fetched once per (row, tile)
+        return (r, 0, 0, 0)
+
+    def _svis_map(r, t, c, tab, live, *scales):
+        return (r, t, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, Pt), _tile_map),
+        pl.BlockSpec((1, Pt), _tile_map),
+        pl.BlockSpec((1, Pt, H, hd), _tile3_map),
+        pl.BlockSpec((1, bs, KV, hd), _kv_map),
+        pl.BlockSpec((1, bs, KV, hd), _kv_map),
+    ]
+    operands = [positions, val, q, k_pool, v_pool]
+    if suffix:
+        S = suffix_k.shape[1]
+        in_specs += [
+            pl.BlockSpec((1, S, KV, hd), _suffix_map),
+            pl.BlockSpec((1, S, KV, hd), _suffix_map),
+            pl.BlockSpec((1, Pt, S), _svis_map),
+        ]
+        operands += [suffix_k, suffix_v, suffix_vis.astype(jnp.int32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         # int8 pools prefetch the per-block dequant scales next to the
         # table/live-lengths so the kernel body reads them from SMEM
         num_scalar_prefetch=4 if quantized else 2,
-        grid=(R, T, M),
-        in_specs=[
-            pl.BlockSpec((1, Pt), _tile_map),
-            pl.BlockSpec((1, Pt), _tile_map),
-            pl.BlockSpec((1, Pt, H, hd), _tile3_map),
-            pl.BlockSpec((1, bs, KV, hd), _kv_map),
-            pl.BlockSpec((1, bs, KV, hd), _kv_map),
-        ],
+        # the suffix slab rides one extra chunk past the table width —
+        # the pool block loop is untouched, the slab chunk finalizes
+        grid=(R, T, M + 1 if suffix else M),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Pt, H, hd), _tile3_map),
         scratch_shapes=[
             pltpu.VMEM((Pt, H, hd), jnp.float32),
@@ -267,13 +356,13 @@ def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
     )
     call = pl.pallas_call(
         functools.partial(_rpa_kernel, bs=bs, scale=1.0 / math.sqrt(hd),
-                          quantized=quantized),
+                          quantized=quantized, suffix=suffix,
+                          nchunks=M),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((R, P, H, hd), q.dtype),
         interpret=interpret,
     )
     if quantized:
         return call(table, live, k_scale.astype(jnp.float32),
-                    v_scale.astype(jnp.float32), positions, val, q,
-                    k_pool, v_pool)
-    return call(table, live, positions, val, q, k_pool, v_pool)
+                    v_scale.astype(jnp.float32), *operands)
+    return call(table, live, *operands)

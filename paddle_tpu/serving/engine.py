@@ -145,6 +145,8 @@ class ServingEngine:
                  kv_dtype: Optional[str] = None,
                  speculative: bool = False, spec_k: int = 4,
                  draft_layers: Optional[int] = None,
+                 spec_tree=None, spec_draft_w8: bool = False,
+                 spec_attention_impl: Optional[str] = None,
                  warmup: bool = False,
                  trace: bool = True, flight_recorder_cap: int = 64,
                  flight_dump_path: Optional[str] = None,
@@ -215,6 +217,8 @@ class ServingEngine:
             weight_dtype=weight_dtype, kv_dtype=kv_dtype,
             speculative=speculative, spec_k=spec_k,
             draft_layers=draft_layers,
+            spec_tree=spec_tree, spec_draft_w8=spec_draft_w8,
+            spec_attention_impl=spec_attention_impl,
             trace=self.trace,
             flight_recorder_cap=flight_recorder_cap,
             profile_sample_every=profile_sample_every,
@@ -379,6 +383,13 @@ class ServingEngine:
         self._g_spec_accept = m.gauge("spec_accept_rate")
         self._g_spec_tps = m.gauge("spec_tokens_per_step")
         self._g_spec_accepted = m.gauge("spec_accepted_tokens")
+        # per-(sweep, slot) accepted-path-length distribution — the
+        # data tree-shape tuning reads (a tree whose deep levels never
+        # accept is wasted verify width); buckets cover path lengths
+        # 0..8+ exactly since depths are small ints
+        self._h_spec_depth = m.histogram(
+            "spec_accept_depth",
+            buckets=[0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0])
         # fault-tolerance surface: the counters health() aggregates
         self._c_step_faults = m.counter("step_faults")
         self._c_quarantines = m.counter("quarantines")
@@ -1771,6 +1782,8 @@ class ServingEngine:
         self._g_spec_accept.set(sp.accept_rate())
         self._g_spec_tps.set(sp.tokens_per_step())
         self._g_spec_accepted.set(sp.accepted)
+        for d in sp.drain_depths():
+            self._h_spec_depth.observe(float(d))
         if pc.get("enabled"):
             self._g_pc_hit_tokens.set(pc["hit_tokens"])
             self._g_pc_hit_rate.set(pc["hit_rate"])

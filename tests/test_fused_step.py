@@ -256,6 +256,122 @@ class TestFusedParity:
         assert base[0] == base[2]            # COW really replayed the hit
 
 
+class TestGroupGrowingAdmission:
+    """Group-growing `_units` (the PR 4 follow-on): an admission burst's
+    single-chunk records regroup into the EARLIEST open same-(bucket,
+    cold) unit with room — interleaved buckets no longer fragment into
+    singleton prefill calls — and a record never jumps over a unit
+    that registered a block it depends on (matched shared-prefix chain
+    or COW source), so greedy tokens are schedule-invariant."""
+
+    @staticmethod
+    def _rec(bucket, start=0, matched=(), cow_src=None, inserted=(),
+             nchunks=1):
+        from types import SimpleNamespace
+        chunks = [(start + i * bucket, start + (i + 1) * bucket,
+                   bucket) for i in range(nchunks)]
+        return SimpleNamespace(chunks=chunks, matched=list(matched),
+                               cow_src=cow_src,
+                               inserted=list(inserted))
+
+    @pytest.fixture(scope="class")
+    def cb(self, setup):
+        cfg, params = setup
+        return _batcher(params, cfg, max_batch=2,
+                        prefill_buckets=(8, 16))
+
+    def test_interleaved_buckets_regroup(self, cb):
+        """A-B-A-B regroups to [A,A], [B,B] when independent (the old
+        consecutive rule produced four singleton units)."""
+        a1 = self._rec(8, inserted=(1,))
+        b1 = self._rec(16, inserted=(2,))
+        a2 = self._rec(8, inserted=(3,))
+        b2 = self._rec(16, inserted=(4,))
+        assert cb._units([a1, b1, a2, b2]) == [[a1, a2], [b1, b2]]
+
+    def test_unit_capacity_respected(self, cb):
+        """A full unit (max_batch records) stops growing — the third
+        same-key record opens a fresh unit."""
+        recs = [self._rec(8, inserted=(i,)) for i in range(3)]
+        assert cb._units(recs) == [[recs[0], recs[1]], [recs[2]]]
+
+    def test_dependency_blocks_the_jump(self, cb):
+        """A record whose chain references a block an INTERMEDIATE
+        unit registered must not move past it — even though an
+        earlier unit has room and the right key."""
+        a = self._rec(8, inserted=(1,))
+        b = self._rec(16, inserted=(2,))
+        c = self._rec(8, cow_src=2, inserted=(3,))   # depends on b's
+        assert cb._units([a, b, c]) == [[a], [b], [c]]
+        # matched (non-COW) chains gate the jump identically
+        d = self._rec(8, matched=(2,), inserted=(4,))
+        assert cb._units([a, b, d]) == [[a], [b], [d]]
+        # ... but an independent record still jumps the same gap
+        e = self._rec(8, inserted=(5,))
+        assert cb._units([a, b, e]) == [[a, e], [b]]
+
+    def test_cow_never_joins_its_source_registrant(self, cb):
+        """The COW clone copies the pool OUTSIDE the compiled call, so
+        the source's prefill must complete in an EARLIER unit — same
+        key, room available, still a new unit."""
+        a = self._rec(8, inserted=(5,))
+        c = self._rec(8, cow_src=5, inserted=(6,))
+        assert cb._units([a, c]) == [[a], [c]]
+
+    def test_chunked_units_stay_closed_but_jumpable(self, cb):
+        """A chunked record's unit never grows; an independent later
+        record jumps over it into an earlier open unit, while a
+        record depending on the chunked record's blocks stays put."""
+        a = self._rec(8, inserted=(1,))
+        ch = self._rec(8, inserted=(2, 3), nchunks=2)
+        free = self._rec(8, inserted=(4,))
+        assert cb._units([a, ch, free]) == [[a, free], [ch]]
+        dep = self._rec(8, matched=(3,), inserted=(5,))
+        assert cb._units([a, ch, dep]) == [[a], [ch], [dep]]
+
+    def test_tokens_schedule_invariant(self, setup):
+        """The end-to-end bar: an interleaved-bucket burst landing
+        mid-decode decodes token-identically whether units group-grow
+        (fused), run standalone (fusion off), or arrive pre-sorted —
+        the reorder changes the schedule, never the tokens."""
+        cfg, params = setup
+        first = _prompts(90, (4,))[0]
+        prompts = _prompts(91, (5, 12, 6, 11))   # A B A B buckets
+
+        def serve(order, fused):
+            cb = _batcher(params, cfg, max_batch=4, chunk=2,
+                          prefill_buckets=(8, 16),
+                          fused_prefill=fused, fused_units=2)
+            cb.submit(first)
+            cb.step()                            # burst lands mid-decode
+            rids = {i: cb.submit(prompts[i]) for i in order}
+            out = cb.run()
+            return [out[rids[i]] for i in range(len(prompts))]
+
+        ref = serve([0, 1, 2, 3], fused=False)
+        assert serve([0, 1, 2, 3], fused=True) == ref
+        assert serve([0, 2, 1, 3], fused=True) == ref   # pre-sorted
+
+    def test_cow_burst_schedule_invariant(self, setup):
+        """Same-prompt pair (the second COW-clones the first's tail)
+        split by an alien-bucket record: the clone may not jump its
+        source, and tokens still match the standalone schedule."""
+        cfg, params = setup
+        (p, q) = _prompts(92, (6, 12))
+
+        def serve(fused):
+            cb = _batcher(params, cfg, max_batch=4, chunk=2,
+                          prefill_buckets=(8, 16), prefix_cache=True,
+                          fused_prefill=fused, fused_units=2)
+            r = [cb.submit(list(p)), cb.submit(q),
+                 cb.submit(list(p))]
+            out = cb.run()
+            assert cb.prefix_stats()["hits"] >= 1
+            return [out[x] for x in r]
+
+        assert serve(True) == serve(False)
+
+
 class TestBucketTuner:
     """tools/bucket_tuner.py: the pad-minimizing ladder fit over the
     batcher's `prefill_suffix_hist` accounting (pure host DP — no

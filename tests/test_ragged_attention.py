@@ -320,6 +320,133 @@ class TestKernelParityInt8:
         np.testing.assert_allclose(out[0], out[1], atol=2e-6)
 
 
+def _slab(rng, B, S, KV, hd):
+    """An in-register draft/verify suffix slab (full precision — slab
+    rows never pass through the pool's quantizer before commit)."""
+    sk = jnp.asarray(rng.randn(B, S, KV, hd), jnp.float32)
+    sv = jnp.asarray(rng.randn(B, S, KV, hd), jnp.float32)
+    return sk, sv
+
+
+class TestSuffixSlabParity:
+    """The spec verify's suffix-slab operand: the Pallas kernel folds
+    the in-register draft slab into the SAME online softmax as the
+    pool sweep at the grid's extra chunk (`c == nchunks`), pinned in
+    interpret mode against the XLA concat formulation
+    (`paged._spec_gqa_attention(impl="xla")` — the bit-stable
+    reference the verify path keeps). Chain triangles and packed-tree
+    ancestor masks, fp and int8 pools, block-boundary straddles and
+    the all-padded batch, in the TestKernelParityInt8 style."""
+
+    N, bs, KV, hd, H, M = 12, 4, 2, 8, 4, 5
+
+    def _q(self, rng, B, P):
+        return jnp.asarray(rng.randn(B, P, self.H, self.hd),
+                           jnp.float32)
+
+    def _parity(self, q, kp, vp, table, base_len, sk, sv, vis,
+                ks=None, vs=None, tol=2e-5):
+        ref = np.asarray(paged._spec_gqa_attention(
+            q, kp, vp, table, base_len, sk, sv, vis,
+            k_scale=ks, v_scale=vs, impl="xla"))
+        out = np.asarray(paged._spec_gqa_attention(
+            q, kp, vp, table, base_len, sk, sv, vis,
+            k_scale=ks, v_scale=vs, impl="pallas"))
+        np.testing.assert_allclose(out, ref, atol=tol, rtol=tol)
+
+    def test_chain_triangle_fp(self):
+        """The chain verify shape: S = k+1 slab rows, causal-triangle
+        visibility, heterogeneous committed lengths."""
+        from paddle_tpu.serving.speculative import SpecConfig
+        rng, kp, vp = _pools(30, self.N, self.bs, self.KV, self.hd)
+        vis = jnp.asarray(SpecConfig(k=4).ancestor_mask())
+        S = vis.shape[0]
+        lengths = [1, 6, 17]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        sk, sv = _slab(rng, 3, S, self.KV, self.hd)
+        self._parity(self._q(rng, 3, S), kp, vp, table,
+                     jnp.asarray(lengths, jnp.int32), sk, sv, vis)
+
+    def test_tree_ancestor_mask_fp(self):
+        """The packed-tree verify shape: every node's query sees the
+        pool plus exactly its root-to-node path (arbitrary per-row
+        visibility, NOT a triangle)."""
+        from paddle_tpu.serving.speculative import SpecConfig
+        rng, kp, vp = _pools(31, self.N, self.bs, self.KV, self.hd)
+        sc = SpecConfig(tree=[2, 2])
+        vis = jnp.asarray(sc.ancestor_mask())
+        S = sc.slab_rows()
+        lengths = [2, 9, 14]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        sk, sv = _slab(rng, 3, S, self.KV, self.hd)
+        self._parity(self._q(rng, 3, S), kp, vp, table,
+                     jnp.asarray(lengths, jnp.int32), sk, sv, vis)
+
+    def test_tree_draft_level_rows(self):
+        """A draft sweep's level shape: P < S queries (one level's
+        nodes) against the full slab, each seeing its own path — the
+        visibility rows are a SLICE of the ancestor mask."""
+        from paddle_tpu.serving.speculative import SpecConfig
+        rng, kp, vp = _pools(32, self.N, self.bs, self.KV, self.hd)
+        sc = SpecConfig(tree=[2, 2])
+        A = jnp.asarray(sc.ancestor_mask())
+        offs = sc.level_offsets()
+        vis = A[offs[1]:offs[2]]                     # level-1 nodes
+        S = sc.slab_rows()
+        lengths = [5, 11]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        sk, sv = _slab(rng, 2, S, self.KV, self.hd)
+        self._parity(self._q(rng, 2, vis.shape[0]), kp, vp, table,
+                     jnp.asarray(lengths, jnp.int32), sk, sv, vis)
+
+    def test_block_boundary_straddle(self):
+        """Committed length exactly at / one past a block boundary:
+        the pool sweep must include the boundary block's last key and
+        the slab fold must not shift by one."""
+        from paddle_tpu.serving.speculative import SpecConfig
+        rng, kp, vp = _pools(33, self.N, self.bs, self.KV, self.hd)
+        vis = jnp.asarray(SpecConfig(k=3).ancestor_mask())
+        S = vis.shape[0]
+        lengths = [self.bs, 2 * self.bs, self.bs + 1]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        sk, sv = _slab(rng, 3, S, self.KV, self.hd)
+        self._parity(self._q(rng, 3, S), kp, vp, table,
+                     jnp.asarray(lengths, jnp.int32), sk, sv, vis)
+
+    def test_chain_and_tree_int8_pool(self):
+        """int8 committed pool under the slab fold: pool scores
+        dequantize inside the block-chunk loop (scales on scalar
+        prefetch), slab rows stay fp — parity vs the XLA reference's
+        after-the-gather dequant, chain AND tree visibility."""
+        from paddle_tpu.serving.speculative import SpecConfig
+        rng, kp, vp = _pools(34, self.N, self.bs, self.KV, self.hd)
+        kq, vq, ks, vs = _quantize_pools(kp, vp)
+        lengths = [3, self.bs, 13]
+        table = _chains(rng, lengths, self.M, self.bs, self.N)
+        for sc in (SpecConfig(k=4), SpecConfig(tree=[2, 1, 1])):
+            vis = jnp.asarray(sc.ancestor_mask())
+            S = sc.slab_rows()
+            sk, sv = _slab(rng, 3, S, self.KV, self.hd)
+            self._parity(self._q(rng, 3, S), kq, vq, table,
+                         jnp.asarray(lengths, jnp.int32), sk, sv, vis,
+                         ks=ks, vs=vs)
+
+    def test_all_padded_exact_zeros(self):
+        """Every query invalid: the suffix-slab grid (pool chunks PLUS
+        the slab chunk) emits EXACT zeros — the slab fold must respect
+        row validity exactly like the pool sweep does."""
+        rng, kp, vp = _pools(35, self.N, self.bs, self.KV, self.hd)
+        B, S = 2, 4
+        q = self._q(rng, B, S)
+        sk, sv = _slab(rng, B, S, self.KV, self.hd)
+        out = np.asarray(ragged_paged_attention(
+            q, kp, vp, jnp.zeros((B, self.M), jnp.int32),
+            jnp.zeros((B, S), jnp.int32), jnp.zeros((B, S), bool),
+            suffix_k=sk, suffix_v=sv,
+            suffix_vis=jnp.ones((B, S, S), bool)))
+        assert (out == 0.0).all()
+
+
 class TestResolveImpl:
     def test_auto_resolves_off_tpu(self):
         """CPU CI: auto means the XLA reference (pallas off-TPU is

@@ -258,7 +258,7 @@ class TestVerifyThenCommit:
         assert keys
         for k in keys:
             assert k[-2:] == ("fp", "int8")
-            assert ("spec", 3, 1) == tuple(k[-5:-2])
+            assert ("spec", 3, 1, "xla") == tuple(k[-6:-2])
         assert {k[0] for k in cb._spec_cache} == {"draft", "verify"}
         # a plain batcher's keys are unchanged (no spec element)
         cb0 = _batcher(params, cfg)
@@ -332,6 +332,225 @@ class TestSpecInt8KV:
             cb._emit_spec([0], out, n_emit)
 
 
+class TestTreeSpecConfig:
+    def test_tree_validation_and_geometry(self):
+        with pytest.raises(ValueError):
+            SpecConfig(tree=[])
+        with pytest.raises(ValueError):
+            SpecConfig(tree=[2, 0])
+        sc = SpecConfig(tree=[2, 2])
+        assert sc.k == 6 and sc.slab_rows() == 7
+        assert sc.tree_depth() == 2
+        assert sc.level_sizes() == [1, 2, 4]
+        assert sc.level_offsets() == [0, 1, 3, 7]
+        assert sc.row_levels() == [0, 1, 1, 2, 2, 2, 2]
+        assert sc.row_parents() == [0, 0, 0, 1, 1, 2, 2]
+        A = sc.ancestor_mask()
+        # node 5 (child 0 of slab row 2): sees exactly root -> 2 -> 5
+        assert [s for s in range(7) if A[5][s]] == [0, 2, 5]
+        # the chain's mask is the causal triangle (the pre-tree shape)
+        Ac = SpecConfig(k=3).ancestor_mask()
+        assert all(Ac[p][s] == (s <= p)
+                   for p in range(4) for s in range(4))
+        assert SpecConfig(k=3).row_parents() == [0, 0, 1, 2]
+
+    def test_tree_key_and_dict(self):
+        """Tree / draft_w8 configs extend the memo-key element; chain
+        configs keep the pre-tree 3-tuple byte-identical."""
+        sc = SpecConfig(tree=[2, 1], draft_layers=1, num_layers=2)
+        assert sc.key(2) == ("spec", 4, 1, "tree", 2, 1)
+        d = sc.as_dict(2)
+        assert d["tree"] == [2, 1] and d["k"] == 4
+        assert SpecConfig(3).key(2) == ("spec", 3, 2)
+        assert SpecConfig(3, draft_w8=True).key(2) == \
+            ("spec", 3, 2, "w8")
+
+    def test_depth_hist_and_accepted_per_sweep(self):
+        s = SpecStats()
+        s.record_step(drafted=8, accepted=5, emitted=6, slots=2,
+                      depths=[2, 3])
+        s.record_step(drafted=8, accepted=3, emitted=4, slots=2,
+                      depths=[0, 3])
+        assert s.accepted_per_sweep() == pytest.approx(8 / 4)
+        assert s.depth_hist == {0: 1, 2: 1, 3: 2}
+        # fresh depths drain exactly once (the engine's gauge sync)
+        assert s.drain_depths() == [2, 3, 0, 3]
+        assert s.drain_depths() == []
+        d = s.as_dict()
+        assert d["accept_depth_hist"] == {0: 1, 2: 1, 3: 2}
+        assert d["accepted_per_sweep"] == pytest.approx(2.0)
+
+
+class TestTreeSpecParity:
+    def test_tree_bit_identical_and_dominates_chain(self, setup):
+        """Tree speculation emits plain greedy's exact tokens with 0
+        post-warmup recompiles, and at equal accepted-path budget
+        (tree depth == chain k) tree acceptance per sweep dominates
+        the chain's — child 0 of every node IS the chain's draft."""
+        cfg, params = setup
+        ref, _ = _run(_batcher(params, cfg), PROMPTS)
+        chain = _batcher(params, cfg, speculative=True, spec_k=3)
+        gc, _ = _run(chain, PROMPTS)
+        tree = _batcher(params, cfg, speculative=True,
+                        spec_tree=[2, 1, 1])
+        gt, rec = _run(tree, PROMPTS)
+        assert gc == ref and gt == ref
+        assert rec == 0
+        assert tree.spec.steps > 0
+        assert tree.spec.accepted_per_sweep() >= \
+            chain.spec.accepted_per_sweep()
+        assert tree.spec.depth_hist          # histogram populated
+        st = tree.spec_stats()
+        assert st["tree"] == [2, 1, 1] and st["k"] == 6
+
+    def test_degenerate_tree_equals_chain(self, setup):
+        """tree=[1,1,1] IS a chain of k=3: identical tokens AND
+        identical acceptance counters (the tree machinery reduces
+        exactly to the chain when every branching factor is 1)."""
+        cfg, params = setup
+        short = PROMPTS[:3]
+        chain = _batcher(params, cfg, speculative=True, spec_k=3,
+                         draft_layers=1)
+        gc, _ = _run(chain, short)
+        tree = _batcher(params, cfg, speculative=True,
+                        spec_tree=[1, 1, 1], draft_layers=1)
+        gt, _ = _run(tree, short)
+        assert gt == gc
+        assert tree.spec.accepted == chain.spec.accepted
+        assert tree.spec.emitted == chain.spec.emitted
+        assert tree.spec.depth_hist == chain.spec.depth_hist
+
+    def test_tree_truncated_draft_bit_identical(self, setup):
+        """A truncated tree draft (real rejections at every level)
+        still lands plain greedy's exact tokens."""
+        cfg, params = setup
+        ref, _ = _run(_batcher(params, cfg), PROMPTS)
+        cb = _batcher(params, cfg, speculative=True,
+                      spec_tree=[2, 2], draft_layers=1)
+        got, rec = _run(cb, PROMPTS)
+        assert got == ref
+        assert rec == 0
+        assert cb.spec.accepted < cb.spec.drafted    # real rejections
+
+    def test_tree_write_set(self, setup):
+        """Verify-then-commit at the write-set level under TREE drafts:
+        per tick the pool changes at exactly the accepted PATH's rows
+        — no sibling branch's K/V ever lands."""
+        cfg, params = setup
+        cb = _batcher(params, cfg, speculative=True,
+                      spec_tree=[2, 1], draft_layers=1)
+        cb.warmup_prefill()
+        cb.submit(PROMPTS[0])
+        cb._admit()
+        assert cb.active[0]
+        while cb.active[0]:
+            len0 = int(np.asarray(cb.cache.lengths)[0])
+            pre = np.asarray(cb.cache.k.astype(jnp.float32))
+            out, n_emit = cb._step_spec()
+            n = int(n_emit[0])
+            assert 1 <= n <= cb._spec_cfg.tree_depth() + 1
+            post = np.asarray(cb.cache.k.astype(jnp.float32))
+            changed = {tuple(c) for c in np.argwhere(
+                np.any(pre != post, axis=(0, 3, 4)))}
+            chain = cb.slot_blocks[0]
+            expect = {(chain[p // cb.bs], p % cb.bs)
+                      for p in range(len0, len0 + n)}
+            assert changed == expect, \
+                "a sibling/rejected tree row wrote the pool"
+            cb._emit_spec([0], out, n_emit)
+
+    def test_tree_int8_kv_scale_cleanliness(self, setup):
+        """Tree spec over an int8 pool: per tick, block scales grow
+        only at blocks holding accepted-path rows (grow-only hygiene
+        survives the tree commit loop)."""
+        cfg, params = setup
+        cb = _batcher(params, cfg, speculative=True,
+                      spec_tree=[2, 1], draft_layers=1,
+                      kv_dtype="int8")
+        cb.warmup_prefill()
+        cb.submit(PROMPTS[0])
+        cb._admit()
+        while cb.active[0]:
+            len0 = int(np.asarray(cb.cache.lengths)[0])
+            pre = np.asarray(cb.cache.k_scale)
+            out, n_emit = cb._step_spec()
+            n = int(n_emit[0])
+            post = np.asarray(cb.cache.k_scale)
+            chain = cb.slot_blocks[0]
+            touched = {chain[p // cb.bs]
+                       for p in range(len0, len0 + n)}
+            changed = set(np.argwhere(
+                np.any(pre != post, axis=0)).ravel().tolist())
+            assert changed <= touched
+            cb._emit_spec([0], out, n_emit)
+
+    def test_draft_w8_bit_identical(self, setup):
+        """draft-from-w8: the truncated draft reads an int8 weight-only
+        quantization of its layer stack (built once at construction on
+        an fp target; a no-op on an int8 target) — verification runs
+        the target's weights, so emitted tokens stay plain greedy's."""
+        cfg, params = setup
+        ref, _ = _run(_batcher(params, cfg), PROMPTS)
+        cb = _batcher(params, cfg, speculative=True, spec_k=3,
+                      draft_layers=1, spec_draft_w8=True)
+        assert cb._spec_dlayers is not None      # built on fp target
+        got, rec = _run(cb, PROMPTS)
+        assert got == ref
+        assert rec == 0
+        # tree x w8 compose
+        cb2 = _batcher(params, cfg, speculative=True,
+                       spec_tree=[2, 1, 1], draft_layers=1,
+                       spec_draft_w8=True)
+        got2, _ = _run(cb2, PROMPTS)
+        assert got2 == ref
+        # int8 target: the draft already reads quantized weights
+        cb3 = _batcher(params, cfg, speculative=True, spec_k=3,
+                       weight_dtype="int8", spec_draft_w8=True)
+        assert cb3._spec_dlayers is None
+
+    def test_pallas_verify_parity(self, setup):
+        """spec_attention_impl="pallas" routes the spec score path
+        through the kernel's suffix-slab operand (interpret mode on
+        CPU) — tokens bit-identical to the XLA score path and to
+        plain decode, chain AND tree."""
+        cfg, params = setup
+        short = PROMPTS[:2]
+        ref, _ = _run(_batcher(params, cfg), short)
+        for tree in (None, [2, 1]):
+            cb = _batcher(params, cfg, speculative=True, spec_k=2,
+                          spec_tree=tree, draft_layers=1,
+                          spec_attention_impl="pallas")
+            assert cb.spec_attention_impl == "pallas"
+            assert cb.attention_impl == "xla"    # decode path unchanged
+            got, rec = _run(cb, short)
+            assert got == ref, f"tree={tree} diverged under pallas"
+            assert rec == 0
+
+    def test_tree_memo_keys(self, setup):
+        """Tree + spec-impl configs ride every compiled-shape memo key
+        (prefill/fused/chunk caches via _skey; the spec cache via
+        _spec_key's phase tuple) — no aliasing across shapes."""
+        cfg, params = setup
+        cb = _batcher(params, cfg, speculative=True,
+                      spec_tree=[2, 1], draft_layers=1,
+                      kv_dtype="int8",
+                      spec_attention_impl="pallas")
+        cb.warmup_prefill()
+        keys = (list(cb._prefill_cache) + list(cb._fused_cache)
+                + list(cb._chunk_cache))
+        assert keys
+        for k in keys:
+            assert k[-2:] == ("fp", "int8")
+            assert tuple(k[-9:-2]) == ("spec", 4, 1, "tree", 2, 1,
+                                       "pallas")
+        sk = [k for k in cb._spec_cache]
+        assert {k[0] for k in sk} == {"draft", "verify"}
+        for k in sk:
+            assert k[1] == 4 and k[2] == 1       # spec_k, draft depth
+            assert "pallas" in k and "xla" in k  # both resolved impls
+            assert "tree" in k
+
+
 class TestSpecEngine:
     def test_engine_parity_gauges_snapshot(self, setup):
         cfg, params = setup
@@ -348,13 +567,20 @@ class TestSpecEngine:
             eng.shutdown()
             return outs, snap
         ref, snap0 = serve()
-        got, snap = serve(speculative=True, spec_k=3)
+        got, snap = serve(speculative=True, spec_tree=[2, 1, 1])
         assert got == ref
         sp = snap["speculative"]
         assert sp["enabled"] and sp["tokens_per_step"] > 1.0
+        assert sp["tree"] == [2, 1, 1]
         assert snap["gauges"]["spec_accept_rate"] == \
             pytest.approx(sp["accept_rate"])
         assert snap["gauges"]["spec_tokens_per_step"] > 1.0
+        # the accept-depth distribution surfaces twice: spec_stats'
+        # exact dict and the drained Prometheus histogram — counts
+        # must agree (every depth observed exactly once)
+        assert sp["accept_depth_hist"]
+        h = snap["histograms"]["spec_accept_depth"]
+        assert h["count"] == sum(sp["accept_depth_hist"].values())
         assert snap0["speculative"]["enabled"] is False
         assert snap0["gauges"]["spec_steps"] == 0
 

@@ -105,6 +105,7 @@ def summarize(events) -> dict:
     # per tick), spec_verify events are per-request with accepted
     # counts — the accepted-per-step column comes from the latter
     spec_draft_spans = 0
+    spec_depth_hist: Counter = Counter()
     # SLO verdict transitions (engine-scoped spans, no trace_id):
     # paired breach→recovered edges become breach windows below
     slo_edges = []
@@ -205,6 +206,11 @@ def summarize(events) -> dict:
             r["spec_steps"] += 1
             r["spec_accepted"] += args.get("accepted", 0)
             r["spec_emitted"] += args.get("emitted", 0)
+            # per-(sweep, request) accepted-path-length distribution —
+            # the tree-shape tuning signal (mirrors the engine's
+            # spec_accept_depth Prometheus histogram)
+            if args.get("accepted") is not None:
+                spec_depth_hist[int(args["accepted"])] += 1
             # a capture window's fenced spec ticks carry device wall
             # exactly like fenced prefill chunks do
             if args.get("device_dur") is not None:
@@ -304,6 +310,8 @@ def summarize(events) -> dict:
         "spec_tokens_per_step": round(
             sum(r["spec_emitted"] for r in per_req.values())
             / max(1, sum(x["spec_steps"] for x in rows)), 4),
+        "spec_accept_depth_hist": {str(k): v for k, v in
+                                   sorted(spec_depth_hist.items())},
         "replicas": dict(sorted(Counter(
             x["replica"] for x in rows
             if x["replica"] is not None).items())),
@@ -396,7 +404,11 @@ def render(summary: dict, show_slo: bool = False) -> str:
         f"speculative: {t.get('spec_verify_steps', 0)} verify steps, "
         f"{t.get('spec_accepted_tokens', 0)} accepted "
         f"({t.get('accepted_per_step', 0.0)} accepted/step, "
-        f"{t.get('spec_tokens_per_step', 0.0)} tokens/step)",
+        f"{t.get('spec_tokens_per_step', 0.0)} tokens/step)  "
+        f"accept-depth hist: "
+        + (" ".join(f"{k}:{v}" for k, v in sorted(
+            t.get("spec_accept_depth_hist", {}).items(),
+            key=lambda kv: int(kv[0]))) or "-"),
         f"replicas: {t['replicas'] or '-'}",
         f"quantization: weights {t['weight_dtype'] or '-'}, "
         f"kv {t['kv_dtype'] or '-'}  kv bytes admitted: "
