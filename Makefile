@@ -68,6 +68,13 @@ test:           ## tier-1 test suite (CPU)
 # stay 0 on both engines, and a TP=2-sharded replica pair survives the
 # --restart chaos shape (failover + supervisor respawn of the sharded
 # slot through its readiness gate).
+# Composition leg: --tp --speculative --attention-impl pallas turns on
+# EVERY fast path at once — the shard_map-wrapped ragged kernel, its
+# suffix-slab spec verify and tree speculation on the TP=4 mesh
+# (interpret mode on the 4 forced host devices); FAILS unless greedy
+# output is bit-identical to the mesh-off plain-decode reference,
+# recompiles stay 0, and the snapshot fast-path stamps (mesh
+# attention_impl / spec_backend) report the kernel actually ran.
 # Load legs: --load is the closed-loop generator (Poisson arrivals,
 # multi-turn sessions, shared system prompts) emitting goodput and
 # p99-under-load as tracked JSON fields (timing-based, not gated);
@@ -113,6 +120,9 @@ bench-smoke:    ## tiny serving benches (non-blocking CI job)
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --restart \
 		--n-requests 8 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --tp \
+		--n-requests 6 --max-new 6
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --tp --speculative \
+		--spec-tree 2,1,1 --attention-impl pallas \
 		--n-requests 6 --max-new 6
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --slo \
 		--n-requests 8 --max-new 6

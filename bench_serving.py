@@ -940,7 +940,8 @@ def _restart_leg(params, cfg, prompts, budgets, base_tokens, *,
     }
 
 
-def _tp_leg(params, cfg, prompts, budgets, **kw) -> dict:
+def _tp_leg(params, cfg, prompts, budgets, speculative=False,
+            spec_tree=None, **kw) -> dict:
     """The tensor-parallel gate (`--tp`), under 4 forced host devices:
     the mixed workload through a single-device reference engine, then
     the SAME workload through a `mesh=MeshConfig(tp=4)` engine whose
@@ -952,7 +953,15 @@ def _tp_leg(params, cfg, prompts, budgets, **kw) -> dict:
     replica pair survives the `--restart` chaos shape — hang →
     failover → supervisor respawn of the SHARDED slot through its
     readiness gate → rejoin → serve — under the same bit-identity and
-    zero-recompile bars."""
+    zero-recompile bars.
+
+    `speculative=True` (`--tp --speculative`) is the fast-path
+    COMPOSITION gate: the sharded engine additionally turns on tree
+    speculation (with `--attention-impl pallas` the ragged kernel and
+    its suffix-slab verify run shard_map-wrapped on the mesh) while
+    the reference stays mesh-off PLAIN decode — so the bit-identity
+    bar covers mesh x impl x speculation all at once, plus the
+    resolved fast-path stamps in snapshot()."""
     import jax
 
     from paddle_tpu.serving.tp import MeshConfig
@@ -967,22 +976,48 @@ def _tp_leg(params, cfg, prompts, budgets, **kw) -> dict:
     ref = _serve(params, cfg, prompts, fused_prefill=True,
                  budgets=budgets, **kw)
     base_tokens = [q.result() for q in ref["reqs"]]
+    spec_kw = dict(speculative=True, spec_tree=spec_tree) \
+        if speculative else {}
     tp = _serve(params, cfg, prompts, fused_prefill=True,
-                budgets=budgets, mesh=MeshConfig(tp=4), **kw)
+                budgets=budgets, mesh=MeshConfig(tp=4), **spec_kw,
+                **kw)
     tp_tokens = [q.result() for q in tp["reqs"]]
+    what = "TP=4 mesh engine" if not speculative else \
+        "TP=4 mesh+speculative engine"
     if tp_tokens != base_tokens:
         bad = sum(a != b for a, b in zip(tp_tokens, base_tokens))
         raise RuntimeError(
             f"tp gate: {bad}/{len(prompts)} requests diverged between "
-            f"the TP=4 mesh engine and single-device — greedy sharded "
-            f"decode must be bit-identical (a mismatch means a wrong "
-            f"sharding spec or a silently resharded intermediate)")
+            f"the {what} and single-device plain decode — greedy "
+            f"sharded decode must be bit-identical (a mismatch means a "
+            f"wrong sharding spec, a silently resharded intermediate, "
+            f"or a verify/commit divergence)")
     if ref["recompiles"] or tp["recompiles"]:
         raise RuntimeError(
             f"tp gate: post-warmup recompiles (single-device "
             f"{ref['recompiles']}, tp=4 {tp['recompiles']}) — the "
             f"warmup ladder no longer covers the sharded shapes (mesh "
             f"key missing from a memo?)")
+    # the fast-path stamps must say what actually ran: a silent
+    # fallback to the XLA gather under the mesh would pass bit-identity
+    # while forfeiting the kernel — exactly the regression this guards
+    mesh_stamp = tp["snap"]["tp"]["mesh"]
+    if mesh_stamp["attention_impl"] != tp["attention_impl"]:
+        raise RuntimeError(
+            f"tp gate: snapshot mesh stamp says attention_impl="
+            f"{mesh_stamp['attention_impl']!r} but the engine resolved "
+            f"{tp['attention_impl']!r}")
+    if speculative:
+        spec_snap = tp["snap"]["speculative"]
+        if not spec_snap["enabled"] or spec_snap["steps"] < 1:
+            raise RuntimeError(
+                "tp gate: the mesh+speculative engine reports no spec "
+                "verify sweeps — speculation silently off under TP")
+        if mesh_stamp["spec_backend"] != spec_snap["backend"]:
+            raise RuntimeError(
+                f"tp gate: mesh stamp spec_backend="
+                f"{mesh_stamp['spec_backend']!r} != batcher backend "
+                f"{spec_snap['backend']!r}")
 
     # the self-healing half at TP=2 × 2 replicas (4 devices, host
     # shards overlap freely): chaos hang, SSE failover, supervisor
@@ -1008,7 +1043,15 @@ def _tp_leg(params, cfg, prompts, budgets, **kw) -> dict:
         "tp_shapes_warmed": tp["warmed"],
         "tp_recompiles_after_warmup": tp["recompiles"],
         "tp_restart_mesh": MeshConfig(tp=2).describe(),
+        "tp_spec_backend": snap_tp["mesh"]["spec_backend"],
     }
+    if speculative:
+        spec_snap = tp["snap"]["speculative"]
+        result["tp_speculative"] = True
+        result["tp_spec_tree"] = spec_snap.get("tree")
+        result["tp_spec_accept_rate"] = spec_snap["accept_rate"]
+        result["tp_spec_tokens_per_step"] = \
+            spec_snap["tokens_per_step"]
     result.update(chaos)
     return result
 
@@ -1477,7 +1520,7 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
          attention_impl: str = "auto", fused_units: int = 1,
          sessions: int = 6, turns: int = 3, rate_hz: float = 8.0,
          deadline_s: float = 5.0, load_router_replicas: int = 0,
-         spec_tree=(2, 1, 1, 1),
+         spec_tree=(2, 1, 1, 1), tp_speculative: bool = False,
          trace_path=None, trace_overhead: bool = False) -> dict:
     import jax
     from paddle_tpu.nlp import llama
@@ -1520,6 +1563,8 @@ def main(n_requests: int = 16, max_new: int = 8, max_batch: int = 4,
                                      num_key_value_heads=4)
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
         return _tp_leg(params, cfg, prompts, kw["budgets"],
+                       speculative=tp_speculative,
+                       spec_tree=spec_tree if tp_speculative else None,
                        **{k: v for k, v in kw.items()
                           if k != "budgets"})
     if workload == "fused":
@@ -1900,7 +1945,10 @@ def _cli() -> dict:
                          "0 on both engines, and a TP=2-sharded "
                          "replica pair survives the --restart chaos "
                          "shape (failover + supervisor respawn of a "
-                         "sharded slot)")
+                         "sharded slot). Composes with --speculative "
+                         "(tree spec on the sharded engine) and "
+                         "--attention-impl pallas (the ragged kernel "
+                         "shard_map-wrapped on the mesh)")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="serve with the prefix cache disabled")
     ap.add_argument("--attention-impl", default="auto",
@@ -1943,18 +1991,25 @@ def _cli() -> dict:
                          "16 for --bucketed/--fused so the workload "
                          "chunks)")
     a = ap.parse_args()
-    # --load --router is the one legal combination (the load generator
-    # through the Router); every other pairing stays exclusive
+    # two legal combinations: --load --router (the load generator
+    # through the Router) and --tp --speculative (the fast-path
+    # composition gate: tree speculation on the TP=4 mesh engine —
+    # add --attention-impl pallas for the full mesh x kernel x spec
+    # composition); every other pairing stays exclusive
     load_router = a.load and a.router
     if load_router:
         a.router = False
+    tp_spec = a.tp and a.speculative
+    if tp_spec:
+        a.speculative = False
     if sum((a.prefix_share, a.bucketed, a.fused, a.chaos,
             a.quantized, a.router, a.restart, a.slo, a.speculative,
             a.disagg, a.load, a.tp)) > 1:
         ap.error("--prefix-share, --bucketed, --fused, --chaos, "
                  "--quantized, --router, --restart, --slo, "
                  "--speculative, --disagg, --load and --tp are "
-                 "mutually exclusive (except --load --router)")
+                 "mutually exclusive (except --load --router and "
+                 "--tp --speculative)")
     workload = ("prefix-share" if a.prefix_share
                 else "mixed" if a.bucketed
                 else "fused" if a.fused
@@ -1997,6 +2052,7 @@ def _cli() -> dict:
                 load_router_replicas=2 if load_router else 0,
                 spec_tree=tuple(int(b) for b in
                                 a.spec_tree.split(",") if b.strip()),
+                tp_speculative=tp_spec,
                 trace_path=a.trace, trace_overhead=a.trace_overhead)
 
 

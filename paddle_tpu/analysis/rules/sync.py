@@ -53,9 +53,12 @@ HOT_ROOTS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
       # the source engine's loop is paused on it; import scatters into
       # the destination pool between its steps — both on serving ticks
       "export_kv", "import_kv")),
-    # the kernel + impl pick: entered from traced code / engine setup
+    # the kernel + impl pick: entered from traced code / engine setup;
+    # _shard_specs is the shard_map composition surface — the
+    # PartitionSpecs every mesh'd kernel call partitions under
     ("nlp/ragged_attention.py",
-     ("ragged_paged_attention", "_rpa_kernel", "resolve_attention_impl")),
+     ("ragged_paged_attention", "_rpa_kernel", "resolve_attention_impl",
+      "_shard_specs")),
     # int8 paged-KV math runs inside every compiled step when
     # kv_dtype="int8"; called from traced bodies, so rooted explicitly
     ("quantization/kv.py",

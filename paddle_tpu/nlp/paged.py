@@ -406,7 +406,8 @@ def _write_pool_int8(pool, scale, table, positions, new, valid):
 
 
 def _paged_gqa_attention(q, k_pool, v_pool, table, positions, valid=None,
-                         impl: str = "xla", k_scale=None, v_scale=None):
+                         impl: str = "xla", k_scale=None, v_scale=None,
+                         mesh=None, mesh_axis: str = "mp"):
     """q [B, P, H, hd] against pool blocks gathered through the table.
     positions [B, P]: query p sees pool keys at absolute positions
     j <= positions[b, p] — per-query causal, so this one path serves
@@ -426,12 +427,17 @@ def _paged_gqa_attention(q, k_pool, v_pool, table, positions, valid=None,
     int8 pool: the XLA path dequantizes AFTER the gather (the bit-
     stable reference formulation), the Pallas kernel dequantizes inside
     its block-chunk loop with the scales riding scalar prefetch — so
-    the quantized gather moves int8 bytes, not fp bytes."""
+    the quantized gather moves int8 bytes, not fp bytes.
+
+    `mesh`/`mesh_axis` (pallas only) run the kernel shard_map-wrapped
+    over the KV-head-sharded pool — the XLA path never needs them: its
+    einsums partition under plain GSPMD."""
     if impl == "pallas":
         from .ragged_attention import ragged_paged_attention
         return ragged_paged_attention(q, k_pool, v_pool, table, positions,
                                       valid, k_scale=k_scale,
-                                      v_scale=v_scale)
+                                      v_scale=v_scale, mesh=mesh,
+                                      mesh_axis=mesh_axis)
     B, P, H, hd = q.shape
     N, bs, KV, _ = k_pool.shape
     M = table.shape[1]
@@ -464,7 +470,8 @@ def _paged_gqa_attention(q, k_pool, v_pool, table, positions, valid=None,
 
 
 def _spec_gqa_attention(q, pk, pv, table, base_len, sk, sv, vis,
-                        k_scale=None, v_scale=None, impl: str = "xla"):
+                        k_scale=None, v_scale=None, impl: str = "xla",
+                        mesh=None, mesh_axis: str = "mp"):
     """The speculative score path's attention: q [B, P, H, hd] over the
     committed pool history PLUS an in-register draft/verify suffix
     slab. The pool is READ-ONLY here — visibility for pool keys is
@@ -491,7 +498,9 @@ def _spec_gqa_attention(q, pk, pv, table, base_len, sk, sv, vis,
     sweep stays the int8-gathered block-chunk loop and the slab folds
     into the same online softmax at the grid's extra chunk — instead
     of this XLA concat formulation, which stays the bit-stable parity
-    reference (and the CPU default)."""
+    reference (and the CPU default). `mesh`/`mesh_axis` (pallas only)
+    shard that kernel call on heads — the slab and its accept walk
+    shard naturally, since slab rows carry whole KV heads."""
     B, P, H, hd = q.shape
     N, bs, KV, _ = pk.shape
     M = table.shape[1]
@@ -506,7 +515,8 @@ def _spec_gqa_attention(q, pk, pv, table, base_len, sk, sv, vis,
             jnp.broadcast_to((base_len - 1)[:, None], (B, P)),
             jnp.ones((B, P), bool), k_scale=k_scale, v_scale=v_scale,
             suffix_k=sk, suffix_v=sv,
-            suffix_vis=jnp.broadcast_to(vis[None], (B, P, S)))
+            suffix_vis=jnp.broadcast_to(vis[None], (B, P, S)),
+            mesh=mesh, mesh_axis=mesh_axis)
     tb = jnp.clip(table, 0)
     if k_scale is not None:
         k = kvq.dequantize(pk[tb],
@@ -539,7 +549,7 @@ def _spec_gqa_attention(q, pk, pv, table, base_len, sk, sv, vis,
 
 def _forward_spec(params, layers, tokens, cache, positions, base_len,
                   slab_k, slab_v, row0, cfg, vis=None,
-                  impl: str = "xla"):
+                  impl: str = "xla", mesh=None, mesh_axis: str = "mp"):
     """The speculative score-path forward: tokens [B, P] at per-request
     absolute positions, attending to the committed pool (READ-ONLY,
     visibility < base_len) plus the spec slab (previously drafted rows
@@ -556,7 +566,8 @@ def _forward_spec(params, layers, tokens, cache, positions, base_len,
     visible slab rows (None = the chain causal triangle relative to
     row0 — the pre-tree behavior); `impl` picks the score-path
     attention backend ("xla" concat reference | "pallas" suffix-slab
-    kernel). Returns (logits [B, P, V], slab_k', slab_v')."""
+    kernel), with `mesh`/`mesh_axis` shard_map-wrapping the pallas
+    case on the TP mesh. Returns (logits [B, P, V], slab_k', slab_v')."""
     cd = cfg.dtype
     T_rope = cache.table.shape[1] * cache.k.shape[2]
     x = jnp.take(params["embed_tokens"], tokens, axis=0).astype(cd)
@@ -595,7 +606,8 @@ def _forward_spec(params, layers, tokens, cache, positions, base_len,
         sv = lax.dynamic_update_slice_in_dim(sv, v.astype(sv.dtype),
                                              row0, axis=1)
         a = _spec_gqa_attention(q, pk, pv, cache.table, base_len,
-                                sk, sv, vis, ks, vs, impl=impl)
+                                sk, sv, vis, ks, vs, impl=impl,
+                                mesh=mesh, mesh_axis=mesh_axis)
         a = a.reshape(B, P, H * hd) @ _wq(lp, "o_proj", cd)
         sk_all = lax.dynamic_update_slice_in_dim(sk_all, sk[None], li, 0)
         sv_all = lax.dynamic_update_slice_in_dim(sv_all, sv[None], li, 0)
@@ -613,7 +625,8 @@ def _forward_spec(params, layers, tokens, cache, positions, base_len,
 
 def _attention_paged(x, lp, cfg, cos, sin, pk, pv, table, positions,
                      valid, is_prefill, attention_impl: str = "xla",
-                     pks=None, pvs=None):
+                     pks=None, pvs=None, mesh=None,
+                     mesh_axis: str = "mp"):
     """One layer's attention. positions [B, P] per-request absolute
     positions of x's tokens; valid masks padded slots. Returns
     (out, pk', pv', pks', pvs') with the new tokens written into the
@@ -650,18 +663,22 @@ def _attention_paged(x, lp, cfg, cos, sin, pk, pv, table, positions,
         # table with per-query causal visibility (j <= position)
         o = _paged_gqa_attention(q, pk, pv, table, positions, valid,
                                  impl=attention_impl, k_scale=pks,
-                                 v_scale=pvs)
+                                 v_scale=pvs, mesh=mesh,
+                                 mesh_axis=mesh_axis)
     return (o.reshape(B, P, H * hd) @ _wq(lp, "o_proj", cd)), pk, pv, \
         pks, pvs
 
 
 def forward_paged(params, tokens, cache: PagedKVCache, positions, valid,
-                  cfg, is_prefill: bool, attention_impl: str = "xla"):
+                  cfg, is_prefill: bool, attention_impl: str = "xla",
+                  mesh=None, mesh_axis: str = "mp"):
     """tokens [B, P] at per-request absolute `positions` [B, P] →
     (logits [B, P, V] f32, cache'). visible_len for decode = position+1
     (the just-written token included). `attention_impl` selects the
     paged-attention backend ("xla" reference gather | "pallas" ragged
-    kernel) for the non-prefill path; cold prefill keeps flash."""
+    kernel) for the non-prefill path; cold prefill keeps flash.
+    `mesh`/`mesh_axis` shard_map-wrap the pallas kernel on the TP mesh
+    (no-op for "xla", which shards under plain GSPMD)."""
     cd = cfg.dtype
     # rope spans the per-request table width (max reachable position),
     # NOT the whole pool — the pool is ~B x larger by construction
@@ -685,7 +702,8 @@ def forward_paged(params, tokens, cache: PagedKVCache, positions, valid,
         h = rms_norm_ref(x, lp["input_layernorm"], cfg.rms_norm_eps)
         a, pk, pv, ks, vs = _attention_paged(
             h, lp, cfg, cos, sin, pk, pv, cache.table, positions, valid,
-            is_prefill, attention_impl, ks, vs)
+            is_prefill, attention_impl, ks, vs, mesh=mesh,
+            mesh_axis=mesh_axis)
         pk_all = lax.dynamic_update_slice_in_dim(pk_all, pk[None], li, 0)
         pv_all = lax.dynamic_update_slice_in_dim(pv_all, pv[None], li, 0)
         if ks_all is not None:
@@ -973,19 +991,19 @@ class ContinuousBatcher:
         # pre-mesh build's, the _skey convention).
         # ptlint: trace-config
         self._mkey = () if mesh is None else mesh.key()
+        # ptlint: memo-invariant(fixed at construction; its key() IS _mkey, which rides every memo key)
         self._mesh_cfg = mesh
+        # ptlint: memo-invariant(built once from _mesh_cfg — mesh identity rides every memo key via _mkey)
         self._mesh = None
         self._shard_params = None
         self._shard_pool = None
         self._shard_repl = None
         if mesh is not None:
-            if self.attention_impl == "pallas":
-                # the Pallas ragged kernel is a per-device program —
-                # partitioning it needs a shard_map wrapper the mesh
-                # path doesn't have yet (ROADMAP direction 1 follow-on)
-                raise ValueError(
-                    "attention_impl='pallas' is not supported with "
-                    "mesh= yet — use the XLA paged-attention path")
+            # attention_impl="pallas" composes: the step programs call
+            # the ragged kernel shard_map-wrapped over the head-sharded
+            # pool (ragged_attention._shard_specs), so each device runs
+            # the per-device Pallas program on its head shard and GSPMD
+            # stitches the head axis — no XLA-gather fallback under TP
             from ..serving.tp import build_shardings
             (self._mesh, self._shard_params, self._shard_pool,
              self._shard_repl) = build_shardings(mesh, cfg, self.params)
@@ -1028,10 +1046,6 @@ class ContinuousBatcher:
         self.spec_attention_impl = self.attention_impl \
             if spec_attention_impl is None \
             else resolve_attention_impl(spec_attention_impl)
-        if mesh is not None and self.spec_attention_impl == "pallas":
-            raise ValueError(
-                "spec_attention_impl='pallas' is not supported with "
-                "mesh= yet — use the XLA spec score path")
         # draft-from-w8: quantize the truncated layer stack ONCE at
         # construction (int8 codes + per-channel scales — the same
         # weight-only math weight_dtype="int8" serves) so every draft
@@ -1805,18 +1819,26 @@ class ContinuousBatcher:
         batch width) so burst sizes draw from a fixed shape ladder."""
         return min(_pow2_ceil(max(1, G)), self.B)
 
+    def _mesh_axis(self) -> str:
+        """The TP mesh axis name the step builders hand to the
+        shard_map-wrapped kernel ("mp" when mesh is off — the kwarg is
+        dead then, since `self._mesh` is None)."""
+        return "mp" if self._mesh_cfg is None else self._mesh_cfg.axis
+
     def _build_prefill(self, cold: bool):
         """The one traced prefill: rows [G, Pb] at per-row absolute
         positions against the shared pool. Pure — compile bookkeeping
         lives host-side in `_prefill_exe` (TRACE001)."""
         cfg, impl = self.cfg, self.attention_impl
+        mesh, max_ = self._mesh, self._mesh_axis()
 
         def prefill(params, rows, k, v, ks, vs, table, positions, valid,
                     lengths):
             sub = PagedKVCache(k, v, table, lengths, ks, vs)
             logits, sub = forward_paged(params, rows, sub, positions,
                                         valid, cfg, is_prefill=cold,
-                                        attention_impl=impl)
+                                        attention_impl=impl, mesh=mesh,
+                                        mesh_axis=max_)
             return logits, sub.k, sub.v, sub.k_scale, sub.v_scale
 
         return jax.jit(prefill)
@@ -2043,7 +2065,17 @@ class ContinuousBatcher:
                              weight_dtype=self.weight_dtype,
                              kv_dtype=self.kv_dtype,
                              kv_block_bytes=self.kv_block_bytes(),
-                             replica_id=self.replica_id)
+                             replica_id=self.replica_id,
+                             # fast-path attribution: resolved backend,
+                             # spec score path and mesh degree — so a
+                             # mixed fleet's trace artifacts say which
+                             # replicas actually ran the kernel paths
+                             attention_impl=self.attention_impl,
+                             spec_backend=(self.spec_attention_impl
+                                           if self.speculative
+                                           else None),
+                             mesh_tp=(1 if self._mesh_cfg is None
+                                      else int(self._mesh_cfg.tp)))
         return _Admission(slot, rid, list(toks), stop, mn, need, matched,
                           cached_len, cow_src, fresh, inserted, chunks)
 
@@ -2637,13 +2669,15 @@ class ContinuousBatcher:
         """The one traced single-token decode step, shared by the plain
         decode chunk AND the fused chunk's post-first-token scan."""
         cfg, impl = self.cfg, self.attention_impl
+        mesh, max_ = self._mesh, self._mesh_axis()
 
         def step(carry, _):
             cache, tok, lengths, budget, act = carry
             pos = lengths[:, None]
             logits, cache = forward_paged(
                 params, tok[:, None], cache, pos, act[:, None],
-                cfg, is_prefill=False, attention_impl=impl)
+                cfg, is_prefill=False, attention_impl=impl, mesh=mesh,
+                mesh_axis=max_)
             nxt, lengths, budget, act = self._emit_one(
                 logits[:, 0], tok, act, lengths, budget, stop)
             # inactive slots must not drift: pin lengths ourselves
@@ -2705,6 +2739,7 @@ class ContinuousBatcher:
         step body."""
         cfg, chunk, B = self.cfg, self.chunk, self.B
         impl = self.attention_impl
+        mesh, max_ = self._mesh, self._mesh_axis()
         maxpos = self.M * self.bs - 1
 
         def run_fused(params, k, v, ks, vs, table, lengths, tok, active,
@@ -2725,7 +2760,7 @@ class ContinuousBatcher:
                 params, jnp.concatenate([dtok, prows], 0), sub,
                 jnp.concatenate([dpos, ppos], 0),
                 jnp.concatenate([dval, pval], 0), cfg, is_prefill=False,
-                attention_impl=impl)
+                attention_impl=impl, mesh=mesh, mesh_axis=max_)
             # ragged last-token logits per prefill row → first tokens
             pfirst = jnp.argmax(logits[B:][jnp.arange(Gp), plast],
                                 axis=-1).astype(jnp.int32)
@@ -2797,7 +2832,8 @@ class ContinuousBatcher:
         counters (steps / drafted / accepted / emitted, accept_rate,
         tokens_per_step). `enabled` False (and config only) when the
         batcher decodes plain."""
-        d: Dict[str, Any] = {"enabled": self.speculative}
+        d: Dict[str, Any] = {"enabled": self.speculative,
+                             "backend": self.spec_attention_impl}
         d.update(self._spec_cfg.as_dict(self.cfg.num_hidden_layers))
         d.update(self.spec.as_dict())
         return d
@@ -2815,6 +2851,7 @@ class ContinuousBatcher:
             self.B
         maxpos = self.M * self.bs - 1
         impl = self.spec_attention_impl
+        mesh, max_ = self._mesh, self._mesh_axis()
 
         def draft(params, dlayers, k, v, ks, vs, table, lengths, tok,
                   active):
@@ -2831,7 +2868,8 @@ class ContinuousBatcher:
                 pos = jnp.minimum(lengths[:, None] + j, maxpos)
                 logits, sk, sv = _forward_spec(
                     params, layers, tok[:, None], cache, pos, lengths,
-                    sk, sv, j, cfg, impl=impl)
+                    sk, sv, j, cfg, impl=impl, mesh=mesh,
+                    mesh_axis=max_)
                 nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
                 nxt = jnp.where(active, nxt, tok)
                 return (nxt, sk, sv), nxt
@@ -2861,6 +2899,7 @@ class ContinuousBatcher:
         Sd = offs[D]                 # draft slab: root + levels 1..D-1
         maxpos = self.M * self.bs - 1
         impl = self.spec_attention_impl
+        mesh, max_ = self._mesh, self._mesh_axis()
         A = sc.ancestor_mask()
         # per-level query visibility: the level's rows of the ancestor
         # mask, restricted to the draft slab's columns (static consts)
@@ -2885,7 +2924,8 @@ class ContinuousBatcher:
                     jnp.minimum(lengths + j, maxpos)[:, None], (B, w))
                 logits, sk, sv = _forward_spec(
                     params, layers, toks, cache, pos, lengths,
-                    sk, sv, offs[j], cfg, vis=vis_lv[j], impl=impl)
+                    sk, sv, offs[j], cfg, vis=vis_lv[j], impl=impl,
+                    mesh=mesh, mesh_axis=max_)
                 # top-b children per node: lax.top_k ties break toward
                 # the lower index, same as argmax — child 0 IS the
                 # greedy continuation, so tree acceptance dominates
@@ -2953,6 +2993,7 @@ class ContinuousBatcher:
         eos = -1 if self.eos is None else int(self.eos)
         maxpos = self.M * self.bs - 1
         impl = self.spec_attention_impl
+        mesh, max_ = self._mesh, self._mesh_axis()
 
         def verify(params, k, v, ks, vs, table, lengths, tok, drafts,
                    active, budget, stop, spec_ok):
@@ -2966,7 +3007,8 @@ class ContinuousBatcher:
             sv = jnp.zeros_like(sk)
             logits, sk, sv = _forward_spec(
                 params, params["layers"], toks_in, cache, pos, lengths,
-                sk, sv, jnp.int32(0), cfg, impl=impl)
+                sk, sv, jnp.int32(0), cfg, impl=impl, mesh=mesh,
+                mesh_axis=max_)
             g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, P]
             # accept proposal i+1 while it equals the target's greedy
             # token at the previous position (longest matching prefix)
@@ -3042,6 +3084,7 @@ class ContinuousBatcher:
         eos = -1 if self.eos is None else int(self.eos)
         maxpos = self.M * self.bs - 1
         impl = self.spec_attention_impl
+        mesh, max_ = self._mesh, self._mesh_axis()
         A = jnp.asarray(sc.ancestor_mask())                   # [S, S]
         lv = jnp.asarray(sc.row_levels(), jnp.int32)          # [S]
 
@@ -3059,7 +3102,8 @@ class ContinuousBatcher:
             sv = jnp.zeros_like(sk)
             logits, sk, sv = _forward_spec(
                 params, params["layers"], toks_in, cache, pos, lengths,
-                sk, sv, jnp.int32(0), cfg, vis=A, impl=impl)
+                sk, sv, jnp.int32(0), cfg, vis=A, impl=impl,
+                mesh=mesh, mesh_axis=max_)
             g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
             # accept walk: cur = the path head's slab row, ci = its
             # index within its level; a level with no matching child

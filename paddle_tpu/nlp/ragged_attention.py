@@ -29,6 +29,15 @@ Inference Kernel for TPU", PAPERS.md, arxiv 2604.15464):
     Attention mixed-mode shape. Invalid (padded) query rows produce
     zeros instead of the XLA path's never-read garbage.
 
+Tensor parallel (ROADMAP direction 7): a `mesh=` kwarg runs the same
+kernel under `shard_map` — each device executes the per-device
+pallas_call on its contiguous head shard (GSPMD cannot partition a
+pallas_call, but it can stitch per-shard kernel outputs on the head
+axis), with the block table, live lengths and dequant scales
+replicated. Per-head math is shard-independent, so the sharded result
+is bit-identical to the mesh-off kernel — the GSPMD-paper property
+that sharded programs inherit single-device kernels.
+
 The XLA gather path stays the reference implementation: CPU runs it by
 default (`resolve_attention_impl("auto")`), and the parity suite
 (tests/test_ragged_attention.py) pins pallas==xla on decode, prefill,
@@ -218,10 +227,37 @@ def _rpa_kernel(*refs, bs: int, scale: float, quantized: bool,
         o_ref[0] = o.astype(o_ref.dtype)
 
 
+def _shard_specs(mesh_axis: str, quantized: bool, suffix: bool):
+    """PartitionSpecs for `shard_map`-wrapping the kernel on a 1-D mesh.
+
+    Positional layout mirrors the pallas_call argument order: scalar
+    prefetch first (table, live[, k_scale, v_scale] — all REPLICATED:
+    every shard walks the same block chains under the same per-block
+    dequant scales), then positions/val (replicated), then the
+    head-carrying operands q, k_pool, v_pool[, suffix_k, suffix_v]
+    split on their head axis (dim 2 for all five), then suffix_vis
+    (replicated — visibility is a per-query/per-slab-row fact, not a
+    per-head one). The output activation [R, P, H, hd] splits on the
+    same head axis.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    repl = P()
+    head = P(None, None, mesh_axis, None)
+    specs = (repl, repl)
+    if quantized:
+        specs += (repl, repl)
+    specs += (repl, repl, head, head, head)
+    if suffix:
+        specs += (head, head, repl)
+    return specs, head
+
+
 def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
                            *, k_scale=None, v_scale=None,
                            suffix_k=None, suffix_v=None, suffix_vis=None,
-                           q_tile: int = 128, interpret=None):
+                           q_tile: int = 128, interpret=None,
+                           mesh=None, mesh_axis: str = "mp"):
     """Paged GQA attention walking only each request's live block chain.
 
     Drop-in twin of the XLA `_paged_gqa_attention` gather path
@@ -266,6 +302,20 @@ def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
     chain's causal triangle or the tree's ancestor mask; invalid
     queries still emit zeros. The XLA formulation in
     `paged._spec_gqa_attention` stays the bit-stable parity reference.
+
+    `mesh` (a 1-D jax.sharding.Mesh over axis `mesh_axis`) runs the
+    kernel tensor-parallel: GSPMD cannot partition a pallas_call, so
+    the call is wrapped in `shard_map` with q/k_pool/v_pool (and the
+    suffix slab) split on their head axis and everything else — block
+    table, live lengths, positions, validity, dequant scales, slab
+    visibility — replicated. Each device runs THIS kernel on its
+    contiguous head shard: per-shard H/tp query heads keep the same
+    GQA group size rep = H/KV, and local head h maps to local kv head
+    h // rep exactly as the global mapping does (the serving mesh's
+    contiguous-shard convention, serving/tp.py), so every head's math
+    is untouched and the head-axis concatenation makes the sharded
+    result BIT-identical to the mesh-off kernel. Requires H and KV
+    divisible by the mesh axis size.
 
     `interpret=None` auto-selects Pallas interpret mode off-TPU — the
     CPU CI parity path. Tolerance vs XLA is tight-but-not-bitwise: the
@@ -323,46 +373,74 @@ def ragged_paged_attention(q, k_pool, v_pool, table, positions, valid=None,
     def _svis_map(r, t, c, tab, live, *scales):
         return (r, t, 0)
 
-    in_specs = [
-        pl.BlockSpec((1, Pt), _tile_map),
-        pl.BlockSpec((1, Pt), _tile_map),
-        pl.BlockSpec((1, Pt, H, hd), _tile3_map),
-        pl.BlockSpec((1, bs, KV, hd), _kv_map),
-        pl.BlockSpec((1, bs, KV, hd), _kv_map),
-    ]
-    operands = [positions, val, q, k_pool, v_pool]
+    nscal = 4 if quantized else 2
+    args = [table, live]
+    if quantized:
+        args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+    args += [positions, val, q, k_pool, v_pool]
     if suffix:
         S = suffix_k.shape[1]
-        in_specs += [
-            pl.BlockSpec((1, S, KV, hd), _suffix_map),
-            pl.BlockSpec((1, S, KV, hd), _suffix_map),
-            pl.BlockSpec((1, Pt, S), _svis_map),
+        args += [suffix_k, suffix_v, suffix_vis.astype(jnp.int32)]
+
+    def _kernel_call(*ops):
+        # per-device body: head counts come from the LOCAL operand
+        # shapes — under shard_map each device sees its contiguous head
+        # shard (H/tp query heads, KV/tp kv heads, same rep = H/KV), so
+        # the kernel body and every index map run unchanged; mesh-off,
+        # the local shapes ARE the global ones
+        q_l, kp_l = ops[nscal + 2], ops[nscal + 3]
+        Hl, KVl = q_l.shape[2], kp_l.shape[2]
+        in_specs = [
+            pl.BlockSpec((1, Pt), _tile_map),
+            pl.BlockSpec((1, Pt), _tile_map),
+            pl.BlockSpec((1, Pt, Hl, hd), _tile3_map),
+            pl.BlockSpec((1, bs, KVl, hd), _kv_map),
+            pl.BlockSpec((1, bs, KVl, hd), _kv_map),
         ]
-        operands += [suffix_k, suffix_v, suffix_vis.astype(jnp.int32)]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        # int8 pools prefetch the per-block dequant scales next to the
-        # table/live-lengths so the kernel body reads them from SMEM
-        num_scalar_prefetch=4 if quantized else 2,
-        # the suffix slab rides one extra chunk past the table width —
-        # the pool block loop is untouched, the slab chunk finalizes
-        grid=(R, T, M + 1 if suffix else M),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, Pt, H, hd), _tile3_map),
-        scratch_shapes=[
-            pltpu.VMEM((Pt, H, hd), jnp.float32),
-            pltpu.VMEM((Pt, H), jnp.float32),
-            pltpu.VMEM((Pt, H), jnp.float32),
-        ],
-    )
-    call = pl.pallas_call(
-        functools.partial(_rpa_kernel, bs=bs, scale=1.0 / math.sqrt(hd),
-                          quantized=quantized, suffix=suffix,
-                          nchunks=M),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((R, P, H, hd), q.dtype),
-        interpret=interpret,
-    )
-    if quantized:
-        return call(table, live, k_scale.astype(jnp.float32),
-                    v_scale.astype(jnp.float32), *operands)
-    return call(table, live, *operands)
+        if suffix:
+            in_specs += [
+                pl.BlockSpec((1, S, KVl, hd), _suffix_map),
+                pl.BlockSpec((1, S, KVl, hd), _suffix_map),
+                pl.BlockSpec((1, Pt, S), _svis_map),
+            ]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            # int8 pools prefetch the per-block dequant scales next to
+            # the table/live-lengths so the kernel body reads from SMEM
+            num_scalar_prefetch=nscal,
+            # the suffix slab rides one extra chunk past the table
+            # width — the pool block loop is untouched, the slab chunk
+            # finalizes
+            grid=(R, T, M + 1 if suffix else M),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, Pt, Hl, hd), _tile3_map),
+            scratch_shapes=[
+                pltpu.VMEM((Pt, Hl, hd), jnp.float32),
+                pltpu.VMEM((Pt, Hl), jnp.float32),
+                pltpu.VMEM((Pt, Hl), jnp.float32),
+            ],
+        )
+        call = pl.pallas_call(
+            functools.partial(_rpa_kernel, bs=bs,
+                              scale=1.0 / math.sqrt(hd),
+                              quantized=quantized, suffix=suffix,
+                              nchunks=M),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((R, P, Hl, hd), q.dtype),
+            interpret=interpret,
+        )
+        return call(*ops)
+
+    if mesh is None:
+        return _kernel_call(*args)
+    size = mesh.shape[mesh_axis]
+    if H % size or KV % size:
+        raise ValueError(
+            f"head counts (H={H}, KV={KV}) must divide the mesh axis "
+            f"{mesh_axis!r} size {size} to shard the ragged kernel")
+    from jax.experimental.shard_map import shard_map
+
+    # check_rep=False: pallas_call has no replication rule; the specs
+    # above are the ground truth
+    in_specs, out_spec = _shard_specs(mesh_axis, quantized, suffix)
+    return shard_map(_kernel_call, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_spec, check_rep=False)(*args)

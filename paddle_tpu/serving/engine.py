@@ -374,6 +374,14 @@ class ServingEngine:
                 "kv_pool_bytes_per_device":
                     self.batcher.kv_pool_bytes(),
                 "weight_bytes_per_device": self.batcher.weight_bytes()}
+        # resolved fast-path stamp (mesh on or off): which attention
+        # backend and spec score path this replica ACTUALLY runs —
+        # "auto" has been resolved by now, so health()/snapshot()
+        # answer "is this replica on the kernel fast path" directly
+        self._mesh_info["attention_impl"] = self.batcher.attention_impl
+        self._mesh_info["spec_backend"] = (
+            self.batcher.spec_attention_impl
+            if self.batcher.speculative else None)
         self._g_mesh_devices.set(1 if mesh is None else int(mesh.tp))
         self._g_kv_pool_bytes_dev.set(
             self._mesh_info["kv_pool_bytes_per_device"])
@@ -842,6 +850,10 @@ class ServingEngine:
             # mesh attribution: a multi-chip replica's health rolls up
             # through the Router with its device footprint attached
             "mesh": self._mesh_info["mesh"],
+            # fast-path attribution: the RESOLVED backends this replica
+            # runs (not the "auto" it may have been configured with)
+            "attention_impl": self._mesh_info["attention_impl"],
+            "spec_backend": self._mesh_info["spec_backend"],
             # readiness: warmed (no cold-compile TTFT cliffs left),
             # loop live, and not declared dead — the supervisor's
             # readiness gate requires this True (plus a served probe)

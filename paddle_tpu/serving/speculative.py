@@ -37,6 +37,20 @@ node is the draft's own argmax, so the tree's candidate set contains
 the chain's path — per sweep, tree acceptance >= chain acceptance at
 equal draft depth.
 
+Tensor parallel (PR 20): speculation composes with a TP mesh and with
+the Pallas verify backend unchanged, because every spec operand already
+shards along axes the mesh splits or replicates. The suffix slab's K/V
+carry a kv-head axis, so they shard with the pool; the ancestor mask,
+per-row base lengths and the accept walk's token comparisons are
+head-free, so they replicate; and the verify's activation all-gather
+reuses the output-split projection convention (serving/tp.py), which
+never reassociates a contracted sum — so greedy output under mesh ×
+speculation × pallas stays BIT-identical to unsharded plain decode.
+The sharded kernel call itself is `shard_map`-wrapped in
+nlp/ragged_attention.py; this module needs no mesh awareness beyond
+`spec_attention_impl` riding the memo keys (`_skey`) so every
+(mesh × impl × spec) combination AOT-lowers at warmup.
+
 The verify-then-commit invariant: neither the draft nor the verify's
 scoring pass writes the KV pool. Proposed tokens' per-layer K/V ride
 an in-register slab; after acceptance is known (on device, same

@@ -20,6 +20,19 @@ slot/scheduler arrays are replicated, per-call host inputs are
 uncommitted and auto-placed by dispatch, and XLA inserts the
 collectives (activation all-gathers ahead of the o/down dots).
 
+The one exception to "parallelism is not code" is the Pallas ragged
+kernel: GSPMD cannot partition a pallas_call, so under a mesh the
+step programs call it `shard_map`-wrapped over the head-sharded pool
+(nlp/ragged_attention.py `_shard_specs`) — each device runs the
+per-device kernel on its contiguous head shard and the head-axis
+concat keeps the result bit-identical to the mesh-off kernel. The
+speculative suffix-slab verify rides the same wrapper (the slab and
+accept walk shard on heads naturally; slab visibility and the block
+table stay replicated), and the verify's activation all-gather is the
+same output-split convention below — so mesh x pallas x speculation
+compose with greedy output still BIT-identical to the unsharded
+batcher.
+
 Unlike the training table (`llama.param_specs`) and the generation
 table (`llama.infer_param_specs`), serving NEVER shards a contracted
 dim: Megatron's o/down row split would make those matmuls per-shard
@@ -212,7 +225,11 @@ def shard_info(mesh_cfg: MeshConfig, batcher) -> Dict[str, Any]:
     accounting — the pool's K/V tensors split by tp (head-axis
     shards), the int8 scale pools and scheduler state replicated, so
     per-device bytes = scales + (pool - scales)/tp. trace_report's
-    replica column attributes multi-chip replicas from this."""
+    replica column attributes multi-chip replicas from this. The mesh
+    dict carries the replica's RESOLVED fast-path backends
+    (attention_impl, spec_backend) so a fleet operator can see which
+    replicas actually run the kernel/spec paths, not just which were
+    asked to."""
     t = int(mesh_cfg.tp)
     total = batcher.kv_pool_bytes()
     scales = 0
@@ -227,8 +244,12 @@ def shard_info(mesh_cfg: MeshConfig, batcher) -> Dict[str, Any]:
     if "lm_head" in batcher.params:
         sharded_w += int(batcher.params["lm_head"].nbytes)
     w_total = batcher.weight_bytes()
+    mesh_d = mesh_cfg.describe()
+    mesh_d["attention_impl"] = batcher.attention_impl
+    mesh_d["spec_backend"] = (batcher.spec_attention_impl
+                              if batcher.speculative else None)
     return {
-        "mesh": mesh_cfg.describe(),
+        "mesh": mesh_d,
         "kv_pool_bytes_per_device": per_dev,
         "weight_bytes_per_device":
             (w_total - sharded_w) + sharded_w // t,

@@ -136,8 +136,13 @@ class TestSpecDerivation:
     def test_shard_info_per_device_bytes(self, setup):
         cb = _batcher(setup, mesh=MeshConfig(tp=2))
         info = shard_info(MeshConfig(tp=2), cb)
+        # the mesh stamp carries the resolved fast-path attribution
+        # (PR 20): which attention impl runs on the mesh, and which
+        # spec backend (None — this batcher isn't speculative)
         assert info["mesh"] == {"tp": 2, "axis": "mp",
-                                "devices": [0, 1]}
+                                "devices": [0, 1],
+                                "attention_impl": "xla",
+                                "spec_backend": None}
         assert info["kv_pool_bytes_per_device"] \
             == cb.kv_pool_bytes() // 2
         assert info["weight_bytes_per_device"] < cb.weight_bytes()
@@ -220,10 +225,30 @@ class TestTPServing:
         assert eng.health()["mesh"] is None
         eng.shutdown()
 
-    def test_pallas_under_mesh_rejected(self, setup):
-        with pytest.raises(ValueError, match="pallas"):
-            _batcher(setup, attention_impl="pallas",
-                     mesh=MeshConfig(tp=2))
+    def test_pallas_spec_mesh_composition(self, setup):
+        """PR 18's mutual exclusion is gone: attention_impl="pallas"
+        under a mesh shard_maps the ragged kernel over the KV-head
+        axis (interpret mode on CPU — tests/test_ragged_shard_map.py
+        is the kernel-level parity suite). TP=2 × pallas × tree
+        speculation serves greedy tokens identical to the mesh-off XLA
+        plain batcher, re-serves with ZERO new compiles, and stamps
+        the pallas backend into spec_stats()."""
+        ref = _batcher(setup)
+        ref_rids = [ref.submit(p) for p in PROMPTS[:2]]
+        want = ref.run()
+        cb = _batcher(setup, attention_impl="pallas", speculative=True,
+                      spec_tree=(2, 1), spec_attention_impl="pallas",
+                      mesh=MeshConfig(tp=2))
+        rids = [cb.submit(p) for p in PROMPTS[:2]]
+        got = cb.run()
+        assert [got[r] for r in rids] == [want[r] for r in ref_rids]
+        warm = cb.compile_count
+        rids2 = [cb.submit(list(p)) for p in PROMPTS[:2]]
+        got2 = cb.run()
+        assert [got2[r] for r in rids2] == [want[r] for r in ref_rids]
+        assert cb.compile_count == warm     # warm re-serve: 0 compiles
+        st = cb.spec_stats()
+        assert st["enabled"] and st["backend"] == "pallas"
 
 
 def _export_mid_decode(cb, rid, min_tokens=2):

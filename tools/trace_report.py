@@ -94,6 +94,11 @@ def summarize(events) -> dict:
     # device.fused / device.prefill on the device lane)
     dev_steps = {"count": 0, "total_ms": 0.0}
     quant = {"weight_dtype": None, "kv_dtype": None}
+    # fast-path attribution stamped on every prepared event: the
+    # resolved attention backend, spec score path and TP mesh degree —
+    # so a mixed fleet's artifacts say which replicas ran the kernel
+    fastpath = {"attention_impl": None, "spec_backend": None,
+                "mesh_tp": None}
     # replica-scoped (not request-scoped) churn: supervisor restart
     # events ride the engine sinks' span lane with no trace_id
     restarts = {"restarting": 0, "restarted": 0}
@@ -187,6 +192,8 @@ def summarize(events) -> dict:
             quant["weight_dtype"] = args.get("weight_dtype",
                                              quant["weight_dtype"])
             quant["kv_dtype"] = args.get("kv_dtype", quant["kv_dtype"])
+            for fk in fastpath:
+                fastpath[fk] = args.get(fk, fastpath[fk])
         elif name == "prefill_chunk":
             r["chunks"] += 1
             r["prefill_ms"] += e.get("dur", 0.0) / 1e3
@@ -318,6 +325,9 @@ def summarize(events) -> dict:
         "weight_dtype": quant["weight_dtype"],
         "kv_dtype": quant["kv_dtype"],
         "kv_bytes_total": sum(x["kv_bytes"] for x in rows),
+        "attention_impl": fastpath["attention_impl"],
+        "spec_backend": fastpath["spec_backend"],
+        "mesh_tp": fastpath["mesh_tp"],
     }
     return {"total": total, "requests": rows,
             "slo": _breach_windows(slo_edges, rows)}
@@ -413,6 +423,9 @@ def render(summary: dict, show_slo: bool = False) -> str:
         f"quantization: weights {t['weight_dtype'] or '-'}, "
         f"kv {t['kv_dtype'] or '-'}  kv bytes admitted: "
         f"{t['kv_bytes_total']}",
+        f"fast path: attention {t.get('attention_impl') or '-'}, "
+        f"spec backend {t.get('spec_backend') or '-'}, "
+        f"mesh tp {t.get('mesh_tp') or '-'}",
         "",
     ]
     cols = ["trace_id", "terminal", "replica", "slot", "prompt_len",
